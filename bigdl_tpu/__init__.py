@@ -1,0 +1,35 @@
+"""bigdl_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA/pjit/Pallas rebuild of the capabilities of BigDL
+(distributed deep learning on Apache Spark; reference surveyed in SURVEY.md):
+
+- Torch-style module/criterion library over pure-functional params/state
+  pytrees (reference: ``DL/nn/abstractnn/AbstractModule.scala``).
+- Composable data pipeline (``Sample``/``MiniBatch``/``Transformer`` chains)
+  feeding device prefetch (reference: ``DL/dataset/*``).
+- Synchronous data-parallel training via XLA collectives over a
+  ``jax.sharding.Mesh`` (replacing the reference's BlockManager parameter
+  server ``DL/parameters/AllReduceParameter.scala``), with sharded
+  optimizer state, plus tensor/sequence/pipeline parallel axes.
+- Local and distributed optimizers with triggers, validation, checkpoints
+  (reference: ``DL/optim/*``).
+- Model zoo (LeNet-5, ResNet, Inception-v1, VGG, PTB LSTM, autoencoder).
+
+Compute is JAX on TPU: MXU-friendly matmuls/convs in bfloat16 with fp32
+masters, XLA fusion instead of hand-scheduled MKL-DNN primitives, and
+Pallas kernels where XLA underperforms.
+"""
+
+from bigdl_tpu.version import __version__
+
+from bigdl_tpu.core.engine import Engine
+from bigdl_tpu.core.config import EngineConfig, DtypePolicy
+from bigdl_tpu.core.rng import RandomGenerator
+
+__all__ = [
+    "__version__",
+    "Engine",
+    "EngineConfig",
+    "DtypePolicy",
+    "RandomGenerator",
+]

@@ -1,0 +1,6 @@
+from bigdl_tpu.core.config import EngineConfig, DtypePolicy
+from bigdl_tpu.core.engine import Engine
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.core.table import T, Table
+
+__all__ = ["EngineConfig", "DtypePolicy", "Engine", "RandomGenerator", "T", "Table"]

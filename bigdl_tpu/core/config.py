@@ -1,0 +1,108 @@
+"""Centralized typed configuration.
+
+The reference scatters ~30 ad-hoc ``bigdl.*`` JVM system properties across
+use sites (reference: ``DL/utils/Engine.scala:191-251``,
+``DL/nn/mkldnn/Fusion.scala:34``, ``DL/parameters/AllReduceParameter.scala:32-44``;
+catalogued in SURVEY.md §5 "Config / flag system" which recommends
+centralizing). Here every knob lives in one typed, immutable config object,
+overridable from environment variables prefixed ``BIGDL_TPU_``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def _env(name: str, default, cast=str):
+    raw = os.environ.get("BIGDL_TPU_" + name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """Mixed-precision policy.
+
+    Replaces the reference's ``TensorNumeric[Float]``/``TensorNumeric[Double]``
+    typeclass dispatch (reference: ``DL/tensor/TensorNumeric.scala:545``) and
+    its fp16 wire compression (``DL/parameters/FP16CompressedTensor.scala``).
+    On TPU the idiomatic choice is bfloat16 compute on the MXU with float32
+    parameter masters; collectives run in ``reduce_dtype``.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    output_dtype: jnp.dtype = jnp.float32
+    reduce_dtype: jnp.dtype = jnp.float32
+
+    @staticmethod
+    def full_precision() -> "DtypePolicy":
+        return DtypePolicy(
+            param_dtype=jnp.float32,
+            compute_dtype=jnp.float32,
+            output_dtype=jnp.float32,
+            reduce_dtype=jnp.float32,
+        )
+
+    @staticmethod
+    def mixed() -> "DtypePolicy":
+        return DtypePolicy()
+
+    def cast_compute(self, x):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(self.compute_dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            x,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Global engine configuration.
+
+    Mesh axis names follow the dp/tp/pp/sp/ep convention; the reference
+    supports only dp (sync data parallel; SURVEY.md §2.3) — the extra axes
+    are TPU-native capabilities layered on ``jax.sharding.Mesh``.
+    """
+
+    # env overrides resolve at instance-construction time (default_factory),
+    # so BIGDL_TPU_* vars set after import still take effect
+    seed: int = dataclasses.field(default_factory=lambda: _env("SEED", 1, int))
+    # mesh topology: axis name -> size; None = use all devices on the dp axis
+    mesh_shape: Optional[Tuple[Tuple[str, int], ...]] = None
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+    pp_axis: str = "pp"
+    sp_axis: str = "sp"
+    ep_axis: str = "ep"
+    # training loop
+    default_batch_size: int = dataclasses.field(
+        default_factory=lambda: _env("BATCH_SIZE", 128, int)
+    )
+    # failure handling (reference: bigdl.failure.retryTimes, DistriOptimizer.scala:881-960)
+    failure_retry_times: int = dataclasses.field(
+        default_factory=lambda: _env("FAILURE_RETRY_TIMES", 5, int)
+    )
+    failure_retry_interval_sec: float = dataclasses.field(
+        default_factory=lambda: _env("FAILURE_RETRY_INTERVAL", 120.0, float)
+    )
+    # logging
+    log_every_n_steps: int = dataclasses.field(default_factory=lambda: _env("LOG_EVERY", 1, int))
+    # checkpoint
+    overwrite_checkpoint: bool = dataclasses.field(
+        default_factory=lambda: _env("OVERWRITE_CHECKPOINT", True, bool)
+    )
+    dtypes: DtypePolicy = dataclasses.field(default_factory=DtypePolicy.full_precision)
+
+    def replace(self, **kw) -> "EngineConfig":
+        return dataclasses.replace(self, **kw)

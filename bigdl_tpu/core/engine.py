@@ -1,0 +1,102 @@
+"""Execution engine: device topology and mesh management.
+
+The reference's ``Engine`` (``DL/utils/Engine.scala:41``) holds global
+node/core topology (``coreNumber()``, ``nodeNumber()``), an engine-type enum
+(MklBlas/MklDnn) and thread pools used for intra-node model replicas. On TPU
+all of that collapses into a ``jax.sharding.Mesh``: one XLA program per chip,
+intra-chip parallelism handled by the compiler, inter-chip parallelism by
+collectives over ICI/DCN. ``Engine`` here owns mesh construction and the
+default sharding axes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.config import EngineConfig
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class Engine:
+    """Singleton-ish engine (reference: ``Engine.init``, ``Engine.scala:106``).
+
+    Unlike the reference there is no node/core bookkeeping: ``node_number``
+    maps to ``jax.process_count()`` and ``core_number`` to
+    ``jax.local_device_count()``.
+    """
+
+    _lock = threading.Lock()
+    _instance: Optional["Engine"] = None
+
+    def __init__(self, config: Optional[EngineConfig] = None):
+        self.config = config or EngineConfig()
+        self._mesh: Optional[Mesh] = None
+
+    # ---- topology (reference: Engine.nodeNumber/coreNumber) ----
+    @staticmethod
+    def node_number() -> int:
+        return jax.process_count()
+
+    @staticmethod
+    def core_number() -> int:
+        return jax.local_device_count()
+
+    @staticmethod
+    def device_count() -> int:
+        return jax.device_count()
+
+    # ---- init / singleton ----
+    @classmethod
+    def init(cls, config: Optional[EngineConfig] = None) -> "Engine":
+        with cls._lock:
+            if cls._instance is None or config is not None:
+                cls._instance = Engine(config)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "Engine":
+        return cls.init()
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    # ---- mesh ----
+    def mesh(self, mesh_shape: Optional[Sequence[Tuple[str, int]]] = None) -> Mesh:
+        """Build (and cache) the device mesh.
+
+        Default: all devices on the data-parallel axis — the TPU-native
+        equivalent of the reference's one-model-replica-per-core data
+        parallelism (``DistriOptimizer.initThreadModels``,
+        ``DL/optim/DistriOptimizer.scala:564-567``).
+        """
+        shape = tuple(mesh_shape or self.config.mesh_shape or ((self.config.dp_axis, jax.device_count()),))
+        if self._mesh is not None and tuple(zip(self._mesh.axis_names, self._mesh.devices.shape)) == shape:
+            return self._mesh
+        names = tuple(n for n, _ in shape)
+        sizes = tuple(s for _, s in shape)
+        n = int(np.prod(sizes))
+        if n > jax.device_count():
+            raise ValueError(
+                f"mesh {dict(shape)} needs {n} devices, only {jax.device_count()} available"
+            )
+        devices = np.asarray(jax.devices()[:n]).reshape(sizes)
+        self._mesh = Mesh(devices, names)
+        return self._mesh
+
+    def data_sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        """Batch-dimension sharding over the dp axis."""
+        mesh = mesh or self.mesh()
+        return NamedSharding(mesh, P(self.config.dp_axis))
+
+    def replicated_sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
+        mesh = mesh or self.mesh()
+        return NamedSharding(mesh, P())

@@ -1,0 +1,68 @@
+"""Deterministic random number generation.
+
+The reference uses a per-thread Mersenne twister
+(``DL/utils/RandomGenerator.scala``); on TPU the idiomatic equivalent is
+JAX's splittable threefry PRNG. ``RandomGenerator`` wraps a root key with
+deterministic fold-in by string path so every module/transformer draws an
+independent, reproducible stream.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def fold_in_str(key: jax.Array, name: str) -> jax.Array:
+    """Deterministically derive a subkey from a string (stable across runs,
+    unlike Python's randomized ``hash``)."""
+    return jax.random.fold_in(key, zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF)
+
+
+class RandomGenerator:
+    """Stateful convenience wrapper over a splittable key.
+
+    Used at pipeline/host level (shuffles, augmentation); inside jitted
+    compute, raw keys are threaded functionally instead.
+    """
+
+    _default: Optional["RandomGenerator"] = None
+
+    def __init__(self, seed: int = 1):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._np = np.random.default_rng(seed)
+
+    @classmethod
+    def default(cls) -> "RandomGenerator":
+        if cls._default is None:
+            cls._default = RandomGenerator()
+        return cls._default
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self.__init__(seed)
+        return self
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def numpy(self) -> np.random.Generator:
+        return self._np
+
+    # host-side draws (numpy; used by data pipeline, not by jitted code)
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self._np.uniform(low, high, size)
+
+    def normal(self, mean=0.0, stdv=1.0, size=None):
+        return self._np.normal(mean, stdv, size)
+
+    def permutation(self, n: int):
+        return self._np.permutation(n)

@@ -1,0 +1,39 @@
+"""Activity/Table conventions.
+
+The reference's ``Activity`` is a ``Tensor | Table`` union
+(``DL/nn/abstractnn/Activity.scala``) and ``Table`` is a Torch-style
+int-keyed map (``DL/utils/Table.scala:34``) built with the ``T()`` helper.
+In JAX the natural union is "pytree": a single ``jax.Array``, a tuple/list,
+or a dict. ``T(...)`` builds a tuple (the common positional-table case) or a
+dict for keyword entries, so ported model code reads the same while staying
+an ordinary pytree that jit/grad understand.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# An Activity is any pytree of arrays. A Table is a tuple or dict.
+Table = tuple
+
+
+def T(*args: Any, **kwargs: Any):
+    """Torch-style table builder (reference ``T()`` in ``DL/utils/Table.scala``).
+
+    ``T(a, b)`` -> ``(a, b)``; ``T(x=a)`` -> ``{"x": a}``.
+    """
+    if args and kwargs:
+        raise ValueError("T() takes positional or keyword entries, not both")
+    if kwargs:
+        return dict(kwargs)
+    return tuple(args)
+
+
+def is_table(x: Any) -> bool:
+    return isinstance(x, (tuple, list, dict))
+
+
+def flatten_activity(x):
+    import jax
+
+    return jax.tree_util.tree_leaves(x)

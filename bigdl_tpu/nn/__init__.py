@@ -1,0 +1,35 @@
+"""Torch-style NN layer library on pure-functional JAX.
+
+Reference: ``DL/nn/`` (227 layer classes + ~40 criterions; SURVEY.md §2.2).
+"""
+
+from bigdl_tpu.nn.module import Module, Criterion, Context, LambdaLayer, Params, State
+from bigdl_tpu.nn.containers import (
+    Container,
+    Sequential,
+    Concat,
+    ConcatTable,
+    ParallelTable,
+    MapTable,
+    Bottle,
+)
+from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.layers import *  # noqa: F401,F403
+from bigdl_tpu.nn.criterion import (
+    ClassNLLCriterion,
+    CrossEntropyCriterion,
+    MSECriterion,
+    AbsCriterion,
+    SmoothL1Criterion,
+    BCECriterion,
+    BCECriterionWithLogits,
+    MarginCriterion,
+    DistKLDivCriterion,
+    HingeEmbeddingCriterion,
+    L1Cost,
+    MultiLabelSoftMarginCriterion,
+    ParallelCriterion,
+    MultiCriterion,
+    TimeDistributedCriterion,
+)
+from bigdl_tpu.nn import init

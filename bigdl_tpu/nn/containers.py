@@ -1,0 +1,111 @@
+"""Containers: Sequential, Concat, ConcatTable, ParallelTable, MapTable, Bottle.
+
+Reference: ``DL/nn/Container.scala``, ``Sequential.scala``, ``Concat.scala``,
+``ConcatTable.scala``, ``ParallelTable.scala``, ``MapTable.scala``,
+``Bottle.scala``. Children are registered under stable string keys so the
+params/state pytrees mirror the module tree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Container(Module):
+    """Ordered container base (reference: ``Container.scala:237``)."""
+
+    def __init__(self, *modules: Module):
+        super().__init__()
+        for m in modules:
+            self.add(m)
+
+    def add(self, module: Module, name: Optional[str] = None) -> "Container":
+        name = name or module.get_name() or str(len(self._modules))
+        if name in self._modules:
+            name = f"{name}_{len(self._modules)}"
+        self._modules[name] = module
+        return self
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __getitem__(self, i: int) -> Module:
+        return list(self._modules.values())[i]
+
+
+class Sequential(Container):
+    """Feed modules in registration order (reference: ``Sequential.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        for name, m in self._modules.items():
+            x = m.forward(ctx.child(name), x)
+        return x
+
+
+class ConcatTable(Container):
+    """Apply every child to the same input, return a tuple of outputs
+    (reference: ``ConcatTable.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        return tuple(m.forward(ctx.child(name), x) for name, m in self._modules.items())
+
+
+class ParallelTable(Container):
+    """Apply i-th child to i-th input element (reference: ``ParallelTable.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        items = list(self._modules.items())
+        if len(items) != len(x):
+            raise ValueError(f"ParallelTable: {len(items)} children but {len(x)} inputs")
+        return tuple(m.forward(ctx.child(name), xi) for (name, m), xi in zip(items, x))
+
+
+class Concat(Container):
+    """Apply every child to the same input and concatenate outputs along
+    ``dimension`` (reference: ``Concat.scala``; used by Inception towers).
+    Dimension is 0-indexed over the full batched shape (the reference is
+    1-indexed; dim=1 there == dim=1 here for NCHW batched input)."""
+
+    def __init__(self, dimension: int, *modules: Module):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def forward(self, ctx: Context, x):
+        outs = [m.forward(ctx.child(name), x) for name, m in self._modules.items()]
+        return jnp.concatenate(outs, axis=self.dimension)
+
+
+class MapTable(Container):
+    """Apply the single child to every element of the input table
+    (reference: ``MapTable.scala``). Parameters are shared across elements."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.add(module, "0")
+
+    def forward(self, ctx: Context, x):
+        (name, m), = self._modules.items()
+        return tuple(m.forward(ctx.child(name), xi) for xi in x)
+
+
+class Bottle(Container):
+    """Flatten leading dims to apply an n-D module to higher-D input
+    (reference: ``Bottle.scala``)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: Optional[int] = None):
+        super().__init__()
+        self.add(module, "0")
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim or n_input_dim
+
+    def forward(self, ctx: Context, x):
+        (name, m), = self._modules.items()
+        shape = x.shape
+        lead = shape[: len(shape) - self.n_input_dim + 1]
+        flat = x.reshape((-1,) + shape[len(shape) - self.n_input_dim + 1 :])
+        y = m.forward(ctx.child(name), flat)
+        return y.reshape(lead + y.shape[1:])

@@ -1,0 +1,242 @@
+"""Criterions (loss functions).
+
+Reference: the ~40 criterions under ``DL/nn/`` (``ClassNLLCriterion.scala``,
+``CrossEntropyCriterion.scala``, ``MSECriterion.scala``, ``AbsCriterion.scala``,
+``SmoothL1Criterion.scala``, ``BCECriterion.scala``, ``MarginCriterion.scala``,
+``DistKLDivCriterion.scala``, ``HingeEmbeddingCriterion.scala``,
+``ParallelCriterion.scala``, ``TimeDistributedCriterion.scala``,
+``MultiCriterion.scala``, ``L1Cost.scala``, ``MultiLabelSoftMarginCriterion``).
+
+Deviation from the reference: class labels are **0-based** integer arrays
+(the reference uses 1-based Torch labels). Losses are pure functions of
+(output, target); gradients come from ``jax.grad`` over the composed step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+
+
+def _reduce(loss, size_average: bool):
+    return jnp.mean(loss) if size_average else jnp.sum(loss)
+
+
+def _bce_with_logits(output, t):
+    """Numerically-stable elementwise sigmoid cross-entropy."""
+    return jnp.maximum(output, 0) - output * t + jnp.log1p(jnp.exp(-jnp.abs(output)))
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities
+    (reference: ``ClassNLLCriterion.scala``). ``logProbAsInput=True`` expects
+    LogSoftMax output; with ``False`` it expects probabilities."""
+
+    def __init__(
+        self,
+        weights: Optional[jnp.ndarray] = None,
+        size_average: bool = True,
+        log_prob_as_input: bool = True,
+    ):
+        self.weights = weights
+        self.size_average = size_average
+        self.log_prob_as_input = log_prob_as_input
+
+    def forward(self, output, target):
+        logp = output if self.log_prob_as_input else jnp.log(jnp.clip(output, 1e-8))
+        t = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, t)
+            total = -jnp.sum(picked * w)
+            return total / jnp.sum(w) if self.size_average else total
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference: ``CrossEntropyCriterion.scala``).
+    Takes raw logits."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+        self.inner = ClassNLLCriterion(weights, size_average)
+
+    def forward(self, output, target):
+        return self.inner.forward(jax.nn.log_softmax(output, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        return _reduce((output - target.astype(output.dtype)) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        return _reduce(jnp.abs(output - target), self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        d = jnp.abs(output - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy over probabilities (reference: ``BCECriterion.scala``)."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        eps = 1e-12
+        t = target.astype(output.dtype)
+        loss = -(t * jnp.log(output + eps) + (1 - t) * jnp.log(1 - output + eps))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class BCECriterionWithLogits(Criterion):
+    """Numerically-stable sigmoid+BCE (TPU-friendly fused form)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        return _reduce(_bce_with_logits(output, target.astype(output.dtype)), self.size_average)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss, targets in {-1, 1} (reference: ``MarginCriterion.scala``).
+    ``squared=True`` gives L2-SVM."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True, squared: bool = False):
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def forward(self, output, target):
+        h = jnp.maximum(0.0, self.margin - output * target)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || output) with output = log-probs (reference:
+    ``DistKLDivCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        t = target.astype(output.dtype)
+        loss = jnp.where(t > 0, t * (jnp.log(jnp.clip(t, 1e-12)) - output), 0.0)
+        if self.size_average:
+            return jnp.sum(loss) / output.shape[0]
+        return jnp.sum(loss)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        loss = jnp.where(target > 0, output, jnp.maximum(0.0, self.margin - output))
+        return _reduce(loss, self.size_average)
+
+
+class L1Cost(Criterion):
+    def forward(self, output, target=None):
+        return jnp.sum(jnp.abs(output))
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def __init__(self, weights: Optional[jnp.ndarray] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        loss = _bce_with_logits(output, target.astype(output.dtype))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss.mean(axis=-1), self.size_average)
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions over a table of (output, target) pairs
+    (reference: ``ParallelCriterion.scala``)."""
+
+    def __init__(self, repeat_target: bool = False):
+        self.criterions = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, output, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.forward(output[i], t)
+        return total
+
+
+class MultiCriterion(Criterion):
+    """Sum of criterions on the same (output, target)
+    (reference: ``MultiCriterion.scala``)."""
+
+    def __init__(self):
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, output, target):
+        return sum(w * c.forward(output, target) for c, w in zip(self.criterions, self.weights))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (batch, time, ...) output
+    (reference: ``TimeDistributedCriterion.scala``)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False, dimension: int = 1):
+        self.criterion = criterion
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def forward(self, output, target):
+        # Vectorized: flatten (batch, time) into one batch and rescale so the
+        # result equals the reference's per-timestep loop (sum over steps of
+        # criterion(output_t, target_t)).
+        steps = output.shape[self.dimension]
+        o = jnp.moveaxis(output, self.dimension, 1)
+        t = jnp.moveaxis(target, self.dimension, 1) if target.ndim >= 2 else target
+        o_flat = o.reshape((-1,) + o.shape[2:])
+        t_flat = t.reshape((-1,) + t.shape[2:]) if t.ndim >= 2 else t
+        flat = self.criterion.forward(o_flat, t_flat)
+        total = flat * steps if getattr(self.criterion, "size_average", True) else flat
+        return total / steps if self.size_average else total

@@ -1,0 +1,140 @@
+"""Graph container: arbitrary DAGs of modules.
+
+Reference: ``DL/nn/Graph.scala`` (node DAG + topo sort via
+``DL/utils/DirectedGraph.scala``) executed by ``StaticGraph``
+(``DL/nn/StaticGraph.scala:56-68``: pre-topo-sorted array walk). Here the
+topo-sorted walk happens at Python trace time; XLA sees one flat fused
+program, so there is no dynamic scheduler to build (the reference's
+``DynamicGraph``/``Scheduler``/``FrameManager`` data-driven execution is
+subsumed by ``lax.cond``/``lax.while_loop`` for genuinely dynamic control
+flow).
+
+Building syntax mirrors the reference's functional API::
+
+    inp = Input()
+    h = ReLU()(SpatialConvolution(1, 6, 5, 5)(inp))
+    out = LogSoftMax()(Linear(84, 10)(h))
+    model = Graph(inp, out)
+
+Weight sharing: using the same module instance at two nodes shares one
+params subtree (the analogue of shared weight storage in the reference's
+``ModelBroadcast`` replica cloning).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.module import Context, Module, Params, State
+
+
+class Node:
+    """A module wired into a DAG with its input nodes."""
+
+    __slots__ = ("element", "prev")
+
+    def __init__(self, element: Optional[Module], prev: Sequence["Node"] = ()):
+        self.element = element
+        self.prev = list(prev)
+
+    def __repr__(self):
+        return f"Node({self.element!r})"
+
+
+def Input() -> Node:
+    """Graph input placeholder (reference: ``DL/nn/Input.scala``)."""
+    return Node(None, [])
+
+
+def to_node(x: Union[Node, Module]) -> Node:
+    if isinstance(x, Node):
+        return x
+    if isinstance(x, Module):
+        return Node(x, [])
+    raise TypeError(f"cannot wire {type(x).__name__} into a graph")
+
+
+class Graph(Module):
+    def __init__(
+        self,
+        inputs: Union[Node, Sequence[Node]],
+        outputs: Union[Node, Sequence[Node]],
+    ):
+        super().__init__()
+        self.inputs: List[Node] = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.outputs: List[Node] = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self._topo: List[Node] = self._topo_sort()
+        self._names: Dict[int, str] = self._assign_names()
+        # register unique modules as children in topo order for init()
+        for node in self._topo:
+            if node.element is not None:
+                name = self._names[id(node)]
+                if name not in self._modules:
+                    self._modules[name] = node.element
+
+    def _topo_sort(self) -> List[Node]:
+        """Deterministic post-order DFS from outputs (reference:
+        ``DirectedGraph.topologySort``)."""
+        order: List[Node] = []
+        seen: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+        def visit(n: Node):
+            nid = id(n)
+            st = seen.get(nid)
+            if st == 1:
+                return
+            if st == 0:
+                raise ValueError("Graph contains a cycle")
+            seen[nid] = 0
+            for p in n.prev:
+                visit(p)
+            seen[nid] = 1
+            order.append(n)
+        for out in self.outputs:
+            visit(out)
+        for inp in self.inputs:
+            if id(inp) not in seen:
+                raise ValueError("a declared Graph input is not reachable from outputs")
+        return order
+
+    def _assign_names(self) -> Dict[int, str]:
+        names: Dict[int, str] = {}
+        by_module: Dict[int, str] = {}
+        counters: Dict[str, int] = {}
+        for node in self._topo:
+            if node.element is None:
+                continue
+            mid = id(node.element)
+            if mid in by_module:  # shared module -> shared params subtree
+                names[id(node)] = by_module[mid]
+                continue
+            base = node.element.get_name() or type(node.element).__name__
+            k = counters.get(base, 0)
+            counters[base] = k + 1
+            name = base if node.element.get_name() else f"{base}_{k}"
+            by_module[mid] = name
+            names[id(node)] = name
+        return names
+
+    def forward(self, ctx: Context, x):
+        acts: Dict[int, object] = {}
+        xs = (x,) if len(self.inputs) == 1 else tuple(x)
+        if len(xs) != len(self.inputs):
+            raise ValueError(f"Graph expects {len(self.inputs)} inputs, got {len(xs)}")
+        for node, xi in zip(self.inputs, xs):
+            acts[id(node)] = xi
+        for node in self._topo:
+            if id(node) in acts:
+                continue
+            if node.element is None:
+                raise ValueError("unbound Input node (not listed in Graph inputs)")
+            parents = [acts[id(p)] for p in node.prev]
+            nin = parents[0] if len(parents) == 1 else tuple(parents)
+            acts[id(node)] = node.element.forward(ctx.child(self._names[id(node)]), nin)
+        outs = tuple(acts[id(n)] for n in self.outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def node_names(self) -> List[str]:
+        return [self._names[id(n)] for n in self._topo if n.element is not None]

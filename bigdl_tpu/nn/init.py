@@ -1,0 +1,94 @@
+"""Weight initialization methods.
+
+Reference: ``DL/nn/InitializationMethod.scala`` — Zeros, Ones, ConstInitMethod,
+RandomUniform, RandomNormal, Xavier (glorot), MsraFiller (kaiming),
+BilinearFiller; layers expose ``setInitMethod(weight, bias)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def __call__(self, rng: jax.Array, shape: Tuple[int, ...], fan_in: int, fan_out: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """Uniform in [lower, upper]; default Torch-style 1/sqrt(fan_in)."""
+
+    def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.lower is None:
+            stdv = 1.0 / math.sqrt(max(1, fan_in))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform (reference default for convolutions)."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-limit, maxval=limit)
+
+
+class MsraFiller(InitializationMethod):
+    """Kaiming/He normal (reference: MsraFiller, used by ResNet)."""
+
+    def __init__(self, variance_norm_average: bool = False):
+        self.variance_norm_average = variance_norm_average
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_out
+        std = math.sqrt(2.0 / max(1.0, n))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling weights for deconvolution."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        # shape: (out_ch, in_ch, kh, kw)
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = jnp.arange(kh)[:, None]
+        xs = jnp.arange(kw)[None, :]
+        filt = (1 - jnp.abs(ys / f_h - c_h)) * (1 - jnp.abs(xs / f_w - c_w))
+        return jnp.broadcast_to(filt, shape).astype(dtype)

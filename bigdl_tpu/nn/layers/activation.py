@@ -1,0 +1,211 @@
+"""Activation and elementwise layers.
+
+Reference: the activation slice of ``DL/nn/`` (ReLU, ReLU6, Tanh, Sigmoid,
+SoftMax, LogSoftMax, ELU, LeakyReLU, PReLU, SReLU, SoftPlus, SoftSign,
+HardTanh, HardSigmoid, Threshold, Power, Square, Sqrt, Abs, Clamp, Log, Exp,
+Negative, AddConstant, MulConstant). All are single XLA elementwise ops that
+fuse into adjacent matmuls/convs — the reference needed MKL-DNN post-op
+fusion (``Fusion.scala``) to get the same effect.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class ReLU(Module):
+    def __init__(self, ip: bool = False):  # ip (in-place) kept for API parity; meaningless in JAX
+        super().__init__()
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class Tanh(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, ctx: Context, x):
+        return jax.nn.sigmoid(x)
+
+
+class SoftMax(Module):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class LogSoftMax(Module):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.elu(x, self.alpha)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negval: float = 0.01):
+        super().__init__()
+        self.negval = negval
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.leaky_relu(x, self.negval)
+
+
+class PReLU(Module):
+    """Learned per-channel negative slope (reference: ``PReLU.scala``;
+    ``n_output_plane=0`` -> one shared slope)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+
+    def build_params(self, rng):
+        n = max(1, self.n_output_plane)
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}
+
+    def forward(self, ctx: Context, x):
+        a = ctx.param("weight").astype(x.dtype)
+        if self.n_output_plane > 0 and x.ndim > 2:
+            shape = [1] * x.ndim
+            shape[1] = self.n_output_plane
+            a = a.reshape(shape)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SoftPlus(Module):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def forward(self, ctx: Context, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(Module):
+    def forward(self, ctx: Context, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class HardTanh(Module):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, ctx: Context, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardSigmoid(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class Threshold(Module):
+    def __init__(self, th: float = 1e-6, v: float = 0.0):
+        super().__init__()
+        self.th, self.v = th, v
+
+    def forward(self, ctx: Context, x):
+        return jnp.where(x > self.th, x, jnp.asarray(self.v, x.dtype))
+
+
+class GELU(Module):
+    def forward(self, ctx: Context, x):
+        return jax.nn.gelu(x)
+
+
+class SiLU(Module):
+    def forward(self, ctx: Context, x):
+        return jax.nn.silu(x)
+
+
+class Power(Module):
+    """(shift + scale * x) ** power (reference: ``Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def forward(self, ctx: Context, x):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Square(Module):
+    def forward(self, ctx: Context, x):
+        return x * x
+
+
+class Sqrt(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.sqrt(x)
+
+
+class Abs(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.abs(x)
+
+
+class Clamp(Module):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__()
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, ctx: Context, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Log(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.log(x)
+
+
+class Exp(Module):
+    def forward(self, ctx: Context, x):
+        return jnp.exp(x)
+
+
+class Negative(Module):
+    def forward(self, ctx: Context, x):
+        return -x
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float):
+        super().__init__()
+        self.constant = constant
+
+    def forward(self, ctx: Context, x):
+        return x + self.constant
+
+
+class MulConstant(Module):
+    def __init__(self, constant: float):
+        super().__init__()
+        self.constant = constant
+
+    def forward(self, ctx: Context, x):
+        return x * self.constant

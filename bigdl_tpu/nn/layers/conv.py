@@ -1,0 +1,266 @@
+"""Convolution layers.
+
+Reference: ``DL/nn/SpatialConvolution.scala:253`` (im2col + MKL gemm, hand
+loops in ``NNPrimitive.scala``), ``SpatialDilatedConvolution.scala``,
+``SpatialFullConvolution.scala`` (deconvolution), ``TemporalConvolution.scala``.
+TPU-native: one ``lax.conv_general_dilated`` per layer — XLA tiles it onto
+the MXU and fuses surrounding elementwise ops; there is no im2col, no
+layout "reorder" pass (the reference's ``ReorderManager``), and no manual
+fusion (the reference's ``Fusion.scala`` conv+bn/conv+relu post-ops).
+
+Argument order keeps the reference's W-before-H convention
+(``kernelW, kernelH, strideW, strideH, padW, padH``). ``pad_w = pad_h = -1``
+selects TF-style SAME padding, as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+def _dimension_numbers(data_format: str):
+    if data_format == "NCHW":
+        return ("NCHW", "OIHW", "NCHW")
+    if data_format == "NHWC":
+        return ("NHWC", "OIHW", "NHWC")
+    raise ValueError(f"unknown data_format {data_format}")
+
+
+def _padding(pad_h: int, pad_w: int):
+    if pad_h == -1 or pad_w == -1:
+        return "SAME"
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+class SpatialConvolution(Module):
+    """2-D convolution (reference ``SpatialConvolution.scala``; groups via
+    ``feature_group_count`` replace the reference's per-group gemm loop)."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        n_group: int = 1,
+        with_bias: bool = True,
+        data_format: str = "NCHW",
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.data_format = data_format
+        self.dilation = (1, 1)
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init:
+            self.weight_init = weight_init
+        if bias_init:
+            self.bias_init = bias_init
+        return self
+
+    def build_params(self, rng):
+        kh, kw = self.kernel
+        cin = self.n_input_plane // self.n_group
+        fan_in = cin * kh * kw
+        fan_out = (self.n_output_plane // self.n_group) * kh * kw
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"),
+                (self.n_output_plane, cin, kh, kw),
+                fan_in,
+                fan_out,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(
+                fold_in_str(rng, "bias"), (self.n_output_plane,), fan_in, fan_out
+            )
+        return p
+
+    def _add_bias(self, ctx: Context, y, dtype):
+        if self.with_bias:
+            b = ctx.param("bias").astype(dtype)
+            y = y + (b[:, None, None] if self.data_format == "NCHW" else b)
+        return y
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=self.stride,
+            padding=_padding(*self.pad),
+            rhs_dilation=self.dilation,
+            feature_group_count=self.n_group,
+            dimension_numbers=_dimension_numbers(self.data_format),
+        )
+        return self._add_bias(ctx, y, x.dtype)
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Reference: ``SpatialDilatedConvolution.scala``. Same lowering as the
+    base conv with ``rhs_dilation`` set."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        dilation_w: int = 1,
+        dilation_h: int = 1,
+        **kw,
+    ):
+        super().__init__(
+            n_input_plane, n_output_plane, kernel_w, kernel_h, stride_w, stride_h, pad_w, pad_h, **kw
+        )
+        self.dilation = (dilation_h, dilation_w)
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution (reference: ``SpatialFullConvolution.scala``).
+
+    Implemented as ``lax.conv_transpose``; ``adj_w/adj_h`` add extra output
+    size as in the reference.
+    """
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        adj_w: int = 0,
+        adj_h: int = 0,
+        with_bias: bool = True,
+        data_format: str = "NCHW",
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.with_bias = with_bias
+        self.data_format = data_format
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        fan_out = self.n_output_plane * kh * kw
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"),
+                (self.n_output_plane, self.n_input_plane, kh, kw),
+                fan_in,
+                fan_out,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(fold_in_str(rng, "bias"), (self.n_output_plane,), fan_in, fan_out)
+        return p
+
+    def forward(self, ctx: Context, x):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        ah, aw = self.adj
+        w = ctx.param("weight").astype(x.dtype)
+        # gradient-of-conv formulation: lhs-dilate input by stride, pad by k-1-p
+        y = lax.conv_general_dilated(
+            x,
+            jnp.flip(w, (-2, -1)),  # stored (out, in, kh, kw): flip spatial only
+            window_strides=(1, 1),
+            padding=[(kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw)],
+            lhs_dilation=(sh, sw),
+            dimension_numbers=_dimension_numbers(self.data_format),
+        )
+        if self.with_bias:
+            b = ctx.param("bias").astype(x.dtype)
+            y = y + (b[:, None, None] if self.data_format == "NCHW" else b)
+        return y
+
+
+class TemporalConvolution(Module):
+    """1-D convolution over (batch, time, feature) input
+    (reference: ``TemporalConvolution.scala``)."""
+
+    def __init__(
+        self,
+        input_frame_size: int,
+        output_frame_size: int,
+        kernel_w: int,
+        stride_w: int = 1,
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        fan_in = self.input_frame_size * self.kernel_w
+        fan_out = self.output_frame_size * self.kernel_w
+        return {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"),
+                (self.output_frame_size, self.input_frame_size, self.kernel_w),
+                fan_in,
+                fan_out,
+            ),
+            "bias": self.bias_init(
+                fold_in_str(rng, "bias"), (self.output_frame_size,), fan_in, fan_out
+            ),
+        }
+
+    def forward(self, ctx: Context, x):
+        # x: (batch, time, feature) -> NCW for lax
+        w = ctx.param("weight").astype(x.dtype)  # (out, in, k)
+        y = lax.conv_general_dilated(
+            x.swapaxes(1, 2),
+            w,
+            window_strides=(self.stride_w,),
+            padding="VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        y = y.swapaxes(1, 2)
+        return y + ctx.param("bias").astype(x.dtype)

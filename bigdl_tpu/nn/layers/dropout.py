@@ -1,0 +1,53 @@
+"""Stochastic regularization layers.
+
+Reference: ``DL/nn/Dropout.scala`` (inverted dropout: scale by 1/(1-p) at
+train time), ``GaussianNoise.scala``, ``GaussianDropout.scala``. RNG is a
+deterministic per-module-path stream derived from the key passed to
+``apply`` (see ``Context.rng``), replacing the reference's per-thread
+mersenne twister.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Dropout(Module):
+    def __init__(self, init_p: float = 0.5, scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def forward(self, ctx: Context, x):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.rng(), keep, x.shape)
+        y = jnp.where(mask, x, jnp.zeros((), x.dtype))
+        return y / keep if self.scale else y
+
+
+class GaussianNoise(Module):
+    def __init__(self, stddev: float):
+        super().__init__()
+        self.stddev = stddev
+
+    def forward(self, ctx: Context, x):
+        if not ctx.training:
+            return x
+        return x + self.stddev * jax.random.normal(ctx.rng(), x.shape, x.dtype)
+
+
+class GaussianDropout(Module):
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, ctx: Context, x):
+        if not ctx.training:
+            return x
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + stddev * jax.random.normal(ctx.rng(), x.shape, x.dtype))

@@ -1,0 +1,46 @@
+"""Embedding layers.
+
+Reference: ``DL/nn/LookupTable.scala`` (index->vector table with optional
+max-norm renorm and padding index). TPU-native: one ``jnp.take`` gather;
+for TP the table is shard-able over the vocab dim (see parallel tier).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, RandomNormal
+from bigdl_tpu.nn.module import Context, Module
+
+
+class LookupTable(Module):
+    def __init__(
+        self,
+        n_index: int,
+        n_output: int,
+        padding_value: Optional[int] = None,
+        weight_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+
+    def build_params(self, rng):
+        w = self.weight_init(
+            fold_in_str(rng, "weight"),
+            (self.n_index, self.n_output),
+            self.n_index,
+            self.n_output,
+        )
+        if self.padding_value is not None:
+            w = w.at[self.padding_value].set(0.0)
+        return {"weight": w}
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight")
+        return jnp.take(w, x.astype(jnp.int32), axis=0)

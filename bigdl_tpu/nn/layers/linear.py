@@ -1,0 +1,77 @@
+"""Linear (fully-connected) layer and trivial passthroughs.
+
+Reference: ``DL/nn/Linear.scala`` (weight (out,in), optional bias, gemm via
+MKL — here a single ``jnp.dot`` that XLA maps straight onto the MXU;
+bfloat16 inputs keep the systolic array fed while params stay fp32 masters).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Linear(Module):
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        with_bias: bool = True,
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None) -> "Linear":
+        if weight_init:
+            self.weight_init = weight_init
+        if bias_init:
+            self.bias_init = bias_init
+        return self
+
+    def build_params(self, rng):
+        fan_in, fan_out = self.input_size, self.output_size
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"), (self.output_size, self.input_size), fan_in, fan_out
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(fold_in_str(rng, "bias"), (self.output_size,), fan_in, fan_out)
+        return p
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        y = jnp.dot(x, w.T)
+        if self.with_bias:
+            y = y + ctx.param("bias").astype(x.dtype)
+        return y
+
+
+class Identity(Module):
+    """Reference: ``DL/nn/Identity.scala``."""
+
+    def forward(self, ctx: Context, x):
+        return x
+
+
+class Echo(Module):
+    """Debug passthrough that prints activation shape at trace time
+    (reference: ``DL/nn/Echo.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        import jax
+
+        shapes = jax.tree_util.tree_map(lambda a: getattr(a, "shape", None), x)
+        print(f"[Echo {self.get_name() or ''}] {shapes}")
+        return x

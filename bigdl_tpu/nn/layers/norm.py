@@ -1,0 +1,140 @@
+"""Normalization layers.
+
+Reference: ``DL/nn/BatchNormalization.scala`` /
+``SpatialBatchNormalization.scala`` (running stats kept per replica and
+copied from replica 0, ``LocalOptimizer.scala:209``), ``DL/nn/Normalize.scala``,
+``DL/nn/LayerNormalization.scala``.
+
+Deliberate TPU deviation (documented in SURVEY.md §7 "hard parts"): under
+SPMD the batch axis is sharded across chips but semantically global — batch
+statistics computed with ``jnp.mean`` over a sharded batch make XLA insert
+the cross-replica ``psum`` automatically, so running stats are *global*
+cross-replica statistics rather than replica-0's local view.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, Ones, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+class BatchNormalization(Module):
+    """BN over a (batch, feature) input; ``SpatialBatchNormalization``
+    handles (batch, channel, H, W). ``momentum`` follows the reference:
+    ``running = (1 - momentum) * running + momentum * batch_stat``."""
+
+    reduce_axes = (0,)
+    param_shape_ndim = 2
+
+    def __init__(
+        self,
+        n_output: int,
+        eps: float = 1e-5,
+        momentum: float = 0.1,
+        affine: bool = True,
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.weight_init = weight_init or Ones()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        if not self.affine:
+            return {}
+        n = self.n_output
+        return {
+            "weight": self.weight_init(fold_in_str(rng, "weight"), (n,), n, n),
+            "bias": self.bias_init(fold_in_str(rng, "bias"), (n,), n, n),
+        }
+
+    def build_state(self):
+        return {
+            "running_mean": jnp.zeros((self.n_output,), jnp.float32),
+            "running_var": jnp.ones((self.n_output,), jnp.float32),
+        }
+
+    def _broadcast(self, v, ndim):
+        shape = [1] * ndim
+        shape[1] = self.n_output
+        return v.reshape(shape)
+
+    def forward(self, ctx: Context, x):
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        if ctx.training:
+            xf = x.astype(jnp.float32)
+            mean = xf.mean(axis=axes)
+            var = xf.var(axis=axes)
+            m = self.momentum
+            n = float(jnp.prod(jnp.asarray([x.shape[i] for i in axes])))
+            unbiased = var * (n / max(1.0, n - 1.0))
+            ctx.put_state("running_mean", (1 - m) * ctx.get_state("running_mean") + m * mean)
+            ctx.put_state("running_var", (1 - m) * ctx.get_state("running_var") + m * unbiased)
+        else:
+            mean = ctx.get_state("running_mean")
+            var = ctx.get_state("running_var")
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        if self.affine:
+            scale = inv * ctx.param("weight")
+            shift = ctx.param("bias") - mean * scale
+        else:
+            scale = inv
+            shift = -mean * scale
+        y = x * self._broadcast(scale, x.ndim).astype(x.dtype) + self._broadcast(
+            shift, x.ndim
+        ).astype(x.dtype)
+        return y
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Reference: ``SpatialBatchNormalization.scala`` (NCHW, stats over
+    N,H,W per channel). Same implementation — channel is axis 1."""
+
+
+class LayerNormalization(Module):
+    """Reference: ``DL/nn/LayerNormalization.scala`` (transformer tier):
+    normalize over the last dim with learned gain/bias."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def build_params(self, rng):
+        return {
+            "weight": jnp.ones((self.hidden_size,), jnp.float32),
+            "bias": jnp.zeros((self.hidden_size,), jnp.float32),
+        }
+
+    def forward(self, ctx: Context, x):
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=-1, keepdims=True)
+        var = xf.var(axis=-1, keepdims=True)
+        y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        y = y * ctx.param("weight") + ctx.param("bias")
+        return y.astype(x.dtype)
+
+
+class Normalize(Module):
+    """Lp-normalize along dim 1 (reference: ``DL/nn/Normalize.scala``)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def forward(self, ctx: Context, x):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=1, keepdims=True) ** (1.0 / self.p)
+        return x / (norm + self.eps)

@@ -1,0 +1,132 @@
+"""Pooling layers.
+
+Reference: ``DL/nn/SpatialMaxPooling.scala``, ``SpatialAveragePooling.scala``
+(with ``ceilMode`` and ``countIncludePad``), ``TemporalMaxPooling.scala``.
+TPU-native: ``lax.reduce_window`` — XLA lowers it to vectorized windowed
+reductions; no pooling-index bookkeeping is needed because gradients come
+from autodiff, not a hand-written ``updateGradInput``.
+
+Argument order keeps the reference's W-before-H convention.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+def _pool_padding(in_size, k, s, pad, ceil_mode):
+    """(lo, hi) padding for one spatial dim, Torch floor/ceil semantics."""
+    if ceil_mode:
+        out = int(np.ceil((in_size + 2 * pad - k) / s)) + 1
+        # Torch: last window must start inside the (left-padded) input
+        if (out - 1) * s >= in_size + pad:
+            out -= 1
+    else:
+        out = int(np.floor((in_size + 2 * pad - k) / s)) + 1
+    needed = max(0, (out - 1) * s + k - in_size - pad)
+    return pad, needed
+
+
+class _Pool2D(Module):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel = (kh, kw)
+        self.stride = (dh or kh, dw or kw)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = False
+        self.data_format = data_format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _window(self, x):
+        if self.data_format == "NCHW":
+            h_ax, w_ax = 2, 3
+        else:
+            h_ax, w_ax = 1, 2
+        dims = [1] * x.ndim
+        strides = [1] * x.ndim
+        pads = [(0, 0)] * x.ndim
+        dims[h_ax], dims[w_ax] = self.kernel
+        strides[h_ax], strides[w_ax] = self.stride
+        pads[h_ax] = _pool_padding(x.shape[h_ax], self.kernel[0], self.stride[0], self.pad[0], self.ceil_mode)
+        pads[w_ax] = _pool_padding(x.shape[w_ax], self.kernel[1], self.stride[1], self.pad[1], self.ceil_mode)
+        return tuple(dims), tuple(strides), tuple(pads)
+
+
+class SpatialMaxPooling(_Pool2D):
+    def forward(self, ctx: Context, x):
+        dims, strides, pads = self._window(x)
+        # scalar init (not an array) so lax picks the reduce_window_max
+        # primitive, which has a reverse-mode autodiff rule
+        neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, neg_inf, lax.max, dims, strides, pads)
+
+
+class SpatialAveragePooling(_Pool2D):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 count_include_pad: bool = True, data_format="NCHW"):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, data_format)
+        self.count_include_pad = count_include_pad
+
+    def forward(self, ctx: Context, x):
+        dims, strides, pads = self._window(x)
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        # Divisor semantics (torch oracle): count_include_pad counts the
+        # official padding but never the ceil-mode extension; the mask is 1
+        # over the (optionally padded) input extent and 0 over the extension.
+        if self.data_format == "NCHW":
+            h_ax, w_ax = 2, 3
+        else:
+            h_ax, w_ax = 1, 2
+        if self.count_include_pad:
+            mask_widths = [(0, 0)] * x.ndim
+            mask_widths[h_ax] = (self.pad[0], self.pad[0])
+            mask_widths[w_ax] = (self.pad[1], self.pad[1])
+            mask = jnp.pad(jnp.ones(x.shape, x.dtype), mask_widths, constant_values=1.0)
+            mask_pads = list(pads)
+            mask_pads[h_ax] = (0, pads[h_ax][1] - self.pad[0])
+            mask_pads[w_ax] = (0, pads[w_ax][1] - self.pad[1])
+            counts = lax.reduce_window(mask, 0.0, lax.add, dims, strides, tuple(mask_pads))
+        else:
+            counts = lax.reduce_window(
+                jnp.ones(x.shape, x.dtype), 0.0, lax.add, dims, strides, pads
+            )
+        return summed / counts
+
+
+class TemporalMaxPooling(Module):
+    """Max pooling over (batch, time, feature) (reference:
+    ``TemporalMaxPooling.scala``)."""
+
+    def __init__(self, k_w: int, d_w: int = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def forward(self, ctx: Context, x):
+        neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(
+            x, neg_inf, lax.max, (1, self.k_w, 1), (1, self.d_w, 1), [(0, 0)] * 3
+        )
+
+
+class GlobalAveragePooling2D(Module):
+    """Mean over spatial dims (keras-tier helper; reference keras
+    ``GlobalAveragePooling2D``)."""
+
+    def __init__(self, data_format="NCHW"):
+        super().__init__()
+        self.axes = (2, 3) if data_format == "NCHW" else (1, 2)
+
+    def forward(self, ctx: Context, x):
+        return x.mean(axis=self.axes)

@@ -1,0 +1,161 @@
+"""Shape/glue layers.
+
+Reference: ``DL/nn/Reshape.scala``, ``View.scala``, ``Squeeze.scala``,
+``Unsqueeze.scala``, ``Transpose.scala``, ``Select.scala``, ``Narrow.scala``,
+``Contiguous.scala``, ``Padding.scala``, ``Replicate.scala``, ``Mean.scala``,
+``Max.scala``, ``Min.scala``, ``Sum.scala``. Dims here are 0-indexed Python
+axes over the batched shape (the reference is 1-indexed Torch dims, usually
+with an implicit batch in front).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Reshape(Module):
+    """Reshape the non-batch dims (reference semantic: size excludes batch
+    when ``batch_mode`` is None/True)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = True):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward(self, ctx: Context, x):
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + self.size)
+        return x.reshape(self.size)
+
+
+class View(Module):
+    """Reshape allowing one -1 wildcard, batch preserved
+    (reference: ``View.scala``)."""
+
+    def __init__(self, *sizes: int):
+        super().__init__()
+        self.sizes = sizes if sizes else (-1,)
+
+    def forward(self, ctx: Context, x):
+        return x.reshape((x.shape[0],) + tuple(self.sizes))
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None):
+        super().__init__()
+        self.dim = dim
+
+    def forward(self, ctx: Context, x):
+        return jnp.squeeze(x, axis=self.dim) if self.dim is not None else jnp.squeeze(x)
+
+
+class Unsqueeze(Module):
+    def __init__(self, dim: int):
+        super().__init__()
+        self.dim = dim
+
+    def forward(self, ctx: Context, x):
+        return jnp.expand_dims(x, self.dim)
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (reference: ``Transpose.scala``)."""
+
+    def __init__(self, *pairs: Tuple[int, int]):
+        super().__init__()
+        self.pairs = pairs
+
+    def forward(self, ctx: Context, x):
+        for a, b in self.pairs:
+            x = jnp.swapaxes(x, a, b)
+        return x
+
+
+class Select(Module):
+    """Select index along dim, squeezing it (reference: ``Select.scala``)."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def forward(self, ctx: Context, x):
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along dim (reference: ``Narrow.scala``).
+    ``length=-1`` means to the end."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def forward(self, ctx: Context, x):
+        end = x.shape[self.dim] if self.length == -1 else self.offset + self.length
+        idx = [slice(None)] * x.ndim
+        idx[self.dim] = slice(self.offset, end)
+        return x[tuple(idx)]
+
+
+class Contiguous(Module):
+    """No-op in XLA (reference: ``Contiguous.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        return x
+
+
+class Padding(Module):
+    """Pad ``pad`` entries (negative = before, positive = after) along dim
+    with ``value`` (reference: ``Padding.scala``)."""
+
+    def __init__(self, dim: int, pad: int, value: float = 0.0):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+
+    def forward(self, ctx: Context, x):
+        widths = [(0, 0)] * x.ndim
+        widths[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class Replicate(Module):
+    """Insert a new dim of size n_features at ``dim`` by replication
+    (reference: ``Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 0):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def forward(self, ctx: Context, x):
+        return jnp.repeat(jnp.expand_dims(x, self.dim), self.n_features, axis=self.dim)
+
+
+class _Reduce(Module):
+    def __init__(self, dimension: int = 0, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+
+class Mean(_Reduce):
+    def forward(self, ctx: Context, x):
+        return x.mean(axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Sum(_Reduce):
+    def forward(self, ctx: Context, x):
+        return x.sum(axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Max(_Reduce):
+    def forward(self, ctx: Context, x):
+        return x.max(axis=self.dimension, keepdims=not self.squeeze)
+
+
+class Min(_Reduce):
+    def forward(self, ctx: Context, x):
+        return x.min(axis=self.dimension, keepdims=not self.squeeze)

@@ -1,0 +1,134 @@
+"""Table (tuple) arithmetic and glue layers.
+
+Reference: ``DL/nn/CAddTable.scala`` and friends (CSubTable, CMulTable,
+CDivTable, CMaxTable, CMinTable, CAveTable), ``JoinTable.scala``,
+``SelectTable.scala``, ``SplitTable.scala``, ``FlattenTable.scala``,
+``DotProduct.scala``, ``MixtureTable.scala``, ``CosineDistance.scala``.
+Inputs are tuples of arrays (the ``Table`` Activity).
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class CAddTable(Module):
+    def forward(self, ctx: Context, x):
+        return reduce(jnp.add, x)
+
+
+class CSubTable(Module):
+    def forward(self, ctx: Context, x):
+        return x[0] - x[1]
+
+
+class CMulTable(Module):
+    def forward(self, ctx: Context, x):
+        return reduce(jnp.multiply, x)
+
+
+class CDivTable(Module):
+    def forward(self, ctx: Context, x):
+        return x[0] / x[1]
+
+
+class CMaxTable(Module):
+    def forward(self, ctx: Context, x):
+        return reduce(jnp.maximum, x)
+
+
+class CMinTable(Module):
+    def forward(self, ctx: Context, x):
+        return reduce(jnp.minimum, x)
+
+
+class CAveTable(Module):
+    def forward(self, ctx: Context, x):
+        return reduce(jnp.add, x) / len(x)
+
+
+class JoinTable(Module):
+    """Concatenate table elements along ``dimension`` (0-indexed over the
+    batched shape; reference: ``JoinTable.scala``)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, ctx: Context, x):
+        return jnp.concatenate(list(x), axis=self.dimension)
+
+
+class SelectTable(Module):
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def forward(self, ctx: Context, x):
+        return x[self.index]
+
+
+class SplitTable(Module):
+    """Split a tensor into a table along ``dimension``
+    (reference: ``SplitTable.scala``)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, ctx: Context, x):
+        n = x.shape[self.dimension]
+        return tuple(jnp.take(x, i, axis=self.dimension) for i in range(n))
+
+
+class FlattenTable(Module):
+    def forward(self, ctx: Context, x):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for e in t:
+                    rec(e)
+            else:
+                out.append(t)
+
+        rec(x)
+        return tuple(out)
+
+
+class DotProduct(Module):
+    """Row-wise dot product of two batched inputs (reference:
+    ``DotProduct.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        a, b = x
+        return jnp.sum(a * b, axis=-1)
+
+
+class MixtureTable(Module):
+    """Weighted sum of expert outputs by a gater (reference:
+    ``MixtureTable.scala``): input = (gates (B,E), experts table of (B,...))."""
+
+    def forward(self, ctx: Context, x):
+        gates, experts = x
+        stacked = jnp.stack(list(experts), axis=1)  # (B, E, ...)
+        g = gates.reshape(gates.shape + (1,) * (stacked.ndim - 2))
+        return jnp.sum(stacked * g, axis=1)
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity (reference: ``CosineDistance.scala``)."""
+
+    def __init__(self, eps: float = 1e-12):
+        super().__init__()
+        self.eps = eps
+
+    def forward(self, ctx: Context, x):
+        a, b = x
+        na = jnp.linalg.norm(a, axis=-1)
+        nb = jnp.linalg.norm(b, axis=-1)
+        return jnp.sum(a * b, axis=-1) / jnp.maximum(na * nb, self.eps)

@@ -1,0 +1,269 @@
+"""Module and Criterion core.
+
+TPU-native redesign of the reference's ``AbstractModule``
+(``DL/nn/abstractnn/AbstractModule.scala:59``). The reference's modules are
+mutable objects holding weight/gradWeight tensors, with hand-written
+``updateOutput``/``updateGradInput``/``accGradParameters``. Here a module is
+a *static description*; its learnable parameters and mutable buffers live in
+separate pytrees so the whole model is a pure function
+
+    ``output, new_state = module.apply(params, x, state=..., training=...)``
+
+that jit/grad/vmap/pjit understand. Backward passes come from ``jax.grad`` —
+there are no hand-written gradients except where numerics demand a
+``custom_vjp`` (SURVEY.md §7 design translation table).
+
+Naming/paths: containers register children under string keys, producing
+nested params/state dicts mirroring the module tree (the analogue of the
+reference's ``getParametersTable()`` keyed by module name,
+``AbstractModule.scala:414``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+class Context:
+    """Per-apply context threading params/state subtree, training flag and RNG.
+
+    Collects state updates (e.g. BN running stats) into a shared flat dict
+    keyed by absolute module path; ``Module.apply`` merges them back into a
+    nested state tree after the (traced) forward completes.
+    """
+
+    __slots__ = ("params", "state", "training", "_rng", "path", "_updates", "_rng_count")
+
+    def __init__(self, params, state, training, rng, path=(), updates=None, rng_count=None):
+        self.params = params if params is not None else {}
+        self.state = state if state is not None else {}
+        self.training = training
+        self._rng = rng
+        self.path = path
+        self._updates = updates if updates is not None else {}
+        self._rng_count = rng_count if rng_count is not None else [0]
+
+    def child(self, name: str) -> "Context":
+        return Context(
+            self.params.get(name, {}),
+            self.state.get(name, {}),
+            self.training,
+            self._rng,
+            self.path + (name,),
+            self._updates,
+            self._rng_count,
+        )
+
+    # params / state access for leaf modules
+    def param(self, key: str):
+        try:
+            return self.params[key]
+        except (KeyError, TypeError):
+            raise KeyError(
+                f"missing parameter '{key}' at module path {'/'.join(self.path) or '<root>'}; "
+                f"did you pass the params tree returned by init()?"
+            ) from None
+
+    def get_state(self, key: str):
+        return self.state[key]
+
+    def put_state(self, key: str, value) -> None:
+        self._updates.setdefault(self.path, {})[key] = value
+
+    def rng(self) -> jax.Array:
+        """Deterministic per-path, per-call RNG stream."""
+        if self._rng is None:
+            raise ValueError(
+                "this module needs an rng (e.g. Dropout in training mode): "
+                "pass rng=... to apply()"
+            )
+        self._rng_count[0] += 1
+        key = fold_in_str(self._rng, "/".join(self.path))
+        return jax.random.fold_in(key, self._rng_count[0])
+
+    @property
+    def updates(self):
+        return self._updates
+
+
+def _merge_updates(state: State, updates: Dict[Tuple[str, ...], Dict[str, Any]]) -> State:
+    if not updates:
+        return state
+    new_state = dict(state)
+    for path, kv in updates.items():
+        node = new_state
+        for name in path:
+            child = dict(node.get(name, {}))
+            node[name] = child
+            node = child
+        node.update(kv)
+    return new_state
+
+
+class Module:
+    """Base class for all layers and containers.
+
+    Key API (mirrors the reference surface where it makes sense):
+
+    - ``init(rng) -> (params, state)`` — build parameter/buffer pytrees
+      (replaces the reference's eager ``reset()`` weight allocation).
+    - ``apply(params, x, state=None, training=False, rng=None)``
+      ``-> (output, new_state)`` — pure forward
+      (replaces ``forward``/``updateOutput``, ``AbstractModule.scala:255``).
+    - ``forward(ctx, x)`` — override point for subclasses.
+    - ``parameters(params)`` — flat (path, array) list (analogue of
+      ``AbstractModule.parameters()``, ``AbstractModule.scala:347``).
+    - ``set_name`` / ``get_name`` (``AbstractModule.scala`` setName).
+    """
+
+    def __init__(self):
+        object.__setattr__(self, "_modules", {})
+        object.__setattr__(self, "_name", None)
+
+    # -- submodule registration via attribute assignment --
+    def __setattr__(self, key: str, value: Any) -> None:
+        if isinstance(value, Module) and not key.startswith("_"):
+            self._modules[key] = value
+        object.__setattr__(self, key, value)
+
+    def add(self, module: "Module", name: Optional[str] = None) -> "Module":
+        """Register a child (containers override ordering semantics)."""
+        name = name or module.get_name() or str(len(self._modules))
+        if name in self._modules:
+            raise ValueError(f"duplicate submodule name '{name}' in {self}")
+        self._modules[name] = module
+        return self
+
+    @property
+    def modules(self) -> Dict[str, "Module"]:
+        return self._modules
+
+    # -- naming --
+    def set_name(self, name: str) -> "Module":
+        object.__setattr__(self, "_name", name)
+        return self
+
+    def get_name(self) -> Optional[str]:
+        return self._name
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._name or ''})"
+
+    # -- init --
+    def build_params(self, rng: jax.Array) -> Params:
+        """Leaf parameter construction; override in layers with weights."""
+        return {}
+
+    def build_state(self) -> State:
+        """Leaf buffer construction (e.g. BN running stats); override."""
+        return {}
+
+    def init(self, rng: jax.Array) -> Tuple[Params, State]:
+        params: Params = {}
+        state: State = {}
+        for name, m in self._modules.items():
+            p, s = m.init(fold_in_str(rng, name))
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        params.update(self.build_params(fold_in_str(rng, "~self")))
+        state.update(self.build_state())
+        return params, state
+
+    # -- forward --
+    def forward(self, ctx: Context, x):
+        raise NotImplementedError(f"{type(self).__name__}.forward")
+
+    def run_child(self, ctx: Context, name: str, x):
+        return self._modules[name].forward(ctx.child(name), x)
+
+    def apply(
+        self,
+        params: Params,
+        x,
+        state: Optional[State] = None,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+    ):
+        state = state if state is not None else {}
+        ctx = Context(params, state, training, rng)
+        out = self.forward(ctx, x)
+        return out, _merge_updates(state, ctx.updates)
+
+    def __call__(self, *nodes):
+        """Graph-building sugar: ``layer(node)`` wires this module into a
+        ``Graph`` DAG (reference: ``Node`` / ``inputs(...)`` in
+        ``DL/nn/Graph.scala``)."""
+        from bigdl_tpu.nn.graph import Node, to_node
+
+        return Node(self, [to_node(n) for n in nodes])
+
+    # -- parameter utilities --
+    def parameters(self, params: Params):
+        """Flat list of (path, leaf) pairs, path like 'conv1/weight'."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            keys = [getattr(k, "key", str(k)) for k in path]
+            out.append(("/".join(keys), leaf))
+        return out
+
+    def n_parameters(self, params: Params) -> int:
+        return sum(int(jnp.size(v)) for _, v in self.parameters(params))
+
+    # -- convenience: stateful eager mode (tests / small scripts) --
+    def init_run(self, rng: Optional[jax.Array] = None) -> "Module":
+        if rng is None:
+            from bigdl_tpu.core.rng import RandomGenerator
+
+            rng = RandomGenerator.default().next_key()
+        p, s = self.init(rng)
+        object.__setattr__(self, "_eager_params", p)
+        object.__setattr__(self, "_eager_state", s)
+        return self
+
+    def eager_forward(self, x, training: bool = False, rng=None):
+        out, new_state = self.apply(
+            self._eager_params, x, state=self._eager_state, training=training, rng=rng
+        )
+        object.__setattr__(self, "_eager_state", new_state)
+        return out
+
+
+class Criterion:
+    """Loss function base (reference: ``AbstractCriterion``).
+
+    Pure: ``loss = criterion.forward(output, target)``. Gradients of the
+    loss w.r.t. output come from ``jax.grad`` over the composed train step —
+    there is no ``backward``/``updateGradInput`` to hand-write.
+    """
+
+    size_average: bool = True
+
+    def forward(self, output, target):
+        raise NotImplementedError
+
+    def __call__(self, output, target):
+        return self.forward(output, target)
+
+
+class LambdaLayer(Module):
+    """Wrap a pure function as a parameterless module."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        super().__init__()
+        self._fn = fn
+        if name:
+            self.set_name(name)
+
+    def forward(self, ctx: Context, x):
+        return self._fn(x)

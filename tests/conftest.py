@@ -1,0 +1,34 @@
+"""Test harness: force a virtual 8-device CPU platform before JAX import.
+
+Mirrors the reference's trick of simulating multi-node behavior with Spark
+``local[N]`` masters inside specs (``DLT/optim/DistriOptimizerSpec.scala:139``)
+— here N virtual XLA host devices stand in for N TPU chips so mesh/sharding
+code paths run without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.key(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine():
+    from bigdl_tpu.core.engine import Engine
+
+    Engine.reset()
+    yield
+    Engine.reset()

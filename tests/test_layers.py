@@ -1,0 +1,260 @@
+"""Layer numerics vs torch (CPU) as the parity oracle.
+
+The reference validates layers against a live Torch process
+(``DLT/torch/TH.scala:46``); here torch (CPU build, baked into the image) is
+the oracle directly, compared against our JAX layers — same spirit, no
+subprocess. Gated with importorskip so the suite stays green without torch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1), (1, 2)])
+def test_conv2d_vs_torch(rng, stride, pad):
+    layer = nn.SpatialConvolution(3, 8, 5, 5, stride, stride, pad, pad)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(0).randn(2, 3, 12, 12).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.conv2d(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=stride,
+        padding=pad,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_groups_vs_torch(rng):
+    layer = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(1).randn(2, 4, 9, 9).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.conv2d(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+        groups=2,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_dilated_conv_vs_torch(rng):
+    layer = nn.SpatialDilatedConvolution(3, 6, 3, 3, 1, 1, 2, 2, 2, 2)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(2).randn(1, 3, 14, 14).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.conv2d(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+        padding=2,
+        dilation=2,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_conv_transpose_vs_torch(rng):
+    layer = nn.SpatialFullConvolution(4, 3, 4, 4, 2, 2, 1, 1)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(3).randn(2, 4, 7, 7).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    # torch wants (in, out, kh, kw)
+    w = np.asarray(params["weight"]).transpose(1, 0, 2, 3)
+    ref = F.conv_transpose2d(
+        torch.from_numpy(x),
+        torch.from_numpy(w),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=2,
+        padding=1,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_maxpool_vs_torch(rng, ceil_mode):
+    layer = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+    if ceil_mode:
+        layer.ceil()
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(4).randn(2, 3, 11, 11).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.max_pool2d(torch.from_numpy(x), 3, 2, 1, ceil_mode=ceil_mode)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("count_include_pad", [True, False])
+def test_avgpool_vs_torch(rng, count_include_pad):
+    layer = nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1, count_include_pad=count_include_pad)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(5).randn(2, 3, 10, 10).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.avg_pool2d(torch.from_numpy(x), 3, 2, 1, count_include_pad=count_include_pad)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_train_vs_torch(rng):
+    layer = nn.SpatialBatchNormalization(5, eps=1e-5, momentum=0.1)
+    params, state = layer.init(rng)
+    x = np.random.RandomState(6).randn(4, 5, 6, 6).astype(np.float32)
+    y, new_state = layer.apply(params, jnp.asarray(x), state=state, training=True)
+    tbn = torch.nn.BatchNorm2d(5, eps=1e-5, momentum=0.1)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.from_numpy(np.asarray(params["weight"])))
+        tbn.bias.copy_(torch.from_numpy(np.asarray(params["bias"])))
+    tbn.train()
+    ref = tbn(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_mean"]), t2n(tbn.running_mean), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_var"]), t2n(tbn.running_var), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_linear_vs_torch(rng):
+    layer = nn.Linear(7, 4)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(7).randn(3, 7).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.linear(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_activations_vs_torch(rng):
+    x = np.random.RandomState(8).randn(4, 9).astype(np.float32)
+    cases = [
+        (nn.ReLU(), F.relu),
+        (nn.Tanh(), torch.tanh),
+        (nn.Sigmoid(), torch.sigmoid),
+        (nn.ELU(), F.elu),
+        (nn.LeakyReLU(0.1), lambda t: F.leaky_relu(t, 0.1)),
+        (nn.SoftPlus(), F.softplus),
+        (nn.SoftSign(), F.softsign),
+        (nn.LogSoftMax(), lambda t: F.log_softmax(t, dim=-1)),
+        (nn.SoftMax(), lambda t: F.softmax(t, dim=-1)),
+        (nn.HardTanh(), F.hardtanh),
+    ]
+    for layer, tfn in cases:
+        params, _ = layer.init(jax.random.key(0))
+        y, _ = layer.apply(params, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(y), t2n(tfn(torch.from_numpy(x))), rtol=5e-4, atol=1e-5,
+            err_msg=type(layer).__name__,
+        )
+
+
+def test_lookup_table_vs_torch(rng):
+    layer = nn.LookupTable(10, 4)
+    params, _ = layer.init(rng)
+    idx = np.array([[1, 2], [3, 9]])
+    y, _ = layer.apply(params, jnp.asarray(idx))
+    ref = F.embedding(torch.from_numpy(idx), torch.from_numpy(np.asarray(params["weight"])))
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-6, atol=1e-6)
+
+
+def test_criterions_vs_torch(rng):
+    rs = np.random.RandomState(9)
+    logits = rs.randn(6, 5).astype(np.float32)
+    labels = rs.randint(0, 5, size=(6,))
+    tl, tt = torch.from_numpy(logits), torch.from_numpy(labels)
+
+    ce = nn.CrossEntropyCriterion().forward(jnp.asarray(logits), jnp.asarray(labels))
+    np.testing.assert_allclose(float(ce), float(F.cross_entropy(tl, tt)), rtol=1e-4)
+
+    logp = np.log(np.abs(logits) + 0.1)
+    nll = nn.ClassNLLCriterion().forward(jnp.asarray(logp), jnp.asarray(labels))
+    np.testing.assert_allclose(
+        float(nll), float(F.nll_loss(torch.from_numpy(logp), tt)), rtol=1e-5
+    )
+
+    pred = rs.randn(4, 3).astype(np.float32)
+    targ = rs.randn(4, 3).astype(np.float32)
+    mse = nn.MSECriterion().forward(jnp.asarray(pred), jnp.asarray(targ))
+    np.testing.assert_allclose(
+        float(mse), float(F.mse_loss(torch.from_numpy(pred), torch.from_numpy(targ))), rtol=1e-5
+    )
+
+    sl1 = nn.SmoothL1Criterion().forward(jnp.asarray(pred), jnp.asarray(targ))
+    np.testing.assert_allclose(
+        float(sl1),
+        float(F.smooth_l1_loss(torch.from_numpy(pred), torch.from_numpy(targ))),
+        rtol=1e-5,
+    )
+
+    prob = 1 / (1 + np.exp(-pred))
+    tgt01 = (targ > 0).astype(np.float32)
+    bce = nn.BCECriterion().forward(jnp.asarray(prob), jnp.asarray(tgt01))
+    np.testing.assert_allclose(
+        float(bce),
+        float(F.binary_cross_entropy(torch.from_numpy(prob), torch.from_numpy(tgt01))),
+        rtol=1e-4,
+    )
+
+    kld = nn.DistKLDivCriterion().forward(jnp.asarray(np.log(prob)), jnp.asarray(tgt01))
+    np.testing.assert_allclose(
+        float(kld),
+        float(F.kl_div(torch.from_numpy(np.log(prob)), torch.from_numpy(tgt01), reduction="batchmean")),
+        rtol=1e-4,
+    )
+
+
+def test_temporal_conv_vs_torch(rng):
+    layer = nn.TemporalConvolution(6, 4, 3, 1)
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(10).randn(2, 10, 6).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    # torch conv1d: (B, C, T), weight (out, in, k)
+    ref = F.conv1d(
+        torch.from_numpy(x.transpose(0, 2, 1)),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+    ).permute(0, 2, 1)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_avgpool_ceil_vs_torch(rng):
+    # regression: ceil-mode extension must shrink the divisor (torch semantics)
+    for cip in (True, False):
+        layer = nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1, count_include_pad=cip).ceil()
+        params, _ = layer.init(rng)
+        x = np.random.RandomState(11).randn(1, 1, 10, 10).astype(np.float32)
+        y, _ = layer.apply(params, jnp.asarray(x))
+        ref = F.avg_pool2d(torch.from_numpy(x), 3, 2, 1, ceil_mode=True, count_include_pad=cip)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5, atol=1e-5,
+                                   err_msg=f"count_include_pad={cip}")
+
+
+def test_time_distributed_criterion_size_average(rng):
+    # regression: inner criterion's size_average flag must be respected
+    rs = np.random.RandomState(12)
+    logits = jnp.asarray(rs.randn(2, 3, 4).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, 4, size=(2, 3)))
+    for inner_avg in (True, False):
+        crit = nn.TimeDistributedCriterion(
+            nn.CrossEntropyCriterion(size_average=inner_avg), dimension=1
+        )
+        got = float(crit.forward(logits, labels))
+        want = sum(
+            float(nn.CrossEntropyCriterion(size_average=inner_avg).forward(
+                logits[:, t], labels[:, t]))
+            for t in range(3)
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=f"inner_avg={inner_avg}")

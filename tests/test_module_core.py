@@ -1,0 +1,156 @@
+"""Module core: init/apply, containers, graph, state, rng, naming.
+
+Modeled on the reference's per-layer specs (``DLT/nn/*Spec.scala``) and
+``GraphSpec``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def test_linear_shapes_and_grad(rng):
+    layer = nn.Linear(4, 3)
+    params, state = layer.init(rng)
+    assert params["weight"].shape == (3, 4)
+    assert params["bias"].shape == (3,)
+    x = jnp.ones((2, 4))
+    y, _ = layer.apply(params, x)
+    assert y.shape == (2, 3)
+
+    def loss(p):
+        out, _ = layer.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    assert g["weight"].shape == (3, 4)
+    assert not np.allclose(np.asarray(g["weight"]), 0)
+
+
+def test_sequential_nesting(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, _ = model.init(rng)
+    assert set(params.keys()) == {"0", "2"}
+    y, _ = model.apply(params, jnp.ones((5, 4)))
+    assert y.shape == (5, 2)
+
+
+def test_custom_module_attribute_registration(rng):
+    class Block(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2)
+
+        def forward(self, ctx, x):
+            h = jax.nn.relu(self.run_child(ctx, "fc1", x))
+            return self.run_child(ctx, "fc2", h)
+
+    m = Block()
+    params, _ = m.init(jax.random.key(1))
+    assert set(params.keys()) == {"fc1", "fc2"}
+    y, _ = m.apply(params, jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+
+
+def test_graph_dag(rng):
+    inp = nn.Input()
+    a = nn.Linear(4, 4)(inp)
+    b = nn.ReLU()(a)
+    c = nn.Tanh()(a)
+    out = nn.CAddTable()(b, c)
+    g = nn.Graph(inp, out)
+    params, _ = g.init(rng)
+    y, _ = g.apply(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 4)
+
+
+def test_graph_weight_sharing(rng):
+    shared = nn.Linear(4, 4)
+    inp = nn.Input()
+    h1 = shared(inp)
+    h2 = shared(h1)
+    g = nn.Graph(inp, h2)
+    params, _ = g.init(rng)
+    # only one params subtree for the shared module
+    assert len(params) == 1
+    y, _ = g.apply(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 4)
+
+
+def test_graph_cycle_detection():
+    inp = nn.Input()
+    a = nn.ReLU()(inp)
+    a.prev.append(a)  # force a cycle
+    with pytest.raises(ValueError, match="cycle"):
+        nn.Graph(inp, a)
+
+
+def test_batchnorm_state_updates(rng):
+    bn = nn.SpatialBatchNormalization(3)
+    params, state = bn.init(rng)
+    x = jax.random.normal(jax.random.key(2), (4, 3, 5, 5)) * 2 + 1.0
+    y, new_state = bn.apply(params, x, state=state, training=True)
+    # normalized output ~ zero mean unit var per channel
+    np.testing.assert_allclose(np.asarray(y.mean(axis=(0, 2, 3))), 0.0, atol=1e-4)
+    assert not np.allclose(np.asarray(new_state["running_mean"]), 0.0)
+    # eval mode uses running stats, no update
+    y2, state2 = bn.apply(params, x, state=new_state, training=False)
+    np.testing.assert_allclose(
+        np.asarray(state2["running_mean"]), np.asarray(new_state["running_mean"])
+    )
+
+
+def test_dropout_determinism_and_eval(rng):
+    d = nn.Dropout(0.5)
+    params, state = d.init(rng)
+    x = jnp.ones((10, 10))
+    y1, _ = d.apply(params, x, training=True, rng=jax.random.key(3))
+    y2, _ = d.apply(params, x, training=True, rng=jax.random.key(3))
+    y3, _ = d.apply(params, x, training=True, rng=jax.random.key(4))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    assert not np.allclose(np.asarray(y1), np.asarray(y3))
+    # scaled: surviving entries = 1/keep
+    vals = set(np.unique(np.asarray(y1)).tolist())
+    assert vals <= {0.0, 2.0}
+    y4, _ = d.apply(params, x, training=False)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(x))
+
+
+def test_missing_param_error(rng):
+    layer = nn.Linear(4, 3)
+    with pytest.raises(KeyError, match="missing parameter"):
+        layer.apply({}, jnp.ones((1, 4)))
+
+
+def test_apply_is_jittable(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, _ = model.init(rng)
+
+    @jax.jit
+    def f(p, x):
+        y, _ = model.apply(p, x)
+        return y
+
+    y = f(params, jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+
+
+def test_init_deterministic(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    p1, _ = model.init(jax.random.key(7))
+    p2, _ = model.init(jax.random.key(7))
+    for (k1, v1), (k2, v2) in zip(model.parameters(p1), model.parameters(p2)):
+        assert k1 == k2
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+
+def test_parameters_flat_paths(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, _ = model.init(rng)
+    paths = [p for p, _ in model.parameters(params)]
+    assert "0/weight" in paths and "2/bias" in paths
+    assert model.n_parameters(params) == 4 * 8 + 8 + 8 * 2 + 2
