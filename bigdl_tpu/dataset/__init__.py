@@ -1,0 +1,17 @@
+from bigdl_tpu.dataset.sample import Sample, MiniBatch, PaddingParam
+from bigdl_tpu.dataset.transformer import (
+    Transformer,
+    ChainedTransformer,
+    FunctionTransformer,
+    SampleToMiniBatch,
+    Shuffle,
+)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet,
+    ArrayDataSet,
+    TensorDataSet,
+    TransformedDataSet,
+    DataSet,
+)
+from bigdl_tpu.dataset.prefetch import device_prefetch, device_put_batch
+from bigdl_tpu.dataset import image, datasets

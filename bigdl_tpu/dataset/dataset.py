@@ -1,0 +1,125 @@
+"""DataSet abstractions.
+
+Reference: ``DL/dataset/DataSet.scala`` — ``AbstractDataSet`` (:53) with
+``data(train)``/``size()``/``shuffle()``; ``LocalDataSet`` (:117) over
+in-memory arrays; ``DistributedDataSet`` (:171) over RDDs, cached per
+partition with an infinite shuffled-index iterator
+(``CachedDistriDataSet.data``, :262-296).
+
+TPU-native: one host feeds its local chips, so ``ArrayDataSet`` plays both
+roles — ``data(train=True)`` is an infinite shuffled-epoch iterator exactly
+like the reference's, and sharding across chips happens at the device-put
+boundary (see ``prefetch.py``), not by partitioning the dataset object.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    def data(self, train: bool) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        pass
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # reference operator: dataset -> transformer
+    def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
+        return self.transform(transformer)
+
+
+class ArrayDataSet(AbstractDataSet):
+    """In-memory dataset of Samples or arbitrary elements
+    (reference: ``LocalArrayDataSet`` + ``CachedDistriDataSet`` semantics:
+    train iterator is infinite with per-epoch reshuffle)."""
+
+    def __init__(self, elements: Sequence[Any], rng: Optional[RandomGenerator] = None):
+        self.elements = list(elements)
+        self.rng = rng or RandomGenerator.default()
+        self._perm = np.arange(len(self.elements))
+
+    def size(self) -> int:
+        return len(self.elements)
+
+    def shuffle(self) -> None:
+        self._perm = self.rng.permutation(len(self.elements))
+
+    def data(self, train: bool) -> Iterator[Any]:
+        if not train:
+            return iter(self.elements)
+        def infinite():
+            while True:
+                self.shuffle()
+                for i in self._perm:
+                    yield self.elements[i]
+        return infinite()
+
+
+class TensorDataSet(AbstractDataSet):
+    """Dataset over pre-stacked arrays (features, labels) — avoids the
+    per-sample Python object overhead for dense fixed-shape data; slices
+    batches directly (fast path used by the vision loaders)."""
+
+    def __init__(
+        self,
+        features: np.ndarray,
+        labels: Optional[np.ndarray] = None,
+        rng: Optional[RandomGenerator] = None,
+    ):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.rng = rng or RandomGenerator.default()
+
+    def size(self) -> int:
+        return len(self.features)
+
+    def data(self, train: bool) -> Iterator[Sample]:
+        if not train:
+            for i in range(len(self.features)):
+                yield Sample(self.features[i], None if self.labels is None else self.labels[i])
+            return
+        while True:
+            perm = self.rng.permutation(len(self.features))
+            for i in perm:
+                yield Sample(self.features[i], None if self.labels is None else self.labels[i])
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def data(self, train: bool) -> Iterator[Any]:
+        return self.transformer.apply(self.base.data(train))
+
+
+class DataSet:
+    """Factory namespace (reference: object ``DataSet`` at
+    ``DataSet.scala:326`` with ``array()``/``rdd()``)."""
+
+    @staticmethod
+    def array(elements: Sequence[Any], rng: Optional[RandomGenerator] = None) -> ArrayDataSet:
+        return ArrayDataSet(elements, rng)
+
+    @staticmethod
+    def tensors(features, labels=None, rng=None) -> TensorDataSet:
+        return TensorDataSet(features, labels, rng)

@@ -1,0 +1,118 @@
+"""Built-in dataset loaders: MNIST, CIFAR-10, PTB-style text.
+
+Reference: the Python-side downloaders ``PY/dataset/{mnist,...}.py`` and
+the Scala seq-file/local loaders (``DataSet.scala:425-487``). Network
+access may be unavailable, so every loader falls back to a deterministic
+synthetic dataset of the right shape when files are absent — the same role
+the reference's ``DistriOptimizerPerf`` dummy data plays for benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+MNIST_TRAIN_MEAN = 0.13066047740239506 * 255
+MNIST_TRAIN_STD = 0.3081078 * 255
+CIFAR_MEANS = (125.3, 123.0, 113.9)
+CIFAR_STDS = (63.0, 62.1, 66.7)
+
+
+def _synthetic_images(n: int, shape, n_classes: int, seed: int):
+    rng = np.random.default_rng(seed)
+    x = (rng.standard_normal((n,) + shape) * 40 + 128).astype(np.float32)
+    y = rng.integers(0, n_classes, n).astype(np.int32)
+    # class-specific spatial templates (fixed across train/test seeds) give a
+    # clearly learnable signal so short demo/CI runs show real convergence
+    template_rng = np.random.default_rng(12345)
+    templates = template_rng.standard_normal((n_classes,) + shape).astype(np.float32) * 25.0
+    x += templates[y]
+    return x, y
+
+
+def load_mnist(
+    folder: Optional[str] = None, train: bool = True, synthetic_size: int = 2048
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Return ((N, 28, 28) float images in [0,255], (N,) int labels).
+
+    Reads idx-format files (train-images-idx3-ubyte[.gz] etc.) if present,
+    else deterministic synthetic data.
+    """
+    if folder:
+        prefix = "train" if train else "t10k"
+        for ext, op in ((".gz", gzip.open), ("", open)):
+            img_p = os.path.join(folder, f"{prefix}-images-idx3-ubyte{ext}")
+            lab_p = os.path.join(folder, f"{prefix}-labels-idx1-ubyte{ext}")
+            if os.path.exists(img_p) and os.path.exists(lab_p):
+                with op(img_p, "rb") as f:
+                    _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                    images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+                with op(lab_p, "rb") as f:
+                    struct.unpack(">II", f.read(8))
+                    labels = np.frombuffer(f.read(), np.uint8)
+                return images.astype(np.float32), labels.astype(np.int32)
+    x, y = _synthetic_images(synthetic_size, (28, 28), 10, seed=7 if train else 8)
+    return np.clip(x, 0, 255), y
+
+
+def load_cifar10(
+    folder: Optional[str] = None, train: bool = True, synthetic_size: int = 2048
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Return ((N, 3, 32, 32) float images in [0,255], (N,) int labels)."""
+    if folder and os.path.isdir(folder):
+        batch_dir = folder
+        sub = os.path.join(folder, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            batch_dir = sub
+        names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+        xs, ys = [], []
+        for name in names:
+            p = os.path.join(batch_dir, name)
+            if not os.path.exists(p):
+                xs = []
+                break
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"].reshape(-1, 3, 32, 32))
+            ys.append(np.asarray(d[b"labels"]))
+        if xs:
+            return (
+                np.concatenate(xs).astype(np.float32),
+                np.concatenate(ys).astype(np.int32),
+            )
+    x, y = _synthetic_images(synthetic_size, (3, 32, 32), 10, seed=9 if train else 10)
+    return np.clip(x, 0, 255), y
+
+
+def load_ptb(
+    folder: Optional[str] = None, split: str = "train", synthetic_tokens: int = 100_000,
+    vocab_size: int = 10_000,
+) -> np.ndarray:
+    """Return a 1-D int32 token stream (reference: ``models/rnn`` PTB data;
+    synthetic fallback is a Markov-ish stream so an LM has signal)."""
+    if folder:
+        p = os.path.join(folder, f"ptb.{split}.txt")
+        if os.path.exists(p):
+            with open(p) as f:
+                words = f.read().replace("\n", " <eos> ").split()
+            vocab_p = os.path.join(folder, "ptb.train.txt")
+            with open(vocab_p) as f:
+                train_words = f.read().replace("\n", " <eos> ").split()
+            vocab = {w: i for i, w in enumerate(sorted(set(train_words)))}
+            return np.asarray([vocab[w] for w in words if w in vocab], np.int32)
+    rng = np.random.default_rng(11 if split == "train" else 12)
+    # order-1 Markov chain over a small transition matrix → learnable structure
+    k = min(vocab_size, 1000)
+    next_tok = rng.integers(0, k, size=(k, 4))
+    stream = np.empty(synthetic_tokens, np.int32)
+    t = 0
+    for i in range(synthetic_tokens):
+        stream[i] = t
+        t = int(next_tok[t, rng.integers(0, 4)])
+    return stream

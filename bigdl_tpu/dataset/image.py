@@ -1,0 +1,121 @@
+"""Image transformers (host-side numpy).
+
+Reference: ``DL/dataset/image/`` (24 files) — ``BytesToGreyImg``,
+``GreyImgNormalizer``, ``GreyImgToSample``, ``BGRImgNormalizer``,
+``BGRImgCropper``, ``HFlip``, ``ColorJitter``, ``Lighting``,
+``RGBImgToSample``. The reference's multi-threaded batcher
+(``MTLabeledBGRImgToBatch``) is unnecessary — batches here are cheap numpy
+stacks and the heavy lifting (normalize/crop) is vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class BytesToGreyImg(Transformer):
+    """(bytes, label) -> (H, W) float image in [0, 255]
+    (reference: ``BytesToGreyImg.scala``)."""
+
+    def __init__(self, row: int, col: int):
+        self.row, self.col = row, col
+
+    def apply(self, it):
+        for raw, label in it:
+            img = np.frombuffer(raw, dtype=np.uint8).astype(np.float32)
+            yield img.reshape(self.row, self.col), label
+
+
+class GreyImgNormalizer(Transformer):
+    """(img, label) -> ((img - mean) / std, label)
+    (reference: ``GreyImgNormalizer.scala``)."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def apply(self, it):
+        for img, label in it:
+            yield (img - self.mean) / self.std, label
+
+
+class GreyImgToSample(Transformer):
+    """(img, label) -> Sample with (1, H, W) feature
+    (reference: ``GreyImgToSample.scala``)."""
+
+    def apply(self, it):
+        for img, label in it:
+            yield Sample(img[None].astype(np.float32), np.asarray(label, np.int32))
+
+
+class BGRImgNormalizer(Transformer):
+    """Per-channel normalize a (C, H, W) image
+    (reference: ``BGRImgNormalizer.scala``)."""
+
+    def __init__(self, means: Tuple[float, ...], stds: Tuple[float, ...]):
+        self.means = np.asarray(means, np.float32).reshape(-1, 1, 1)
+        self.stds = np.asarray(stds, np.float32).reshape(-1, 1, 1)
+
+    def apply(self, it):
+        for img, label in it:
+            yield (img - self.means) / self.stds, label
+
+
+class RandomCropper(Transformer):
+    """Random crop to (crop_h, crop_w), optionally padded first
+    (reference: ``BGRImgCropper.scala`` / ``BGRImgRdmCropper``)."""
+
+    def __init__(self, crop_w: int, crop_h: int, pad: int = 0,
+                 rng: Optional[RandomGenerator] = None):
+        self.crop_w, self.crop_h, self.pad = crop_w, crop_h, pad
+        self.rng = rng or RandomGenerator.default()
+
+    def apply(self, it):
+        np_rng = self.rng.numpy()
+        for img, label in it:
+            if self.pad:
+                img = np.pad(
+                    img, [(0, 0), (self.pad, self.pad), (self.pad, self.pad)], mode="constant"
+                )
+            _, h, w = img.shape
+            y = np_rng.integers(0, h - self.crop_h + 1)
+            x = np_rng.integers(0, w - self.crop_w + 1)
+            yield img[:, y : y + self.crop_h, x : x + self.crop_w], label
+
+
+class CenterCropper(Transformer):
+    def __init__(self, crop_w: int, crop_h: int):
+        self.crop_w, self.crop_h = crop_w, crop_h
+
+    def apply(self, it):
+        for img, label in it:
+            _, h, w = img.shape
+            y = (h - self.crop_h) // 2
+            x = (w - self.crop_w) // 2
+            yield img[:, y : y + self.crop_h, x : x + self.crop_w], label
+
+
+class HFlip(Transformer):
+    """Random horizontal flip (reference: ``HFlip.scala``)."""
+
+    def __init__(self, threshold: float = 0.5, rng: Optional[RandomGenerator] = None):
+        self.threshold = threshold
+        self.rng = rng or RandomGenerator.default()
+
+    def apply(self, it):
+        np_rng = self.rng.numpy()
+        for img, label in it:
+            if np_rng.random() < self.threshold:
+                img = img[..., ::-1].copy()
+            yield img, label
+
+
+class BGRImgToSample(Transformer):
+    def apply(self, it):
+        for img, label in it:
+            yield Sample(np.ascontiguousarray(img, np.float32), np.asarray(label, np.int32))

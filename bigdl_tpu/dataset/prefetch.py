@@ -1,0 +1,47 @@
+"""Host->device prefetch.
+
+The reference feeds executors from cached RDD partitions
+(``CachedDistriDataSet``); on TPU the equivalent hot path is overlapping
+host batch preparation with device compute. ``device_prefetch`` keeps
+``buffer_size`` batches in flight via ``jax.device_put`` (async dispatch),
+optionally sharding the batch over a mesh's dp axis (replacing the
+reference's per-partition locality pinning,
+``ZippedPartitionsWithLocalityRDD.scala:28``).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Iterator, Optional
+
+import jax
+
+from bigdl_tpu.dataset.sample import MiniBatch
+
+
+def device_put_batch(batch: MiniBatch, sharding=None):
+    """Move a host MiniBatch to device(s), batch-sharded if given."""
+    put = (lambda a: jax.device_put(a, sharding)) if sharding is not None else jax.device_put
+    inp = jax.tree_util.tree_map(put, batch.input)
+    tgt = None if batch.target is None else jax.tree_util.tree_map(put, batch.target)
+    return inp, tgt
+
+
+def device_prefetch(
+    batches: Iterator[MiniBatch],
+    sharding=None,
+    buffer_size: int = 2,
+):
+    """Yield (input, target) device trees, keeping a small pipeline of
+    transfers in flight ahead of compute."""
+    queue = collections.deque()
+    batches = iter(batches)
+    for batch in itertools.islice(batches, buffer_size):
+        queue.append(device_put_batch(batch, sharding))
+    while queue:
+        out = queue.popleft()
+        nxt = next(batches, None)
+        if nxt is not None:
+            queue.append(device_put_batch(nxt, sharding))
+        yield out

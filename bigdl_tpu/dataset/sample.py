@@ -1,0 +1,104 @@
+"""Sample and MiniBatch.
+
+Reference: ``DL/dataset/Sample.scala:32,138,446`` (feature+label tensor
+pack) and ``MiniBatch.scala:34,111`` (batched pack with ``slice()`` for
+per-thread splits and padding strategies :523-587). Host-side data is
+numpy; a ``MiniBatch`` converts to device arrays at the trainer boundary.
+
+The reference's per-thread ``slice()`` is unnecessary under SPMD (one
+program per chip) — sharding happens via ``jax.device_put`` with a
+NamedSharding instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Sample:
+    """One training example: feature pytree + label pytree (numpy)."""
+
+    feature: Any
+    label: Any = None
+
+    @staticmethod
+    def of(feature, label=None) -> "Sample":
+        return Sample(np.asarray(feature), None if label is None else np.asarray(label))
+
+    def feature_shape(self):
+        return np.asarray(self.feature).shape
+
+    def label_shape(self):
+        return None if self.label is None else np.asarray(self.label).shape
+
+
+class PaddingParam:
+    """Padding strategy for variable-length samples
+    (reference: ``MiniBatch.scala:523-587`` PaddingLongest/FixedLength)."""
+
+    def __init__(self, padding_value: float = 0.0, fixed_length: Optional[int] = None):
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length
+
+    def target_length(self, lengths: Sequence[int]) -> int:
+        return self.fixed_length if self.fixed_length is not None else max(lengths)
+
+
+@dataclasses.dataclass
+class MiniBatch:
+    """A batch of stacked features/labels (numpy, host)."""
+
+    input: Any
+    target: Any = None
+
+    def size(self) -> int:
+        leaf = self.input
+        while isinstance(leaf, (tuple, list, dict)):
+            leaf = list(leaf.values())[0] if isinstance(leaf, dict) else leaf[0]
+        return leaf.shape[0]
+
+    def get_input(self):
+        return self.input
+
+    def get_target(self):
+        return self.target
+
+    @staticmethod
+    def stack(
+        samples: Sequence[Sample],
+        feature_padding: Optional[PaddingParam] = None,
+        label_padding: Optional[PaddingParam] = None,
+    ) -> "MiniBatch":
+        feats = [np.asarray(s.feature) for s in samples]
+        feats = _stack_padded(feats, feature_padding)
+        labels = None
+        if samples[0].label is not None:
+            labs = [np.asarray(s.label) for s in samples]
+            labels = _stack_padded(labs, label_padding)
+        return MiniBatch(feats, labels)
+
+
+def _stack_padded(arrays, padding: Optional[PaddingParam]):
+    shapes = {a.shape for a in arrays}
+    if len(shapes) == 1 and padding is None:
+        return np.stack(arrays)
+    if padding is None:
+        raise ValueError(
+            f"samples have differing shapes {shapes}; pass a PaddingParam to pad/bucket them"
+        )
+    # pad dim 0 (sequence dim of each sample) to target length
+    lengths = [a.shape[0] for a in arrays]
+    target = padding.target_length(lengths)
+    out = []
+    for a in arrays:
+        if a.shape[0] < target:
+            widths = [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            a = np.pad(a, widths, constant_values=padding.padding_value)
+        elif a.shape[0] > target:
+            a = a[:target]
+        out.append(a)
+    return np.stack(out)

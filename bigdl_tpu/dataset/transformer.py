@@ -1,0 +1,90 @@
+"""Composable transformer chains.
+
+Reference: ``DL/dataset/Transformer.scala:44`` — a ``Transformer[A, B]``
+maps ``Iterator[A] -> Iterator[B]`` and chains with ``->``
+(``SampleToMiniBatch`` at :309). Here chaining is ``>>``::
+
+    pipeline = BytesToGreyImg(28, 28) >> GreyImgNormalizer(mean, std) >> SampleToMiniBatch(128)
+
+Each transformer is host-side (numpy) — this is the CPU input pipeline that
+feeds device prefetch, the TPU analogue of the reference's Spark-executor
+transformer chains.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
+
+
+class Transformer:
+    def apply(self, it: Iterator[Any]) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __call__(self, it):
+        return self.apply(iter(it))
+
+    def __rshift__(self, other: "Transformer") -> "Transformer":
+        return ChainedTransformer(self, other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def apply(self, it):
+        return self.second.apply(self.first.apply(it))
+
+
+class FunctionTransformer(Transformer):
+    """Wrap a per-element function."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def apply(self, it):
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group samples into MiniBatches (reference: ``SampleToMiniBatch``,
+    ``Transformer.scala:309``). ``partial_batch``: emit the trailing
+    incomplete batch (the reference drops it in training)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        feature_padding: Optional[PaddingParam] = None,
+        label_padding: Optional[PaddingParam] = None,
+        partial_batch: bool = False,
+    ):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.partial_batch = partial_batch
+
+    def apply(self, it):
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield MiniBatch.stack(buf, self.feature_padding, self.label_padding)
+                buf = []
+        if buf and self.partial_batch:
+            yield MiniBatch.stack(buf, self.feature_padding, self.label_padding)
+
+
+class Shuffle(Transformer):
+    """Full-buffer shuffle (reference: ``CachedDistriDataSet.shuffle``)."""
+
+    def __init__(self, rng: Optional[RandomGenerator] = None):
+        self.rng = rng or RandomGenerator.default()
+
+    def apply(self, it):
+        items = list(it)
+        perm = self.rng.permutation(len(items))
+        return (items[i] for i in perm)

@@ -1,0 +1,97 @@
+"""LeNet-5 on MNIST.
+
+Reference: ``DL/models/lenet/LeNet5.scala`` (Sequential, graph and
+dnnGraph variants), ``Train.scala`` (scopt CLI: batchSize, maxEpoch,
+checkpoint, optim state resume), ``Test.scala``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import bigdl_tpu.nn as nn
+
+
+def build(class_num: int = 10) -> nn.Sequential:
+    """Sequential LeNet-5 (reference: ``LeNet5.apply``)."""
+    return nn.Sequential(
+        nn.Reshape([1, 28, 28]),
+        nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([12 * 4 * 4]),
+        nn.Linear(12 * 4 * 4, 100).set_name("fc1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num).set_name("fc2"),
+        nn.LogSoftMax(),
+    )
+
+
+def build_graph(class_num: int = 10) -> nn.Graph:
+    """Graph variant (reference: ``LeNet5.graph``)."""
+    inp = nn.Input()
+    x = nn.Reshape([1, 28, 28])(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Reshape([12 * 4 * 4])(x)
+    x = nn.Linear(12 * 4 * 4, 100)(x)
+    x = nn.Tanh()(x)
+    x = nn.Linear(100, class_num)(x)
+    out = nn.LogSoftMax()(x)
+    return nn.Graph(inp, out)
+
+
+def mnist_train_pipeline(folder=None, batch_size=128, train=True):
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.datasets import (
+        MNIST_TRAIN_MEAN,
+        MNIST_TRAIN_STD,
+        load_mnist,
+    )
+
+    x, y = load_mnist(folder, train=train)
+    x = (x - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD
+    ds = DataSet.tensors(x[:, None].astype("float32"), y)
+    if train:
+        return ds >> SampleToMiniBatch(batch_size)
+    return ds
+
+
+def main(argv=None):
+    """Train CLI (reference: ``lenet/Train.scala``)."""
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger, optimizer
+
+    parser = argparse.ArgumentParser("lenet-train")
+    parser.add_argument("-f", "--folder", default=None, help="mnist dir (synthetic if absent)")
+    parser.add_argument("-b", "--batchSize", type=int, default=128)
+    parser.add_argument("-e", "--maxEpoch", type=int, default=5)
+    parser.add_argument("--learningRate", type=float, default=0.05)
+    parser.add_argument("--checkpoint", default=None)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    model = build()
+    criterion = nn.ClassNLLCriterion()
+    train_ds = mnist_train_pipeline(args.folder, args.batchSize, train=True)
+    val_ds = mnist_train_pipeline(args.folder, train=False)
+
+    opt = optimizer(model, train_ds, criterion, batch_size=args.batchSize)
+    opt.set_optim_method(SGD(learning_rate=args.learningRate, momentum=0.9))
+    opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
+    opt.set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()], args.batchSize)
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    params, state = opt.optimize()
+    return params, state
+
+
+if __name__ == "__main__":
+    main()

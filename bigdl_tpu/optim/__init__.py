@@ -1,0 +1,41 @@
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod,
+    SGD,
+    Adam,
+    ParallelAdam,
+    Adagrad,
+    Adadelta,
+    Adamax,
+    RMSprop,
+    Ftrl,
+    LarsSGD,
+)
+from bigdl_tpu.optim.schedules import (
+    LearningRateSchedule,
+    Default,
+    Step,
+    MultiStep,
+    Poly,
+    Exponential,
+    NaturalExp,
+    EpochDecay,
+    EpochStep,
+    EpochSchedule,
+    Warmup,
+    SequentialSchedule,
+    Plateau,
+)
+from bigdl_tpu.optim.trigger import Trigger, TrainingState
+from bigdl_tpu.optim.validation import (
+    ValidationMethod,
+    ValidationResult,
+    Top1Accuracy,
+    Top5Accuracy,
+    TopKAccuracy,
+    Loss,
+    HitRatio,
+    NDCG,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, optimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer

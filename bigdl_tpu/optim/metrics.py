@@ -1,0 +1,53 @@
+"""Training metrics gauges.
+
+Reference: ``DL/optim/Metrics.scala:31`` — named distributed gauges set
+each iteration in ``DistriOptimizer.optimize`` ("computing time for each
+node", "aggregate gradient time", ...), dumped via ``summary()`` (:103).
+Here there are no Spark accumulators; gauges are host-side counters (one
+process per host under SPMD), with the same names kept where they still
+make sense for log parity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scalars: Dict[str, float] = {}
+        self._aggregates: Dict[str, Tuple[float, int]] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._scalars[name] = float(value)
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            total, n = self._aggregates.get(name, (0.0, 0))
+            self._aggregates[name] = (total + float(value), n + 1)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            if name in self._scalars:
+                return self._scalars[name]
+            total, n = self._aggregates.get(name, (0.0, 0))
+            return total / max(1, n)
+
+    def summary(self, unit_scale: float = 1.0) -> str:
+        """Reference: ``Metrics.summary`` (:103)."""
+        with self._lock:
+            lines = ["========== Metrics Summary =========="]
+            for k, v in self._scalars.items():
+                lines.append(f"{k} : {v * unit_scale} s")
+            for k, (total, n) in self._aggregates.items():
+                lines.append(f"{k} : {total / max(1, n) * unit_scale} (avg over {n})")
+            lines.append("=====================================")
+            return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._scalars.clear()
+            self._aggregates.clear()

@@ -1,0 +1,302 @@
+"""Optimization methods.
+
+Reference: ``DL/optim/`` — ``OptimMethod`` trait (state table +
+``optimize(feval, x)``), ``SGD.scala:39`` (momentum/nesterov/dampening/
+weightDecay + per-layer lr scales), ``Adam``, ``ParallelAdam`` (thread-
+chunked update — on TPU that role is played by sharded optimizer state, so
+``ParallelAdam`` is an alias), ``Adagrad``, ``Adadelta``, ``Adamax``,
+``RMSprop``, ``Ftrl``, ``LarsSGD`` (layer-wise trust ratio).
+
+TPU-native design: an optim method is a pure state transition
+
+    ``new_params, new_state = method.update(grads, params, state, lr_factor)``
+
+over pytrees, jit-safe, with the step counter inside the state so the whole
+update compiles into the train step. The reference mutates a flat parameter
+vector slice per PS partition (``DistriOptimizer.scala:383-390``); here
+sharding of the update is decided by the trainer's pjit shardings (ZeRO-1
+equivalence documented in the parallel tier).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
+
+tmap = jax.tree_util.tree_map
+
+
+class OptimMethod:
+    """Base. Subclasses define ``_init_buffers`` and ``_apply``."""
+
+    def __init__(self, learning_rate: float = 1e-3, schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.schedule = schedule or Default()
+
+    # -- state --
+    def init_state(self, params) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32), **self._init_buffers(params)}
+
+    def _init_buffers(self, params) -> Dict[str, Any]:
+        return {}
+
+    # -- lr --
+    def current_lr(self, state, epoch=None):
+        return self.schedule(self.learning_rate, state["step"], epoch)
+
+    # -- update --
+    def update(self, grads, params, state, epoch=None, lr_factor=1.0):
+        lr = self.current_lr(state, epoch) * lr_factor
+        new_params, buffers = self._apply(grads, params, state, lr)
+        return new_params, {**buffers, "step": state["step"] + 1}
+
+    def _apply(self, grads, params, state, lr):
+        raise NotImplementedError
+
+    # host-side metadata for checkpointing
+    def get_hyper_parameters(self) -> Dict[str, Any]:
+        return {"learning_rate": self.learning_rate, "type": type(self).__name__}
+
+
+def _l2(grads, params, weight_decay):
+    if weight_decay == 0.0:
+        return grads
+    return tmap(lambda g, p: g + weight_decay * p, grads, params)
+
+
+class SGD(OptimMethod):
+    """Reference: ``SGD.scala:39``. momentum/dampening/nesterov/weightDecay."""
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-3,
+        momentum: float = 0.0,
+        dampening: Optional[float] = None,
+        nesterov: bool = False,
+        weight_decay: float = 0.0,
+        schedule: Optional[LearningRateSchedule] = None,
+    ):
+        super().__init__(learning_rate, schedule)
+        self.momentum = momentum
+        self.dampening = 0.0 if dampening is None else dampening
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+        if nesterov and (momentum <= 0 or self.dampening != 0.0):
+            raise ValueError("nesterov momentum requires momentum > 0 and zero dampening")
+
+    def _init_buffers(self, params):
+        if self.momentum == 0.0:
+            return {}
+        return {"velocity": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        g = _l2(grads, params, self.weight_decay)
+        if self.momentum == 0.0:
+            return tmap(lambda p, gi: p - lr * gi, params, g), {}
+        def upd_v(v, gi):
+            return self.momentum * v + (1.0 - self.dampening) * gi
+        vel = tmap(upd_v, state["velocity"], g)
+        if self.nesterov:
+            step = tmap(lambda gi, v: gi + self.momentum * v, g, vel)
+        else:
+            step = vel
+        return tmap(lambda p, s: p - lr * s, params, step), {"velocity": vel}
+
+
+class Adam(OptimMethod):
+    """Reference: ``Adam.scala`` (and ``ParallelAdam.scala`` — the chunked
+    variant; chunking is replaced by sharded state under pjit)."""
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-3,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        epsilon: float = 1e-8,
+        weight_decay: float = 0.0,
+        schedule: Optional[LearningRateSchedule] = None,
+    ):
+        super().__init__(learning_rate, schedule)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+
+    def _init_buffers(self, params):
+        return {"m": tmap(jnp.zeros_like, params), "v": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        g = _l2(grads, params, self.weight_decay)
+        t = state["step"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = tmap(lambda mi, gi: b1 * mi + (1 - b1) * gi, state["m"], g)
+        v = tmap(lambda vi, gi: b2 * vi + (1 - b2) * gi * gi, state["v"], g)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        bc2 = 1 - b2 ** t.astype(jnp.float32)
+        def upd(p, mi, vi):
+            mhat = mi / bc1
+            vhat = vi / bc2
+            return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return tmap(upd, params, m, v), {"m": m, "v": v}
+
+
+ParallelAdam = Adam
+
+
+class Adagrad(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-2, weight_decay: float = 0.0,
+                 schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, schedule)
+        self.weight_decay = weight_decay
+
+    def _init_buffers(self, params):
+        return {"accum": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        g = _l2(grads, params, self.weight_decay)
+        accum = tmap(lambda a, gi: a + gi * gi, state["accum"], g)
+        new_params = tmap(
+            lambda p, gi, a: p - lr * gi / (jnp.sqrt(a) + 1e-10), params, g, accum
+        )
+        return new_params, {"accum": accum}
+
+
+class Adadelta(OptimMethod):
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__(1.0)
+        self.rho = decay_rate
+        self.epsilon = epsilon
+
+    def _init_buffers(self, params):
+        return {
+            "accum": tmap(jnp.zeros_like, params),
+            "delta_accum": tmap(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, params, state, lr):
+        rho, eps = self.rho, self.epsilon
+        accum = tmap(lambda a, g: rho * a + (1 - rho) * g * g, state["accum"], grads)
+        def step(g, a, d):
+            return g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps)
+        deltas = tmap(step, grads, accum, state["delta_accum"])
+        delta_accum = tmap(
+            lambda d, dl: rho * d + (1 - rho) * dl * dl, state["delta_accum"], deltas
+        )
+        return tmap(lambda p, d: p - lr * d, params, deltas), {
+            "accum": accum,
+            "delta_accum": delta_accum,
+        }
+
+
+class Adamax(OptimMethod):
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_buffers(self, params):
+        return {"m": tmap(jnp.zeros_like, params), "u": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        b1, b2 = self.beta1, self.beta2
+        t = (state["step"] + 1).astype(jnp.float32)
+        m = tmap(lambda mi, g: b1 * mi + (1 - b1) * g, state["m"], grads)
+        u = tmap(lambda ui, g: jnp.maximum(b2 * ui, jnp.abs(g) + self.epsilon), state["u"], grads)
+        bc = 1 - b1 ** t
+        return tmap(lambda p, mi, ui: p - lr / bc * mi / ui, params, m, u), {"m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-2, decay_rate: float = 0.99,
+                 epsilon: float = 1e-8, schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, schedule)
+        self.rho = decay_rate
+        self.epsilon = epsilon
+
+    def _init_buffers(self, params):
+        return {"accum": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        accum = tmap(lambda a, g: self.rho * a + (1 - self.rho) * g * g, state["accum"], grads)
+        new_params = tmap(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon), params, grads, accum
+        )
+        return new_params, {"accum": accum}
+
+
+class Ftrl(OptimMethod):
+    """Reference: ``Ftrl.scala`` (follow-the-regularized-leader)."""
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-3,
+        learning_rate_power: float = -0.5,
+        initial_accumulator_value: float = 0.1,
+        l1_regularization_strength: float = 0.0,
+        l2_regularization_strength: float = 0.0,
+    ):
+        super().__init__(learning_rate)
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def _init_buffers(self, params):
+        return {
+            "accum": tmap(lambda p: jnp.full_like(p, self.init_accum), params),
+            "linear": tmap(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, params, state, lr):
+        lp = self.lr_power
+        accum = tmap(lambda n, g: n + g * g, state["accum"], grads)
+        def upd_z(p, g, n, n_new, z):
+            sigma = (n_new ** -lp - n ** -lp) / lr
+            return z + g - sigma * p
+        linear = tmap(upd_z, params, grads, state["accum"], accum, state["linear"])
+        def upd_p(p, n_new, z_new):
+            quad = n_new ** -lp / lr + 2 * self.l2
+            return jnp.where(
+                jnp.abs(z_new) > self.l1,
+                -(z_new - jnp.sign(z_new) * self.l1) / quad,
+                jnp.zeros_like(p),
+            )
+        p_new = tmap(upd_p, params, accum, linear)
+        return p_new, {"accum": accum, "linear": linear}
+
+
+class LarsSGD(OptimMethod):
+    """LARS: layer-wise adaptive rate scaling (reference: ``LarsSGD.scala:47``
+    — per-module trust ratio ||w|| / (||g|| + wd*||w||)). Applied per leaf
+    of the params pytree, which matches per-layer granularity."""
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-2,
+        momentum: float = 0.9,
+        weight_decay: float = 5e-4,
+        trust_coefficient: float = 0.001,
+        schedule: Optional[LearningRateSchedule] = None,
+    ):
+        super().__init__(learning_rate, schedule)
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.trust = trust_coefficient
+
+    def _init_buffers(self, params):
+        return {"velocity": tmap(jnp.zeros_like, params)}
+
+    def _apply(self, grads, params, state, lr):
+        def upd_v(p, g, v):
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+            denom = g_norm + self.weight_decay * w_norm
+            ratio = jnp.where(
+                (w_norm > 0) & (denom > 0), self.trust * w_norm / denom, 1.0
+            )
+            scaled = ratio * (g + self.weight_decay * p)
+            return self.momentum * v + lr * scaled
+        vel = tmap(upd_v, params, grads, state["velocity"])
+        return tmap(lambda p, v: p - v, params, vel), {"velocity": vel}

@@ -1,0 +1,227 @@
+"""Learning-rate schedules.
+
+Reference: the ``LearningRateSchedule`` family inside ``DL/optim/SGD.scala:200``
+(EpochSchedule, Poly, Step, MultiStep, EpochDecay, EpochStep, NaturalExp,
+Exponential, Plateau :544, Warmup :599, SequentialSchedule :623,
+EpochDecayWithWarmUp :671). Schedules here are pure functions of the global
+step (and optionally epoch), returning the learning rate — jit-safe via
+``jnp`` math so they can live inside the compiled train step.
+
+The ResNet-50 recipe needs Warmup + Poly/MultiStep (SURVEY.md §7 phase 3).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class LearningRateSchedule:
+    """lr = schedule(base_lr, step, epoch). ``step`` may be a traced array."""
+
+    def __call__(self, base_lr, step, epoch=None):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """Constant (reference SGD's default when no schedule given)."""
+
+    def __call__(self, base_lr, step, epoch=None):
+        return base_lr
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(step / step_size)) (reference: ``SGD.Step``)."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, base_lr, step, epoch=None):
+        return base_lr * self.gamma ** jnp.floor(step / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """Decay by gamma at each milestone step (reference: ``SGD.MultiStep``)."""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float = 0.1):
+        self.step_sizes = tuple(step_sizes)
+        self.gamma = gamma
+
+    def __call__(self, base_lr, step, epoch=None):
+        milestones = jnp.asarray(self.step_sizes)
+        n = jnp.sum(step >= milestones)
+        return base_lr * self.gamma ** n
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/max_steps)^power (reference: ``SGD.Poly`` — the
+    ResNet-50 ImageNet recipe uses power=2)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def __call__(self, base_lr, step, epoch=None):
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(step / decay_steps), optionally staircased
+    (reference: ``SGD.Exponential``)."""
+
+    def __init__(self, decay_step: int, decay_rate: float, staircase: bool = False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def __call__(self, base_lr, step, epoch=None):
+        p = step / self.decay_step
+        if self.staircase:
+            p = jnp.floor(p)
+        return base_lr * self.decay_rate ** p
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(step/decay_step)) (reference: ``SGD.NaturalExp``)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step = decay_step
+        self.gamma = gamma
+
+    def __call__(self, base_lr, step, epoch=None):
+        return base_lr * jnp.exp(-self.gamma * jnp.floor(step / self.decay_step))
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^floor(epoch / decay_epoch) (reference: ``SGD.EpochDecay``)."""
+
+    def __init__(self, decay_epoch: int = 100):
+        self.decay_epoch = decay_epoch
+
+    def __call__(self, base_lr, step, epoch=None):
+        e = 0 if epoch is None else epoch
+        return base_lr * 0.1 ** jnp.floor(e / self.decay_epoch)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^floor(epoch / step_size) (reference: ``SGD.EpochStep``)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, base_lr, step, epoch=None):
+        e = 0 if epoch is None else epoch
+        return base_lr * self.gamma ** jnp.floor(e / self.step_size)
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-constant lr by epoch regime (reference: ``SGD.EpochSchedule``
+    with ``Regime(startEpoch, endEpoch, config)``)."""
+
+    def __init__(self, regimes: Sequence[Tuple[int, int, float]]):
+        # [(start_epoch, end_epoch, lr)]
+        self.regimes = list(regimes)
+
+    def __call__(self, base_lr, step, epoch=None):
+        e = 0 if epoch is None else epoch
+        lr = base_lr
+        for start, end, r in self.regimes:
+            lr = jnp.where((e >= start) & (e <= end), r, lr)
+        return lr
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp base_lr -> base_lr + delta*step over warmup steps
+    (reference: ``SGD.Warmup`` — used in the large-batch ResNet recipe).
+    Typically wrapped in a SequentialSchedule."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def __call__(self, base_lr, step, epoch=None):
+        return base_lr + self.delta * step
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for a given number of steps
+    (reference: ``SGD.SequentialSchedule``)."""
+
+    def __init__(self):
+        self.schedules: List[Tuple[LearningRateSchedule, Optional[int]]] = []
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: Optional[int] = None):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def __call__(self, base_lr, step, epoch=None):
+        lr = base_lr
+        offset = 0
+        result = None
+        for schedule, max_it in self.schedules:
+            local = step - offset
+            val = schedule(base_lr, jnp.maximum(local, 0), epoch)
+            if result is None:
+                result = val
+            else:
+                result = jnp.where(step >= offset, val, result)
+            if max_it is not None:
+                offset += max_it
+        return result if result is not None else lr
+
+
+class Plateau:
+    """Reduce-on-plateau (reference: ``SGD.Plateau`` at ``SGD.scala:544``).
+
+    Stateful and metric-driven, so it runs host-side between epochs (not
+    inside jit): call ``update(metric)`` and read ``.lr_factor``.
+    """
+
+    def __init__(
+        self,
+        monitor: str = "score",
+        factor: float = 0.1,
+        patience: int = 10,
+        mode: str = "min",
+        epsilon: float = 1e-4,
+        cooldown: int = 0,
+        min_lr: float = 0.0,
+    ):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.lr_factor = 1.0
+        self._best = math.inf if mode == "min" else -math.inf
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def better(self, a, b):
+        return a < b - self.epsilon if self.mode == "min" else a > b + self.epsilon
+
+    def update(self, metric: float, base_lr: float = 1.0) -> float:
+        """Advance with a new monitored value; returns the multiplier to
+        apply to ``base_lr``. ``min_lr`` floors the resulting learning rate
+        itself (reference semantics), i.e. the factor never drops below
+        ``min_lr / base_lr``."""
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        if self.better(metric, self._best):
+            self._best = metric
+            self._wait = 0
+        elif self._cooldown_left <= 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                floor = self.min_lr / base_lr if base_lr > 0 else 0.0
+                self.lr_factor = max(self.lr_factor * self.factor, floor)
+                self._cooldown_left = self.cooldown
+                self._wait = 0
+        return self.lr_factor

@@ -1,0 +1,123 @@
+"""Triggers driving epochs/validation/checkpoints.
+
+Reference: ``DL/optim/Trigger.scala:27`` — everyEpoch, severalIteration,
+maxEpoch, maxIteration, maxScore, minLoss, and/or composition. A trigger is
+a host-side predicate over the training ``TrainingState`` (driver state in
+the reference's ``DistriOptimizer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainingState:
+    """Host-side driver state (reference: the ``driverState`` Table in
+    ``DistriOptimizer.optimize``)."""
+
+    epoch: int = 1
+    iteration: int = 0
+    records_processed_this_epoch: int = 0
+    epoch_finished: bool = False
+    loss: float = float("inf")
+    score: float = 0.0
+
+
+class Trigger:
+    def __call__(self, state: TrainingState) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        return _EveryEpoch()
+
+    @staticmethod
+    def several_iteration(n: int) -> "Trigger":
+        return _SeveralIteration(n)
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        return _MaxEpoch(n)
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        return _MaxIteration(n)
+
+    @staticmethod
+    def max_score(s: float) -> "Trigger":
+        return _MaxScore(s)
+
+    @staticmethod
+    def min_loss(l: float) -> "Trigger":
+        return _MinLoss(l)
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return _And(triggers)
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return _Or(triggers)
+
+
+class _EveryEpoch(Trigger):
+    def __call__(self, state):
+        return state.epoch_finished
+
+
+class _SeveralIteration(Trigger):
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, state):
+        return state.iteration > 0 and state.iteration % self.n == 0
+
+
+class _MaxEpoch(Trigger):
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, state):
+        return state.epoch > self.n
+
+
+class _MaxIteration(Trigger):
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, state):
+        return state.iteration >= self.n
+
+
+class _MaxScore(Trigger):
+    def __init__(self, s: float):
+        self.s = s
+
+    def __call__(self, state):
+        return state.score >= self.s
+
+
+class _MinLoss(Trigger):
+    def __init__(self, l: float):
+        self.l = l
+
+    def __call__(self, state):
+        return state.loss <= self.l
+
+
+class _And(Trigger):
+    def __init__(self, triggers):
+        self.triggers = triggers
+
+    def __call__(self, state):
+        return all(t(state) for t in self.triggers)
+
+
+class _Or(Trigger):
+    def __init__(self, triggers):
+        self.triggers = triggers
+
+    def __call__(self, state):
+        return any(t(state) for t in self.triggers)

@@ -1,0 +1,130 @@
+"""Validation methods and results.
+
+Reference: ``DL/optim/ValidationMethod.scala`` — ``Top1Accuracy`` (:174),
+``Top5Accuracy``, ``Loss``, ``HitRatio``, ``NDCG``, ``TreeNNAccuracy``,
+plus result types with ``+`` aggregation (the reference reduces
+``ValidationResult`` across executors, ``Evaluator.scala:51``). Here the
+per-batch computation is jit-safe jnp math returning (value-sum, count)
+pairs; aggregation is plain ``+`` on results, matching the reference's
+``.reduce(_ + _)``.
+
+Deviation: labels are 0-based (see criterion.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+
+
+class ValidationResult:
+    """(sum, count) pair with + (reference: ``AccuracyResult``/``LossResult``)."""
+
+    def __init__(self, value: float, count: int, name: str = "result"):
+        self.value = float(value)
+        self.count = int(count)
+        self.name = name
+
+    def result(self):
+        return (self.value / max(1, self.count), self.count)
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        assert self.name == other.name
+        return ValidationResult(self.value + other.value, self.count + other.count, self.name)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"{self.name}: {v:.6f} (count {n})"
+
+
+class ValidationMethod:
+    """Computes a per-batch (sum, count); host wraps into ValidationResult."""
+
+    name = "method"
+
+    def batch(self, output, target):
+        """Return (value_sum, count) as jnp scalars — jit-safe."""
+        raise NotImplementedError
+
+    def __call__(self, output, target) -> ValidationResult:
+        v, n = self.batch(output, target)
+        return ValidationResult(float(v), int(n), self.name)
+
+
+class Top1Accuracy(ValidationMethod):
+    name = "Top1Accuracy"
+
+    def batch(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        t = target.astype(pred.dtype).reshape(pred.shape)
+        return jnp.sum(pred == t), t.size
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def batch(self, output, target):
+        _, top5 = jax.lax.top_k(output, 5)
+        t = target.astype(top5.dtype).reshape(top5.shape[:-1] + (1,))
+        return jnp.sum(jnp.any(top5 == t, axis=-1)), target.size
+
+
+class TopKAccuracy(ValidationMethod):
+    def __init__(self, k: int):
+        self.k = k
+        self.name = f"Top{k}Accuracy"
+
+    def batch(self, output, target):
+        _, topk = jax.lax.top_k(output, self.k)
+        t = target.astype(topk.dtype).reshape(topk.shape[:-1] + (1,))
+        return jnp.sum(jnp.any(topk == t, axis=-1)), target.size
+
+
+class Loss(ValidationMethod):
+    """Average criterion value (reference: ``Loss`` validation method)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion: Optional[Criterion] = None):
+        if criterion is None:
+            from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+
+            criterion = CrossEntropyCriterion()
+        self.criterion = criterion
+
+    def batch(self, output, target):
+        n = output.shape[0]
+        return self.criterion.forward(output, target) * n, n
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for ranking (reference: ``ValidationMethod.scala`` HitRatio):
+    output = scores over candidates, target row 0 is the positive item."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.neg = neg_num
+        self.name = f"HitRatio@{k}"
+
+    def batch(self, output, target):
+        # output (B, n_candidates) scores; positive is column 0
+        rank = jnp.sum(output > output[:, :1], axis=-1)
+        return jnp.sum(rank < self.k), output.shape[0]
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k with a single positive at column 0 (reference: ``NDCG``)."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.neg = neg_num
+        self.name = f"NDCG@{k}"
+
+    def batch(self, output, target):
+        rank = jnp.sum(output > output[:, :1], axis=-1)
+        gain = jnp.where(rank < self.k, 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0), 0.0)
+        return jnp.sum(gain), output.shape[0]

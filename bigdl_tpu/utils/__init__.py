@@ -1,0 +1,1 @@
+from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint

@@ -1,0 +1,98 @@
+"""Checkpoint save/load.
+
+Reference: ``Optimizer.setCheckpoint`` (``DL/optim/Optimizer.scala:198``),
+``AbstractOptimizer.checkpoint`` (``AbstractOptimizer.scala:205``) saving
+(a) the model and (b) each OptimMethod with its state; resume via
+``Module.load`` + ``OptimMethod.load`` (``models/lenet/Train.scala:48,65``);
+``getLatestFile`` discovery (``DistriOptimizer.scala:986``).
+
+TPU-native: a checkpoint is the (params, module-state, optim-state) pytree
+triple serialized with flax's msgpack (+ a JSON sidecar for host counters:
+epoch, iteration, records-processed — the reference's ``endEpoch``/
+``recordsProcessedThisEpoch`` state keys). Orbax-grade async/multi-host
+checkpointing can layer on later; this format is the stable core.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_numpy(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def save_checkpoint(
+    path: str,
+    tag: str,
+    params: Any,
+    module_state: Any = None,
+    optim_state: Any = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write ``<path>/<tag>.ckpt`` (+ ``.meta.json``). Returns the file path."""
+    os.makedirs(path, exist_ok=True)
+    payload = {
+        "params": _to_numpy(params),
+        "module_state": _to_numpy(module_state or {}),
+        "optim_state": _to_numpy(optim_state or {}),
+    }
+    blob = serialization.to_bytes(payload)
+    f = os.path.join(path, f"{tag}.ckpt")
+    tmp = f + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(blob)
+    os.replace(tmp, f)
+    meta = dict(meta or {})
+    meta.setdefault("wall_time", time.time())
+    with open(os.path.join(path, f"{tag}.meta.json"), "w") as fh:
+        json.dump(meta, fh)
+    return f
+
+
+def load_checkpoint(file: str, template: Optional[Dict[str, Any]] = None):
+    """Load a checkpoint. With a ``template`` (same-structure pytrees from a
+    fresh ``init``), leaves are restored with correct tree structure;
+    without, returns raw nested dicts."""
+    with open(file, "rb") as fh:
+        blob = fh.read()
+    target = None
+    if template is not None:
+        target = {
+            "params": template.get("params"),
+            "module_state": template.get("module_state") or {},
+            "optim_state": template.get("optim_state") or {},
+        }
+    payload = serialization.from_bytes(target, blob)
+    meta_path = file[: -len(".ckpt")] + ".meta.json"
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    return payload, meta
+
+
+def latest_checkpoint(path: str, prefix: str = "") -> Optional[str]:
+    """Newest ``*.ckpt`` by embedded iteration number then mtime
+    (reference: ``getLatestFile``)."""
+    if not os.path.isdir(path):
+        return None
+    best: Tuple[int, float, Optional[str]] = (-1, -1.0, None)
+    for name in os.listdir(path):
+        if not name.endswith(".ckpt") or not name.startswith(prefix):
+            continue
+        m = re.search(r"(\d+)", name)
+        it = int(m.group(1)) if m else 0
+        full = os.path.join(path, name)
+        key = (it, os.path.getmtime(full), full)
+        if (key[0], key[1]) > (best[0], best[1]):
+            best = key
+    return best[2]

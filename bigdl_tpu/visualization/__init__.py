@@ -1,0 +1,2 @@
+from bigdl_tpu.visualization.summary import Summary, TrainSummary, ValidationSummary
+from bigdl_tpu.visualization.events import EventWriter, read_events

@@ -1,0 +1,70 @@
+"""Training/validation summaries (TensorBoard-compatible).
+
+Reference: ``DL/visualization/Summary.scala:32`` (``addScalar``:44,
+``addHistogram``:61), ``TrainSummary.scala`` (Loss/Throughput/LearningRate
++ opt-in Parameters histograms), ``ValidationSummary.scala``; readable back
+via ``FileReader`` / ``TrainSummary.readScalar``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.visualization.events import (
+    EventWriter,
+    encode_event,
+    encode_histogram_summary,
+    encode_scalar_summary,
+    read_events,
+)
+
+
+class Summary:
+    def __init__(self, log_dir: str, app_name: str, tag_suffix: str = ""):
+        self.log_dir = os.path.join(log_dir, app_name + tag_suffix)
+        self._writer = EventWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
+        self._writer.write_event(encode_event(step, summary=encode_scalar_summary(tag, float(value))))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self._writer.write_event(
+            encode_event(step, summary=encode_histogram_summary(tag, values))
+        )
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """(step, value) series for a tag (reference: ``readScalar``)."""
+        out = []
+        for name in sorted(os.listdir(self.log_dir)):
+            if "tfevents" not in name:
+                continue
+            for _, step, scalars in read_events(os.path.join(self.log_dir, name)):
+                for t, v in scalars:
+                    if t == tag:
+                        out.append((step, v))
+        return out
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class TrainSummary(Summary):
+    """Reference: ``TrainSummary.scala`` — default scalar triggers for
+    Loss/Throughput/LearningRate; ``set_summary_trigger("Parameters", ...)``
+    opts into weight histograms."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "/train")
+        self.triggers: Dict[str, object] = {}
+
+    def set_summary_trigger(self, name: str, trigger) -> "TrainSummary":
+        self.triggers[name] = trigger
+        return self
+
+
+class ValidationSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "/validation")

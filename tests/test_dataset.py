@@ -1,0 +1,106 @@
+"""Data pipeline: transformer chains, minibatch padding, loaders, prefetch.
+
+Reference: ``DLT/dataset/*Spec.scala`` (DataSetSpec, TransformersSpec,
+MiniBatchSpec with padding strategies).
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (
+    DataSet,
+    MiniBatch,
+    PaddingParam,
+    Sample,
+    SampleToMiniBatch,
+    FunctionTransformer,
+    device_prefetch,
+)
+from bigdl_tpu.dataset.datasets import load_cifar10, load_mnist, load_ptb
+from bigdl_tpu.dataset.image import (
+    BGRImgNormalizer,
+    CenterCropper,
+    GreyImgNormalizer,
+    GreyImgToSample,
+    HFlip,
+    RandomCropper,
+)
+
+
+def test_minibatch_stack_and_size():
+    samples = [Sample.of(np.ones((3, 4)) * i, i) for i in range(5)]
+    mb = MiniBatch.stack(samples)
+    assert mb.input.shape == (5, 3, 4)
+    assert mb.target.shape == (5,)
+    assert mb.size() == 5
+
+
+def test_minibatch_padding():
+    samples = [Sample.of(np.ones((n, 2)), 0) for n in (3, 5, 2)]
+    with pytest.raises(ValueError, match="PaddingParam"):
+        MiniBatch.stack(samples)
+    mb = MiniBatch.stack(samples, feature_padding=PaddingParam(padding_value=-1))
+    assert mb.input.shape == (3, 5, 2)
+    assert mb.input[0, 3, 0] == -1  # padded region
+    mb2 = MiniBatch.stack(samples, feature_padding=PaddingParam(fixed_length=6))
+    assert mb2.input.shape == (3, 6, 2)
+
+
+def test_transformer_chain_and_batching():
+    data = [(np.full((28 * 28,), i, np.float32).tobytes(), i % 10) for i in range(10)]
+    # emulate BytesToGreyImg via FunctionTransformer on float bytes
+    to_img = FunctionTransformer(
+        lambda t: (np.frombuffer(t[0], np.float32).reshape(28, 28), t[1])
+    )
+    chain = to_img >> GreyImgNormalizer(0.0, 1.0) >> GreyImgToSample() >> SampleToMiniBatch(4)
+    batches = list(chain(iter(data)))
+    assert len(batches) == 2  # 10 // 4, partial dropped
+    assert batches[0].input.shape == (4, 1, 28, 28)
+    assert batches[0].target.shape == (4,)
+
+
+def test_dataset_train_iterator_infinite_and_shuffled():
+    ds = DataSet.tensors(np.arange(20).reshape(10, 2).astype(np.float32), np.arange(10))
+    assert ds.size() == 10
+    it = ds.data(train=True)
+    seen = [next(it).label for _ in range(25)]  # crosses epoch boundaries
+    assert len(seen) == 25
+    # eval iterator is finite and ordered
+    labels = [s.label for s in ds.data(train=False)]
+    assert labels == list(range(10))
+
+
+def test_image_transforms():
+    imgs = [(np.random.RandomState(i).rand(3, 10, 10).astype(np.float32), i) for i in range(4)]
+    out = list(BGRImgNormalizer((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))(iter(imgs)))
+    assert out[0][0].shape == (3, 10, 10)
+    out = list(RandomCropper(8, 8)(iter(imgs)))
+    assert out[0][0].shape == (3, 8, 8)
+    out = list(CenterCropper(6, 6)(iter(imgs)))
+    assert out[0][0].shape == (3, 6, 6)
+    out = list(HFlip(threshold=1.1)(iter(imgs)))  # always flip
+    np.testing.assert_allclose(out[0][0], imgs[0][0][..., ::-1])
+
+
+def test_loaders_synthetic_fallback():
+    x, y = load_mnist(None, synthetic_size=64)
+    assert x.shape == (64, 28, 28) and y.shape == (64,)
+    assert x.min() >= 0 and x.max() <= 255
+    x2, y2 = load_cifar10(None, synthetic_size=32)
+    assert x2.shape == (32, 3, 32, 32)
+    stream = load_ptb(None, synthetic_tokens=1000)
+    assert stream.shape == (1000,) and stream.dtype == np.int32
+    # deterministic
+    x3, _ = load_mnist(None, synthetic_size=64)
+    np.testing.assert_allclose(x, x3)
+
+
+def test_device_prefetch():
+    ds = DataSet.tensors(
+        np.random.RandomState(0).rand(32, 4).astype(np.float32), np.arange(32) % 3
+    )
+    batches = SampleToMiniBatch(8).apply(ds.data(train=False))
+    out = list(device_prefetch(batches, buffer_size=2))
+    assert len(out) == 4
+    x, y = out[0]
+    assert x.shape == (8, 4) and y.shape == (8,)

@@ -1,0 +1,188 @@
+"""Optim methods vs torch.optim as the oracle + schedule/trigger units.
+
+Reference test model: ``DLT/optim/*Spec.scala`` (SGDSpec, AdamSpec etc.
+optimize small quadratics / compare against stored values).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.optim as optim
+from bigdl_tpu.optim.trigger import TrainingState
+
+torch = pytest.importorskip("torch")
+
+
+def _rosenbrock_like(params):
+    # simple convex quadratic over a pytree
+    return sum(jnp.sum((p - 0.5) ** 2) for p in jax.tree_util.tree_leaves(params))
+
+
+@pytest.mark.parametrize(
+    "method",
+    [
+        optim.SGD(learning_rate=0.1),
+        optim.SGD(learning_rate=0.1, momentum=0.9),
+        optim.SGD(learning_rate=0.1, momentum=0.9, nesterov=True),
+        optim.Adam(learning_rate=0.1),
+        optim.Adagrad(learning_rate=0.5),
+        optim.Adadelta(epsilon=1e-4),  # reference default 1e-10 crawls for ages by design
+        optim.Adamax(learning_rate=0.1),
+        optim.RMSprop(learning_rate=0.05),
+        optim.Ftrl(learning_rate=0.5),
+        optim.LarsSGD(learning_rate=1.0, weight_decay=0.0, trust_coefficient=0.1),
+    ],
+    ids=lambda m: type(m).__name__ + str(id(m) % 97),
+)
+def test_methods_minimize_quadratic(method):
+    params = {"w": jnp.ones((4, 3)) * 3.0, "b": jnp.zeros((3,))}
+    state = method.init_state(params)
+    loss0 = float(_rosenbrock_like(params))
+    for _ in range(150):
+        grads = jax.grad(_rosenbrock_like)(params)
+        params, state = method.update(grads, params, state)
+    loss1 = float(_rosenbrock_like(params))
+    assert loss1 < loss0 * 0.05, f"{type(method).__name__}: {loss0} -> {loss1}"
+
+
+def _compare_with_torch(our_method, torch_opt_fn, steps=20):
+    w0 = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+
+    params = {"w": jnp.asarray(w0)}
+    state = our_method.init_state(params)
+
+    tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    topt = torch_opt_fn([tw])
+
+    target = jnp.asarray(np.linspace(-1, 1, 20).reshape(5, 4).astype(np.float32))
+    ttarget = torch.from_numpy(np.asarray(target))
+
+    for _ in range(steps):
+        grads = jax.grad(lambda p: jnp.sum((p["w"] - target) ** 2))(params)
+        params, state = our_method.update(grads, params, state)
+
+        topt.zero_grad()
+        tloss = ((tw - ttarget) ** 2).sum()
+        tloss.backward()
+        topt.step()
+
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), tw.detach().numpy(), rtol=1e-3, atol=2e-4
+    )
+
+
+def test_sgd_momentum_vs_torch():
+    _compare_with_torch(
+        optim.SGD(learning_rate=0.01, momentum=0.9),
+        lambda p: torch.optim.SGD(p, lr=0.01, momentum=0.9),
+    )
+
+
+def test_sgd_weight_decay_vs_torch():
+    _compare_with_torch(
+        optim.SGD(learning_rate=0.01, momentum=0.9, weight_decay=0.1),
+        lambda p: torch.optim.SGD(p, lr=0.01, momentum=0.9, weight_decay=0.1),
+    )
+
+
+def test_sgd_nesterov_vs_torch():
+    _compare_with_torch(
+        optim.SGD(learning_rate=0.01, momentum=0.9, nesterov=True),
+        lambda p: torch.optim.SGD(p, lr=0.01, momentum=0.9, nesterov=True),
+    )
+
+
+def test_adam_vs_torch():
+    _compare_with_torch(
+        optim.Adam(learning_rate=0.05),
+        lambda p: torch.optim.Adam(p, lr=0.05),
+    )
+
+
+def test_rmsprop_vs_torch():
+    _compare_with_torch(
+        optim.RMSprop(learning_rate=0.01, decay_rate=0.99),
+        lambda p: torch.optim.RMSprop(p, lr=0.01, alpha=0.99),
+    )
+
+
+def test_adagrad_vs_torch():
+    _compare_with_torch(
+        optim.Adagrad(learning_rate=0.1),
+        lambda p: torch.optim.Adagrad(p, lr=0.1),
+    )
+
+
+def test_schedules():
+    s = optim.Step(10, 0.5)
+    assert float(s(1.0, jnp.asarray(0))) == 1.0
+    assert float(s(1.0, jnp.asarray(10))) == 0.5
+    assert float(s(1.0, jnp.asarray(25))) == 0.25
+
+    ms = optim.MultiStep([5, 15], 0.1)
+    assert float(ms(1.0, jnp.asarray(4))) == 1.0
+    np.testing.assert_allclose(float(ms(1.0, jnp.asarray(5))), 0.1)
+    np.testing.assert_allclose(float(ms(1.0, jnp.asarray(20))), 0.01, rtol=1e-6)
+
+    poly = optim.Poly(2.0, 100)
+    np.testing.assert_allclose(float(poly(1.0, jnp.asarray(50))), 0.25)
+    np.testing.assert_allclose(float(poly(1.0, jnp.asarray(100))), 0.0)
+
+    warm = optim.SequentialSchedule().add(optim.Warmup(0.1), 5).add(optim.Default())
+    np.testing.assert_allclose(float(warm(1.0, jnp.asarray(3))), 1.3)
+    np.testing.assert_allclose(float(warm(1.0, jnp.asarray(7))), 1.0)
+
+    plateau = optim.Plateau(factor=0.5, patience=2, mode="min")
+    for metric in [1.0, 1.0, 1.0]:
+        f = plateau.update(metric)
+    assert f == 0.5  # no improvement for patience=2 → decay
+
+
+def test_schedule_inside_sgd():
+    method = optim.SGD(learning_rate=1.0, schedule=optim.Step(5, 0.1))
+    params = {"w": jnp.zeros(())}
+    state = method.init_state(params)
+    for i in range(6):
+        lr = float(method.current_lr(state))
+        expect = 1.0 if i < 5 else 0.1
+        np.testing.assert_allclose(lr, expect)
+        params, state = method.update({"w": jnp.ones(())}, params, state)
+
+
+def test_triggers():
+    t = optim.Trigger.every_epoch()
+    st = TrainingState(epoch=1, epoch_finished=False)
+    assert not t(st)
+    st.epoch_finished = True
+    assert t(st)
+
+    t2 = optim.Trigger.several_iteration(3)
+    st.iteration = 6
+    assert t2(st)
+    st.iteration = 7
+    assert not t2(st)
+
+    t3 = optim.Trigger.and_(optim.Trigger.max_iteration(5), optim.Trigger.min_loss(0.1))
+    st.iteration = 6
+    st.loss = 0.05
+    assert t3(st)
+    st.loss = 0.5
+    assert not t3(st)
+
+
+def test_validation_methods():
+    out = jnp.asarray(
+        [[0.1, 0.5, 0.2, 0.1, 0.05, 0.05], [0.6, 0.1, 0.1, 0.1, 0.05, 0.05]]
+    )
+    target = jnp.asarray([1, 2])
+    top1 = optim.Top1Accuracy()
+    v, n = top1.batch(out, target)
+    assert (int(v), int(n)) == (1, 2)
+    top5 = optim.Top5Accuracy()
+    v, n = top5.batch(out, target)
+    assert (int(v), int(n)) == (2, 2)
+    r1 = optim.ValidationResult(1.0, 2, "Top1Accuracy")
+    r2 = optim.ValidationResult(3.0, 4, "Top1Accuracy")
+    assert (r1 + r2).result() == (4.0 / 6.0, 6)
