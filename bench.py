@@ -1,0 +1,101 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Mirrors the reference's perf harnesses (`DistriOptimizerPerf` /
+`LocalOptimizerPerf`, ``DL/models/utils/DistriOptimizerPerf.scala:82`` —
+dummy-data throughput, canonical metric the driver "Throughput is N
+records/second" line, ``DistriOptimizer.scala:410-417``).
+
+Runs a full jitted train step (fwd + bwd + SGD update, bf16 compute /
+fp32 master) on dummy data and reports images/sec on the available
+device(s). ``vs_baseline`` is measured against the north-star target of
+3000 images/sec/chip (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from bigdl_tpu.core.config import DtypePolicy, EngineConfig
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    batch = 256 if on_tpu else 16
+    model = resnet.build_imagenet(50, 1000)
+    criterion = CrossEntropyCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    # bf16 compute / fp32 master on TPU; plain fp32 on the CPU fallback
+    # (bf16 is emulated and pathologically slow on CPU)
+    policy = DtypePolicy.mixed() if on_tpu else DtypePolicy.full_precision()
+    dtypes = EngineConfig(dtypes=policy).dtypes
+
+    rng = jax.random.key(0)
+    params, mstate = model.init(rng)
+    ostate = method.init_state(params)
+
+    def step(params, mstate, ostate, x, y):
+        def loss_fn(p):
+            out, new_ms = model.apply(p, dtypes.cast_compute(x), state=mstate, training=True)
+            return criterion.forward(out.astype(jnp.float32), y), new_ms
+
+        (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_os = method.update(grads, params, ostate, jnp.int32(1))
+        return new_p, new_ms, new_os, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1, 2))
+    x = jnp.asarray(np.random.rand(batch, 3, 224, 224), dtypes.compute_dtype)
+    y = jnp.asarray(np.random.randint(0, 1000, (batch,)), jnp.int32)
+
+    # warmup / compile
+    params, mstate, ostate, loss = step(params, mstate, ostate, x, y)
+    jax.block_until_ready((params, loss))
+
+    n_iters = 50 if on_tpu else 3
+    best = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            params, mstate, ostate, loss = step(params, mstate, ostate, x, y)
+        jax.block_until_ready((params, mstate, ostate, loss))
+        best = min(best, time.perf_counter() - t0)
+    dt = best
+
+    # single-device step (no sharding annotations) -> per-chip == total
+    imgs_per_sec = n_iters * batch / dt
+    per_chip = imgs_per_sec
+
+    # MFU: ResNet-50 fwd ~4.09 GFLOP/img @224; train step ~3x fwd.
+    step_flops_per_img = 3 * 4.089e9
+    peak = {
+        # bf16 peak FLOP/s per chip by TPU generation
+        "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+    }
+    kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
+    peak_flops = next((v for k, v in peak.items() if k in kind), 197e12)
+    mfu = per_chip * step_flops_per_img / peak_flops if on_tpu else float("nan")
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / 3000.0, 4),
+        "batch": batch,
+        "iters": n_iters,
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "mfu": None if mfu != mfu else round(mfu, 4),
+        "loss": float(loss),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""MNIST fully-connected autoencoder.
+
+Reference: ``DL/models/autoencoder/Autoencoder.scala`` (784-32-784 MLP
+with sigmoid output trained with MSE, ``Train.scala`` uses Adagrad).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build(class_num: int = 32) -> nn.Sequential:
+    """``class_num`` is the bottleneck width, matching the reference's
+    (mis)use of the name (``Autoencoder.scala:30``)."""
+    return nn.Sequential(
+        nn.Reshape([784]),
+        nn.Linear(784, class_num),
+        nn.ReLU(),
+        nn.Linear(class_num, 784),
+        nn.Sigmoid(),
+    )

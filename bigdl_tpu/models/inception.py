@@ -1,0 +1,73 @@
+"""Inception v1 (GoogLeNet).
+
+Reference: ``DL/models/inception/Inception_v1.scala`` (graph builders,
+1,208 LoC) — inception modules as a 4-tower ``Concat`` (1x1 / 1x1-3x3 /
+1x1-5x5 / pool-1x1). This builds the no-aux-head variant
+(``Inception_v1_NoAuxClassifier.apply``); the aux-classifier training
+heads are a later addition alongside the multi-loss training recipe.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.init import Xavier
+
+
+def _conv(cin, cout, k, stride=1, pad=0, name=""):
+    seq = nn.Sequential(
+        nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                              weight_init=Xavier()).set_name(name + "_conv"),
+        nn.ReLU(),
+    )
+    return seq
+
+
+def inception_module(cin: int, config, name: str = "") -> nn.Concat:
+    """``config`` = [[c1x1], [c3x3r, c3x3], [c5x5r, c5x5], [pool_proj]]
+    (reference ``Inception_v1.scala`` ``inception`` function)."""
+    (c1,), (c3r, c3), (c5r, c5), (cp,) = config
+    return nn.Concat(
+        1,
+        _conv(cin, c1, 1, name=name + "1x1"),
+        nn.Sequential(
+            _conv(cin, c3r, 1, name=name + "3x3r"),
+            _conv(c3r, c3, 3, pad=1, name=name + "3x3"),
+        ),
+        nn.Sequential(
+            _conv(cin, c5r, 1, name=name + "5x5r"),
+            _conv(c5r, c5, 5, pad=2, name=name + "5x5"),
+        ),
+        nn.Sequential(
+            nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1),
+            _conv(cin, cp, 1, name=name + "pool"),
+        ),
+    )
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """Inception-v1 without aux heads (reference
+    ``Inception_v1_NoAuxClassifier.apply``)."""
+    model = nn.Sequential(
+        _conv(3, 64, 7, 2, 3, "conv1/7x7_s2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        _conv(64, 64, 1, name="conv2/3x3_reduce"),
+        _conv(64, 192, 3, pad=1, name="conv2/3x3"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+    )
+    model.add(inception_module(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
+    model.add(inception_module(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(inception_module(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
+    model.add(inception_module(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"))
+    model.add(inception_module(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"))
+    model.add(inception_module(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"))
+    model.add(inception_module(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"))
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(inception_module(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"))
+    model.add(inception_module(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"))
+    model.add(nn.GlobalAveragePooling2D())
+    if has_dropout:
+        model.add(nn.Dropout(0.4))
+    model.add(nn.Linear(1024, class_num, weight_init=Xavier()).set_name("loss3/classifier"))
+    model.add(nn.LogSoftMax())
+    return model

@@ -1,0 +1,160 @@
+"""ResNet for CIFAR-10 and ImageNet.
+
+Reference: ``DL/models/resnet/ResNet.scala`` (CIFAR + ImageNet variants,
+shortcut types A/B/C, basic vs bottleneck blocks, optimnet-style init),
+``DL/models/resnet/Train.scala`` / ``TrainImageNet.scala`` (recipes:
+warmup + multi-step / poly decay, momentum SGD, label smoothing option).
+
+TPU-native notes: residual add + BN + ReLU fuse in XLA; blocks are built
+with ``ConcatTable``/``CAddTable`` exactly like the reference's Sequential
+composition, so the params tree mirrors the reference's module tree. The
+ImageNet stem uses the 7x7/2 conv + 3x3/2 maxpool; bottleneck stride
+placement follows the reference's "v1.5" choice (stride on the 3x3,
+``ResNet.scala`` ``useConv`` path) which is also the better MXU mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.init import MsraFiller, Zeros
+
+
+def _conv(cin, cout, k, stride=1, pad=0):
+    return nn.SpatialConvolution(
+        cin, cout, k, k, stride, stride, pad, pad,
+        with_bias=False, weight_init=MsraFiller(),
+    )
+
+
+def _bn(n, zero_init=False):
+    # reference zero-inits the last BN gamma of each block when
+    # optnet/warm-up recipes are on (ResNet.scala getShortcut/iChannels)
+    return (
+        nn.SpatialBatchNormalization(n, weight_init=Zeros())
+        if zero_init
+        else nn.SpatialBatchNormalization(n)
+    )
+
+
+def shortcut(cin: int, cout: int, stride: int, shortcut_type: str = "B") -> nn.Module:
+    """Shortcut types (reference ``ResNet.scala`` ``shortcut``):
+    A = identity/zero-pad (CIFAR), B = 1x1 conv when shape changes,
+    C = always 1x1 conv."""
+    use_conv = shortcut_type == "C" or (shortcut_type == "B" and (cin != cout or stride != 1))
+    if use_conv:
+        return nn.Sequential(_conv(cin, cout, 1, stride), _bn(cout))
+    if cin != cout:
+        # type A: stride then zero-pad channels (Pad on channel dim)
+        return nn.Sequential(
+            nn.SpatialAveragePooling(1, 1, stride, stride),
+            nn.Padding(1, cout - cin),
+        )
+    return nn.Identity()
+
+
+def basic_block(cin: int, cout: int, stride: int, shortcut_type: str = "B",
+                zero_init_residual: bool = False) -> nn.Module:
+    block = nn.Sequential(
+        _conv(cin, cout, 3, stride, 1),
+        _bn(cout),
+        nn.ReLU(),
+        _conv(cout, cout, 3, 1, 1),
+        _bn(cout, zero_init=zero_init_residual),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type)),
+        nn.CAddTable(),
+        nn.ReLU(),
+    )
+
+
+def bottleneck(cin: int, planes: int, stride: int, shortcut_type: str = "B",
+               zero_init_residual: bool = False) -> nn.Module:
+    cout = planes * 4
+    block = nn.Sequential(
+        _conv(cin, planes, 1),
+        _bn(planes),
+        nn.ReLU(),
+        _conv(planes, planes, 3, stride, 1),
+        _bn(planes),
+        nn.ReLU(),
+        _conv(planes, cout, 1),
+        _bn(cout, zero_init=zero_init_residual),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type)),
+        nn.CAddTable(),
+        nn.ReLU(),
+    )
+
+
+IMAGENET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = "B",
+                   zero_init_residual: bool = True) -> nn.Sequential:
+    """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch)."""
+    if depth not in IMAGENET_CFG:
+        raise ValueError(f"unsupported imagenet resnet depth {depth}")
+    kind, counts = IMAGENET_CFG[depth]
+    block = basic_block if kind == "basic" else bottleneck
+    expansion = 1 if kind == "basic" else 4
+
+    model = nn.Sequential(
+        _conv(3, 64, 7, 2, 3).set_name("conv1"),
+        _bn(64),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+    )
+    cin = 64
+    for stage, (planes, n_blocks) in enumerate(zip([64, 128, 256, 512], counts)):
+        for i in range(n_blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            model.add(
+                block(cin, planes, stride, shortcut_type, zero_init_residual),
+                name=f"layer{stage + 1}_{i}",
+            )
+            cin = planes * expansion
+    model.add(nn.GlobalAveragePooling2D())
+    model.add(nn.Linear(cin, class_num, weight_init=MsraFiller()).set_name("fc"))
+    return model
+
+
+def build_cifar(depth: int = 20, class_num: int = 10, shortcut_type: str = "A") -> nn.Sequential:
+    """CIFAR-10 ResNet: depth = 6n+2 basic blocks (reference ``ResNet.apply``
+    CIFAR-10 branch)."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("cifar resnet depth must be 6n+2")
+    n = (depth - 2) // 6
+    model = nn.Sequential(
+        _conv(3, 16, 3, 1, 1),
+        _bn(16),
+        nn.ReLU(),
+    )
+    cin = 16
+    for stage, planes in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            model.add(
+                basic_block(cin, planes, stride, shortcut_type),
+                name=f"stage{stage + 1}_{i}",
+            )
+            cin = planes
+    model.add(nn.GlobalAveragePooling2D())
+    model.add(nn.Linear(cin, class_num, weight_init=MsraFiller()).set_name("fc"))
+    return model
+
+
+def build(depth: int = 50, class_num: int = 1000, dataset: str = "imagenet",
+          shortcut_type: Optional[str] = None) -> nn.Sequential:
+    if dataset.lower() in ("imagenet", "i"):
+        return build_imagenet(depth, class_num, shortcut_type or "B")
+    return build_cifar(depth, class_num, shortcut_type or "A")

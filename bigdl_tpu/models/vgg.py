@@ -1,0 +1,74 @@
+"""VGG models.
+
+Reference: ``DL/models/vgg/VggForCifar10.scala`` (conv-BN-ReLU stacks with
+dropout head) and ``DL/models/vgg/Vgg_16.scala`` / ``Vgg_19``
+(plain ImageNet VGG with fc6/fc7/fc8 head, used by the Caffe-loaded
+inference benchmark config).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.init import MsraFiller
+
+VGG16_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M"]
+VGG19_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+             512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+
+
+def build_cifar(class_num: int = 10, has_dropout: bool = True) -> nn.Sequential:
+    """VGG-16-shaped CIFAR model with BN (reference ``VggForCifar10.apply``)."""
+    model = nn.Sequential()
+    cin = 3
+    for v in VGG16_CFG:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(nn.SpatialConvolution(cin, v, 3, 3, 1, 1, 1, 1,
+                                            weight_init=MsraFiller()))
+            model.add(nn.SpatialBatchNormalization(v))
+            model.add(nn.ReLU())
+            cin = v
+    model.add(nn.Reshape([512]))
+    model.add(nn.Linear(512, 512))
+    model.add(nn.BatchNormalization(512))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(512, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def _vgg_imagenet(cfg, class_num: int, has_dropout: bool) -> nn.Sequential:
+    model = nn.Sequential()
+    cin = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(nn.SpatialConvolution(cin, v, 3, 3, 1, 1, 1, 1))
+            model.add(nn.ReLU())
+            cin = v
+    model.add(nn.Reshape([512 * 7 * 7]))
+    model.add(nn.Linear(512 * 7 * 7, 4096).set_name("fc6"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096).set_name("fc7"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num).set_name("fc8"))
+    return model
+
+
+def build_vgg16(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """ImageNet VGG-16 (reference ``Vgg_16.scala``)."""
+    return _vgg_imagenet(VGG16_CFG, class_num, has_dropout)
+
+
+def build_vgg19(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """ImageNet VGG-19 (reference ``Vgg_19.scala``)."""
+    return _vgg_imagenet(VGG19_CFG, class_num, has_dropout)

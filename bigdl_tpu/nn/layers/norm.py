@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from bigdl_tpu.core.rng import fold_in_str
@@ -75,7 +76,7 @@ class BatchNormalization(Module):
             mean = xf.mean(axis=axes)
             var = xf.var(axis=axes)
             m = self.momentum
-            n = float(jnp.prod(jnp.asarray([x.shape[i] for i in axes])))
+            n = float(np.prod([x.shape[i] for i in axes]))
             unbiased = var * (n / max(1.0, n - 1.0))
             ctx.put_state("running_mean", (1 - m) * ctx.get_state("running_mean") + m * mean)
             ctx.put_state("running_var", (1 - m) * ctx.get_state("running_var") + m * unbiased)
