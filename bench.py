@@ -22,7 +22,7 @@ import numpy as np
 
 
 def main():
-    from bigdl_tpu.core.config import DtypePolicy, EngineConfig
+    from bigdl_tpu.core.config import DtypePolicy
     from bigdl_tpu.models import resnet
     from bigdl_tpu.nn import CrossEntropyCriterion
     from bigdl_tpu.optim.optim_method import SGD
@@ -35,8 +35,7 @@ def main():
     method = SGD(learning_rate=0.1, momentum=0.9)
     # bf16 compute / fp32 master on TPU; plain fp32 on the CPU fallback
     # (bf16 is emulated and pathologically slow on CPU)
-    policy = DtypePolicy.mixed() if on_tpu else DtypePolicy.full_precision()
-    dtypes = EngineConfig(dtypes=policy).dtypes
+    dtypes = DtypePolicy.mixed() if on_tpu else DtypePolicy.full_precision()
 
     rng = jax.random.key(0)
     params, mstate = model.init(rng)
@@ -79,9 +78,12 @@ def main():
         # bf16 peak FLOP/s per chip by TPU generation
         "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
     }
-    kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
-    peak_flops = next((v for k, v in peak.items() if k in kind), 197e12)
-    mfu = per_chip * step_flops_per_img / peak_flops if on_tpu else float("nan")
+    kind = jax.devices()[0].device_kind.lower().replace(" lite", "e") if on_tpu else ""
+    peak_flops = next((v for k, v in peak.items() if k in kind), None)
+    mfu = (
+        per_chip * step_flops_per_img / peak_flops
+        if (on_tpu and peak_flops) else float("nan")
+    )
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",

@@ -1,0 +1,42 @@
+"""PTB-style recurrent language model.
+
+Reference: ``DL/models/rnn/SimpleRNN.scala`` (LookupTable -> recurrent
+stack -> TimeDistributed Linear -> LogSoftMax over time),
+``DL/example/languagemodel/PTBModel.scala`` (the LSTM LM variant) and
+``Train.scala`` (TimeDistributedCriterion(CrossEntropy) loss).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.layers.recurrent import LSTMCell, MultiRNNCell, Recurrent, RnnCell, TimeDistributed
+
+
+def build_simple_rnn(vocab_size: int = 4000, hidden_size: int = 40,
+                     class_num: int = 4000) -> nn.Sequential:
+    """reference ``SimpleRNN.scala`` (embedding + vanilla RNN + softmax)."""
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, hidden_size),
+        Recurrent(RnnCell(hidden_size, hidden_size)),
+        TimeDistributed(nn.Linear(hidden_size, class_num)),
+        nn.LogSoftMax(),
+    )
+
+
+def build_ptb_lstm(vocab_size: int = 10000, embed_size: int = 650,
+                   hidden_size: int = 650, num_layers: int = 2,
+                   dropout: float = 0.5, class_num: int = 0) -> nn.Sequential:
+    """PTB LSTM LM (reference ``PTBModel.scala``): embedding, stacked LSTM,
+    per-timestep projection."""
+    class_num = class_num or vocab_size
+    cells = [LSTMCell(embed_size if i == 0 else hidden_size, hidden_size)
+             for i in range(num_layers)]
+    model = nn.Sequential(
+        nn.LookupTable(vocab_size, embed_size),
+        nn.Dropout(dropout),
+        Recurrent(MultiRNNCell(cells)),
+        nn.Dropout(dropout),
+        TimeDistributed(nn.Linear(hidden_size, class_num)),
+        nn.LogSoftMax(),
+    )
+    return model

@@ -33,3 +33,19 @@ from bigdl_tpu.nn.criterion import (
     TimeDistributedCriterion,
 )
 from bigdl_tpu.nn import init
+from bigdl_tpu.nn.layers.recurrent import (
+    Cell,
+    RnnCell,
+    LSTMCell,
+    LSTMPeepholeCell,
+    GRUCell,
+    ConvLSTMPeepholeCell,
+    MultiRNNCell,
+    Recurrent,
+    BiRecurrent,
+    TimeDistributed,
+    RecurrentDecoder,
+    LSTM,
+    GRU,
+    SimpleRNN,
+)

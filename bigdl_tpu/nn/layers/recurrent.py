@@ -1,0 +1,400 @@
+"""Recurrent stack: cells + scan-based containers.
+
+Reference: ``DL/nn/Recurrent.scala`` (857 LoC BPTT container cloning the
+cell per timestep), ``Cell.scala`` (abstract cell), ``RnnCell`` in
+``RNN.scala``, ``LSTM.scala``, ``LSTMPeephole.scala``, ``GRU.scala``,
+``ConvLSTMPeephole.scala``, ``MultiRNNCell.scala``, ``BiRecurrent.scala``,
+``TimeDistributed.scala``, ``RecurrentDecoder.scala``.
+
+TPU-native redesign: the reference unrolls time in Scala and clones the
+cell module per step (hidden state is mutable module state). Here a cell is
+a pure step function ``(carry, x_t) -> (carry, y_t)`` and ``Recurrent`` is
+one ``lax.scan`` — XLA compiles the whole sequence into a single fused
+loop, weights stay resident, and the backward pass is scan's transpose (no
+hand-written BPTT). Gate matmuls are packed into one ``(input_size +
+hidden, 4*hidden)``-style gemm so the MXU sees few large matmuls instead
+of many small ones.
+
+Layout: inputs are (batch, time, feature) — the reference's default
+``batchNormParams == null`` NCHW-ish (B, T, D) layout. Internally scan runs
+over a (time, batch, feature) transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, RandomUniform, Xavier, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Cell(Module):
+    """Recurrent cell base (reference: ``Cell.scala``).
+
+    Subclasses define ``build_params``, ``init_carry(batch) -> carry`` and
+    ``step(ctx, carry, x) -> (new_carry, output)``. Cells are also usable
+    as plain modules on a single timestep input (carry defaults to zeros).
+    """
+
+    hidden_size: int
+
+    def init_carry(self, batch: int, dtype=jnp.float32, input_shape=None):
+        """Zero carry. ``input_shape`` is the per-timestep input shape
+        (without batch), needed by conv cells to size spatial state."""
+        raise NotImplementedError
+
+    def step(self, ctx: Context, carry, x):
+        raise NotImplementedError
+
+    def forward(self, ctx: Context, x):
+        carry = self.init_carry(x.shape[0], x.dtype, x.shape[1:])
+        _, y = self.step(ctx, carry, x)
+        return y
+
+
+def _uniform_std(hidden_size: float) -> RandomUniform:
+    bound = 1.0 / (hidden_size ** 0.5)
+    return RandomUniform(-bound, bound)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: ``act(W x + U h + b)`` (reference ``RNN.scala``)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation: str = "tanh",
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
+        self.weight_init = weight_init or _uniform_std(hidden_size)
+
+    def build_params(self, rng):
+        i, h = self.input_size, self.hidden_size
+        init = self.weight_init
+        return {
+            "weight": init(fold_in_str(rng, "w"), (i + h, h), i + h, h),
+            "bias": init(fold_in_str(rng, "b"), (h,), i + h, h),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, ctx: Context, carry, x):
+        w = ctx.param("weight").astype(x.dtype)
+        b = ctx.param("bias").astype(x.dtype)
+        h = self.activation(jnp.concatenate([x, carry], axis=-1) @ w + b)
+        return h, h
+
+
+class LSTMCell(Cell):
+    """LSTM (reference ``LSTM.scala``): gates packed into ONE gemm of
+    shape (input+hidden, 4*hidden); gate order i, f, g, o."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 forget_bias: float = 0.0,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self.weight_init = weight_init or _uniform_std(hidden_size)
+
+    def build_params(self, rng):
+        i, h = self.input_size, self.hidden_size
+        init = self.weight_init
+        b = init(fold_in_str(rng, "b"), (4 * h,), i + h, h)
+        if self.forget_bias:
+            b = b.at[h:2 * h].add(self.forget_bias)
+        return {
+            "weight": init(fold_in_str(rng, "w"), (i + h, 4 * h), i + h, h),
+            "bias": b,
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        return (
+            jnp.zeros((batch, self.hidden_size), dtype),  # h
+            jnp.zeros((batch, self.hidden_size), dtype),  # c
+        )
+
+    def step(self, ctx: Context, carry, x):
+        h_prev, c_prev = carry
+        w = ctx.param("weight").astype(x.dtype)
+        b = ctx.param("bias").astype(x.dtype)
+        z = jnp.concatenate([x, h_prev], axis=-1) @ w + b
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class LSTMPeepholeCell(LSTMCell):
+    """LSTM with peephole connections from the cell state into i/f/o
+    (reference ``LSTMPeephole.scala``)."""
+
+    def build_params(self, rng):
+        p = super().build_params(rng)
+        h = self.hidden_size
+        init = self.weight_init
+        p["peep_i"] = init(fold_in_str(rng, "pi"), (h,), h, h)
+        p["peep_f"] = init(fold_in_str(rng, "pf"), (h,), h, h)
+        p["peep_o"] = init(fold_in_str(rng, "po"), (h,), h, h)
+        return p
+
+    def step(self, ctx: Context, carry, x):
+        h_prev, c_prev = carry
+        w = ctx.param("weight").astype(x.dtype)
+        b = ctx.param("bias").astype(x.dtype)
+        z = jnp.concatenate([x, h_prev], axis=-1) @ w + b
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i + c_prev * ctx.param("peep_i").astype(x.dtype))
+        f = jax.nn.sigmoid(f + c_prev * ctx.param("peep_f").astype(x.dtype))
+        c = f * c_prev + i * jnp.tanh(g)
+        o = jax.nn.sigmoid(o + c * ctx.param("peep_o").astype(x.dtype))
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+
+class GRUCell(Cell):
+    """GRU (reference ``GRU.scala``): r/z packed into one gemm; candidate
+    uses torch convention ``n = tanh(W_n x + r * (U_n h + b_hn))``."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_init = weight_init or _uniform_std(hidden_size)
+
+    def build_params(self, rng):
+        i, h = self.input_size, self.hidden_size
+        init = self.weight_init
+        return {
+            "weight_rz": init(fold_in_str(rng, "wrz"), (i + h, 2 * h), i + h, h),
+            "bias_rz": init(fold_in_str(rng, "brz"), (2 * h,), i + h, h),
+            "weight_in": init(fold_in_str(rng, "wn"), (i, h), i, h),
+            "bias_in": init(fold_in_str(rng, "bin"), (h,), i, h),
+            "weight_hn": init(fold_in_str(rng, "un"), (h, h), h, h),
+            "bias_hn": init(fold_in_str(rng, "bhn"), (h,), h, h),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, ctx: Context, carry, x):
+        dt = x.dtype
+        rz = jnp.concatenate([x, carry], axis=-1) @ ctx.param("weight_rz").astype(dt) \
+            + ctx.param("bias_rz").astype(dt)
+        r, z = jnp.split(jax.nn.sigmoid(rz), 2, axis=-1)
+        n = jnp.tanh(
+            x @ ctx.param("weight_in").astype(dt) + ctx.param("bias_in").astype(dt)
+            + r * (carry @ ctx.param("weight_hn").astype(dt) + ctx.param("bias_hn").astype(dt))
+        )
+        h = (1.0 - z) * n + z * carry
+        return h, h
+
+
+class ConvLSTMPeepholeCell(Cell):
+    """2-D convolutional LSTM with peepholes (reference
+    ``ConvLSTMPeephole.scala``). State is (batch, channels, H, W); the
+    gate convs are packed into one conv producing 4*out channels."""
+
+    def __init__(self, input_size: int, output_size: int, kernel: int = 3,
+                 stride: int = 1, with_peephole: bool = True,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        assert stride == 1, "ConvLSTM state must keep spatial dims (stride 1)"
+        self.input_size = input_size
+        self.hidden_size = output_size
+        self.kernel = kernel
+        self.with_peephole = with_peephole
+        self.weight_init = weight_init or Xavier()
+
+    def build_params(self, rng):
+        k, cin, cout = self.kernel, self.input_size, self.hidden_size
+        fan_in = (cin + cout) * k * k
+        fan_out = 4 * cout * k * k
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "w"), (4 * cout, cin + cout, k, k), fan_in, fan_out
+            ),
+            "bias": Zeros()(fold_in_str(rng, "b"), (4 * cout,), fan_in, fan_out),
+        }
+        if self.with_peephole:
+            p["peep_i"] = Zeros()(fold_in_str(rng, "pi"), (cout,), cout, cout)
+            p["peep_f"] = Zeros()(fold_in_str(rng, "pf"), (cout,), cout, cout)
+            p["peep_o"] = Zeros()(fold_in_str(rng, "po"), (cout,), cout, cout)
+        return p
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        assert input_shape is not None and len(input_shape) == 3, (
+            "ConvLSTM needs the (C, H, W) per-step input shape to size its state"
+        )
+        shape = (batch, self.hidden_size) + tuple(input_shape[-2:])
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def step(self, ctx: Context, carry, x):
+        h_prev, c_prev = carry
+        w = ctx.param("weight").astype(x.dtype)
+        b = ctx.param("bias").astype(x.dtype)
+        pad = self.kernel // 2
+        z = lax.conv_general_dilated(
+            jnp.concatenate([x, h_prev], axis=1), w, (1, 1),
+            [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        ) + b[None, :, None, None]
+        i, f, g, o = jnp.split(z, 4, axis=1)
+
+        def peep(name):
+            return ctx.param(name).astype(x.dtype)[None, :, None, None]
+
+        if self.with_peephole:
+            i = i + peep("peep_i") * c_prev
+            f = f + peep("peep_f") * c_prev
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if self.with_peephole:
+            o = o + peep("peep_o") * c
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied at each timestep (reference
+    ``MultiRNNCell.scala``)."""
+
+    def __init__(self, cells: Sequence[Cell]):
+        super().__init__()
+        self.cells = list(cells)
+        for idx, c in enumerate(self.cells):
+            self.add(c, name=f"cell{idx}")
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        carries = []
+        shape = tuple(input_shape) if input_shape is not None else None
+        for c in self.cells:
+            carries.append(c.init_carry(batch, dtype, shape))
+            if shape is not None:
+                # next cell sees this cell's output: hidden_size features,
+                # spatial dims preserved (conv cells are stride 1)
+                shape = (c.hidden_size,) + shape[1:] if len(shape) > 1 else (c.hidden_size,)
+        return tuple(carries)
+
+    def step(self, ctx: Context, carry, x):
+        new_carry = []
+        for idx, cell in enumerate(self.cells):
+            c, x = cell.step(ctx.child(f"cell{idx}"), carry[idx], x)
+            new_carry.append(c)
+        return tuple(new_carry), x
+
+
+class Recurrent(Module):
+    """Run a cell over (batch, time, feature) via ``lax.scan`` (reference:
+    ``Recurrent.scala`` — its per-step module cloning and BPTT collapse
+    into the scan and its transpose).
+
+    ``return_sequences=False`` returns only the last output (the reference
+    keeps full sequences; Keras-tier uses last-output mode).
+    """
+
+    def __init__(self, cell: Cell, return_sequences: bool = True, reverse: bool = False):
+        super().__init__()
+        self.cell = cell  # registers child under 'cell'
+        self.return_sequences = return_sequences
+        self.reverse = reverse
+
+    def _scan(self, ctx: Context, x, carry):
+        cell = self.cell
+        cell_ctx = ctx.child("cell")
+
+        def step_fn(carry, x_t):
+            new_carry, y = cell.step(cell_ctx, carry, x_t)
+            return new_carry, y
+
+        xs = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
+        carry, ys = lax.scan(step_fn, carry, xs, reverse=self.reverse)
+        return carry, jnp.moveaxis(ys, 0, 1)
+
+    def forward(self, ctx: Context, x):
+        carry = self.cell.init_carry(x.shape[0], x.dtype, x.shape[2:])
+        _, ys = self._scan(ctx, x, carry)
+        if self.return_sequences:
+            return ys
+        return ys[:, -1] if not self.reverse else ys[:, 0]
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper (reference ``BiRecurrent.scala``): forward and
+    backward passes concatenated (or merged by sum) on the feature dim."""
+
+    def __init__(self, fwd_cell: Cell, bwd_cell: Cell, merge: str = "concat"):
+        super().__init__()
+        self.fwd = Recurrent(fwd_cell, return_sequences=True, reverse=False)
+        self.bwd = Recurrent(bwd_cell, return_sequences=True, reverse=True)
+        if merge not in ("concat", "sum"):
+            raise ValueError(f"unknown merge mode {merge}")
+        self.merge = merge
+
+    def forward(self, ctx: Context, x):
+        yf = self.fwd.forward(ctx.child("fwd"), x)
+        yb = self.bwd.forward(ctx.child("bwd"), x)
+        if self.merge == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        return yf + yb
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at every timestep (reference
+    ``TimeDistributed.scala``). Implemented as a reshape (merge batch and
+    time) rather than a loop — one big gemm for the MXU."""
+
+    def __init__(self, inner: Module):
+        super().__init__()
+        self.inner = inner  # registers child under 'inner'
+
+    def forward(self, ctx: Context, x):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y = self.inner.forward(ctx.child("inner"), flat)
+        return y.reshape((b, t) + y.shape[1:])
+
+
+class RecurrentDecoder(Module):
+    """Decode ``seq_length`` steps feeding each output back as the next
+    input (reference ``RecurrentDecoder.scala``). Input is the first-step
+    input (batch, feature)."""
+
+    def __init__(self, cell: Cell, seq_length: int):
+        super().__init__()
+        self.cell = cell  # registers child under 'cell'
+        self.seq_length = seq_length
+
+    def forward(self, ctx: Context, x):
+        cell = self.cell
+        cell_ctx = ctx.child("cell")
+        carry = cell.init_carry(x.shape[0], x.dtype, x.shape[1:])
+
+        def step_fn(state, _):
+            carry, inp = state
+            new_carry, y = cell.step(cell_ctx, carry, inp)
+            return (new_carry, y), y
+
+        _, ys = lax.scan(step_fn, (carry, x), None, length=self.seq_length)
+        return jnp.moveaxis(ys, 0, 1)
+
+
+# convenience aliases mirroring the reference's layer names
+def LSTM(input_size, hidden_size, **kw) -> Recurrent:
+    return Recurrent(LSTMCell(input_size, hidden_size, **kw))
+
+
+def GRU(input_size, hidden_size, **kw) -> Recurrent:
+    return Recurrent(GRUCell(input_size, hidden_size, **kw))
+
+
+def SimpleRNN(input_size, hidden_size, **kw) -> Recurrent:
+    return Recurrent(RnnCell(input_size, hidden_size, **kw))

@@ -84,8 +84,10 @@ def test_autoencoder_reconstruction_shape():
 
 def test_graft_entry_contract():
     import importlib.util
+    from pathlib import Path
 
-    spec = importlib.util.spec_from_file_location("__graft_entry__", "/root/repo/__graft_entry__.py")
+    path = Path(__file__).resolve().parent.parent / "__graft_entry__.py"
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     # multichip dry run on the virtual CPU mesh

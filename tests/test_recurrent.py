@@ -1,0 +1,200 @@
+"""Recurrent stack tests: torch parity for LSTM/GRU numerics (same oracle
+role as the reference's live-Torch specs, ``DLT/torch/TH.scala``), shape
+and gradient checks for the containers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.layers.recurrent import (
+    BiRecurrent,
+    ConvLSTMPeepholeCell,
+    GRUCell,
+    LSTMCell,
+    LSTMPeepholeCell,
+    MultiRNNCell,
+    Recurrent,
+    RecurrentDecoder,
+    RnnCell,
+    TimeDistributed,
+)
+
+torch = pytest.importorskip("torch")
+
+
+def _lstm_params_to_torch(params, tl, input_size, hidden):
+    """Load our packed (in+h, 4h) weights into torch.nn.LSTM.
+
+    Gate order: ours i,f,g,o == torch i,f,g,o. Torch splits input vs
+    hidden weights and keeps two bias vectors."""
+    w = np.asarray(params["weight"])  # (in+h, 4h)
+    b = np.asarray(params["bias"])
+    w_ih = w[:input_size].T  # (4h, in)
+    w_hh = w[input_size:].T  # (4h, h)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.from_numpy(w_ih))
+        tl.weight_hh_l0.copy_(torch.from_numpy(w_hh))
+        tl.bias_ih_l0.copy_(torch.from_numpy(b))
+        tl.bias_hh_l0.zero_()
+
+
+def test_lstm_vs_torch(rng):
+    B, T, I, H = 3, 7, 5, 4
+    layer = Recurrent(LSTMCell(I, H))
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    _lstm_params_to_torch(params["cell"], tl, I, H)
+    ref, _ = tl(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), ref.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_vs_torch(rng):
+    B, T, I, H = 2, 5, 4, 3
+    layer = Recurrent(GRUCell(I, H))
+    params, _ = layer.init(rng)
+    x = np.random.RandomState(1).randn(B, T, I).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+
+    p = params["cell"]
+    w_rz = np.asarray(p["weight_rz"])  # (I+H, 2H)
+    b_rz = np.asarray(p["bias_rz"])
+    w_in = np.asarray(p["weight_in"])  # (I, H)
+    w_hn = np.asarray(p["weight_hn"])  # (H, H)
+    tl = torch.nn.GRU(I, H, batch_first=True)
+    # torch gate order: r, z, n
+    w_ih = np.concatenate([w_rz[:I].T, w_in.T], axis=0)  # (3H, I)
+    w_hh = np.concatenate([w_rz[I:].T, w_hn.T], axis=0)  # (3H, H)
+    b_ih = np.concatenate([b_rz, np.asarray(p["bias_in"])])
+    b_hh = np.concatenate([np.zeros(2 * H, np.float32), np.asarray(p["bias_hn"])])
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.from_numpy(w_ih))
+        tl.weight_hh_l0.copy_(torch.from_numpy(w_hh))
+        tl.bias_ih_l0.copy_(torch.from_numpy(b_ih))
+        tl.bias_hh_l0.copy_(torch.from_numpy(b_hh))
+    ref, _ = tl(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y), ref.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_cell_last_output(rng):
+    layer = Recurrent(RnnCell(4, 6), return_sequences=False)
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.zeros((2, 5, 4)))
+    assert y.shape == (2, 6)
+
+
+def test_lstm_peephole_shapes(rng):
+    layer = Recurrent(LSTMPeepholeCell(4, 3))
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.ones((2, 6, 4)))
+    assert y.shape == (2, 6, 3)
+
+
+def test_multi_rnn_cell_stack(rng):
+    cell = MultiRNNCell([LSTMCell(4, 8), LSTMCell(8, 5)])
+    layer = Recurrent(cell)
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.ones((2, 6, 4)))
+    assert y.shape == (2, 6, 5)
+
+
+def test_birecurrent_concat_and_sum(rng):
+    layer = BiRecurrent(GRUCell(4, 3), GRUCell(4, 3))
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.ones((2, 5, 4)))
+    assert y.shape == (2, 5, 6)
+
+    layer2 = BiRecurrent(GRUCell(4, 3), GRUCell(4, 3), merge="sum")
+    params2, _ = layer2.init(rng)
+    y2, _ = layer2.apply(params2, jnp.ones((2, 5, 4)))
+    assert y2.shape == (2, 5, 3)
+
+
+def test_bidirectional_reverse_really_reverses(rng):
+    """The reverse pass must process the sequence back-to-front: its output
+    at t=0 must depend on the input at t=T-1."""
+    layer = Recurrent(RnnCell(2, 3), reverse=True)
+    params, _ = layer.init(rng)
+    x = np.zeros((1, 4, 2), np.float32)
+    y1, _ = layer.apply(params, jnp.asarray(x))
+    x2 = x.copy()
+    x2[0, -1] = 1.0  # change the LAST input
+    y2, _ = layer.apply(params, jnp.asarray(x2))
+    # output at the FIRST timestep changes
+    assert not np.allclose(np.asarray(y1)[0, 0], np.asarray(y2)[0, 0])
+
+
+def test_conv_lstm(rng):
+    cell = ConvLSTMPeepholeCell(2, 4, kernel=3)
+    layer = Recurrent(cell)
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.ones((2, 5, 2, 8, 8)))
+    assert y.shape == (2, 5, 4, 8, 8)
+
+
+def test_time_distributed(rng):
+    layer = TimeDistributed(nn.Linear(4, 7))
+    params, _ = layer.init(rng)
+    y, _ = layer.apply(params, jnp.ones((3, 5, 4)))
+    assert y.shape == (3, 5, 7)
+
+
+def test_recurrent_decoder(rng):
+    dec = RecurrentDecoder(LSTMCell(4, 4), seq_length=6)
+    params, _ = dec.init(rng)
+    y, _ = dec.apply(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 6, 4)
+
+
+def test_recurrent_grads_flow(rng):
+    """BPTT through scan: gradient w.r.t. cell weights is nonzero."""
+    layer = Recurrent(LSTMCell(3, 4), return_sequences=False)
+    params, _ = layer.init(rng)
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 6, 3), jnp.float32)
+
+    def loss(p):
+        y, _ = layer.apply(p, x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["cell"]["weight"]).sum()) > 0
+
+
+def test_ptb_lm_trains(rng):
+    from bigdl_tpu.models.rnn import build_ptb_lstm
+    from bigdl_tpu.nn import TimeDistributedCriterion, ClassNLLCriterion
+
+    model = build_ptb_lstm(vocab_size=50, embed_size=16, hidden_size=16,
+                           num_layers=2, dropout=0.0)
+    params, state = model.init(rng)
+    x = jnp.asarray(np.random.RandomState(3).randint(0, 50, (4, 12)))
+    y = jnp.asarray(np.random.RandomState(4).randint(0, 50, (4, 12)))
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x, state=state, training=True,
+                             rng=jax.random.key(7))
+        return crit(out, y)
+
+    l0 = loss_fn(params)
+    g = jax.grad(loss_fn)(params)
+    p2 = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, params, g)
+    assert float(loss_fn(p2)) < float(l0)
+
+
+def test_conv_lstm_standalone_and_stacked(rng):
+    """Regression: conv cells must size their state from the input shape in
+    every entry path (standalone single-step and inside MultiRNNCell)."""
+    cell = ConvLSTMPeepholeCell(2, 4)
+    params, _ = cell.init(rng)
+    y, _ = cell.apply(params, jnp.ones((2, 2, 8, 8)))
+    assert y.shape == (2, 4, 8, 8)
+
+    stack = Recurrent(MultiRNNCell([ConvLSTMPeepholeCell(2, 4), ConvLSTMPeepholeCell(4, 3)]))
+    p2, _ = stack.init(rng)
+    y2, _ = stack.apply(p2, jnp.ones((1, 3, 2, 8, 8)))
+    assert y2.shape == (1, 3, 3, 8, 8)
