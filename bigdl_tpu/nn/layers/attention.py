@@ -1,0 +1,297 @@
+"""Transformer tier: multi-head attention, FFN, transformer encoder/decoder.
+
+Reference: ``DL/nn/Attention.scala:35`` (Attention(hiddenSize, numHeads,
+attentionDropout)), ``DL/nn/FeedForwardNetwork.scala:32``,
+``DL/nn/Transformer.scala:53`` (vocabSize/hiddenSize/numHeads/filterSize/
+numHiddenlayers/dropouts, LanguageModel | Translation) and
+``TransformerOperation.scala`` (position encoding, masks, pre/post
+processing: LayerNorm -> sublayer -> dropout -> residual).
+
+TPU-native differences:
+- attention math is the fused flash op (``bigdl_tpu.ops.dot_product_attention``)
+  instead of a Graph of MM/SoftMax modules;
+- the (B, S) padding mask / causal structure travel as an additive bias or a
+  static ``causal`` flag, so everything jits with static shapes;
+- incremental decoding keeps a fixed-size KV cache updated with
+  ``lax.dynamic_update_slice`` (the reference grows K/V with JoinTable,
+  ``Attention.scala:39-40`` — dynamic shapes would defeat XLA).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import RandomNormal
+from bigdl_tpu.nn.layers.dropout import Dropout
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.layers.norm import LayerNormalization
+from bigdl_tpu.nn.module import Context, Module
+from bigdl_tpu.ops.attention import (
+    attention_bias_from_padding,
+    dot_product_attention,
+)
+
+
+def position_encoding(length: int, hidden_size: int, dtype=jnp.float32) -> jax.Array:
+    """Sinusoidal positions (reference: ``TransformerOperation.getPositionEncode``)."""
+    pos = jnp.arange(length, dtype=jnp.float32)[:, None]
+    n_timescales = hidden_size // 2
+    log_inc = math.log(10000.0) / max(n_timescales - 1, 1)
+    inv = jnp.exp(jnp.arange(n_timescales, dtype=jnp.float32) * -log_inc)
+    scaled = pos * inv[None, :]
+    enc = jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+    if hidden_size % 2:
+        enc = jnp.pad(enc, ((0, 0), (0, 1)))
+    return enc.astype(dtype)
+
+
+class Attention(Module):
+    """Multi-head attention, self- or cross- (reference ``Attention.scala:35``).
+
+    Input: ``x`` or ``(x, y)`` (query source, key/value source) plus an
+    optional additive ``bias``; heads = ``num_heads`` splits of
+    ``hidden_size``. Projections are bias-free Linears, as in the reference
+    (``TransformerOperation.dense(..., false)``).
+    """
+
+    def __init__(self, hidden_size: int, num_heads: int, attention_dropout: float = 0.0):
+        super().__init__()
+        if hidden_size % num_heads:
+            raise ValueError(f"hidden_size {hidden_size} % num_heads {num_heads} != 0")
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.attention_dropout = attention_dropout
+        init = RandomNormal(0.0, hidden_size ** -0.5)
+        self.q_layer = Linear(hidden_size, hidden_size, with_bias=False, weight_init=init)
+        self.k_layer = Linear(hidden_size, hidden_size, with_bias=False, weight_init=init)
+        self.v_layer = Linear(hidden_size, hidden_size, with_bias=False, weight_init=init)
+        self.output_layer = Linear(hidden_size, hidden_size, with_bias=False, weight_init=init)
+
+    def _split_heads(self, t):
+        b, s, _ = t.shape
+        d = self.hidden_size // self.num_heads
+        return t.reshape(b, s, self.num_heads, d).transpose(0, 2, 1, 3)
+
+    def _join_heads(self, t):
+        b, h, s, d = t.shape
+        return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def forward(self, ctx: Context, x, bias: Optional[jax.Array] = None,
+                causal: bool = False, cache=None, cache_index=None):
+        if isinstance(x, (tuple, list)):
+            x, y = x
+        else:
+            y = x
+        q = self._split_heads(self.run_child(ctx, "q_layer", x))
+        k = self._split_heads(self.run_child(ctx, "k_layer", y))
+        v = self._split_heads(self.run_child(ctx, "v_layer", y))
+
+        new_cache = None
+        if cache is not None:
+            ck, cv = cache
+            idx = cache_index if cache_index is not None else 0
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, idx, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, idx, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv)
+            # a cache implies decode: mask both future in-chunk positions and
+            # unwritten cache slots — key col j is valid for local query row i
+            # iff j <= idx + i (never rely on the caller's bias for this)
+            rows = idx + jnp.arange(q.shape[2])[:, None]
+            cols = jnp.arange(ck.shape[2])[None, :]
+            validity = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
+            bias = validity if bias is None else bias + validity
+
+        drop = self.attention_dropout if ctx.training else 0.0
+        out = dot_product_attention(
+            q, k, v, bias,
+            causal=causal and cache is None,
+            dropout_rate=drop,
+            dropout_rng=ctx.rng() if drop > 0.0 else None,
+        )
+        out = self.run_child(ctx, "output_layer", self._join_heads(out))
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class FeedForwardNetwork(Module):
+    """hidden -> filter (ReLU, dropout) -> hidden
+    (reference ``FeedForwardNetwork.scala:32``)."""
+
+    def __init__(self, hidden_size: int, filter_size: int, relu_dropout: float = 0.0):
+        super().__init__()
+        self.filter_layer = Linear(hidden_size, filter_size)
+        self.drop = Dropout(relu_dropout)
+        self.output_layer = Linear(filter_size, hidden_size)
+
+    def forward(self, ctx: Context, x):
+        h = jax.nn.relu(self.run_child(ctx, "filter_layer", x))
+        h = self.run_child(ctx, "drop", h)
+        return self.run_child(ctx, "output_layer", h)
+
+
+class _SubLayer(Module):
+    """Pre/post-processing wrapper: LayerNorm -> fn -> dropout -> +residual
+    (reference ``TransformerOperation.processInputLayer`` /
+    ``prePostProcessingWrapper``)."""
+
+    def __init__(self, inner: Module, hidden_size: int, dropout: float):
+        super().__init__()
+        self.norm = LayerNormalization(hidden_size)
+        self.inner = inner
+        self.drop = Dropout(dropout)
+
+    def forward(self, ctx: Context, x, **kw):
+        if isinstance(x, (tuple, list)):
+            q, y = x
+            normed = self.run_child(ctx, "norm", q)
+            out = self.inner.forward(ctx.child("inner"), (normed, y), **kw)
+            residual = q
+        else:
+            normed = self.run_child(ctx, "norm", x)
+            out = self.inner.forward(ctx.child("inner"), normed, **kw)
+            residual = x
+        cache = None
+        if isinstance(out, tuple):
+            out, cache = out
+        out = self.run_child(ctx, "drop", out)
+        out = residual + out.astype(residual.dtype)
+        return (out, cache) if cache is not None else out
+
+
+class TransformerLayer(Module):
+    """One pre-norm block: self-attn (+ optional cross-attn) + FFN."""
+
+    def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
+                 attention_dropout: float = 0.0, ffn_dropout: float = 0.0,
+                 residual_dropout: float = 0.0, cross_attention: bool = False):
+        super().__init__()
+        self.self_attention = _SubLayer(
+            Attention(hidden_size, num_heads, attention_dropout),
+            hidden_size, residual_dropout)
+        self.cross = cross_attention
+        if cross_attention:
+            self.cross_attention = _SubLayer(
+                Attention(hidden_size, num_heads, attention_dropout),
+                hidden_size, residual_dropout)
+        self.ffn = _SubLayer(
+            FeedForwardNetwork(hidden_size, filter_size, ffn_dropout),
+            hidden_size, residual_dropout)
+
+    def forward(self, ctx: Context, x, bias=None, causal=False,
+                encoder_output=None, encoder_bias=None, cache=None, cache_index=None):
+        out = self.self_attention.forward(
+            ctx.child("self_attention"), x,
+            bias=bias, causal=causal, cache=cache, cache_index=cache_index)
+        new_cache = None
+        if isinstance(out, tuple):
+            out, new_cache = out
+        if self.cross and encoder_output is not None:
+            out = self.cross_attention.forward(
+                ctx.child("cross_attention"), (out, encoder_output),
+                bias=encoder_bias)
+        out = self.ffn.forward(ctx.child("ffn"), out)
+        return (out, new_cache) if new_cache is not None else out
+
+
+LANGUAGE_MODEL = "language_model"
+TRANSLATION = "translation"
+
+
+class Transformer(Module):
+    """Full transformer (reference ``DL/nn/Transformer.scala:53``).
+
+    ``language_model``: decoder-only causal LM over token ids (B, S) ->
+    logits (B, S, vocab). ``translation``: encoder-decoder; input is
+    ``(src_ids, tgt_ids)``. Embedding is scaled by sqrt(hidden) and shared
+    with the output projection when ``with_share_weights_linear`` (reference
+    :63; standard weight tying).
+    """
+
+    def __init__(self, vocab_size: int, hidden_size: int, num_heads: int,
+                 filter_size: int, num_hidden_layers: int,
+                 embedding_dropout: float = 0.0, attention_dropout: float = 0.0,
+                 ffn_dropout: float = 0.0, padding_value: int = 0,
+                 with_share_weights_linear: bool = True,
+                 transformer_type: str = LANGUAGE_MODEL):
+        super().__init__()
+        if transformer_type not in (LANGUAGE_MODEL, TRANSLATION):
+            raise ValueError(transformer_type)
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.padding_value = padding_value
+        self.embedding_dropout = embedding_dropout
+        self.transformer_type = transformer_type
+        self.share_embedding = with_share_weights_linear
+        self.embed_drop = Dropout(embedding_dropout)
+
+        def make_stack(prefix, cross):
+            for i in range(num_hidden_layers):
+                self.add(TransformerLayer(
+                    hidden_size, num_heads, filter_size,
+                    attention_dropout, ffn_dropout,
+                    residual_dropout=embedding_dropout,
+                    cross_attention=cross,
+                ), name=f"{prefix}{i}")
+
+        if transformer_type == TRANSLATION:
+            make_stack("encoder_", False)
+            self.src_norm = LayerNormalization(hidden_size)
+        make_stack("decoder_", transformer_type == TRANSLATION)
+        self.final_norm = LayerNormalization(hidden_size)
+        if not with_share_weights_linear:
+            self.project = Linear(hidden_size, vocab_size, with_bias=False)
+
+    def build_params(self, rng):
+        emb = RandomNormal(0.0, self.hidden_size ** -0.5)(
+            fold_in_str(rng, "embedding"),
+            (self.vocab_size, self.hidden_size), self.vocab_size, self.hidden_size)
+        return {"embedding": emb}
+
+    def _embed(self, ctx: Context, ids):
+        emb = ctx.param("embedding")
+        x = emb[ids] * (self.hidden_size ** 0.5)
+        x = x + position_encoding(ids.shape[1], self.hidden_size, x.dtype)[None]
+        return self.run_child(ctx, "embed_drop", x)
+
+    def _logits(self, ctx: Context, h):
+        if self.share_embedding:
+            emb = ctx.param("embedding").astype(h.dtype)
+            return jnp.einsum("bsh,vh->bsv", h, emb)
+        return self.run_child(ctx, "project", h)
+
+    def _padding_bias(self, ids):
+        return attention_bias_from_padding((ids == self.padding_value))
+
+    def forward(self, ctx: Context, x):
+        if self.transformer_type == LANGUAGE_MODEL:
+            ids = x
+            h = self._embed(ctx, ids)
+            for name in self._modules:
+                if name.startswith("decoder_"):
+                    h = self._modules[name].forward(ctx.child(name), h, causal=True)
+            h = self.run_child(ctx, "final_norm", h)
+            return self._logits(ctx, h)
+
+        src, tgt = x
+        src_bias = self._padding_bias(src)
+        enc = self._embed(ctx, src)
+        for name in self._modules:
+            if name.startswith("encoder_"):
+                enc = self._modules[name].forward(ctx.child(name), enc, bias=src_bias)
+        enc = self.run_child(ctx, "src_norm", enc)
+
+        dec = self._embed(ctx, tgt)
+        for name in self._modules:
+            if name.startswith("decoder_"):
+                dec = self._modules[name].forward(
+                    ctx.child(name), dec, causal=True,
+                    encoder_output=enc, encoder_bias=src_bias)
+        dec = self.run_child(ctx, "final_norm", dec)
+        return self._logits(ctx, dec)

@@ -1,0 +1,18 @@
+"""TPU compute ops: Pallas kernels + XLA reference paths.
+
+This is the analogue of the reference's native-kernel tier (the BigDL-core
+JNI surface, SURVEY.md §2.1: MKL BLAS/VML + MKL-DNN primitives). On TPU the
+compiler provides fusion/layout, so only ops where XLA underperforms get
+hand-written Pallas kernels (flash attention); everything else is plain
+jax.numpy and relies on XLA fusion (SURVEY.md §7 design translation table).
+"""
+
+from bigdl_tpu.ops.attention import dot_product_attention, attention_bias_from_padding, causal_bias
+from bigdl_tpu.ops.flash_attention import flash_attention
+
+__all__ = [
+    "dot_product_attention",
+    "attention_bias_from_padding",
+    "causal_bias",
+    "flash_attention",
+]

@@ -1,0 +1,84 @@
+"""Scaled-dot-product attention dispatch + attention-bias helpers.
+
+Reference semantics: ``DL/nn/Attention.scala`` computes
+softmax(QK^T / sqrt(d) + bias) V with an additive bias carrying both the
+padding mask (``TransformerOperation.getPaddingBias``) and, for decoders,
+the causal mask (``TransformerOperation.attentionBiasLowerTriangle``).
+Here the same contract is a single functional op that routes to the Pallas
+flash kernel on TPU (fused, no S×S materialisation) and to a plain XLA
+einsum path elsewhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops import flash_attention as _fa
+
+_NEG = -1e9
+
+
+def attention_bias_from_padding(padding_mask: jax.Array) -> jax.Array:
+    """(B, S) 1-where-padding -> additive bias (B, 1, 1, S).
+
+    Reference: ``TransformerOperation.getPaddingBias`` (pad positions get
+    a large negative logit)."""
+    return (padding_mask.astype(jnp.float32) * _NEG)[:, None, None, :]
+
+
+def causal_bias(length: int) -> jax.Array:
+    """(1, 1, S, S) additive lower-triangle bias.
+
+    Reference: ``TransformerOperation.attentionBiasLowerTriangle``."""
+    mask = jnp.tril(jnp.ones((length, length), jnp.float32))
+    return ((1.0 - mask) * _NEG)[None, None, :, :]
+
+
+def _flash_ok(q, k) -> bool:
+    if q.shape[-1] > 256:
+        return False
+    sq, sk = q.shape[-2], k.shape[-2]
+    bq = min(128, sq)
+    bk = min(128, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    bias: Optional[jax.Array] = None,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Attention over (B, H, S, D) tensors.
+
+    ``use_flash=None`` auto-selects: Pallas kernel on TPU when shapes allow
+    and there is no attention dropout (dropout inside the probability matrix
+    defeats the fused formulation; the reference's attentionDropout is only
+    active in training, where the XLA path is used instead).
+    """
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    platform = jax.devices()[0].platform
+    if use_flash is None:
+        use_flash = platform == "tpu" and dropout_rate == 0.0 and _flash_ok(q, k)
+
+    if use_flash and dropout_rate == 0.0:
+        return _fa.flash_attention(
+            q, k, v, bias, scale, causal,
+            interpret=(platform != "tpu"),
+        )
+
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("attention dropout needs dropout_rng")
+    return _fa._xla_attention(
+        q, k, v, bias, scale, causal,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+    )

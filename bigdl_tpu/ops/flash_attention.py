@@ -1,0 +1,190 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference computes attention as unfused matmul/softmax/matmul modules
+(``DL/nn/Attention.scala:35`` builds a Graph of MM + SoftMax + CMulTable);
+at sequence length S that materialises the (S, S) score matrix in memory.
+On TPU the memory-bound softmax traffic dominates HBM bandwidth, so the
+TPU-native design is the online-softmax (flash) formulation: stream K/V
+blocks through VMEM, keep running max/sum statistics, never materialise the
+score matrix. Forward is a Pallas kernel; backward recomputes attention
+(rematerialisation — FLOPs are cheap on the MXU, HBM is not) with a plain
+XLA implementation under ``jax.custom_vjp``.
+
+Shapes follow (batch, heads, seq, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_MIN_LANE = 128
+
+
+def _xla_attention(q, k, v, bias, sm_scale, causal,
+                   dropout_rate=0.0, dropout_rng=None):
+    """Reference XLA path (also the recompute used by the flash backward).
+
+    Causal convention (shared with the kernel): END-aligned — query row i
+    attends key cols j with ``j <= i + (klen - qlen)``, i.e. queries are the
+    LAST ``qlen`` positions of the key sequence (the decode-time case; for
+    qlen == klen this is the ordinary lower triangle).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_q, block_k, n_k, causal_offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    should_run = True
+    if causal:
+        # end-aligned: row i may see cols <= i + causal_offset
+        should_run = qi * block_q + block_q - 1 + causal_offset >= ki * block_k
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)          # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                               # (block_q, block_k)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + causal_offset >= cols, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (block_q, 1)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                    # (block_q, block_k)
+        l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)            # fully-masked rows -> 0 output
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) not divisible by blocks ({block_q},{block_k})")
+    n_q, n_k = sq // block_q, sk // block_k
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b * h, sq, sk)
+        in_specs.append(
+            pl.BlockSpec((1, block_q, block_k), lambda bh, qi, ki: (bh, qi, ki))
+        )
+        args.append(bias)
+        kernel = functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k, causal_offset=sk - sq,
+        )
+    else:
+        kernel = functools.partial(
+            lambda qf, kf, vf, o, acc, m, l, **kw: _fwd_kernel(
+                qf, kf, vf, None, o, acc, m, l, **kw),
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k, causal_offset=sk - sq,
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _MIN_LANE), jnp.float32),
+            pltpu.VMEM((block_q, _MIN_LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused online-softmax attention. q/k/v: (B, H, S, D)."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret)
+
+
+def _vjp_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, bias)
+
+
+def _vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+    def ref(q, k, v, bias):
+        if bias is None:
+            return _xla_attention(q, k, v, None, scale, causal)
+        return _xla_attention(q, k, v, bias, scale, causal)
+
+    if bias is None:
+        _, vjp = jax.vjp(lambda q, k, v: ref(q, k, v, None), q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+    _, vjp = jax.vjp(ref, q, k, v, bias)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

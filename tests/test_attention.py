@@ -1,0 +1,168 @@
+"""Transformer-tier tests: flash kernel vs XLA reference, attention layers,
+full Transformer forward/backward (reference specs: ``DLT/nn/AttentionSpec``,
+``TransformerSpec``, ``FeedForwardNetworkSpec``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn import (
+    Attention,
+    FeedForwardNetwork,
+    Transformer,
+    TransformerLayer,
+    TRANSLATION,
+)
+from bigdl_tpu.ops.attention import (
+    attention_bias_from_padding,
+    dot_product_attention,
+)
+from bigdl_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(rng, b, h, s, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    return (
+        jax.random.normal(kq, (b, h, s, d), dtype),
+        jax.random.normal(kk, (b, h, s, d), dtype),
+        jax.random.normal(kv, (b, h, s, d), dtype),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla(self, rng, causal):
+        q, k, v = _rand_qkv(rng, 2, 2, 128, 64)
+        ref = dot_product_attention(q, k, v, causal=causal, use_flash=False)
+        out = flash_attention(q, k, v, None, None, causal, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_bias(self, rng):
+        q, k, v = _rand_qkv(rng, 1, 2, 128, 32)
+        bias = attention_bias_from_padding(
+            jnp.zeros((1, 128)).at[:, 100:].set(1)
+        )
+        ref = dot_product_attention(q, k, v, bias, use_flash=False)
+        out = flash_attention(q, k, v, bias, None, False, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_cross_length_causal(self, rng):
+        """qlen != klen: kernel, backward recompute and XLA path must agree
+        on the end-aligned causal convention."""
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 2, 64, 32))
+        k = jax.random.normal(kk, (1, 2, 128, 32))
+        v = jax.random.normal(kv, (1, 2, 128, 32))
+        ref = dot_product_attention(q, k, v, causal=True, use_flash=False)
+        out = flash_attention(q, k, v, None, None, True, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        g = jax.grad(lambda q: flash_attention(
+            q, k, v, None, None, True, 64, 64, True).sum())(q)
+        g_ref = jax.grad(lambda q: dot_product_attention(
+            q, k, v, causal=True, use_flash=False).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=2e-4)
+
+    def test_grad_flows(self, rng):
+        q, k, v = _rand_qkv(rng, 1, 1, 64, 32)
+
+        def loss(q):
+            return flash_attention(q, k, v, None, None, True, 32, 32, True).sum()
+
+        g = jax.grad(loss)(q)
+
+        def ref_loss(q):
+            return dot_product_attention(q, k, v, causal=True, use_flash=False).sum()
+
+        g_ref = jax.grad(ref_loss)(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=2e-4)
+
+
+class TestAttentionLayer:
+    def test_self_attention_shape(self, rng):
+        m = Attention(hidden_size=32, num_heads=4)
+        params, state = m.init(rng)
+        x = jax.random.normal(rng, (2, 10, 32))
+        out, _ = m.apply(params, x)
+        assert out.shape == (2, 10, 32)
+
+    def test_kv_cache_matches_full(self, rng):
+        """Incremental decode with a KV cache == full causal forward."""
+        m = Attention(hidden_size=16, num_heads=2)
+        params, _ = m.init(rng)
+        x = jax.random.normal(jax.random.key(1), (1, 6, 16))
+        full, _ = m.apply(params, x, training=False)
+        # wire causal through Context-free manual call
+        from bigdl_tpu.nn.module import Context
+
+        ctx = Context(params, {}, False, None)
+        full = m.forward(ctx, x, causal=True)
+
+        cache = (jnp.zeros((1, 2, 6, 8)), jnp.zeros((1, 2, 6, 8)))
+        outs = []
+        for t in range(6):
+            ctx = Context(params, {}, False, None)
+            step = x[:, t : t + 1]
+            # no manual bias: the layer masks unwritten slots + future itself
+            out, cache = m.forward(ctx, step, cache=cache, cache_index=t)
+            outs.append(out)
+        inc = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(inc), np.asarray(full), atol=1e-5)
+
+
+class TestTransformer:
+    def test_lm_forward_backward(self, rng):
+        m = Transformer(
+            vocab_size=50, hidden_size=32, num_heads=4, filter_size=64,
+            num_hidden_layers=2)
+        params, state = m.init(rng)
+        ids = jax.random.randint(rng, (2, 12), 0, 50)
+        logits, _ = m.apply(params, ids)
+        assert logits.shape == (2, 12, 50)
+
+        def loss_fn(p):
+            out, _ = m.apply(p, ids)
+            return out.sum()
+
+        grads = jax.grad(loss_fn)(params)
+        assert jnp.isfinite(
+            jnp.asarray([jnp.abs(g).sum() for g in jax.tree_util.tree_leaves(grads)])
+        ).all()
+
+    def test_lm_causality(self, rng):
+        """Changing a future token must not change past logits."""
+        m = Transformer(vocab_size=20, hidden_size=16, num_heads=2,
+                        filter_size=32, num_hidden_layers=1)
+        params, _ = m.init(rng)
+        ids = jax.random.randint(rng, (1, 8), 1, 20)
+        out1, _ = m.apply(params, ids)
+        ids2 = ids.at[0, 7].set((ids[0, 7] + 1) % 19 + 1)
+        out2, _ = m.apply(params, ids2)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :7]), np.asarray(out2[:, :7]), atol=1e-5)
+
+    def test_translation(self, rng):
+        m = Transformer(
+            vocab_size=30, hidden_size=16, num_heads=2, filter_size=32,
+            num_hidden_layers=1, transformer_type=TRANSLATION)
+        params, _ = m.init(rng)
+        src = jax.random.randint(rng, (2, 7), 1, 30)
+        tgt = jax.random.randint(rng, (2, 5), 1, 30)
+        logits, _ = m.apply(params, (src, tgt))
+        assert logits.shape == (2, 5, 30)
+
+    def test_ffn(self, rng):
+        m = FeedForwardNetwork(hidden_size=8, filter_size=16)
+        params, _ = m.init(rng)
+        out, _ = m.apply(params, jnp.ones((2, 3, 8)))
+        assert out.shape == (2, 3, 8)
+
+    def test_layer_dropout_deterministic_eval(self, rng):
+        m = TransformerLayer(16, 2, 32, attention_dropout=0.5,
+                             ffn_dropout=0.5, residual_dropout=0.5)
+        params, _ = m.init(rng)
+        x = jax.random.normal(rng, (1, 4, 16))
+        o1, _ = m.apply(params, x, training=False)
+        o2, _ = m.apply(params, x, training=False)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
