@@ -179,6 +179,28 @@ class Module:
         state.update(self.build_state())
         return params, state
 
+    def build_param_pspecs(self) -> Dict[str, Any]:
+        """Leaf parameter PartitionSpecs (mirrors ``build_params`` keys).
+
+        Override in tensor/expert-parallel layers to declare how their
+        weights shard over named mesh axes; trainers consult this via
+        ``param_pspecs()`` when placing params (the TPU-native analogue of
+        the reference deciding which PS partition owns which weight slice,
+        ``DL/parameters/AllReduceParameter.scala:177-190``).
+        """
+        return {}
+
+    def param_pspecs(self) -> Dict[str, Any]:
+        """Nested PartitionSpec tree mirroring the params tree (sparse:
+        only annotated leaves appear; everything else is trainer's choice)."""
+        out: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            sub = m.param_pspecs()
+            if sub:
+                out[name] = sub
+        out.update(self.build_param_pspecs())
+        return out
+
     # -- forward --
     def forward(self, ctx: Context, x):
         raise NotImplementedError(f"{type(self).__name__}.forward")
@@ -193,10 +215,11 @@ class Module:
         state: Optional[State] = None,
         training: bool = False,
         rng: Optional[jax.Array] = None,
+        **forward_kwargs,
     ):
         state = state if state is not None else {}
         ctx = Context(params, state, training, rng)
-        out = self.forward(ctx, x)
+        out = self.forward(ctx, x, **forward_kwargs)
         return out, _merge_updates(state, ctx.updates)
 
     def __call__(self, *nodes):

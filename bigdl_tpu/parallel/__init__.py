@@ -1,0 +1,46 @@
+"""Parallelism strategies beyond data parallelism.
+
+The reference implements exactly two axes of parallelism — synchronous data
+parallelism over a BlockManager parameter server and intra-node thread
+replicas (SURVEY.md §2.3 checklist; ``DL/optim/DistriOptimizer.scala``,
+``DL/parameters/AllReduceParameter.scala``). Tensor, pipeline,
+sequence/context and expert parallelism are absent there. On TPU these are
+first-class: a ``jax.sharding.Mesh`` with named axes plus ``shard_map`` and
+XLA collectives (psum / all_gather / ppermute / all_to_all) over ICI.
+
+Axis-name conventions used across the framework:
+
+- ``dp``   data parallel (batch dim; gradients psum over it)
+- ``fsdp`` parameter/optimizer-state sharding (ZeRO-style)
+- ``tp``   tensor (a.k.a. model) parallel — weight-matrix sharding
+- ``pp``   pipeline parallel — layer stages
+- ``sp``   sequence/context parallel — ring attention over the seq dim
+- ``ep``   expert parallel — MoE experts
+"""
+
+from bigdl_tpu.parallel.mesh import (
+    MeshSpec,
+    constrain,
+    current_mesh,
+    make_mesh,
+    use_mesh,
+)
+from bigdl_tpu.parallel.tp import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    TensorParallelAttention,
+    TensorParallelFFN,
+)
+from bigdl_tpu.parallel.ring_attention import ring_attention
+from bigdl_tpu.parallel.ulysses import ulysses_attention
+from bigdl_tpu.parallel.pipeline import Pipeline, pipeline_apply
+from bigdl_tpu.parallel.moe import MoE, SwitchFFN
+
+__all__ = [
+    "MeshSpec", "make_mesh", "use_mesh", "current_mesh", "constrain",
+    "ColumnParallelLinear", "RowParallelLinear",
+    "TensorParallelAttention", "TensorParallelFFN",
+    "ring_attention", "ulysses_attention",
+    "Pipeline", "pipeline_apply",
+    "MoE", "SwitchFFN",
+]

@@ -1,0 +1,136 @@
+"""Device-mesh construction and sharding-constraint helpers.
+
+The reference's notion of topology is ``Engine.nodeNumber x coreNumber``
+(``DL/utils/Engine.scala:279,302``) wired into Spark partition placement.
+The TPU-native topology is a named ``jax.sharding.Mesh``; every parallelism
+strategy is an axis name, and placement is expressed as ``PartitionSpec``s
+that XLA's GSPMD partitioner turns into collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_local = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh axes, e.g. ``MeshSpec(dp=2, tp=2, sp=2)``.
+
+    Axis order follows the declaration order; put the fastest-varying
+    (innermost-ICI) axis last — on real slices, XLA maps trailing mesh dims
+    to the most tightly coupled devices, so ``tp``/``sp`` (which carry
+    per-layer collectives) should come after ``dp``/``pp``.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    def __init__(self, axes: Optional[Sequence[Tuple[str, int]]] = None, **kw: int):
+        entries = tuple(axes or ()) + tuple(kw.items())
+        object.__setattr__(self, "axes", entries)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    def names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+
+def make_mesh(spec: MeshSpec, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if spec.size > len(devices):
+        raise ValueError(f"mesh needs {spec.size} devices, have {len(devices)}")
+    arr = np.asarray(devices[: spec.size]).reshape([s for _, s in spec.axes])
+    return Mesh(arr, spec.names())
+
+
+def factor_devices(n: int, want: Sequence[str]) -> Dict[str, int]:
+    """Greedily factor ``n`` devices over the requested axis names.
+
+    Each axis gets the smallest prime factor still available (so e.g.
+    n=8, want=(dp, tp, sp) -> {dp: 2, tp: 2, sp: 2}); leftover factors fold
+    into the first axis. Axes that can't get a factor >1 get size 1.
+    """
+    sizes = {name: 1 for name in want}
+    rem = n
+    for name in want:
+        for f in (2, 3, 5, 7):
+            if rem % f == 0:
+                sizes[name] = f
+                rem //= f
+                break
+    if rem > 1 and want:
+        sizes[want[0]] *= rem
+    return sizes
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Activate ``mesh`` for `constrain` calls in this thread."""
+    prev = getattr(_local, "mesh", None)
+    _local.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _local.mesh = prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_local, "mesh", None)
+
+
+UNCONSTRAINED = P.UNCONSTRAINED
+
+
+def constrain(x, *spec_parts):
+    """``with_sharding_constraint`` that degrades to a no-op.
+
+    Spec-part semantics per dim:
+
+    - an axis name (or tuple of names): shard over those mesh axes;
+    - ``None``: explicitly REPLICATED over all mesh axes;
+    - ``UNCONSTRAINED``: leave the dim's layout to GSPMD (use this for
+      batch/sequence dims so a tp constraint never un-shards dp/sp).
+
+    Degrades: with no active mesh the call is a no-op; axis names missing
+    from the active mesh become UNCONSTRAINED (not replicated), so
+    tensor-parallel layers run unchanged on a single chip or a pure-dp
+    mesh; if after degradation every dim is UNCONSTRAINED, no constraint
+    is emitted at all.
+    """
+    mesh = getattr(_local, "mesh", None)
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+
+    def keep(part):
+        if part is None or part is UNCONSTRAINED:
+            return part
+        if isinstance(part, (tuple, list)):
+            kept = tuple(p for p in part if p in names)
+            return kept if kept else UNCONSTRAINED
+        return part if part in names else UNCONSTRAINED
+
+    cleaned = [keep(p) for p in spec_parts]
+    if all(c is UNCONSTRAINED for c in cleaned):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*cleaned)))
+
+
+def sharding_for(mesh: Mesh, *spec_parts) -> NamedSharding:
+    names = set(mesh.axis_names)
+    cleaned = [p if (p in names or p is None) else None for p in spec_parts]
+    return NamedSharding(mesh, P(*cleaned))

@@ -1,0 +1,111 @@
+"""Mixture-of-Experts with expert parallelism.
+
+The reference has a ``MixtureTable`` gate-combiner (``DL/nn/MixtureTable.scala``)
+but no expert parallelism (SURVEY.md §2.3 — EP absent). TPU-native design:
+expert weights carry a leading ``[n_experts, ...]`` dim sharded over the
+``ep`` mesh axis (declared via ``param_pspecs``); token dispatch/combine are
+einsums against one-hot capacity-limited dispatch tensors. Under jit, GSPMD
+sees tokens sharded on ``dp``/batch and experts on ``ep`` and inserts the
+all-to-all pair automatically — the classic Switch/GShard lowering, no
+hand-written collectives.
+
+Router: top-1 (Switch) with capacity factor + auxiliary load-balancing loss
+(stashed in module state so trainers can add it to the objective).
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import Xavier
+from bigdl_tpu.nn.module import Context, Module
+from bigdl_tpu.parallel.mesh import constrain
+
+
+class SwitchFFN(Module):
+    """Switch-style top-1 MoE FFN: route each token to one expert.
+
+    Input (batch, seq, hidden) -> output same shape. Aux load-balance loss
+    is returned via module state key ``aux_loss``.
+    """
+
+    def __init__(self, hidden_size: int, filter_size: int, n_experts: int,
+                 capacity_factor: float = 1.25, axis: str = "ep",
+                 router_noise: float = 0.0):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.filter_size = filter_size
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.axis = axis
+        self.router_noise = router_noise
+
+    def build_params(self, rng):
+        xavier = Xavier()
+        e, h, f = self.n_experts, self.hidden_size, self.filter_size
+        return {
+            "router": xavier(fold_in_str(rng, "router"), (h, e), h, e),
+            "wi": xavier(fold_in_str(rng, "wi"), (e, h, f), h, f),
+            "wo": xavier(fold_in_str(rng, "wo"), (e, f, h), f, h),
+        }
+
+    def build_param_pspecs(self):
+        return {
+            "router": P(),
+            "wi": P(self.axis, None, None),
+            "wo": P(self.axis, None, None),
+        }
+
+    def build_state(self):
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def forward(self, ctx: Context, x):
+        b, s, h = x.shape
+        n_tok = b * s
+        e = self.n_experts
+        cap = max(1, int(self.capacity_factor * n_tok / e))
+
+        tokens = x.reshape(n_tok, h)
+        logits = jnp.matmul(tokens.astype(jnp.float32), ctx.param("router"))
+        if ctx.training and self.router_noise > 0.0:
+            logits = logits + self.router_noise * jax.random.normal(
+                ctx.rng(), logits.shape)
+        probs = jax.nn.softmax(logits, axis=-1)          # [N, E]
+        gate, choice = jnp.max(probs, -1), jnp.argmax(probs, -1)
+
+        # capacity assignment: position of each token within its expert queue
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)      # [N, E]
+        pos = jnp.cumsum(onehot, axis=0) - 1                     # [N, E]
+        pos_in_expert = jnp.sum(pos * onehot, axis=-1)           # [N]
+        keep = pos_in_expert < cap
+
+        # dispatch tensor [N, E, C]: 1 where token n goes to (expert, slot)
+        dispatch = (jax.nn.one_hot(choice, e, dtype=x.dtype)[..., None]
+                    * jax.nn.one_hot(jnp.where(keep, pos_in_expert, cap), cap,
+                                     dtype=x.dtype)[:, None, :])
+        combine = dispatch * gate[:, None, None].astype(x.dtype)
+
+        # expert inputs [E, C, H] — GSPMD inserts the all-to-all over ep here
+        xin = jnp.einsum("nec,nh->ech", dispatch, tokens)
+        xin = constrain(xin, self.axis, None, None)
+        wi, wo = ctx.param("wi"), ctx.param("wo")
+        hmid = jnp.maximum(jnp.einsum("ech,ehf->ecf", xin, wi.astype(x.dtype)), 0.0)
+        xout = jnp.einsum("ecf,efh->ech", hmid, wo.astype(x.dtype))
+        xout = constrain(xout, self.axis, None, None)
+
+        out = jnp.einsum("nec,ech->nh", combine, xout)
+
+        # Switch aux loss: E * sum_e (fraction tokens_e * mean prob_e)
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        ctx.put_state("aux_loss", e * jnp.sum(frac * mean_prob))
+
+        return out.reshape(b, s, h)
+
+
+class MoE(SwitchFFN):
+    """Alias with the historical name; top-1 Switch routing."""

@@ -1,0 +1,147 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Absent from the reference (SURVEY.md §2.3 — no PP). TPU-native design:
+stage parameters are stacked along a leading ``[n_stages, ...]`` dim that is
+sharded over the ``pp`` mesh axis, so each chip physically holds exactly one
+stage's weights. A ``shard_map`` runs the classic GPipe schedule: for
+``n_micro + n_stages - 1`` ticks, every chip applies its stage to the
+activation it holds and ``ppermute``s the result to the next chip. The
+schedule is a ``lax.scan`` (static trip count — XLA-friendly), and the whole
+thing is reverse-differentiable: the transpose of ``ppermute`` is the
+reverse ppermute, so ``jax.grad`` of a pipelined loss yields the standard
+backward pipeline schedule automatically.
+
+This mirrors the collective-pipelining recipe of the public scaling
+literature rather than anything in the reference, whose only scale-out axis
+is data parallelism over the BlockManager PS (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _stage_body(stage_fn, n_stages, n_micro, params, xs):
+    """Per-chip GPipe schedule. ``params``: this chip's stage params (leading
+    stage dim of size 1, squeezed). ``xs``: [n_micro, ...] microbatches
+    (meaningful on stage 0; other chips carry zeros)."""
+    stage = lax.axis_index("pp")
+    n = n_stages
+    total = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    micro_shape = xs.shape[1:]
+    out0 = jnp.zeros((n_micro,) + micro_shape, xs.dtype)
+    recv0 = jnp.zeros(micro_shape, xs.dtype)
+    from bigdl_tpu.parallel.ring_attention import _mark_varying
+    out0 = _mark_varying(out0, "pp")
+    recv0 = _mark_varying(recv0, "pp")
+    xs = _mark_varying(xs, "pp")
+
+    def tick(carry, t):
+        recv, outs = carry
+        # stage 0 feeds microbatch t (clipped; masked out when t >= n_micro)
+        feed = xs[jnp.clip(t, 0, n_micro - 1)]
+        x_in = jnp.where(stage == 0, feed, recv)
+        y = stage_fn(params, x_in)
+        # last stage banks output for microbatch t-(n-1)
+        widx = t - (n - 1)
+        wclip = jnp.clip(widx, 0, n_micro - 1)
+        bank = jnp.where((stage == n - 1) & (widx >= 0), y, outs[wclip])
+        outs = lax.dynamic_update_index_in_dim(outs, bank, wclip, 0)
+        recv_next = lax.ppermute(y, "pp", perm)
+        return (recv_next, outs), None
+
+    (recv, outs), _ = lax.scan(tick, (recv0, out0), jnp.arange(total))
+    # deliver outputs from the last stage to every chip (so the caller can
+    # compute a replicated loss); psum of a one-hot-masked bank
+    outs = lax.psum(jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), "pp")
+    return outs
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   mesh: Mesh,
+                   stacked_params: Any,
+                   x: jax.Array,
+                   n_micro: int,
+                   axis_name: str = "pp"):
+    """Run ``x`` through a pipeline of stages over ``mesh[axis_name]``.
+
+    - ``stage_fn(params_i, x_micro) -> y_micro`` — one stage's computation;
+      every stage must map the same activation shape to itself.
+    - ``stacked_params``: pytree whose leaves have leading dim n_stages,
+      sharded over ``axis_name``.
+    - ``x``: [batch, ...] global batch; must divide into ``n_micro``
+      microbatches.
+
+    Returns [batch, ...] outputs (replicated over the pp axis).
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    xs = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    body = functools.partial(_stage_body, stage_fn, n_stages, n_micro)
+
+    def per_chip(params, xs_local):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
+        return body(squeezed, xs_local)
+
+    fn = shard_map(per_chip, mesh=mesh,
+                   in_specs=(param_specs, P()),
+                   out_specs=P())
+    ys = fn(stacked_params, xs)
+    return ys.reshape((b,) + ys.shape[2:])
+
+
+class Pipeline:
+    """Convenience wrapper: stack per-stage params and apply the schedule.
+
+    ``Pipeline(module, mesh, n_micro)`` treats ``module`` as ONE repeated
+    stage (the homogeneous-stage case — e.g. a transformer block repeated
+    ``pp`` times). ``init`` builds per-stage params stacked on dim 0 with
+    per-stage RNG streams; ``apply`` runs the GPipe schedule.
+    """
+
+    def __init__(self, stage_module, mesh: Mesh, n_micro: int,
+                 axis_name: str = "pp"):
+        self.stage = stage_module
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.axis_name = axis_name
+        self.n_stages = mesh.shape[axis_name]
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_stages)
+        inits = [self.stage.init(k) for k in keys]
+        if any(s for _, s in inits):
+            raise ValueError(
+                "Pipeline stages with mutable state (BatchNorm running stats, "
+                "...) are not supported yet: state/training/rng are not "
+                "threaded through the GPipe schedule. Use stateless stages "
+                "(e.g. LayerNormalization instead of BatchNormalization)."
+            )
+        ps = [p for p, _ in inits]
+        stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *ps)
+        sharding = jax.tree_util.tree_map(
+            lambda _: jax.sharding.NamedSharding(self.mesh, P(self.axis_name)),
+            stacked)
+        return jax.tree_util.tree_map(jax.device_put, stacked, sharding)
+
+    def apply(self, stacked_params, x):
+        def stage_fn(p, xm):
+            out, _ = self.stage.apply(p, xm)
+            return out
+
+        return pipeline_apply(stage_fn, self.mesh, stacked_params, x,
+                              self.n_micro, self.axis_name)

@@ -1,0 +1,196 @@
+"""Parallelism tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of simulating multi-node training inside
+specs (``DLT/optim/DistriOptimizerSpec.scala:139`` uses Spark local[N]);
+here N XLA host devices stand in for TPU chips. Each strategy is checked
+for NUMERICAL EQUALITY against its single-device reference computation —
+parallelism must not change the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel import (
+    ColumnParallelLinear,
+    MeshSpec,
+    Pipeline,
+    RowParallelLinear,
+    SwitchFFN,
+    TensorParallelAttention,
+    TensorParallelFFN,
+    make_mesh,
+    use_mesh,
+)
+from bigdl_tpu.parallel.ring_attention import make_ring_attention
+from bigdl_tpu.parallel.ulysses import make_ulysses_attention
+from bigdl_tpu.ops.attention import dot_product_attention
+
+
+def _ref_attention(q, k, v, causal):
+    return dot_product_attention(q, k, v, causal=causal, force_xla=True) \
+        if "force_xla" in dot_product_attention.__code__.co_varnames \
+        else dot_product_attention(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_local(causal):
+    mesh = make_mesh(MeshSpec(sp=4))
+    b, h, s, d = 2, 2, 32, 8
+    key = jax.random.key(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, h, s, d),
+                                 jnp.float32) for i in range(3))
+    ring = make_ring_attention(mesh, "sp", causal=causal)
+    out = jax.jit(ring)(q, k, v)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_local(causal):
+    mesh = make_mesh(MeshSpec(sp=4))
+    b, h, s, d = 2, 4, 16, 8  # h divisible by sp
+    key = jax.random.key(1)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, h, s, d),
+                                 jnp.float32) for i in range(3))
+    uly = make_ulysses_attention(mesh, "sp", causal=causal)
+    out = jax.jit(uly)(q, k, v)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_grads_match():
+    mesh = make_mesh(MeshSpec(sp=4))
+    b, h, s, d = 1, 2, 16, 4
+    key = jax.random.key(2)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, h, s, d),
+                                 jnp.float32) for i in range(3))
+    ring = make_ring_attention(mesh, "sp", causal=True)
+
+    g_ring = jax.grad(lambda *a: jnp.sum(jax.jit(ring)(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda *a: jnp.sum(_ref_attention(*a, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)
+
+
+def test_tensor_parallel_ffn_matches_replicated():
+    mesh = make_mesh(MeshSpec(tp=4))
+    ffn = TensorParallelFFN(16, 64)
+    params, _ = ffn.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(3), (2, 8, 16))
+
+    ref, _ = ffn.apply(params, x)  # no mesh active -> plain computation
+
+    specs = ffn.param_pspecs()
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs, is_leaf=lambda l: isinstance(l, jnp.ndarray))
+
+    with use_mesh(mesh):
+        out, _ = jax.jit(lambda p, x: ffn.apply(p, x))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_tensor_parallel_attention_shards_heads():
+    mesh = make_mesh(MeshSpec(tp=2, sp=2))
+    attn = TensorParallelAttention(hidden_size=16, num_heads=4, sp_axis="sp")
+    params, _ = attn.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(4), (2, 8, 16))
+
+    ref, _ = attn.apply(params, x, causal=True)
+
+    specs = attn.param_pspecs()
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs, is_leaf=lambda l: isinstance(l, jnp.ndarray))
+    with use_mesh(mesh):
+        out, _ = jax.jit(lambda p, x: attn.apply(p, x, causal=True))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_matches_sequential():
+    from bigdl_tpu.nn import Linear, Sequential
+    from bigdl_tpu.nn.layers.activation import Tanh
+
+    mesh = make_mesh(MeshSpec(pp=4))
+    stage = Sequential().add(Linear(8, 8)).add(Tanh())
+    pipe = Pipeline(stage, mesh, n_micro=4)
+    stacked = pipe.init(jax.random.key(0))
+
+    x = jax.random.normal(jax.random.key(5), (8, 8))
+    out = jax.jit(pipe.apply)(stacked, x)
+
+    # reference: apply the 4 stages sequentially with each stage's params
+    ref = x
+    for i in range(4):
+        p_i = jax.tree_util.tree_map(lambda a: a[i], stacked)
+        ref, _ = stage.apply(p_i, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_is_differentiable():
+    from bigdl_tpu.nn import Linear
+
+    mesh = make_mesh(MeshSpec(pp=4))
+    pipe = Pipeline(Linear(4, 4), mesh, n_micro=2)
+    stacked = pipe.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(6), (4, 4))
+
+    def loss(p):
+        return jnp.mean(pipe.apply(p, x) ** 2)
+
+    g = jax.jit(jax.grad(loss))(stacked)
+    flat = jax.tree_util.tree_leaves(g)
+    assert flat and all(jnp.all(jnp.isfinite(l)) for l in flat)
+    assert any(float(jnp.abs(l).sum()) > 0 for l in flat)
+
+
+def test_switch_ffn_routes_and_balances():
+    mesh = make_mesh(MeshSpec(ep=4))
+    moe = SwitchFFN(hidden_size=8, filter_size=16, n_experts=4,
+                    capacity_factor=2.0)
+    params, state = moe.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(7), (2, 16, 8))
+
+    ref, ref_state = moe.apply(params, x, state=state)
+
+    specs = moe.param_pspecs()
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs, is_leaf=lambda l: isinstance(l, jnp.ndarray))
+    with use_mesh(mesh):
+        out, new_state = jax.jit(
+            lambda p, x: moe.apply(p, x, state=state))(sharded, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    assert float(new_state["aux_loss"]) > 0.5  # ~1.0 when balanced
+
+    # with generous capacity every token must be routed (output nonzero rows)
+    norms = jnp.linalg.norm(out.reshape(-1, 8), axis=-1)
+    assert float(jnp.mean(norms > 0)) > 0.9
+
+
+def test_column_row_parallel_linear_roundtrip():
+    mesh = make_mesh(MeshSpec(tp=4))
+    col = ColumnParallelLinear(8, 32)
+    row = RowParallelLinear(32, 8)
+    pc, _ = col.init(jax.random.key(0))
+    pr, _ = row.init(jax.random.key(1))
+    x = jax.random.normal(jax.random.key(8), (4, 8))
+
+    ref_h, _ = col.apply(pc, x)
+    ref, _ = row.apply(pr, ref_h)
+
+    shard = lambda p, specs: jax.tree_util.tree_map(
+        lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
+        p, specs, is_leaf=lambda l: isinstance(l, jnp.ndarray))
+    pc_s, pr_s = shard(pc, col.param_pspecs()), shard(pr, row.param_pspecs())
+
+    with use_mesh(mesh):
+        def f(pc, pr, x):
+            h, _ = col.apply(pc, x)
+            y, _ = row.apply(pr, h)
+            return y
+        out = jax.jit(f)(pc_s, pr_s, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
