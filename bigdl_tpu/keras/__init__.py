@@ -1,0 +1,57 @@
+"""Keras-1.2-style API tier (reference: ``DL/nn/keras/*``, 71 files).
+
+Shape-inferring layers + ``Sequential``/``Model`` with
+``compile``/``fit``/``evaluate``/``predict``. See ``topology.py``.
+"""
+
+from bigdl_tpu.keras.engine import Input, KerasLayer
+from bigdl_tpu.keras.layers import (
+    Activation,
+    AtrousConvolution2D,
+    AveragePooling1D,
+    AveragePooling2D,
+    BatchNormalization,
+    Bidirectional,
+    ConvLSTM2D,
+    Convolution1D,
+    Convolution2D,
+    Cropping1D,
+    Cropping2D,
+    Deconvolution2D,
+    Dense,
+    Dropout,
+    ELU,
+    Embedding,
+    Flatten,
+    GRU,
+    GaussianDropout,
+    GaussianNoise,
+    GlobalAveragePooling1D,
+    GlobalAveragePooling2D,
+    GlobalMaxPooling1D,
+    GlobalMaxPooling2D,
+    Highway,
+    InputLayer,
+    LSTM,
+    LeakyReLU,
+    Masking,
+    MaxPooling1D,
+    MaxPooling2D,
+    MaxoutDense,
+    Merge,
+    PReLU,
+    Permute,
+    RepeatVector,
+    Reshape,
+    SimpleRNN,
+    ThresholdedReLU,
+    TimeDistributed,
+    UpSampling1D,
+    UpSampling2D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+    merge,
+)
+from bigdl_tpu.keras.topology import KerasModel, Model, Sequential
+
+__all__ = [k for k in dir() if not k.startswith("_")]

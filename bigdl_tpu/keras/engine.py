@@ -1,0 +1,147 @@
+"""Keras-tier engine: shape-inferring layer adapter.
+
+Reference: ``DL/nn/keras/KerasLayer`` + ``InferShape``
+(``DL/nn/abstractnn/InferShape.scala``) — every Keras-style layer knows its
+output shape given an input shape, so users never spell out fan-in sizes.
+
+TPU-native design: a ``KerasLayer`` is a *builder* around the core layer
+zoo. ``build(input_shape)`` instantiates the underlying
+:class:`bigdl_tpu.nn.module.Module` once the input shape is known
+(``Sequential.add`` or functional ``layer(node)`` both trigger it); after
+that the KerasLayer delegates ``init``/``forward`` straight to the inner
+module, so parameter trees look exactly like hand-built core models.
+
+Shapes are Keras-style: tuples WITHOUT the batch dimension, e.g.
+``(channels, h, w)`` for NCHW image inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+from bigdl_tpu.nn.graph import Node
+from bigdl_tpu.nn.module import Context, Module
+
+Shape = Tuple[int, ...]
+
+
+def conv_output_length(input_len: int, filter_size: int, border_mode: str,
+                       stride: int, dilation: int = 1) -> int:
+    """Keras conv/pool length arithmetic ('valid' or 'same')."""
+    if input_len is None:
+        return None
+    eff = filter_size + (filter_size - 1) * (dilation - 1)
+    if border_mode == "same":
+        out = input_len
+    elif border_mode == "valid":
+        out = input_len - eff + 1
+    else:
+        raise ValueError(f"unknown border_mode {border_mode!r}")
+    return (out + stride - 1) // stride
+
+
+def same_padding(filter_size: int, dilation: int = 1) -> int:
+    """Symmetric pad amount approximating Keras 'same' (odd kernels exact)."""
+    eff = filter_size + (filter_size - 1) * (dilation - 1)
+    return (eff - 1) // 2
+
+
+class KerasLayer(Module):
+    """Base for all Keras-style layers.
+
+    Subclasses implement ``build(input_shape) -> Module`` and
+    ``compute_output_shape(input_shape) -> shape``; everything else
+    (delegation, shape bookkeeping, the functional-API ``__call__``) lives
+    here.
+    """
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None, name: Optional[str] = None):
+        super().__init__()
+        self._input_shape: Optional[Shape] = tuple(input_shape) if input_shape else None
+        self._output_shape: Optional[Shape] = None
+        self._inner: Optional[Module] = None
+        if name:
+            self.set_name(name)
+
+    # -- to be overridden --
+    def build(self, input_shape: Shape) -> Module:
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        raise NotImplementedError
+
+    # -- machinery --
+    def ensure_built(self, input_shape: Optional[Shape] = None) -> "KerasLayer":
+        if self._inner is not None:
+            return self
+        shape = input_shape if input_shape is not None else self._input_shape
+        if shape is None:
+            raise ValueError(
+                f"{type(self).__name__} needs an input_shape (first layer of a "
+                f"Sequential must pass input_shape=...)"
+            )
+        self._input_shape = tuple(shape) if not _is_multi(shape) else tuple(map(tuple, shape))
+        self._inner = self.build(self._input_shape)
+        self._output_shape = self.compute_output_shape(self._input_shape)
+        return self
+
+    @property
+    def input_shape(self) -> Optional[Shape]:
+        return self._input_shape
+
+    def get_output_shape(self) -> Shape:
+        if self._output_shape is None:
+            raise ValueError(f"{type(self).__name__} is not built yet")
+        return self._output_shape
+
+    # delegate init/forward to the inner module at the SAME tree level so
+    # param paths match an equivalently hand-built core model
+    def init(self, rng):
+        self.ensure_built()
+        return self._inner.init(rng)
+
+    def forward(self, ctx: Context, x):
+        self.ensure_built()
+        return self._inner.forward(ctx, x)
+
+    def param_pspecs(self):
+        self.ensure_built()
+        return self._inner.param_pspecs()
+
+    # -- functional API: layer(node) with shape propagation --
+    def __call__(self, *nodes):
+        nodes = [n for n in nodes]
+        if len(nodes) == 1 and isinstance(nodes[0], (list, tuple)):
+            nodes = list(nodes[0])
+        in_nodes = []
+        for n in nodes:
+            if not isinstance(n, Node):
+                raise TypeError(
+                    f"Keras functional API wires nodes (from Input()); got {type(n).__name__}"
+                )
+            in_nodes.append(n)
+        shapes = [getattr(n, "keras_shape", None) for n in in_nodes]
+        if any(s is None for s in shapes):
+            raise ValueError("upstream node has no shape; start from keras.Input(shape=...)")
+        in_shape = shapes[0] if len(shapes) == 1 else tuple(shapes)
+        self.ensure_built(in_shape)
+        out = Node(self, in_nodes)
+        out.keras_shape = self.get_output_shape()
+        return out
+
+
+def _is_multi(shape) -> bool:
+    return bool(shape) and isinstance(shape[0], (tuple, list))
+
+
+def Input(shape: Sequence[int], name: Optional[str] = None) -> Node:
+    """Functional-API entry point (reference ``DL/nn/keras`` Input).
+
+    Returns a graph :class:`Node` carrying ``keras_shape`` (batch dim
+    excluded) for downstream shape inference.
+    """
+    node = Node(None, [])
+    node.keras_shape = tuple(shape)
+    if name:
+        node.name = name
+    return node

@@ -1,0 +1,825 @@
+"""Keras-1.2-style layer set.
+
+Reference: ``DL/nn/keras/*`` (71 files — Dense, Convolution1D/2D,
+MaxPooling, LSTM/GRU/SimpleRNN, Bidirectional, Merge, Embedding,
+BatchNormalization, advanced activations, …). Each class here is a
+shape-inferring builder over the core layer zoo (see ``engine.py``);
+the heavy lifting (conv lowering to ``lax.conv_general_dilated``,
+scan-based recurrence, …) lives in ``bigdl_tpu.nn.layers``.
+
+Shapes exclude the batch dim. Image layout is NCHW (Keras "th"
+dim-ordering, the reference's default for its Keras tier).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.keras.engine import (
+    KerasLayer, Shape, conv_output_length, same_padding,
+)
+from bigdl_tpu.nn import containers as C
+from bigdl_tpu.nn import layers as L
+from bigdl_tpu.nn.module import LambdaLayer, Module
+
+# ---------------------------------------------------------------- helpers
+
+_ACTIVATIONS = {
+    "relu": L.ReLU,
+    "relu6": L.ReLU6,
+    "tanh": L.Tanh,
+    "sigmoid": L.Sigmoid,
+    "hard_sigmoid": L.HardSigmoid,
+    "softmax": L.SoftMax,
+    "log_softmax": L.LogSoftMax,
+    "softplus": L.SoftPlus,
+    "softsign": L.SoftSign,
+    "elu": L.ELU,
+    "gelu": L.GELU,
+    "silu": L.SiLU,
+    "swish": L.SiLU,
+    "linear": L.Identity,
+    "identity": L.Identity,
+}
+
+
+def get_activation(name: Optional[str]) -> Optional[Module]:
+    if name is None or isinstance(name, Module):
+        return name
+    try:
+        return _ACTIVATIONS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
+        ) from None
+
+
+def _seq(*modules: Optional[Module]) -> Module:
+    mods = [m for m in modules if m is not None]
+    if len(mods) == 1:
+        return mods[0]
+    s = C.Sequential()
+    for m in mods:
+        s.add(m)
+    return s
+
+
+# ------------------------------------------------------------- core layers
+
+
+class InputLayer(KerasLayer):
+    def build(self, input_shape):
+        return L.Identity()
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Dense(KerasLayer):
+    """Fully connected (reference ``DL/nn/keras/Dense.scala``)."""
+
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        return _seq(
+            L.Linear(input_shape[-1], self.output_dim, with_bias=self.bias),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[:-1] + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str, **kw):
+        super().__init__(**kw)
+        self.activation = activation
+
+    def build(self, input_shape):
+        return get_activation(self.activation)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return L.Dropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Flatten(KerasLayer):
+    def build(self, input_shape):
+        n = int(math.prod(input_shape))
+        return L.Reshape((n,), batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        return (int(math.prod(input_shape)),)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.target_shape = tuple(target_shape)
+
+    def build(self, input_shape):
+        tgt = self.compute_output_shape(input_shape)
+        return L.Reshape(tgt, batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        n = int(math.prod(input_shape))
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            i = tgt.index(-1)
+            known = int(math.prod(d for d in tgt if d != -1))
+            tgt[i] = n // known
+        return tuple(tgt)
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims; ``dims`` is 1-indexed like Keras."""
+
+    def __init__(self, dims: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.dims = tuple(dims)
+
+    def build(self, input_shape):
+        perm = (0,) + tuple(d for d in self.dims)  # batch + 1-indexed dims
+        return LambdaLayer(lambda x: jnp.transpose(x, perm))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, **kw):
+        super().__init__(**kw)
+        self.n = n
+
+    def build(self, input_shape):
+        n = self.n
+        return LambdaLayer(lambda x: jnp.repeat(x[:, None, :], n, axis=1))
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+class Masking(KerasLayer):
+    """Zero out timesteps equal to ``mask_value`` (soft version: masks the
+    features; downstream recurrent layers see zeros)."""
+
+    def __init__(self, mask_value: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.mask_value = mask_value
+
+    def build(self, input_shape):
+        mv = self.mask_value
+        def f(x):
+            keep = jnp.any(x != mv, axis=-1, keepdims=True)
+            return jnp.where(keep, x, 0.0)
+        return LambdaLayer(f)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Merge(KerasLayer):
+    """Merge a list of inputs (reference ``DL/nn/keras/Merge.scala``).
+    Modes: sum, mul, max, min, ave, concat, dot, cosine."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, **kw):
+        super().__init__(**kw)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def build(self, input_shape):
+        mode, axis = self.mode, self.concat_axis
+        table = {
+            "sum": L.CAddTable, "mul": L.CMulTable, "max": L.CMaxTable,
+            "min": L.CMinTable, "ave": L.CAveTable,
+        }
+        if mode in table:
+            return table[mode]()
+        if mode == "concat":
+            return L.JoinTable(axis if axis >= 0 else axis)
+        if mode == "dot":
+            return L.DotProduct()
+        if mode == "cosine":
+            return L.CosineDistance()
+        raise ValueError(f"unknown merge mode {mode!r}")
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape  # tuple of shapes
+        if self.mode in ("sum", "mul", "max", "min", "ave"):
+            return shapes[0]
+        if self.mode == "concat":
+            axis = self.concat_axis
+            idx = axis - 1 if axis > 0 else len(shapes[0]) + axis
+            out = list(shapes[0])
+            out[idx] = sum(s[idx] for s in shapes)
+            return tuple(out)
+        return (1,)
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float, **kw):
+        super().__init__(**kw)
+        self.sigma = sigma
+
+    def build(self, input_shape):
+        return L.GaussianNoise(self.sigma)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return L.GaussianDropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class Highway(KerasLayer):
+    """y = t * h(Wx+b) + (1-t) * x (reference ``DL/nn/keras/Highway``)."""
+
+    def __init__(self, activation: str = "tanh", bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        d = input_shape[-1]
+        h = L.Linear(d, d, with_bias=self.bias)
+        t = L.Linear(d, d, with_bias=self.bias)
+        act = get_activation(self.activation)
+
+        class _Highway(Module):
+            def __init__(self):
+                super().__init__()
+                self.h = h
+                self.t = t
+                self.act = act
+
+            def forward(self, ctx, x):
+                hx = self.act.forward(ctx.child("act"), self.run_child(ctx, "h", x))
+                tx = jax.nn.sigmoid(self.run_child(ctx, "t", x))
+                return tx * hx + (1 - tx) * x
+
+        return _Highway()
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class MaxoutDense(KerasLayer):
+    """Max over ``nb_feature`` linear maps (reference ``MaxoutDense``)."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def build(self, input_shape):
+        lin = L.Linear(input_shape[-1], self.output_dim * self.nb_feature)
+        k, d = self.nb_feature, self.output_dim
+
+        class _Maxout(Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = lin
+
+            def forward(self, ctx, x):
+                z = self.run_child(ctx, "lin", x)
+                return jnp.max(z.reshape(z.shape[:-1] + (k, d)), axis=-2)
+
+        return _Maxout()
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[:-1] + (self.output_dim,)
+
+
+# ------------------------------------------------------------ convolution
+
+
+class Convolution2D(KerasLayer):
+    """2-D conv, NCHW (reference ``DL/nn/keras/Convolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+
+        self.bias = bias
+
+    def build(self, input_shape):
+        cin = input_shape[0]
+        ph = same_padding(self.nb_row) if self.border_mode == "same" else 0
+        pw = same_padding(self.nb_col) if self.border_mode == "same" else 0
+        return _seq(
+            L.SpatialConvolution(
+                cin, self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0], pw, ph,
+                with_bias=self.bias,
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        oh = conv_output_length(h, self.nb_row, self.border_mode, self.subsample[0])
+        ow = conv_output_length(w, self.nb_col, self.border_mode, self.subsample[1])
+        return (self.nb_filter, oh, ow)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """Dilated 2-D conv (reference ``AtrousConvolution2D``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate: Tuple[int, int] = (1, 1),
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.atrous_rate = tuple(atrous_rate)
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        cin = input_shape[0]
+        return _seq(
+            L.SpatialDilatedConvolution(
+                cin, self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0], 0, 0,
+                self.atrous_rate[1], self.atrous_rate[0],
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        oh = conv_output_length(h, self.nb_row, "valid", self.subsample[0], self.atrous_rate[0])
+        ow = conv_output_length(w, self.nb_col, "valid", self.subsample[1], self.atrous_rate[1])
+        return (self.nb_filter, oh, ow)
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv (reference ``Deconvolution2D``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        cin = input_shape[0]
+        return _seq(
+            L.SpatialFullConvolution(
+                cin, self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0],
+                with_bias=self.bias,
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        oh = (h - 1) * self.subsample[0] + self.nb_row
+        ow = (w - 1) * self.subsample[1] + self.nb_col
+        return (self.nb_filter, oh, ow)
+
+
+class Convolution1D(KerasLayer):
+    """1-D conv over (steps, dim) inputs (reference ``Convolution1D``)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, border_mode: str = "valid",
+                 subsample_length: int = 1, bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def build(self, input_shape):
+        steps, dim = input_shape
+        conv = L.TemporalConvolution(
+            dim, self.nb_filter, self.filter_length, self.subsample_length,
+        )
+        if self.border_mode == "same":
+            p = same_padding(self.filter_length)
+            pad = LambdaLayer(lambda x: jnp.pad(x, ((0, 0), (p, p), (0, 0))))
+            return _seq(pad, conv, get_activation(self.activation))
+        return _seq(conv, get_activation(self.activation))
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        out = conv_output_length(steps, self.filter_length, self.border_mode,
+                                 self.subsample_length)
+        return (out, self.nb_filter)
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, **kw):
+        super().__init__(**kw)
+        self.padding = padding
+
+    def build(self, input_shape):
+        p = self.padding
+        return LambdaLayer(lambda x: jnp.pad(x, ((0, 0), (p, p), (0, 0))))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0] + 2 * self.padding,) + tuple(input_shape[1:])
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding: Tuple[int, int] = (1, 1), **kw):
+        super().__init__(**kw)
+        self.padding = tuple(padding)
+
+    def build(self, input_shape):
+        ph, pw = self.padding
+        return LambdaLayer(
+            lambda x: jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        )
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h + 2 * self.padding[0], w + 2 * self.padding[1])
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping: Tuple[int, int] = (1, 1), **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(cropping)
+
+    def build(self, input_shape):
+        a, b = self.cropping
+        end = input_shape[0] - b
+        return LambdaLayer(lambda x: x[:, a:end])
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0] - sum(self.cropping),) + tuple(input_shape[1:])
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(map(tuple, cropping))
+
+    def build(self, input_shape):
+        (t, b), (l, r) = self.cropping
+        _, h, w = input_shape
+        return LambdaLayer(lambda x: x[:, :, t:h - b, l:w - r])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        (t, b), (l, r) = self.cropping
+        return (c, h - t - b, w - l - r)
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2, **kw):
+        super().__init__(**kw)
+        self.length = length
+
+    def build(self, input_shape):
+        n = self.length
+        return LambdaLayer(lambda x: jnp.repeat(x, n, axis=1))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0] * self.length,) + tuple(input_shape[1:])
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size: Tuple[int, int] = (2, 2), **kw):
+        super().__init__(**kw)
+        self.size = tuple(size)
+
+    def build(self, input_shape):
+        sh, sw = self.size
+        return LambdaLayer(
+            lambda x: jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+        )
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h * self.size[0], w * self.size[1])
+
+
+# ---------------------------------------------------------------- pooling
+
+
+class _Pool2D(KerasLayer):
+    pool_cls = None
+
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid", **kw):
+        super().__init__(**kw)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+    def build(self, input_shape):
+        ph = same_padding(self.pool_size[0]) if self.border_mode == "same" else 0
+        pw = same_padding(self.pool_size[1]) if self.border_mode == "same" else 0
+        return self.pool_cls(
+            self.pool_size[1], self.pool_size[0],
+            self.strides[1], self.strides[0], pw, ph,
+        )
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        oh = conv_output_length(h, self.pool_size[0], self.border_mode, self.strides[0])
+        ow = conv_output_length(w, self.pool_size[1], self.border_mode, self.strides[1])
+        return (c, oh, ow)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_cls = L.SpatialMaxPooling
+
+
+class AveragePooling2D(_Pool2D):
+    pool_cls = L.SpatialAveragePooling
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def build(self, input_shape):
+        return L.TemporalMaxPooling(self.pool_length, self.stride)
+
+    def compute_output_shape(self, input_shape):
+        out = conv_output_length(input_shape[0], self.pool_length, "valid", self.stride)
+        return (out, input_shape[1])
+
+
+class AveragePooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def build(self, input_shape):
+        k, s = self.pool_length, self.stride
+        def f(x):
+            n = (x.shape[1] - k) // s + 1
+            idx = jnp.arange(n) * s
+            # strided window gather: (B, n, k, D) -> mean over k
+            gather = x[:, idx[:, None] + jnp.arange(k)[None, :], :]
+            return jnp.mean(gather, axis=2)
+        return LambdaLayer(f)
+
+    def compute_output_shape(self, input_shape):
+        out = conv_output_length(input_shape[0], self.pool_length, "valid", self.stride)
+        return (out, input_shape[1])
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build(self, input_shape):
+        return LambdaLayer(lambda x: jnp.max(x, axis=1))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build(self, input_shape):
+        return LambdaLayer(lambda x: jnp.mean(x, axis=1))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build(self, input_shape):
+        return LambdaLayer(lambda x: jnp.max(x, axis=(2, 3)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build(self, input_shape):
+        return L.GlobalAveragePooling2D()
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+# -------------------------------------------------------------- recurrent
+
+
+class _KerasRecurrent(KerasLayer):
+    def __init__(self, output_dim: int, activation: str = "tanh",
+                 return_sequences: bool = False, go_backwards: bool = False, **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def make_cell(self, input_dim: int):
+        raise NotImplementedError
+
+    def build(self, input_shape):
+        cell = self.make_cell(input_shape[-1])
+        return L.Recurrent(cell, return_sequences=self.return_sequences,
+                           reverse=self.go_backwards)
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], self.output_dim)
+        return (self.output_dim,)
+
+
+class SimpleRNN(_KerasRecurrent):
+    def make_cell(self, input_dim):
+        return L.RnnCell(input_dim, self.output_dim, activation=self.activation)
+
+
+class LSTM(_KerasRecurrent):
+    def make_cell(self, input_dim):
+        return L.LSTMCell(input_dim, self.output_dim)
+
+
+class GRU(_KerasRecurrent):
+    def make_cell(self, input_dim):
+        return L.GRUCell(input_dim, self.output_dim)
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over (steps, channels, h, w) inputs."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int = 3,
+                 return_sequences: bool = False, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+
+    def build(self, input_shape):
+        _, cin, h, w = input_shape
+        cell = L.ConvLSTMPeepholeCell(cin, self.nb_filter, self.nb_kernel)
+        return L.Recurrent(cell, return_sequences=self.return_sequences)
+
+    def compute_output_shape(self, input_shape):
+        t, _, h, w = input_shape
+        out = (self.nb_filter, h, w)
+        return (t,) + out if self.return_sequences else out
+
+
+class Bidirectional(KerasLayer):
+    """Wrap a recurrent Keras layer front-and-back (reference
+    ``DL/nn/keras/Bidirectional.scala``)."""
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode: str = "concat", **kw):
+        super().__init__(**kw)
+        self.layer = layer
+        self.merge_mode = "concat" if merge_mode == "concat" else "sum"
+
+    def build(self, input_shape):
+        fwd = self.layer.make_cell(input_shape[-1])
+        bwd = self.layer.make_cell(input_shape[-1])
+        if not self.layer.return_sequences:
+            raise ValueError("Bidirectional requires return_sequences=True")
+        return L.BiRecurrent(fwd, bwd, merge=self.merge_mode)
+
+    def compute_output_shape(self, input_shape):
+        d = self.layer.output_dim
+        if self.merge_mode == "concat":
+            d *= 2
+        return (input_shape[0], d)
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner Keras layer to every timestep."""
+
+    def __init__(self, layer: KerasLayer, **kw):
+        super().__init__(**kw)
+        self.layer = layer
+
+    def build(self, input_shape):
+        self.layer.ensure_built(tuple(input_shape[1:]))
+        return L.TimeDistributed(self.layer)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(self.layer.get_output_shape())
+
+
+# ------------------------------------------------- embedding / norm / act
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int, **kw):
+        super().__init__(**kw)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build(self, input_shape):
+        return L.LookupTable(self.input_dim, self.output_dim)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build(self, input_shape):
+        if len(input_shape) == 3:  # NCHW feature maps
+            return L.SpatialBatchNormalization(
+                input_shape[0], eps=self.epsilon, momentum=1 - self.momentum,
+            )
+        return L.BatchNormalization(
+            input_shape[-1], eps=self.epsilon, momentum=1 - self.momentum,
+        )
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return L.LeakyReLU(self.alpha)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def build(self, input_shape):
+        return L.ELU(self.alpha)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class PReLU(KerasLayer):
+    def build(self, input_shape):
+        return L.PReLU()
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def build(self, input_shape):
+        return L.Threshold(self.theta, 0.0)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape

@@ -1,0 +1,98 @@
+"""Keras-style name → framework-object mappings.
+
+Reference: the Scala Keras tier accepts strings in ``compile`` for
+optimizer / loss / metrics (``DL/nn/keras/Topology.scala:55-87``,
+``KerasUtils.toBigDLCriterion`` / ``toBigDLOptimMethod``).
+
+Label convention: classification losses here take INTEGER class labels
+(the framework-native convention, like the reference's ClassNLLCriterion
+1-based targets made 0-based); ``categorical_crossentropy`` accepts
+either int labels or one-hot rows (argmax'd on the fly).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import criterion as Cr
+from bigdl_tpu.nn.module import Criterion
+from bigdl_tpu.optim import optim_method as Om
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.validation import Loss, Top1Accuracy, Top5Accuracy, ValidationMethod
+
+
+class _CategoricalCrossEntropy(Criterion):
+    """Cross-entropy over softmax probabilities (Keras semantics); accepts
+    one-hot or integer targets."""
+
+    def __init__(self, from_logits: bool = False):
+        self.from_logits = from_logits
+
+    def forward(self, output, target):
+        if self.from_logits:
+            logp = output - jax.nn.logsumexp(output, axis=-1, keepdims=True)
+        else:
+            logp = jnp.log(jnp.clip(output, 1e-8, 1.0))
+        if target.ndim == output.ndim:  # one-hot
+            target = jnp.argmax(target, axis=-1)
+        onehot = jnp.take_along_axis(logp, target[..., None].astype(jnp.int32), axis=-1)
+        return -jnp.mean(onehot)
+
+
+_LOSSES = {
+    "mse": Cr.MSECriterion,
+    "mean_squared_error": Cr.MSECriterion,
+    "mae": Cr.AbsCriterion,
+    "mean_absolute_error": Cr.AbsCriterion,
+    "categorical_crossentropy": _CategoricalCrossEntropy,
+    "sparse_categorical_crossentropy": _CategoricalCrossEntropy,
+    "binary_crossentropy": Cr.BCECriterion,
+    "hinge": Cr.MarginCriterion,
+    "kld": Cr.DistKLDivCriterion,
+    "kullback_leibler_divergence": Cr.DistKLDivCriterion,
+    "nll": Cr.ClassNLLCriterion,
+    "crossentropy_from_logits": Cr.CrossEntropyCriterion,
+}
+
+_OPTIMIZERS = {
+    "sgd": lambda: Om.SGD(learning_rate=0.01),
+    "adam": lambda: Om.Adam(),
+    "adamax": lambda: Om.Adamax(),
+    "adagrad": lambda: Om.Adagrad(),
+    "adadelta": lambda: Om.Adadelta(),
+    "rmsprop": lambda: Om.RMSprop(),
+}
+
+
+def to_criterion(loss: Union[str, Criterion]) -> Criterion:
+    if isinstance(loss, Criterion):
+        return loss
+    try:
+        return _LOSSES[loss.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}") from None
+
+
+def to_optim_method(opt: Union[str, OptimMethod]) -> OptimMethod:
+    if isinstance(opt, OptimMethod):
+        return opt
+    try:
+        return _OPTIMIZERS[opt.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown optimizer {opt!r}; known: {sorted(_OPTIMIZERS)}") from None
+
+
+def to_metric(metric, criterion: Criterion) -> ValidationMethod:
+    if isinstance(metric, ValidationMethod):
+        return metric
+    name = str(metric).lower()
+    if name in ("accuracy", "acc", "top1", "top1accuracy"):
+        return Top1Accuracy()
+    if name in ("top5", "top5accuracy"):
+        return Top5Accuracy()
+    if name == "loss":
+        return Loss(criterion)
+    raise ValueError(f"unknown metric {metric!r}")

@@ -1,0 +1,198 @@
+"""Keras-tier topology: Sequential / Model with compile·fit·evaluate·predict.
+
+Reference: ``DL/nn/keras/Topology.scala`` — ``KerasModel.compile`` (:55),
+``fit`` (:89), ``evaluate`` (:127), ``predict`` (:149); ``Model`` (:165,
+functional graph), ``Sequential`` (:262).
+
+TPU-native: ``fit`` builds a core :class:`~bigdl_tpu.optim.optimizer.Optimizer`
+(jit on one chip, pjit over the mesh when more devices are visible) over an
+in-memory ``DataSet``; ``predict``/``evaluate`` run a jitted forward in
+batches. Trained params/state live on the model object so the Keras tier is
+usable imperatively, like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet, DataSet
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.keras.engine import KerasLayer
+from bigdl_tpu.keras.objectives import to_criterion, to_metric, to_optim_method
+from bigdl_tpu.nn import containers as C
+from bigdl_tpu.nn.graph import Graph, Node
+from bigdl_tpu.nn.module import Context, Criterion, Module
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.trigger import Trigger
+
+log = logging.getLogger("bigdl_tpu.keras")
+
+
+class KerasModel(Module):
+    """compile/fit/evaluate/predict mixin (reference ``KerasModel``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._optim_method: Optional[OptimMethod] = None
+        self._criterion: Optional[Criterion] = None
+        self._metrics: Optional[list] = None
+        self._params = None
+        self._state = None
+
+    # -- training ----------------------------------------------------------
+    def compile(self, optimizer: Union[str, OptimMethod],
+                loss: Union[str, Criterion],
+                metrics: Optional[Sequence] = None) -> "KerasModel":
+        self._optim_method = to_optim_method(optimizer)
+        self._criterion = to_criterion(loss)
+        self._metrics = [to_metric(m, self._criterion) for m in (metrics or [])]
+        return self
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: Optional[bool] = None):
+        """Train. ``x`` may be arrays (with ``y``) or an ``AbstractDataSet``
+        yielding MiniBatches."""
+        if self._optim_method is None:
+            raise RuntimeError("call compile(...) before fit(...)")
+        if isinstance(x, AbstractDataSet):
+            ds = x
+        else:
+            ds = DataSet.tensors(np.asarray(x), np.asarray(y)) >> SampleToMiniBatch(batch_size)
+
+        if distributed is None:
+            distributed = jax.device_count() > 1
+        if distributed:
+            from bigdl_tpu.optim.distri_optimizer import DistriOptimizer as Opt
+        else:
+            from bigdl_tpu.optim.optimizer import LocalOptimizer as Opt
+        opt = Opt(self, ds, self._criterion, batch_size=batch_size)
+        opt.set_optim_method(self._optim_method)
+        opt.set_end_when(Trigger.max_epoch(nb_epoch))
+        if self._params is not None:
+            opt.set_model_and_state(self._params, self._state)
+        if validation_data is not None and self._metrics:
+            vx, vy = validation_data
+            vds = DataSet.tensors(np.asarray(vx), np.asarray(vy))
+            opt.set_validation(Trigger.every_epoch(), vds, self._metrics, batch_size)
+        self._params, self._state = opt.optimize()
+        return self
+
+    # -- inference ---------------------------------------------------------
+    def _require_params(self):
+        if self._params is None:
+            self._params, self._state = self.init(jax.random.key(0))
+        return self._params, self._state or {}
+
+    def predict(self, x, batch_size: int = 32):
+        """Forward in batches; returns a stacked np.ndarray
+        (reference ``KerasModel.predict``, ``Topology.scala:149``)."""
+        params, state = self._require_params()
+
+        @jax.jit
+        def fwd(p, s, xb):
+            out, _ = self.apply(p, xb, state=s, training=False)
+            return out
+
+        x = np.asarray(x)
+        outs = []
+        for i in range(0, len(x), batch_size):
+            outs.append(np.asarray(fwd(params, state, jnp.asarray(x[i:i + batch_size]))))
+        return np.concatenate(outs, axis=0)
+
+    def predict_classes(self, x, batch_size: int = 32):
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        """Returns [(name, value)] for loss + compiled metrics."""
+        from bigdl_tpu.optim.validation import Loss, ValidationResult
+
+        params, state = self._require_params()
+        methods = [Loss(self._criterion)] + list(self._metrics or [])
+
+        @jax.jit
+        def eval_step(p, s, xb, yb):
+            out, _ = self.apply(p, xb, state=s, training=False)
+            return [m.batch(out, yb) for m in methods]
+
+        x, y = np.asarray(x), np.asarray(y)
+        totals = [ValidationResult(0.0, 0, m.name) for m in methods]
+        for i in range(0, len(x), batch_size):
+            outs = eval_step(params, state, jnp.asarray(x[i:i + batch_size]),
+                             jnp.asarray(y[i:i + batch_size]))
+            for j, (v, n) in enumerate(outs):
+                totals[j] = totals[j] + ValidationResult(float(v), int(n), totals[j].name)
+        return [(t.name, t.result()[0]) for t in totals]
+
+    # -- weights access ----------------------------------------------------
+    def get_weights(self):
+        params, _ = self._require_params()
+        return params
+
+    def set_weights(self, params, state=None) -> "KerasModel":
+        self._params = params
+        if state is not None:
+            self._state = state
+        return self
+
+
+class Sequential(KerasModel):
+    """Linear layer stack with shape inference on ``add``
+    (reference ``DL/nn/keras/Topology.scala:262``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._seq = C.Sequential()
+        self._modules.clear()
+        self._modules["seq"] = self._seq
+        self._layers: list = []
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if not isinstance(layer, KerasLayer):
+            raise TypeError(
+                f"keras.Sequential takes Keras-style layers; got {type(layer).__name__} "
+                f"(use bigdl_tpu.nn.Sequential for core layers)"
+            )
+        if self._layers:
+            layer.ensure_built(self._layers[-1].get_output_shape())
+        else:
+            layer.ensure_built()  # needs input_shape=...
+        self._layers.append(layer)
+        name = layer.get_name() or f"{type(layer).__name__.lower()}_{len(self._layers)}"
+        self._seq.add(layer, name)
+        return self
+
+    def get_output_shape(self):
+        return self._layers[-1].get_output_shape()
+
+    def forward(self, ctx: Context, x):
+        return self.run_child(ctx, "seq", x)
+
+
+class Model(KerasModel):
+    """Functional graph model (reference ``Topology.scala:165``)::
+
+        inp = Input(shape=(784,))
+        h = Dense(128, activation="relu")(inp)
+        out = Dense(10, activation="softmax")(h)
+        model = Model(inp, out).compile("sgd", "categorical_crossentropy")
+    """
+
+    def __init__(self, input: Union[Node, Sequence[Node]],
+                 output: Union[Node, Sequence[Node]]):
+        super().__init__()
+        self._graph = Graph(input, output)
+        self._modules.clear()
+        self._modules["graph"] = self._graph
+        outs = [output] if isinstance(output, Node) else list(output)
+        self._output_shapes = [getattr(n, "keras_shape", None) for n in outs]
+
+    def get_output_shape(self):
+        return self._output_shapes[0] if len(self._output_shapes) == 1 else tuple(self._output_shapes)
+
+    def forward(self, ctx: Context, x):
+        return self.run_child(ctx, "graph", x)

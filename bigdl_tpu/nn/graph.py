@@ -34,7 +34,7 @@ from bigdl_tpu.nn.module import Context, Module, Params, State
 class Node:
     """A module wired into a DAG with its input nodes."""
 
-    __slots__ = ("element", "prev")
+    __slots__ = ("element", "prev", "keras_shape", "name")
 
     def __init__(self, element: Optional[Module], prev: Sequence["Node"] = ()):
         self.element = element

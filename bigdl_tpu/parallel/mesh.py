@@ -130,7 +130,19 @@ def constrain(x, *spec_parts):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*cleaned)))
 
 
-def sharding_for(mesh: Mesh, *spec_parts) -> NamedSharding:
-    names = set(mesh.axis_names)
-    cleaned = [p if (p in names or p is None) else None for p in spec_parts]
-    return NamedSharding(mesh, P(*cleaned))
+def mark_varying(t, axis_name):
+    """Cast ``t`` to device-varying over ``axis_name`` (shard_map type
+    system). ``pcast`` is the current API; ``pvary`` its deprecated
+    ancestor; very old jax has neither and tracks no varying types, so
+    identity is correct. Shared by the ring-attention and pipeline
+    collectives."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(t, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(t, (axis_name,))
+    return t
+
+
+def ring_perm(n: int):
+    """Neighbor permutation for ``lax.ppermute`` ring shifts."""
+    return [(i, (i + 1) % n) for i in range(n)]

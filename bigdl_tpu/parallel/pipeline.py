@@ -27,6 +27,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from bigdl_tpu.parallel.mesh import mark_varying, ring_perm
+
 
 def _stage_body(stage_fn, n_stages, n_micro, params, xs):
     """Per-chip GPipe schedule. ``params``: this chip's stage params (leading
@@ -35,15 +37,14 @@ def _stage_body(stage_fn, n_stages, n_micro, params, xs):
     stage = lax.axis_index("pp")
     n = n_stages
     total = n_micro + n - 1
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
 
     micro_shape = xs.shape[1:]
     out0 = jnp.zeros((n_micro,) + micro_shape, xs.dtype)
     recv0 = jnp.zeros(micro_shape, xs.dtype)
-    from bigdl_tpu.parallel.ring_attention import _mark_varying
-    out0 = _mark_varying(out0, "pp")
-    recv0 = _mark_varying(recv0, "pp")
-    xs = _mark_varying(xs, "pp")
+    out0 = mark_varying(out0, "pp")
+    recv0 = mark_varying(recv0, "pp")
+    xs = mark_varying(xs, "pp")
 
     def tick(carry, t):
         recv, outs = carry

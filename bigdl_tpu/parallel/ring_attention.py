@@ -31,17 +31,8 @@ from jax import lax
 _NEG_INF = -1e30
 
 
-def _mark_varying(t, axis_name):
-    """Cast ``t`` to device-varying over ``axis_name`` (shard_map type system).
-
-    ``pcast`` is the current API; ``pvary`` its deprecated ancestor; very old
-    jax has neither and tracks no varying types, so identity is correct.
-    """
-    if hasattr(lax, "pcast"):
-        return lax.pcast(t, axis_name, to="varying")
-    if hasattr(lax, "pvary"):
-        return lax.pvary(t, (axis_name,))
-    return t
+from bigdl_tpu.parallel.mesh import mark_varying as _mark_varying
+from bigdl_tpu.parallel.mesh import ring_perm
 
 
 def _block_attend(q, k, v, scale, mask):
@@ -70,7 +61,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     my_idx = lax.axis_index(axis_name)
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     b, h, sq, d = q.shape
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
 
     qf = q.astype(jnp.float32)
 

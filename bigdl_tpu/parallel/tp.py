@@ -30,7 +30,7 @@ from bigdl_tpu.nn.init import Xavier, Zeros
 from bigdl_tpu.nn.layers.linear import Linear
 from bigdl_tpu.nn.module import Context, Module
 from bigdl_tpu.ops.attention import dot_product_attention
-from bigdl_tpu.parallel.mesh import UNCONSTRAINED, constrain
+from bigdl_tpu.parallel.mesh import UNCONSTRAINED, constrain, current_mesh
 
 
 class ColumnParallelLinear(Linear):
@@ -140,10 +140,17 @@ class TensorParallelAttention(Module):
         q = self._heads(self.run_child(ctx, "q", x))
         k = self._heads(self.run_child(ctx, "k", x))
         v = self._heads(self.run_child(ctx, "v", x))
+        # Under an active mesh the heads/sequence dims are sharded; the
+        # Pallas flash kernel is a Mosaic custom call with no GSPMD
+        # partitioning rule, so force the XLA einsum path there (XLA
+        # partitions it and inserts the collectives). Single-chip keeps the
+        # auto-selected flash kernel.
+        use_flash = False if current_mesh() is not None else None
         o = dot_product_attention(
             q, k, v, bias=bias, causal=causal,
             dropout_rate=self.attention_dropout if ctx.training else 0.0,
             dropout_rng=ctx.rng() if (ctx.training and self.attention_dropout) else None,
+            use_flash=use_flash,
         )
         o = constrain(o, UNCONSTRAINED, self.axis, self.sp_axis or UNCONSTRAINED,
                       UNCONSTRAINED)

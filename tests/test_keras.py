@@ -1,0 +1,178 @@
+"""Keras-tier tests: shape inference, layer forward shapes, compile/fit/
+evaluate/predict (reference test model: ``DLT/keras/*Spec.scala``, 89 specs
+— keyed on output-shape inference and training round-trips)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import keras
+
+
+def _rand(*shape, dtype="float32"):
+    return np.random.RandomState(0).rand(*shape).astype(dtype)
+
+
+# ------------------------------------------------------- shape inference
+
+
+@pytest.mark.parametrize(
+    "layer,in_shape,expected",
+    [
+        (keras.Dense(7), (3,), (7,)),
+        (keras.Flatten(), (2, 3, 4), (24,)),
+        (keras.Reshape((6, 4)), (2, 3, 4), (6, 4)),
+        (keras.Reshape((-1, 4)), (2, 3, 4), (6, 4)),
+        (keras.Permute((2, 1)), (3, 5), (5, 3)),
+        (keras.RepeatVector(4), (6,), (4, 6)),
+        (keras.Convolution2D(8, 3, 3), (2, 10, 12), (8, 8, 10)),
+        (keras.Convolution2D(8, 3, 3, border_mode="same"), (2, 10, 12), (8, 10, 12)),
+        (keras.Convolution2D(8, 3, 3, subsample=(2, 2)), (2, 11, 11), (8, 5, 5)),
+        (keras.Deconvolution2D(4, 2, 2, subsample=(2, 2)), (3, 5, 5), (4, 10, 10)),
+        (keras.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2)), (2, 9, 9), (4, 5, 5)),
+        (keras.Convolution1D(6, 3), (10, 4), (8, 6)),
+        (keras.MaxPooling2D((2, 2)), (3, 8, 8), (3, 4, 4)),
+        (keras.AveragePooling2D((3, 3), strides=(2, 2)), (3, 9, 9), (3, 4, 4)),
+        (keras.MaxPooling1D(2), (8, 5), (4, 5)),
+        (keras.AveragePooling1D(2), (8, 5), (4, 5)),
+        (keras.GlobalMaxPooling2D(), (3, 8, 8), (3,)),
+        (keras.GlobalAveragePooling1D(), (8, 5), (5,)),
+        (keras.ZeroPadding2D((1, 2)), (3, 4, 4), (3, 6, 8)),
+        (keras.Cropping2D(((1, 1), (2, 2))), (3, 8, 8), (3, 6, 4)),
+        (keras.UpSampling2D((2, 2)), (3, 4, 4), (3, 8, 8)),
+        (keras.UpSampling1D(3), (4, 5), (12, 5)),
+        (keras.Embedding(50, 8), (7,), (7, 8)),
+        (keras.LSTM(9), (7, 4), (9,)),
+        (keras.LSTM(9, return_sequences=True), (7, 4), (7, 9)),
+        (keras.GRU(5, return_sequences=True), (7, 4), (7, 5)),
+        (keras.SimpleRNN(5), (7, 4), (5,)),
+        (keras.MaxoutDense(6, nb_feature=3), (4,), (6,)),
+        (keras.Highway(), (5,), (5,)),
+    ],
+)
+def test_output_shape_inference(layer, in_shape, expected):
+    layer.ensure_built(in_shape)
+    assert layer.get_output_shape() == expected
+
+
+@pytest.mark.parametrize(
+    "layer,in_shape",
+    [
+        (keras.Dense(7, activation="relu"), (3,)),
+        (keras.Convolution2D(8, 3, 3, border_mode="same", activation="relu"), (2, 6, 6)),
+        (keras.Convolution1D(6, 3, border_mode="same"), (10, 4)),
+        (keras.BatchNormalization(), (3, 4, 4)),
+        (keras.BatchNormalization(), (5,)),
+        (keras.LeakyReLU(0.1), (5,)),
+        (keras.ELU(), (5,)),
+        (keras.PReLU(), (5,)),
+        (keras.ThresholdedReLU(0.5), (5,)),
+        (keras.Masking(0.0), (4, 5)),
+        (keras.GaussianNoise(0.1), (5,)),
+        (keras.GaussianDropout(0.1), (5,)),
+        (keras.Dropout(0.3), (5,)),
+        (keras.ConvLSTM2D(4, 3), (5, 2, 6, 6)),
+        (keras.Bidirectional(keras.LSTM(3, return_sequences=True)), (6, 4)),
+        (keras.TimeDistributed(keras.Dense(3)), (6, 4)),
+    ],
+)
+def test_forward_shape_matches_inference(layer, in_shape, rng):
+    """Actual forward output shape == inferred shape (with batch prepended)."""
+    import jax
+
+    layer.ensure_built(in_shape)
+    params, state = layer.init(rng)
+    x = _rand(2, *in_shape)
+    out, _ = layer.apply(params, x, state=state, training=False)
+    assert out.shape == (2,) + layer.get_output_shape()
+
+
+def test_sequential_shape_chaining():
+    m = keras.Sequential()
+    m.add(keras.Convolution2D(4, 3, 3, input_shape=(1, 12, 12)))
+    m.add(keras.MaxPooling2D())
+    m.add(keras.Flatten())
+    m.add(keras.Dense(10))
+    assert m.get_output_shape() == (10,)
+
+
+def test_sequential_requires_input_shape_on_first_layer():
+    m = keras.Sequential()
+    with pytest.raises(ValueError, match="input_shape"):
+        m.add(keras.Dense(4))
+
+
+# ------------------------------------------------------- training round-trips
+
+
+def test_mlp_fit_reduces_loss():
+    rs = np.random.RandomState(1)
+    x = rs.rand(128, 10).astype("float32")
+    w = rs.rand(10, 3).astype("float32")
+    y = np.argmax(x @ w, axis=1)
+
+    m = keras.Sequential()
+    m.add(keras.Dense(32, activation="relu", input_shape=(10,)))
+    m.add(keras.Dense(3, activation="softmax"))
+    m.compile("adam", "categorical_crossentropy", metrics=["accuracy"])
+    before = dict(m.evaluate(x, y))["Loss"]
+    m.fit(x, y, batch_size=32, nb_epoch=15, distributed=False)
+    after = dict(m.evaluate(x, y))["Loss"]
+    assert after < before * 0.7
+
+
+def test_functional_model_with_merge():
+    inp = keras.Input(shape=(6,))
+    a = keras.Dense(4, activation="relu")(inp)
+    b = keras.Dense(4, activation="tanh")(inp)
+    out = keras.Dense(2, activation="softmax")(keras.merge([a, b], mode="concat"))
+    m = keras.Model(inp, out)
+    m.compile("sgd", "categorical_crossentropy")
+    x = _rand(20, 6)
+    y = np.random.RandomState(2).randint(0, 2, 20)
+    m.fit(x, y, batch_size=10, nb_epoch=1, distributed=False)
+    assert m.predict(x).shape == (20, 2)
+    assert m.predict_classes(x).shape == (20,)
+
+
+def test_merge_modes_forward(rng):
+    for mode in ("sum", "mul", "max", "ave", "concat"):
+        inp1 = keras.Input(shape=(5,))
+        d1 = keras.Dense(4)(inp1)
+        d2 = keras.Dense(4)(inp1)
+        out = keras.merge([d1, d2], mode=mode)
+        m = keras.Model(inp1, out)
+        params, state = m.init(rng)
+        o, _ = m.apply(params, _rand(3, 5), state=state)
+        exp = 8 if mode == "concat" else 4
+        assert o.shape == (3, exp), mode
+
+
+def test_evaluate_reports_loss_and_metrics():
+    m = keras.Sequential()
+    m.add(keras.Dense(3, activation="softmax", input_shape=(4,)))
+    m.compile("sgd", "categorical_crossentropy", metrics=["accuracy"])
+    x, y = _rand(16, 4), np.random.RandomState(0).randint(0, 3, 16)
+    res = dict(m.evaluate(x, y))
+    assert set(res) == {"Loss", "Top1Accuracy"}
+    assert 0.0 <= res["Top1Accuracy"] <= 1.0
+
+
+def test_weight_sharing_via_functional_reuse(rng):
+    shared = keras.Dense(4)
+    inp = keras.Input(shape=(4,))
+    h1 = shared(inp)
+    h2 = shared(h1)  # same layer twice -> one params subtree
+    m = keras.Model(inp, h2)
+    params, _ = m.init(rng)
+    assert len(params["graph"]) == 1
+
+
+def test_string_lookups_reject_unknown():
+    m = keras.Sequential()
+    m.add(keras.Dense(2, input_shape=(2,)))
+    with pytest.raises(ValueError, match="unknown loss"):
+        m.compile("sgd", "nope")
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        m.compile("nope", "mse")
+    with pytest.raises(ValueError, match="unknown activation"):
+        keras.Activation("nope").ensure_built((3,))
