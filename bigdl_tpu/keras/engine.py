@@ -46,6 +46,13 @@ def same_padding(filter_size: int, dilation: int = 1) -> int:
     return (eff - 1) // 2
 
 
+def same_pad_amounts(filter_size: int, dilation: int = 1) -> Tuple[int, int]:
+    """Exact (lo, hi) pad for 'same' with stride 1 — asymmetric for even
+    kernels (the extra zero goes on the high side, TF/Keras convention)."""
+    eff = filter_size + (filter_size - 1) * (dilation - 1)
+    return (eff - 1) // 2, eff // 2
+
+
 class KerasLayer(Module):
     """Base for all Keras-style layers.
 

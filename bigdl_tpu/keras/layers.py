@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.keras.engine import (
-    KerasLayer, Shape, conv_output_length, same_padding,
+    KerasLayer, Shape, conv_output_length, same_pad_amounts, same_padding,
 )
 from bigdl_tpu.nn import containers as C
 from bigdl_tpu.nn import layers as L
@@ -217,10 +217,20 @@ class Merge(KerasLayer):
             return table[mode]()
         if mode == "concat":
             return L.JoinTable(axis if axis >= 0 else axis)
-        if mode == "dot":
-            return L.DotProduct()
-        if mode == "cosine":
-            return L.CosineDistance()
+        if mode in ("dot", "cosine"):
+            inner = L.DotProduct() if mode == "dot" else L.CosineDistance()
+
+            class _Scalar(Module):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, ctx, x):
+                    # keep a trailing feature dim so the inferred (1,)
+                    # shape matches reality for downstream layers
+                    return self.run_child(ctx, "inner", x)[..., None]
+
+            return _Scalar()
         raise ValueError(f"unknown merge mode {mode!r}")
 
     def compute_output_shape(self, input_shape):
@@ -342,9 +352,18 @@ class Convolution2D(KerasLayer):
 
     def build(self, input_shape):
         cin = input_shape[0]
-        ph = same_padding(self.nb_row) if self.border_mode == "same" else 0
-        pw = same_padding(self.nb_col) if self.border_mode == "same" else 0
+        pad_layer, ph, pw = None, 0, 0
+        if self.border_mode == "same":
+            (ph_lo, ph_hi) = same_pad_amounts(self.nb_row)
+            (pw_lo, pw_hi) = same_pad_amounts(self.nb_col)
+            if ph_lo == ph_hi and pw_lo == pw_hi:
+                ph, pw = ph_lo, pw_lo
+            else:
+                # even kernel: exact 'same' needs asymmetric zero pad
+                pad_layer = LambdaLayer(lambda x: jnp.pad(
+                    x, ((0, 0), (0, 0), (ph_lo, ph_hi), (pw_lo, pw_hi))))
         return _seq(
+            pad_layer,
             L.SpatialConvolution(
                 cin, self.nb_filter, self.nb_col, self.nb_row,
                 self.subsample[1], self.subsample[0], pw, ph,
@@ -442,8 +461,8 @@ class Convolution1D(KerasLayer):
             dim, self.nb_filter, self.filter_length, self.subsample_length,
         )
         if self.border_mode == "same":
-            p = same_padding(self.filter_length)
-            pad = LambdaLayer(lambda x: jnp.pad(x, ((0, 0), (p, p), (0, 0))))
+            lo, hi = same_pad_amounts(self.filter_length)
+            pad = LambdaLayer(lambda x: jnp.pad(x, ((0, 0), (lo, hi), (0, 0))))
             return _seq(pad, conv, get_activation(self.activation))
         return _seq(conv, get_activation(self.activation))
 
@@ -546,6 +565,10 @@ class UpSampling2D(KerasLayer):
 
 
 class _Pool2D(KerasLayer):
+    """'same' uses symmetric padding of (pool-1)//2 — exact Keras 'same'
+    for odd pool sizes; for even pool sizes this degrades to 'valid'
+    behavior, and the inferred shape below reports that truthfully."""
+
     pool_cls = None
 
     def __init__(self, pool_size: Tuple[int, int] = (2, 2),
@@ -556,9 +579,13 @@ class _Pool2D(KerasLayer):
         self.strides = tuple(strides) if strides else self.pool_size
         self.border_mode = border_mode
 
+    def _pads(self):
+        if self.border_mode == "same":
+            return same_padding(self.pool_size[0]), same_padding(self.pool_size[1])
+        return 0, 0
+
     def build(self, input_shape):
-        ph = same_padding(self.pool_size[0]) if self.border_mode == "same" else 0
-        pw = same_padding(self.pool_size[1]) if self.border_mode == "same" else 0
+        ph, pw = self._pads()
         return self.pool_cls(
             self.pool_size[1], self.pool_size[0],
             self.strides[1], self.strides[0], pw, ph,
@@ -566,8 +593,9 @@ class _Pool2D(KerasLayer):
 
     def compute_output_shape(self, input_shape):
         c, h, w = input_shape
-        oh = conv_output_length(h, self.pool_size[0], self.border_mode, self.strides[0])
-        ow = conv_output_length(w, self.pool_size[1], self.border_mode, self.strides[1])
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool_size[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool_size[1]) // self.strides[1] + 1
         return (c, oh, ow)
 
 
@@ -679,12 +707,12 @@ class SimpleRNN(_KerasRecurrent):
 
 class LSTM(_KerasRecurrent):
     def make_cell(self, input_dim):
-        return L.LSTMCell(input_dim, self.output_dim)
+        return L.LSTMCell(input_dim, self.output_dim, activation=self.activation)
 
 
 class GRU(_KerasRecurrent):
     def make_cell(self, input_dim):
-        return L.GRUCell(input_dim, self.output_dim)
+        return L.GRUCell(input_dim, self.output_dim, activation=self.activation)
 
 
 class ConvLSTM2D(KerasLayer):
@@ -715,7 +743,12 @@ class Bidirectional(KerasLayer):
     def __init__(self, layer: _KerasRecurrent, merge_mode: str = "concat", **kw):
         super().__init__(**kw)
         self.layer = layer
-        self.merge_mode = "concat" if merge_mode == "concat" else "sum"
+        if merge_mode not in ("concat", "sum"):
+            raise ValueError(
+                f"unsupported Bidirectional merge_mode {merge_mode!r} "
+                f"(supported: 'concat', 'sum')"
+            )
+        self.merge_mode = merge_mode
 
     def build(self, input_shape):
         fwd = self.layer.make_cell(input_shape[-1])

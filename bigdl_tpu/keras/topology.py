@@ -43,6 +43,8 @@ class KerasModel(Module):
         self._metrics: Optional[list] = None
         self._params = None
         self._state = None
+        self._jit_fwd = None
+        self._jit_eval = None
 
     # -- training ----------------------------------------------------------
     def compile(self, optimizer: Union[str, OptimMethod],
@@ -51,6 +53,7 @@ class KerasModel(Module):
         self._optim_method = to_optim_method(optimizer)
         self._criterion = to_criterion(loss)
         self._metrics = [to_metric(m, self._criterion) for m in (metrics or [])]
+        self._jit_eval = None  # loss/metrics changed: rebuild the eval step
         return self
 
     def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
@@ -88,16 +91,21 @@ class KerasModel(Module):
             self._params, self._state = self.init(jax.random.key(0))
         return self._params, self._state or {}
 
+    def _forward_fn(self):
+        """Jitted forward, compiled once and cached across calls."""
+        if self._jit_fwd is None:
+            def fwd(p, s, xb):
+                out, _ = self.apply(p, xb, state=s, training=False)
+                return out
+
+            self._jit_fwd = jax.jit(fwd)
+        return self._jit_fwd
+
     def predict(self, x, batch_size: int = 32):
         """Forward in batches; returns a stacked np.ndarray
         (reference ``KerasModel.predict``, ``Topology.scala:149``)."""
         params, state = self._require_params()
-
-        @jax.jit
-        def fwd(p, s, xb):
-            out, _ = self.apply(p, xb, state=s, training=False)
-            return out
-
+        fwd = self._forward_fn()
         x = np.asarray(x)
         outs = []
         for i in range(0, len(x), batch_size):
@@ -114,11 +122,13 @@ class KerasModel(Module):
         params, state = self._require_params()
         methods = [Loss(self._criterion)] + list(self._metrics or [])
 
-        @jax.jit
-        def eval_step(p, s, xb, yb):
-            out, _ = self.apply(p, xb, state=s, training=False)
-            return [m.batch(out, yb) for m in methods]
+        if self._jit_eval is None:
+            def eval_fn(p, s, xb, yb):
+                out, _ = self.apply(p, xb, state=s, training=False)
+                return [m.batch(out, yb) for m in methods]
 
+            self._jit_eval = jax.jit(eval_fn)
+        eval_step = self._jit_eval
         x, y = np.asarray(x), np.asarray(y)
         totals = [ValidationResult(0.0, 0, m.name) for m in methods]
         for i in range(0, len(x), batch_size):

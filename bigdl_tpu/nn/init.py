@@ -15,6 +15,12 @@ import jax.numpy as jnp
 
 
 class InitializationMethod:
+    def __init_subclass__(cls, **kw):
+        from bigdl_tpu.nn.module import capture_init_args
+
+        super().__init_subclass__(**kw)
+        capture_init_args(cls)
+
     def __call__(self, rng: jax.Array, shape: Tuple[int, ...], fan_in: int, fan_out: int, dtype=jnp.float32):
         raise NotImplementedError
 

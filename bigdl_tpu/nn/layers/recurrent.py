@@ -62,6 +62,18 @@ def _uniform_std(hidden_size: float) -> RandomUniform:
     return RandomUniform(-bound, bound)
 
 
+_CELL_ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid}
+
+
+def _cell_act(name: str):
+    try:
+        return _CELL_ACTS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown cell activation {name!r}; known: {sorted(_CELL_ACTS)}"
+        ) from None
+
+
 class RnnCell(Cell):
     """Vanilla RNN cell: ``act(W x + U h + b)`` (reference ``RNN.scala``)."""
 
@@ -70,7 +82,7 @@ class RnnCell(Cell):
         super().__init__()
         self.input_size = input_size
         self.hidden_size = hidden_size
-        self.activation = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
+        self.activation = _cell_act(activation)
         self.weight_init = weight_init or _uniform_std(hidden_size)
 
     def build_params(self, rng):
@@ -96,12 +108,13 @@ class LSTMCell(Cell):
     shape (input+hidden, 4*hidden); gate order i, f, g, o."""
 
     def __init__(self, input_size: int, hidden_size: int,
-                 forget_bias: float = 0.0,
+                 forget_bias: float = 0.0, activation: str = "tanh",
                  weight_init: Optional[InitializationMethod] = None):
         super().__init__()
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.forget_bias = forget_bias
+        self.activation = _cell_act(activation)
         self.weight_init = weight_init or _uniform_std(hidden_size)
 
     def build_params(self, rng):
@@ -127,8 +140,8 @@ class LSTMCell(Cell):
         b = ctx.param("bias").astype(x.dtype)
         z = jnp.concatenate([x, h_prev], axis=-1) @ w + b
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
-        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * self.activation(g)
+        h = jax.nn.sigmoid(o) * self.activation(c)
         return (h, c), h
 
 
@@ -153,9 +166,9 @@ class LSTMPeepholeCell(LSTMCell):
         i, f, g, o = jnp.split(z, 4, axis=-1)
         i = jax.nn.sigmoid(i + c_prev * ctx.param("peep_i").astype(x.dtype))
         f = jax.nn.sigmoid(f + c_prev * ctx.param("peep_f").astype(x.dtype))
-        c = f * c_prev + i * jnp.tanh(g)
+        c = f * c_prev + i * self.activation(g)
         o = jax.nn.sigmoid(o + c * ctx.param("peep_o").astype(x.dtype))
-        h = o * jnp.tanh(c)
+        h = o * self.activation(c)
         return (h, c), h
 
 
@@ -163,11 +176,12 @@ class GRUCell(Cell):
     """GRU (reference ``GRU.scala``): r/z packed into one gemm; candidate
     uses torch convention ``n = tanh(W_n x + r * (U_n h + b_hn))``."""
 
-    def __init__(self, input_size: int, hidden_size: int,
+    def __init__(self, input_size: int, hidden_size: int, activation: str = "tanh",
                  weight_init: Optional[InitializationMethod] = None):
         super().__init__()
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.activation = _cell_act(activation)
         self.weight_init = weight_init or _uniform_std(hidden_size)
 
     def build_params(self, rng):
@@ -190,7 +204,7 @@ class GRUCell(Cell):
         rz = jnp.concatenate([x, carry], axis=-1) @ ctx.param("weight_rz").astype(dt) \
             + ctx.param("bias_rz").astype(dt)
         r, z = jnp.split(jax.nn.sigmoid(rz), 2, axis=-1)
-        n = jnp.tanh(
+        n = self.activation(
             x @ ctx.param("weight_in").astype(dt) + ctx.param("bias_in").astype(dt)
             + r * (carry @ ctx.param("weight_hn").astype(dt) + ctx.param("bias_hn").astype(dt))
         )

@@ -32,6 +32,31 @@ Params = Dict[str, Any]
 State = Dict[str, Any]
 
 
+def capture_init_args(cls) -> None:
+    """Wrap ``cls.__init__`` (own, not inherited) to record the outermost
+    constructor call's ``(args, kwargs)`` as ``self._init_config``.
+
+    This powers structure serialization (``utils/serializer.py``): the
+    reference persists every module through reflection over its constructor
+    (``ModuleSerializer.scala:36`` default ``ModuleSerializable``); here the
+    captured config is the reflective record. Inner ``super().__init__``
+    calls see the attribute already set and leave it alone.
+    """
+    if "__init__" not in cls.__dict__ or getattr(cls.__init__, "_bigdl_captured", False):
+        return
+    orig = cls.__init__
+
+    def wrapped(self, *args, **kwargs):
+        if not hasattr(self, "_init_config"):
+            object.__setattr__(self, "_init_config", (args, kwargs))
+        orig(self, *args, **kwargs)
+
+    wrapped._bigdl_captured = True
+    wrapped.__wrapped__ = orig
+    wrapped.__name__ = "__init__"
+    cls.__init__ = wrapped
+
+
 class Context:
     """Per-apply context threading params/state subtree, training flag and RNG.
 
@@ -123,6 +148,10 @@ class Module:
       ``AbstractModule.parameters()``, ``AbstractModule.scala:347``).
     - ``set_name`` / ``get_name`` (``AbstractModule.scala`` setName).
     """
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        capture_init_args(cls)
 
     def __init__(self):
         object.__setattr__(self, "_modules", {})
@@ -243,6 +272,12 @@ class Module:
     def n_parameters(self, params: Params) -> int:
         return sum(int(jnp.size(v)) for _, v in self.parameters(params))
 
+    # -- persistence (reference: ``AbstractModule.saveModule``) --
+    def save_module(self, file: str, params=None, state=None, overwrite: bool = True) -> str:
+        from bigdl_tpu.utils.serializer import save_module
+
+        return save_module(file, self, params=params, state=state, overwrite=overwrite)
+
     # -- convenience: stateful eager mode (tests / small scripts) --
     def init_run(self, rng: Optional[jax.Array] = None) -> "Module":
         if rng is None:
@@ -271,6 +306,10 @@ class Criterion:
     """
 
     size_average: bool = True
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        capture_init_args(cls)
 
     def forward(self, output, target):
         raise NotImplementedError

@@ -38,4 +38,5 @@ from bigdl_tpu.optim.validation import (
 )
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, optimizer
+from bigdl_tpu.optim.predictor import Evaluator, PredictionService, Predictor
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer

@@ -33,6 +33,12 @@ tmap = jax.tree_util.tree_map
 class OptimMethod:
     """Base. Subclasses define ``_init_buffers`` and ``_apply``."""
 
+    def __init_subclass__(cls, **kw):
+        from bigdl_tpu.nn.module import capture_init_args
+
+        super().__init_subclass__(**kw)
+        capture_init_args(cls)
+
     def __init__(self, learning_rate: float = 1e-3, schedule: Optional[LearningRateSchedule] = None):
         self.learning_rate = learning_rate
         self.schedule = schedule or Default()

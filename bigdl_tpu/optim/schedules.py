@@ -21,6 +21,12 @@ import jax.numpy as jnp
 class LearningRateSchedule:
     """lr = schedule(base_lr, step, epoch). ``step`` may be a traced array."""
 
+    def __init_subclass__(cls, **kw):
+        from bigdl_tpu.nn.module import capture_init_args
+
+        super().__init_subclass__(**kw)
+        capture_init_args(cls)
+
     def __call__(self, base_lr, step, epoch=None):
         raise NotImplementedError
 
@@ -151,12 +157,21 @@ class SequentialSchedule(LearningRateSchedule):
     """Chain schedules, each active for a given number of steps
     (reference: ``SGD.SequentialSchedule``)."""
 
-    def __init__(self):
-        self.schedules: List[Tuple[LearningRateSchedule, Optional[int]]] = []
+    def __init__(self, schedules: Optional[List[Tuple[LearningRateSchedule, Optional[int]]]] = None):
+        # accepting the chain in the constructor keeps the schedule
+        # serializable via init-config capture (utils/serializer.py)
+        self.schedules: List[Tuple[LearningRateSchedule, Optional[int]]] = [
+            (s, n) for s, n in (schedules or [])
+        ]
 
     def add(self, schedule: LearningRateSchedule, max_iteration: Optional[int] = None):
         self.schedules.append((schedule, max_iteration))
         return self
+
+    def serial_config(self):
+        # serialize the LIVE chain, not the constructor snapshot, so
+        # schedules appended via add() survive save/load
+        return (list(self.schedules),), {}
 
     def __call__(self, base_lr, step, epoch=None):
         lr = base_lr

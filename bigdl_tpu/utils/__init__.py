@@ -1,1 +1,10 @@
 from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
+from bigdl_tpu.utils.serializer import (
+    SerializationError,
+    load_module,
+    load_optim_method,
+    module_from_spec,
+    module_to_spec,
+    save_module,
+    save_optim_method,
+)

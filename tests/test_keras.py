@@ -176,3 +176,65 @@ def test_string_lookups_reject_unknown():
         m.compile("nope", "mse")
     with pytest.raises(ValueError, match="unknown activation"):
         keras.Activation("nope").ensure_built((3,))
+
+
+# ------------------------------------------------ review regression tests
+
+
+def test_even_kernel_same_mode_shapes(rng):
+    """'same' with even kernels must pad asymmetrically (exact Keras)."""
+    for layer, shape in [
+        (keras.Convolution1D(6, 4, border_mode="same"), (10, 3)),
+        (keras.Convolution2D(5, 2, 4, border_mode="same"), (3, 8, 9)),
+    ]:
+        layer.ensure_built(shape)
+        params, state = layer.init(rng)
+        out, _ = layer.apply(params, _rand(2, *shape), state=state)
+        assert out.shape == (2,) + layer.get_output_shape()
+
+
+def test_pool_same_even_shape_truthful(rng):
+    p = keras.MaxPooling2D((2, 2), border_mode="same")
+    p.ensure_built((3, 7, 7))
+    params, state = p.init(rng)
+    out, _ = p.apply(params, _rand(2, 3, 7, 7), state=state)
+    assert out.shape == (2,) + p.get_output_shape()
+
+
+def test_merge_dot_shape_matches_forward(rng):
+    inp = keras.Input(shape=(5,))
+    a = keras.Dense(4)(inp)
+    b = keras.Dense(4)(inp)
+    out = keras.Dense(2)(keras.merge([a, b], mode="dot"))
+    m = keras.Model(inp, out)
+    params, state = m.init(rng)
+    o, _ = m.apply(params, _rand(3, 5), state=state)
+    assert o.shape == (3, 2)
+
+
+def test_bidirectional_rejects_unsupported_merge():
+    with pytest.raises(ValueError, match="merge_mode"):
+        keras.Bidirectional(keras.LSTM(3, return_sequences=True), merge_mode="mul")
+
+
+def test_lstm_activation_is_used(rng):
+    t = keras.LSTM(4, activation="tanh", return_sequences=True)
+    r = keras.LSTM(4, activation="relu", return_sequences=True)
+    t.ensure_built((5, 3))
+    r.ensure_built((5, 3))
+    pt, st = t.init(rng)
+    x = _rand(2, 5, 3)
+    ot, _ = t.apply(pt, x, state=st)
+    orl, _ = r.apply(pt, x, state=st)  # same params, different activation
+    assert not np.allclose(np.asarray(ot), np.asarray(orl))
+
+
+def test_predict_caches_compiled_forward():
+    m = keras.Sequential()
+    m.add(keras.Dense(3, input_shape=(4,)))
+    m.compile("sgd", "mse")
+    x = _rand(8, 4)
+    m.predict(x)
+    fwd1 = m._jit_fwd
+    m.predict(x)
+    assert m._jit_fwd is fwd1 and fwd1 is not None

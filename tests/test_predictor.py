@@ -1,0 +1,96 @@
+"""Inference-tier tests (reference: ``Predictor``/``Evaluator``/
+``PredictionService`` behavior, ``DL/optim/Predictor.scala:92`` splitBatch,
+``Evaluator.scala:51`` reduce)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.nn import (
+    ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential,
+)
+from bigdl_tpu.optim.predictor import Evaluator, PredictionService, Predictor
+from bigdl_tpu.optim.validation import Loss, Top1Accuracy
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = Sequential().add(Linear(8, 16)).add(ReLU()).add(Linear(16, 4)).add(LogSoftMax())
+    params, state = model.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    x = rs.rand(37, 8).astype("float32")
+    y = rs.randint(0, 4, 37)
+    return model, params, state, x, y
+
+
+def test_predict_splits_per_sample(setup):
+    model, params, state, x, _ = setup
+    p = Predictor(model, params, state)
+    outs = p.predict(x)
+    assert len(outs) == 37 and outs[0].shape == (4,)
+    # per-sample outputs must equal the full-batch forward rows
+    full, _ = model.apply(params, x, state=state)
+    np.testing.assert_allclose(np.asarray(outs[3]), np.asarray(full)[3], rtol=1e-5)
+
+
+def test_predict_class(setup):
+    model, params, state, x, _ = setup
+    p = Predictor(model, params, state)
+    cls = p.predict_class(x)
+    full, _ = model.apply(params, x, state=state)
+    np.testing.assert_array_equal(cls, np.argmax(np.asarray(full), axis=-1))
+
+
+def test_predict_on_samples_list(setup):
+    model, params, state, x, y = setup
+    p = Predictor(model, params, state)
+    samples = [Sample.of(x[i], y[i]) for i in range(10)]
+    assert len(p.predict(samples)) == 10
+
+
+def test_evaluator_counts_all_records(setup):
+    model, params, state, x, y = setup
+    ev = Evaluator(model, params, state, batch_size=8)  # 37 -> partial batch
+    res = ev.test(DataSet.tensors(x, y), [Top1Accuracy(), Loss(ClassNLLCriterion())])
+    for r in res:
+        v, n = r.result()
+        assert n == 37
+    acc, _ = res[0].result()
+    full, _ = model.apply(params, x, state=state)
+    expected = float(np.mean(np.argmax(np.asarray(full), -1) == y))
+    assert abs(acc - expected) < 1e-6
+
+
+def test_evaluator_requires_labels(setup):
+    model, params, state, x, _ = setup
+    ev = Evaluator(model, params, state)
+    with pytest.raises(ValueError, match="labels"):
+        ev.test(DataSet.tensors(x), [Top1Accuracy()])
+
+
+def test_prediction_service_concurrent(setup):
+    model, params, state, x, _ = setup
+    svc = PredictionService(model, params, state, n_concurrent=3)
+    outs = [None] * 12
+    def call(i):
+        outs[i] = svc.predict(x[i])
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert svc.served == 12
+    full, _ = model.apply(params, x, state=state)
+    for i in (0, 5, 11):
+        np.testing.assert_allclose(outs[i], np.asarray(full)[i], rtol=1e-5)
+
+
+def test_prediction_service_accepts_sample(setup):
+    model, params, state, x, y = setup
+    svc = PredictionService(model, params, state)
+    out = svc.predict(Sample.of(x[0], y[0]))
+    assert out.shape == (4,)
