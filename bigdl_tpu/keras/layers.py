@@ -216,7 +216,7 @@ class Merge(KerasLayer):
         if mode in table:
             return table[mode]()
         if mode == "concat":
-            return L.JoinTable(axis if axis >= 0 else axis)
+            return L.JoinTable(axis)
         if mode in ("dot", "cosine"):
             inner = L.DotProduct() if mode == "dot" else L.CosineDistance()
 
@@ -853,6 +853,251 @@ class ThresholdedReLU(KerasLayer):
 
     def build(self, input_shape):
         return L.Threshold(self.theta, 0.0)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+# -------------------------------------------------------- 3-D / extra tier
+
+
+class Convolution3D(KerasLayer):
+    """3-D conv over (channels, dim1, dim2, dim3) (reference
+    ``DL/nn/keras/Convolution3D.scala``)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation: Optional[str] = None,
+                 subsample: Tuple[int, int, int] = (1, 1, 1),
+                 bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        cin = input_shape[0]
+        return _seq(
+            L.VolumetricConvolution(
+                cin, self.nb_filter,
+                self.kernel[0], self.kernel[2], self.kernel[1],
+                self.subsample[0], self.subsample[2], self.subsample[1],
+                with_bias=self.bias,
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, d1, d2, d3 = input_shape
+        dims = tuple(
+            conv_output_length(n, k, "valid", s)
+            for n, k, s in zip((d1, d2, d3), self.kernel, self.subsample)
+        )
+        return (self.nb_filter,) + dims
+
+
+class _Pool3D(KerasLayer):
+    mode = "max"
+
+    def __init__(self, pool_size: Tuple[int, int, int] = (2, 2, 2),
+                 strides: Optional[Tuple[int, int, int]] = None, **kw):
+        super().__init__(**kw)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides else self.pool_size
+
+    def build(self, input_shape):
+        cls = L.VolumetricMaxPooling if self.mode == "max" else L.VolumetricAveragePooling
+        k, s = self.pool_size, self.strides
+        return cls(k[0], k[2], k[1], s[0], s[2], s[1])
+
+    def compute_output_shape(self, input_shape):
+        c = input_shape[0]
+        dims = tuple(
+            conv_output_length(n, k, "valid", s)
+            for n, k, s in zip(input_shape[1:], self.pool_size, self.strides)
+        )
+        return (c,) + dims
+
+
+class MaxPooling3D(_Pool3D):
+    mode = "max"
+
+
+class AveragePooling3D(_Pool3D):
+    mode = "avg"
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def build(self, input_shape):
+        return LambdaLayer(lambda x: jnp.max(x, axis=(2, 3, 4)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build(self, input_shape):
+        return LambdaLayer(lambda x: jnp.mean(x, axis=(2, 3, 4)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding: Tuple[int, int, int] = (1, 1, 1), **kw):
+        super().__init__(**kw)
+        self.padding = tuple(padding)
+
+    def build(self, input_shape):
+        p1, p2, p3 = self.padding
+        return LambdaLayer(lambda x: jnp.pad(
+            x, ((0, 0), (0, 0), (p1, p1), (p2, p2), (p3, p3))))
+
+    def compute_output_shape(self, input_shape):
+        c, d1, d2, d3 = input_shape
+        p1, p2, p3 = self.padding
+        return (c, d1 + 2 * p1, d2 + 2 * p2, d3 + 2 * p3)
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(map(tuple, cropping))
+
+    def build(self, input_shape):
+        return L.Cropping3D(*self.cropping)
+
+    def compute_output_shape(self, input_shape):
+        c = input_shape[0]
+        return (c,) + tuple(
+            n - a - b for n, (a, b) in zip(input_shape[1:], self.cropping)
+        )
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size: Tuple[int, int, int] = (2, 2, 2), **kw):
+        super().__init__(**kw)
+        self.size = tuple(size)
+
+    def build(self, input_shape):
+        return L.UpSampling3D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c = input_shape[0]
+        return (c,) + tuple(n * s for n, s in zip(input_shape[1:], self.size))
+
+
+class _KerasSpatialDropout(KerasLayer):
+    cls = None
+
+    def __init__(self, p: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.p = p
+
+    def build(self, input_shape):
+        return self.cls(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class SpatialDropout1D(_KerasSpatialDropout):
+    cls = L.SpatialDropout1D
+
+
+class SpatialDropout2D(_KerasSpatialDropout):
+    cls = L.SpatialDropout2D
+
+
+class SpatialDropout3D(_KerasSpatialDropout):
+    cls = L.SpatialDropout3D
+
+
+class SeparableConvolution2D(KerasLayer):
+    """Depthwise + pointwise conv (reference ``SeparableConvolution2D``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 depth_multiplier: int = 1, activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.depth_multiplier = depth_multiplier
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        return _seq(
+            L.SpatialSeparableConvolution(
+                input_shape[0], self.nb_filter, self.depth_multiplier,
+                self.nb_col, self.nb_row, self.subsample[1], self.subsample[0],
+                with_bias=self.bias,
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        oh = conv_output_length(h, self.nb_row, "valid", self.subsample[0])
+        ow = conv_output_length(w, self.nb_col, "valid", self.subsample[1])
+        return (self.nb_filter, oh, ow)
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build(self, input_shape):
+        c, h, w = input_shape
+        return _seq(
+            L.LocallyConnected2D(
+                c, w, h, self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0], with_bias=self.bias,
+            ),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        _, h, w = input_shape
+        oh = conv_output_length(h, self.nb_row, "valid", self.subsample[0])
+        ow = conv_output_length(w, self.nb_col, "valid", self.subsample[1])
+        return (self.nb_filter, oh, ow)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None,
+                 subsample_length: int = 1, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build(self, input_shape):
+        steps, dim = input_shape
+        return _seq(
+            L.LocallyConnected1D(steps, dim, self.nb_filter,
+                                 self.filter_length, self.subsample_length),
+            get_activation(self.activation),
+        )
+
+    def compute_output_shape(self, input_shape):
+        out = conv_output_length(input_shape[0], self.filter_length, "valid",
+                                 self.subsample_length)
+        return (out, self.nb_filter)
+
+
+class SReLU(KerasLayer):
+    def build(self, input_shape):
+        return L.SReLU(input_shape)
 
     def compute_output_shape(self, input_shape):
         return input_shape

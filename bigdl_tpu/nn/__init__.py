@@ -31,6 +31,20 @@ from bigdl_tpu.nn.criterion import (
     ParallelCriterion,
     MultiCriterion,
     TimeDistributedCriterion,
+    CosineEmbeddingCriterion,
+    MarginRankingCriterion,
+    MultiLabelMarginCriterion,
+    MultiMarginCriterion,
+    SoftMarginCriterion,
+    L1HingeEmbeddingCriterion,
+    KLDCriterion,
+    GaussianCriterion,
+    PoissonCriterion,
+    CosineProximityCriterion,
+    DiceCoefficientCriterion,
+    ClassSimplexCriterion,
+    CategoricalCrossEntropy,
+    TransformerCriterion,
 )
 from bigdl_tpu.nn import init
 from bigdl_tpu.nn.layers.recurrent import (

@@ -240,3 +240,219 @@ class TimeDistributedCriterion(Criterion):
         flat = self.criterion.forward(o_flat, t_flat)
         total = flat * steps if getattr(self.criterion, "size_average", True) else flat
         return total / steps if self.size_average else total
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """Reference ``CosineEmbeddingCriterion.scala``: for input pair (x1, x2)
+    and target y in {1, -1}: ``1 - cos`` for y=1, ``max(0, cos - margin)``
+    for y=-1."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        x1, x2 = output
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+        )
+        loss = jnp.where(target > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """Reference ``MarginRankingCriterion.scala``:
+    ``max(0, -y*(x1 - x2) + margin)``."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        x1, x2 = output
+        loss = jnp.maximum(0.0, -target * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Reference ``MultiLabelMarginCriterion.scala``: multi-class multi-label
+    hinge. ``target`` is a 0/1 indicator matrix shaped like ``output``
+    (deviation: the reference packs 1-based label indices; an indicator mask
+    is the XLA-friendly equivalent)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        t = target.astype(bool)
+        # hinge between every (positive, other) pair
+        pos = jnp.where(t, output, jnp.inf)[..., None]        # (B, C, 1)
+        neg = jnp.where(t, -jnp.inf, output)[..., None, :]    # (B, 1, C)
+        pair = jnp.maximum(0.0, 1.0 - (pos - neg))
+        pair = jnp.where(jnp.isfinite(pair), pair, 0.0)
+        loss = jnp.sum(pair, axis=(-2, -1)) / output.shape[-1]
+        return _reduce(loss, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Reference ``MultiMarginCriterion.scala``: multi-class hinge
+    ``sum_j max(0, margin - x_y + x_j)^p / C``."""
+
+    def __init__(self, p: int = 1, margin: float = 1.0, size_average: bool = True):
+        self.p = p
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        t = target.astype(jnp.int32)
+        x_y = jnp.take_along_axis(output, t[..., None], axis=-1)
+        m = jnp.maximum(0.0, self.margin - x_y + output) ** self.p
+        m = m * (1 - jax.nn.one_hot(t, output.shape[-1], dtype=output.dtype))
+        loss = jnp.sum(m, -1) / output.shape[-1]
+        return _reduce(loss, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """Reference ``SoftMarginCriterion.scala``:
+    ``mean(log(1 + exp(-y*x)))``."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        return _reduce(jnp.log1p(jnp.exp(-target * output)), self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Reference ``L1HingeEmbeddingCriterion.scala``: L1 distance of a pair,
+    hinged for dissimilar (y=-1) targets."""
+
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def forward(self, output, target):
+        x1, x2 = output
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        loss = jnp.where(target > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(loss)
+
+
+class KLDCriterion(Criterion):
+    """Reference ``KLDCriterion.scala``: KL(q(z|x) || N(0,1)) from
+    (mean, log_variance) — the VAE latent loss."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target=None):
+        mean, log_var = output
+        kld = 0.5 * jnp.sum(
+            jnp.square(mean) + jnp.exp(log_var) - 1.0 - log_var, axis=-1
+        )
+        return jnp.mean(kld) if self.size_average else jnp.sum(kld)
+
+
+class GaussianCriterion(Criterion):
+    """Reference ``GaussianCriterion.scala``: negative log-likelihood of
+    target under a diagonal Gaussian given (mean, log_variance)."""
+
+    def forward(self, output, target):
+        mean, log_var = output
+        nll = 0.5 * (
+            jnp.log(2 * jnp.pi) + log_var
+            + jnp.square(target - mean) / jnp.exp(log_var)
+        )
+        return jnp.sum(nll)
+
+
+class PoissonCriterion(Criterion):
+    """Reference ``PoissonCriterion.scala``: mean(pred - target*log(pred))."""
+
+    def forward(self, output, target):
+        return jnp.mean(output - target * jnp.log(jnp.clip(output, 1e-8)))
+
+
+class CosineProximityCriterion(Criterion):
+    """Reference ``CosineProximityCriterion.scala`` (Keras cosine_proximity):
+    ``-mean(cos(output, target))``."""
+
+    def forward(self, output, target):
+        o = output / jnp.maximum(jnp.linalg.norm(output, axis=-1, keepdims=True), 1e-12)
+        t = target / jnp.maximum(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(o * t, axis=-1))
+
+
+class DiceCoefficientCriterion(Criterion):
+    """Reference ``DiceCoefficientCriterion.scala``: 1 - Dice overlap
+    (segmentation loss)."""
+
+    def __init__(self, epsilon: float = 1.0):
+        self.epsilon = epsilon
+
+    def forward(self, output, target):
+        axes = tuple(range(1, output.ndim))
+        inter = jnp.sum(output * target, axis=axes)
+        union = jnp.sum(output, axis=axes) + jnp.sum(target, axis=axes)
+        dice = (2.0 * inter + self.epsilon) / (union + self.epsilon)
+        return jnp.mean(1.0 - dice)
+
+
+class ClassSimplexCriterion(Criterion):
+    """Reference ``ClassSimplexCriterion.scala``: MSE against learned-free
+    regular-simplex embeddings of the classes."""
+
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.simplex = self._build_simplex(n_classes)
+
+    @staticmethod
+    def _build_simplex(n):
+        # regular simplex: centered identity rows e_i - 1/n are pairwise
+        # equidistant; a uniform row normalization preserves that
+        import numpy as _np
+
+        a = _np.eye(n, dtype=_np.float32) - 1.0 / n
+        scale = _np.linalg.norm(a[0])
+        return jnp.asarray(a / max(scale, 1e-12))
+
+    def forward(self, output, target):
+        t = target.astype(jnp.int32)
+        goal = jnp.take(self.simplex, t, axis=0)
+        return jnp.mean(jnp.square(output - goal))
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Cross-entropy over probabilities with one-hot OR int targets
+    (reference: Keras ``categorical_crossentropy`` mapping in
+    ``DL/nn/keras``)."""
+
+    def __init__(self, from_logits: bool = False):
+        self.from_logits = from_logits
+
+    def forward(self, output, target):
+        if self.from_logits:
+            logp = output - jax.nn.logsumexp(output, axis=-1, keepdims=True)
+        else:
+            logp = jnp.log(jnp.clip(output, 1e-8, 1.0))
+        if target.ndim == output.ndim:
+            target = jnp.argmax(target, axis=-1)
+        picked = jnp.take_along_axis(logp, target[..., None].astype(jnp.int32), axis=-1)
+        return -jnp.mean(picked)
+
+
+class TransformerCriterion(Criterion):
+    """Apply transforms to output/target before an inner criterion
+    (reference ``TransformerCriterion.scala``)."""
+
+    def __init__(self, criterion: Criterion, input_transformer=None,
+                 target_transformer=None):
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def forward(self, output, target):
+        if self.input_transformer is not None:
+            output = self.input_transformer(output)
+        if self.target_transformer is not None:
+            target = self.target_transformer(target)
+        return self.criterion.forward(output, target)

@@ -1,0 +1,203 @@
+"""Volumetric (3-D) convolution / pooling layers.
+
+Reference: ``DL/nn/VolumetricConvolution.scala``,
+``VolumetricFullConvolution.scala``, ``VolumetricMaxPooling.scala``,
+``VolumetricAveragePooling.scala`` — hand-written loops over (T, H, W)
+volumes. TPU-native: one ``lax.conv_general_dilated`` /
+``lax.reduce_window`` over NCDHW, which XLA tiles onto the MXU exactly like
+the 2-D case.
+
+Argument order keeps the reference's (kT, kW, kH) / (dT, dW, dH) /
+(padT, padW, padH) convention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+_DNUMS = ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _pad3(pad_t: int, pad_w: int, pad_h: int):
+    if -1 in (pad_t, pad_w, pad_h):
+        return "SAME"
+    return [(pad_t, pad_t), (pad_h, pad_h), (pad_w, pad_w)]
+
+
+class VolumetricConvolution(Module):
+    """3-D conv over (N, C, D, H, W) (reference
+    ``VolumetricConvolution.scala``)."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        k_t: int,
+        k_w: int,
+        k_h: int,
+        d_t: int = 1,
+        d_w: int = 1,
+        d_h: int = 1,
+        pad_t: int = 0,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        with_bias: bool = True,
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_w, pad_h)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane * kt * kh * kw
+        fan_out = self.n_output_plane * kt * kh * kw
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"),
+                (self.n_output_plane, self.n_input_plane, kt, kh, kw),
+                fan_in, fan_out,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(
+                fold_in_str(rng, "bias"), (self.n_output_plane,), fan_in, fan_out
+            )
+        return p
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=self.stride,
+            padding=_pad3(*self.pad),
+            dimension_numbers=_DNUMS,
+        )
+        if self.with_bias:
+            y = y + ctx.param("bias").astype(x.dtype)[:, None, None, None]
+        return y
+
+
+class VolumetricFullConvolution(Module):
+    """3-D transposed conv (reference ``VolumetricFullConvolution.scala``):
+    lowered as input-dilated conv with a spatially-flipped kernel."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        k_t: int,
+        k_w: int,
+        k_h: int,
+        d_t: int = 1,
+        d_w: int = 1,
+        d_h: int = 1,
+        pad_t: int = 0,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        adj_t: int = 0,
+        adj_w: int = 0,
+        adj_h: int = 0,
+        with_bias: bool = True,
+        weight_init: Optional[InitializationMethod] = None,
+        bias_init: Optional[InitializationMethod] = None,
+    ):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane * kt * kh * kw
+        fan_out = self.n_output_plane * kt * kh * kw
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"),
+                (self.n_input_plane, self.n_output_plane, kt, kh, kw),
+                fan_in, fan_out,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(
+                fold_in_str(rng, "bias"), (self.n_output_plane,), fan_in, fan_out
+            )
+        return p
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        # transpose conv: lhs_dilation = stride, kernel flipped, IO swapped
+        w = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)
+        kt, kh, kw = self.kernel
+        pt, ph, pw = self.pad
+        at, ah, aw = self.adj
+        pads = [
+            (kt - 1 - pt, kt - 1 - pt + at),
+            (kh - 1 - ph, kh - 1 - ph + ah),
+            (kw - 1 - pw, kw - 1 - pw + aw),
+        ]
+        y = lax.conv_general_dilated(
+            x, w,
+            window_strides=(1, 1, 1),
+            padding=pads,
+            lhs_dilation=self.stride,
+            dimension_numbers=_DNUMS,
+        )
+        if self.with_bias:
+            y = y + ctx.param("bias").astype(x.dtype)[:, None, None, None]
+        return y
+
+
+class _Pool3D(Module):
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def _window(self):
+        return (1, 1) + self.kernel, (1, 1) + self.stride, \
+            [(0, 0), (0, 0)] + [(p, p) for p in self.pad]
+
+
+class VolumetricMaxPooling(_Pool3D):
+    """Reference ``VolumetricMaxPooling.scala``."""
+
+    def forward(self, ctx: Context, x):
+        win, stride, pads = self._window()
+        # scalar init value keeps the max-reduce_window differentiable
+        return lax.reduce_window(x, -jnp.inf, lax.max, win, stride, pads)
+
+
+class VolumetricAveragePooling(_Pool3D):
+    """Reference ``VolumetricAveragePooling.scala`` (count includes pad,
+    matching the reference's default countIncludePad=true)."""
+
+    def forward(self, ctx: Context, x):
+        win, stride, pads = self._window()
+        summed = lax.reduce_window(x, 0.0, lax.add, win, stride, pads)
+        kt, kh, kw = self.kernel
+        return summed / float(kt * kh * kw)

@@ -1,0 +1,617 @@
+"""Misc parameterized & structural layers.
+
+Reference (all under ``DL/nn/``): ``CMul``/``CAdd`` (broadcast learnable
+scale/offset), ``Mul``/``Add`` (scalar/bias), ``Scale`` (CMul+CAdd),
+``Bilinear``, ``Cosine``, ``Euclidean``, ``Masking``, ``MaskedSelect``,
+``Index``, ``GradientReversal``, ``L1Penalty``, ``Maxout``, ``SReLU``,
+``RReLU``, ``SpatialDropout1D/2D/3D``, ``LocallyConnected1D/2D``,
+``SpatialSeparableConvolution``, ``SpatialUpSampling*``,
+``SpatialZeroPadding``, ``Cropping2D/3D``, ``UpSampling1D/2D/3D``.
+
+Each docstring cites its reference file. Implementations are single XLA
+ops where possible (the reference hand-loops most of these).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, Ones, RandomUniform, Xavier, Zeros
+from bigdl_tpu.nn.layers.conv import SpatialConvolution
+from bigdl_tpu.nn.module import Context, Module
+
+
+class CMul(Module):
+    """Learnable componentwise scale, broadcast over the batch
+    (reference ``CMul.scala``; ``size`` includes broadcast 1-dims)."""
+
+    def __init__(self, size: Sequence[int],
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.weight_init = weight_init or Ones()
+
+    def build_params(self, rng):
+        n = int(jnp.prod(jnp.asarray(self.size)))
+        return {"weight": self.weight_init(fold_in_str(rng, "w"), self.size, n, n)}
+
+    def forward(self, ctx: Context, x):
+        return x * ctx.param("weight").astype(x.dtype)
+
+
+class CAdd(Module):
+    """Learnable componentwise bias (reference ``CAdd.scala``)."""
+
+    def __init__(self, size: Sequence[int],
+                 bias_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        n = int(jnp.prod(jnp.asarray(self.size)))
+        return {"bias": self.bias_init(fold_in_str(rng, "b"), self.size, n, n)}
+
+    def forward(self, ctx: Context, x):
+        return x + ctx.param("bias").astype(x.dtype)
+
+
+class Mul(Module):
+    """Single learnable scalar gain (reference ``Mul.scala``)."""
+
+    def build_params(self, rng):
+        return {"weight": RandomUniform(-1.0, 1.0)(fold_in_str(rng, "w"), (1,), 1, 1)}
+
+    def forward(self, ctx: Context, x):
+        return x * ctx.param("weight").astype(x.dtype)
+
+
+class Add(Module):
+    """Learnable bias vector over the last dim (reference ``Add.scala``)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+
+    def build_params(self, rng):
+        return {"bias": Zeros()(fold_in_str(rng, "b"), (self.input_size,), 1, 1)}
+
+    def forward(self, ctx: Context, x):
+        return x + ctx.param("bias").astype(x.dtype)
+
+
+class Scale(Module):
+    """CMul then CAdd (reference ``Scale.scala`` — the caffe Scale layer)."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def forward(self, ctx: Context, x):
+        return self.run_child(ctx, "cadd", self.run_child(ctx, "cmul", x))
+
+
+class Bilinear(Module):
+    """Bilinear form over an input pair: ``y_k = x1^T W_k x2 (+ b_k)``
+    (reference ``Bilinear.scala``)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.weight_init = weight_init or Xavier()
+
+    def build_params(self, rng):
+        fan_in = self.input_size1 * self.input_size2
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "w"),
+                (self.output_size, self.input_size1, self.input_size2),
+                fan_in, self.output_size,
+            )
+        }
+        if self.bias_res:
+            p["bias"] = Zeros()(fold_in_str(rng, "b"), (self.output_size,), fan_in, 1)
+        return p
+
+    def forward(self, ctx: Context, x):
+        x1, x2 = x
+        w = ctx.param("weight").astype(x1.dtype)
+        y = jnp.einsum("bi,kij,bj->bk", x1, w, x2)
+        if self.bias_res:
+            y = y + ctx.param("bias").astype(x1.dtype)
+        return y
+
+
+class Cosine(Module):
+    """Cosine similarity of the input to each of ``output_size`` learned
+    prototype rows (reference ``Cosine.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def build_params(self, rng):
+        return {
+            "weight": RandomUniform(-1.0, 1.0)(
+                fold_in_str(rng, "w"), (self.output_size, self.input_size),
+                self.input_size, self.output_size,
+            )
+        }
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), 1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """Distance of the input to ``output_size`` learned centers
+    (reference ``Euclidean.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def build_params(self, rng):
+        bound = 1.0 / (self.input_size ** 0.5)
+        return {
+            "weight": RandomUniform(-bound, bound)(
+                fold_in_str(rng, "w"), (self.output_size, self.input_size),
+                self.input_size, self.output_size,
+            )
+        }
+
+    def forward(self, ctx: Context, x):
+        w = ctx.param("weight").astype(x.dtype)
+        diff = x[:, None, :] - w[None, :, :]
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-12)
+
+
+class Masking(Module):
+    """Zero timesteps whose features all equal ``mask_value``
+    (reference ``Masking.scala``)."""
+
+    def __init__(self, mask_value: float = 0.0):
+        super().__init__()
+        self.mask_value = mask_value
+
+    def forward(self, ctx: Context, x):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0)
+
+
+class MaskedSelect(Module):
+    """Select input elements where a (same-shape) mask is nonzero
+    (reference ``MaskedSelect.scala``). Output keeps the input shape with
+    unselected entries zeroed: a dynamic-size gather has no place under
+    XLA's static shapes, so the reference's compacted vector becomes a
+    masked tensor (documented deviation)."""
+
+    def forward(self, ctx: Context, x):
+        t, mask = x
+        return jnp.where(mask != 0, t, 0.0)
+
+
+class Index(Module):
+    """Index along ``dimension`` with an integer index tensor
+    (reference ``Index.scala``)."""
+
+    def __init__(self, dimension: int = 0):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, ctx: Context, x):
+        t, idx = x
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.dimension)
+
+
+@jax.custom_vjp
+def _grad_reverse(x, lam):
+    return x
+
+
+def _grad_reverse_fwd(x, lam):
+    return x, lam
+
+
+def _grad_reverse_bwd(lam, g):
+    return (-lam * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(Module):
+    """Identity forward, ``-lambda * grad`` backward (reference
+    ``GradientReversal.scala`` — domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def forward(self, ctx: Context, x):
+        return _grad_reverse(x, self.the_lambda)
+
+
+@jax.custom_vjp
+def _l1_penalty(x, scale):
+    return x
+
+
+def _l1_penalty_fwd(x, scale):
+    return x, (jnp.sign(x), scale)
+
+
+def _l1_penalty_bwd(res, g):
+    sign, scale = res
+    return (g + scale * sign.astype(g.dtype), None)
+
+
+_l1_penalty.defvjp(_l1_penalty_fwd, _l1_penalty_bwd)
+
+
+class L1Penalty(Module):
+    """Identity forward that injects an L1 sparsity gradient on the
+    activations (reference ``L1Penalty.scala``)."""
+
+    def __init__(self, l1weight: float):
+        super().__init__()
+        self.l1weight = float(l1weight)
+
+    def forward(self, ctx: Context, x):
+        return _l1_penalty(x, self.l1weight)
+
+
+class Maxout(Module):
+    """Max over ``maxout_number`` linear maps (reference ``Maxout.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.weight_init = weight_init or Xavier()
+
+    def build_params(self, rng):
+        k = self.maxout_number * self.output_size
+        return {
+            "weight": self.weight_init(
+                fold_in_str(rng, "w"), (self.input_size, k), self.input_size, k),
+            "bias": Zeros()(fold_in_str(rng, "b"), (k,), self.input_size, k),
+        }
+
+    def forward(self, ctx: Context, x):
+        z = x @ ctx.param("weight").astype(x.dtype) + ctx.param("bias").astype(x.dtype)
+        z = z.reshape(z.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(z, axis=-2)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with four learnable per-channel params
+    (reference ``SReLU.scala``)."""
+
+    def __init__(self, shape: Sequence[int]):
+        super().__init__()
+        self.shape = tuple(shape)
+
+    def build_params(self, rng):
+        n = 1
+        return {
+            "t_right": Ones()(fold_in_str(rng, "tr"), self.shape, n, n),
+            "a_right": Ones()(fold_in_str(rng, "ar"), self.shape, n, n),
+            "t_left": Zeros()(fold_in_str(rng, "tl"), self.shape, n, n),
+            "a_left": Zeros()(fold_in_str(rng, "al"), self.shape, n, n),
+        }
+
+    def forward(self, ctx: Context, x):
+        dt = x.dtype
+        tr = ctx.param("t_right").astype(dt)
+        ar = ctx.param("a_right").astype(dt)
+        tl = ctx.param("t_left").astype(dt)
+        al = ctx.param("a_left").astype(dt)
+        y_high = tr + ar * (x - tr)
+        y_low = tl + al * (x - tl)
+        return jnp.where(x >= tr, y_high, jnp.where(x <= tl, y_low, x))
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (reference ``RReLU.scala``): slope sampled
+    in [lower, upper) in training, fixed to the mean at inference."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, ctx: Context, x):
+        if ctx.training:
+            a = jax.random.uniform(
+                ctx.rng(), x.shape, jnp.float32, self.lower, self.upper
+            ).astype(x.dtype)
+        else:
+            a = jnp.asarray((self.lower + self.upper) / 2, x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class _SpatialDropoutND(Module):
+    """Drop whole feature channels (reference ``SpatialDropout1D/2D/3D.scala``)."""
+
+    spatial_dims = 2
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def _mask_shape(self, x):
+        # channel-first (NCHW / NCDHW): keep (B, C), broadcast over space
+        return x.shape[: x.ndim - self.spatial_dims] + (1,) * self.spatial_dims
+
+    def forward(self, ctx: Context, x):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = jax.random.bernoulli(ctx.rng(), 1.0 - self.p, self._mask_shape(x))
+        return jnp.where(keep, x / (1.0 - self.p), 0.0)
+
+
+class SpatialDropout1D(_SpatialDropoutND):
+    spatial_dims = 1
+
+    def _mask_shape(self, x):
+        # 1-D sequences are channel-LAST (B, T, D): drop whole feature
+        # channels, broadcast over time
+        return (x.shape[0], 1, x.shape[2])
+
+
+class SpatialDropout2D(_SpatialDropoutND):
+    spatial_dims = 2
+
+
+class SpatialDropout3D(_SpatialDropoutND):
+    spatial_dims = 3
+
+
+class LocallyConnected2D(Module):
+    """Unshared-weight conv (reference ``LocallyConnected2D.scala``):
+    every output pixel owns its own kernel. Lowered as patch extraction +
+    one batched einsum (MXU-friendly) instead of per-pixel loops."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.input_width = input_width
+        self.input_height = input_height
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.out_h = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.out_w = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+
+    def build_params(self, rng):
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "w"),
+                (self.out_h, self.out_w, self.n_output_plane,
+                 self.n_input_plane, kh, kw),
+                fan_in, self.n_output_plane,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = Zeros()(
+                fold_in_str(rng, "b"),
+                (self.n_output_plane, self.out_h, self.out_w), fan_in, 1,
+            )
+        return p
+
+    def forward(self, ctx: Context, x):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        if ph or pw:
+            x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        # patches: (B, C*kh*kw, out_h, out_w)
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        b = x.shape[0]
+        patches = patches.reshape(b, self.n_input_plane, kh, kw, self.out_h, self.out_w)
+        w = ctx.param("weight").astype(x.dtype)
+        y = jnp.einsum("bcklhw,hwockl->bohw", patches, w)
+        if self.with_bias:
+            y = y + ctx.param("bias").astype(x.dtype)
+        return y
+
+
+class LocallyConnected1D(Module):
+    """Reference ``LocallyConnected1D.scala`` — per-step unshared temporal
+    conv over (B, T, D) inputs."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.weight_init = weight_init or Xavier()
+        self.out_frames = (n_input_frame - kernel_w) // stride_w + 1
+
+    def build_params(self, rng):
+        fan_in = self.input_frame_size * self.kernel_w
+        return {
+            "weight": self.weight_init(
+                fold_in_str(rng, "w"),
+                (self.out_frames, self.kernel_w * self.input_frame_size,
+                 self.output_frame_size),
+                fan_in, self.output_frame_size,
+            ),
+            "bias": Zeros()(
+                fold_in_str(rng, "b"),
+                (self.out_frames, self.output_frame_size), fan_in, 1,
+            ),
+        }
+
+    def forward(self, ctx: Context, x):
+        idx = jnp.arange(self.out_frames) * self.stride_w
+        windows = x[:, idx[:, None] + jnp.arange(self.kernel_w)[None, :], :]
+        b = x.shape[0]
+        windows = windows.reshape(b, self.out_frames, -1)
+        w = ctx.param("weight").astype(x.dtype)
+        y = jnp.einsum("btk,tko->bto", windows, w)
+        return y + ctx.param("bias").astype(x.dtype)
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise conv + 1x1 pointwise conv (reference
+    ``SpatialSeparableConvolution.scala``)."""
+
+    def __init__(self, n_input_channel: int, n_output_channel: int,
+                 depth_multiplier: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True):
+        super().__init__()
+        mid = n_input_channel * depth_multiplier
+        self.depthwise = SpatialConvolution(
+            n_input_channel, mid, kernel_w, kernel_h, stride_w, stride_h,
+            pad_w, pad_h, n_group=n_input_channel, with_bias=False,
+        )
+        self.pointwise = SpatialConvolution(
+            mid, n_output_channel, 1, 1, with_bias=with_bias,
+        )
+
+    def forward(self, ctx: Context, x):
+        return self.run_child(ctx, "pointwise", self.run_child(ctx, "depthwise", x))
+
+
+# ----------------------------------------------------- resizing / padding
+
+
+class UpSampling1D(Module):
+    """Repeat timesteps (reference ``UpSampling1D.scala``)."""
+
+    def __init__(self, length: int = 2):
+        super().__init__()
+        self.length = length
+
+    def forward(self, ctx: Context, x):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbor spatial upsampling on NCHW (reference
+    ``UpSampling2D.scala``)."""
+
+    def __init__(self, size: Tuple[int, int] = (2, 2)):
+        super().__init__()
+        self.size = tuple(size)
+
+    def forward(self, ctx: Context, x):
+        return jnp.repeat(jnp.repeat(x, self.size[0], axis=2), self.size[1], axis=3)
+
+
+class UpSampling3D(Module):
+    """Reference ``UpSampling3D.scala`` (NCDHW)."""
+
+    def __init__(self, size: Tuple[int, int, int] = (2, 2, 2)):
+        super().__init__()
+        self.size = tuple(size)
+
+    def forward(self, ctx: Context, x):
+        for i, s in enumerate(self.size):
+            x = jnp.repeat(x, s, axis=2 + i)
+        return x
+
+
+class SpatialUpSamplingNearest(Module):
+    """Reference ``SpatialUpSamplingNearest.scala``."""
+
+    def __init__(self, scale: int):
+        super().__init__()
+        self.scale = scale
+
+    def forward(self, ctx: Context, x):
+        return jnp.repeat(jnp.repeat(x, self.scale, axis=2), self.scale, axis=3)
+
+
+class SpatialUpSamplingBilinear(Module):
+    """Bilinear resize (reference ``SpatialUpSamplingBilinear.scala``,
+    align_corners semantics of the reference's default=false)."""
+
+    def __init__(self, out_height: int, out_width: int):
+        super().__init__()
+        self.out_height = out_height
+        self.out_width = out_width
+
+    def forward(self, ctx: Context, x):
+        b, c, h, w = x.shape
+        return jax.image.resize(
+            x, (b, c, self.out_height, self.out_width), method="bilinear"
+        )
+
+
+class SpatialZeroPadding(Module):
+    """Reference ``SpatialZeroPadding.scala`` (negative pad crops)."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int, pad_bottom: int):
+        super().__init__()
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def forward(self, ctx: Context, x):
+        l, r, t, b = self.pads
+        if min(self.pads) < 0:
+            h, w = x.shape[2], x.shape[3]
+            x = x[:, :, max(0, -t): h - max(0, -b), max(0, -l): w - max(0, -r)]
+            l, r, t, b = (max(0, v) for v in (l, r, t, b))
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+
+
+class Cropping2D(Module):
+    """Reference ``Cropping2D.scala`` (NCHW)."""
+
+    def __init__(self, height_crop: Tuple[int, int], width_crop: Tuple[int, int]):
+        super().__init__()
+        self.height_crop = tuple(height_crop)
+        self.width_crop = tuple(width_crop)
+
+    def forward(self, ctx: Context, x):
+        (t, b), (l, r) = self.height_crop, self.width_crop
+        return x[:, :, t: x.shape[2] - b, l: x.shape[3] - r]
+
+
+class Cropping3D(Module):
+    """Reference ``Cropping3D.scala`` (NCDHW)."""
+
+    def __init__(self, dim1_crop: Tuple[int, int], dim2_crop: Tuple[int, int],
+                 dim3_crop: Tuple[int, int]):
+        super().__init__()
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def forward(self, ctx: Context, x):
+        (a0, a1), (b0, b1), (c0, c1) = self.crops
+        return x[:, :, a0: x.shape[2] - a1, b0: x.shape[3] - b1, c0: x.shape[4] - c1]

@@ -18,6 +18,7 @@ from typing import Optional
 
 import numpy as np
 import jax.numpy as jnp
+from jax import lax
 
 from bigdl_tpu.core.rng import fold_in_str
 from bigdl_tpu.nn.init import InitializationMethod, Ones, Zeros
@@ -139,3 +140,53 @@ class Normalize(Module):
         else:
             norm = jnp.sum(jnp.abs(x) ** self.p, axis=1, keepdims=True) ** (1.0 / self.p)
         return x / (norm + self.eps)
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels (reference
+    ``SpatialCrossMapLRN.scala``; AlexNet/Inception-v1 use it):
+    ``y = x / (k + alpha/size * sum_{nearby c} x_c^2)^beta``.
+
+    TPU-native: the cross-channel window sum is one avg-pool over the
+    channel axis — no hand loops.
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def forward(self, ctx: Context, x):
+        sq = jnp.square(x)
+        half = (self.size - 1) // 2
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add,
+            (1, self.size, 1, 1), (1, 1, 1, 1),
+            [(0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)],
+        )
+        denom = (self.k + (self.alpha / self.size) * window_sum) ** self.beta
+        return x / denom
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel (reference
+    ``SpatialWithinChannelLRN.scala``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def forward(self, ctx: Context, x):
+        sq = jnp.square(x)
+        half = (self.size - 1) // 2
+        pad = [(0, 0), (0, 0), (half, self.size - 1 - half), (half, self.size - 1 - half)]
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add, (1, 1, self.size, self.size), (1, 1, 1, 1), pad,
+        )
+        denom = (1.0 + (self.alpha / (self.size * self.size)) * window_sum) ** self.beta
+        return x / denom

@@ -47,9 +47,14 @@ def capture_init_args(cls) -> None:
     orig = cls.__init__
 
     def wrapped(self, *args, **kwargs):
-        if not hasattr(self, "_init_config"):
+        outermost = not hasattr(self, "_init_config")
+        if outermost:
             object.__setattr__(self, "_init_config", (args, kwargs))
         orig(self, *args, **kwargs)
+        if outermost and hasattr(self, "_modules"):
+            # which children the constructor itself created — the
+            # serializer re-encodes only children added AFTER construction
+            object.__setattr__(self, "_ctor_children", frozenset(self._modules))
 
     wrapped._bigdl_captured = True
     wrapped.__wrapped__ = orig

@@ -175,12 +175,27 @@ def module_to_spec(m: Module) -> Dict[str, Any]:
         spec["keras_input_shape"] = encode_value(m.input_shape)
         return spec
 
-    children = {}
-    for name, child in m.modules.items():
-        children[name] = module_to_spec(child)
-    if children:
-        spec["children"] = children
+    patch = _children_patch(m)
+    if patch:
+        spec["children"] = patch
     return spec
+
+
+def _children_patch(m: Module) -> Dict[str, Any]:
+    """Spec only for children the constructor did NOT create (added via
+    ``add()`` afterwards), plus nested patches inside ctor-created children.
+    Ctor-created children are reachable from the encoded constructor args,
+    so re-encoding them here would double the spec per nesting level."""
+    ctor = getattr(m, "_ctor_children", frozenset())
+    out: Dict[str, Any] = {}
+    for name, child in m.modules.items():
+        if name in ctor:
+            sub = _children_patch(child)
+            if sub:
+                out[name] = {"patch": sub}
+        else:
+            out[name] = {"spec": module_to_spec(child)}
+    return out
 
 
 def module_from_spec(spec: Dict[str, Any]) -> Module:
@@ -232,15 +247,13 @@ def _maybe_name(inst: Module, spec) -> None:
         inst.set_name(spec["name"])
 
 
-def _replay_children(inst: Module, children: Dict[str, Any]) -> None:
-    """Re-attach children added after construction. Children the constructor
-    already recreated (identical config => identical structure) are left in
-    place; only missing ones are rebuilt and added, in saved order."""
-    for name, cspec in children.items():
-        if name in inst.modules:
-            _replay_children(inst.modules[name], cspec.get("children", {}))
+def _replay_children(inst: Module, patch: Dict[str, Any]) -> None:
+    """Re-attach post-construction children from a ``_children_patch``."""
+    for name, entry in patch.items():
+        if "spec" in entry:
+            inst.add(module_from_spec(entry["spec"]), name)
         else:
-            inst.add(module_from_spec(cspec), name)
+            _replay_children(inst.modules[name], entry["patch"])
 
 
 # ----------------------------------------------------------------- graphs

@@ -1,0 +1,302 @@
+"""Extended layer-zoo tests: LRN, volumetric (3-D), locally-connected,
+upsampling/padding/cropping, misc parameterized layers, new criterions —
+torch (CPU) as the parity oracle where torch has the op (reference test
+model: ``DLT/torch/*Spec.scala``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+def _x(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ----------------------------------------------------------- torch parity
+
+
+def test_lrn_vs_torch(rng):
+    layer = nn.SpatialCrossMapLRN(5, alpha=1e-4, beta=0.75, k=1.0)
+    params, _ = layer.init(rng)
+    x = _x(2, 8, 6, 6)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.local_response_norm(torch.from_numpy(x), 5, alpha=1e-4, beta=0.75, k=1.0)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_volumetric_conv_vs_torch(rng):
+    layer = nn.VolumetricConvolution(3, 6, 3, 3, 3, 2, 2, 2, 1, 1, 1)
+    params, _ = layer.init(rng)
+    x = _x(2, 3, 8, 8, 8)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.conv3d(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=2, padding=1,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_volumetric_full_conv_vs_torch(rng):
+    layer = nn.VolumetricFullConvolution(3, 4, 2, 2, 2, 2, 2, 2)
+    params, _ = layer.init(rng)
+    x = _x(1, 3, 4, 4, 4)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.conv_transpose3d(
+        torch.from_numpy(x),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=2,
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_volumetric_max_pool_vs_torch(rng):
+    layer = nn.VolumetricMaxPooling(2, 2, 2)
+    params, _ = layer.init(rng)
+    x = _x(2, 3, 6, 6, 6)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.max_pool3d(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5)
+
+
+def test_volumetric_avg_pool_vs_torch(rng):
+    layer = nn.VolumetricAveragePooling(2, 2, 2)
+    params, _ = layer.init(rng)
+    x = _x(2, 3, 6, 6, 6)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.avg_pool3d(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-5)
+
+
+def test_bilinear_vs_torch(rng):
+    layer = nn.Bilinear(4, 5, 3)
+    params, _ = layer.init(rng)
+    x1, x2 = _x(6, 4), _x(6, 5, seed=1)
+    y, _ = layer.apply(params, (jnp.asarray(x1), jnp.asarray(x2)))
+    ref = F.bilinear(
+        torch.from_numpy(x1), torch.from_numpy(x2),
+        torch.from_numpy(np.asarray(params["weight"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+    )
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_bilinear_vs_torch(rng):
+    layer = nn.SpatialUpSamplingBilinear(8, 10)
+    params, _ = layer.init(rng)
+    x = _x(2, 3, 4, 5)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    ref = F.interpolate(torch.from_numpy(x), size=(8, 10), mode="bilinear",
+                        align_corners=False)
+    np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_pairwise_criterions_vs_torch():
+    o = _x(8, 5)
+    t = np.sign(_x(8, seed=3)) .astype(np.float32)
+    v = nn.SoftMarginCriterion().forward(jnp.asarray(o[:, 0]), jnp.asarray(t))
+    ref = torch.nn.SoftMarginLoss()(torch.from_numpy(o[:, 0]), torch.from_numpy(t))
+    np.testing.assert_allclose(float(v), float(ref), rtol=1e-5)
+
+    x1, x2 = _x(8, 5), _x(8, 5, seed=1)
+    v = nn.CosineEmbeddingCriterion(0.3).forward(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(t))
+    ref = torch.nn.CosineEmbeddingLoss(margin=0.3)(
+        torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(t))
+    np.testing.assert_allclose(float(v), float(ref), rtol=1e-5)
+
+    v = nn.MarginRankingCriterion(0.5).forward(
+        (jnp.asarray(x1[:, 0]), jnp.asarray(x2[:, 0])), jnp.asarray(t))
+    ref = torch.nn.MarginRankingLoss(margin=0.5)(
+        torch.from_numpy(x1[:, 0]), torch.from_numpy(x2[:, 0]), torch.from_numpy(t))
+    np.testing.assert_allclose(float(v), float(ref), rtol=1e-5)
+
+
+def test_multi_margin_vs_torch():
+    o = _x(6, 4)
+    t = np.random.RandomState(0).randint(0, 4, 6)
+    v = nn.MultiMarginCriterion().forward(jnp.asarray(o), jnp.asarray(t))
+    ref = torch.nn.MultiMarginLoss()(torch.from_numpy(o), torch.from_numpy(t))
+    np.testing.assert_allclose(float(v), float(ref), rtol=1e-5)
+
+
+def test_poisson_vs_torch():
+    o = np.abs(_x(6, 4)) + 0.1
+    t = np.abs(_x(6, 4, seed=1))
+    v = nn.PoissonCriterion().forward(jnp.asarray(o), jnp.asarray(t))
+    ref = torch.nn.PoissonNLLLoss(log_input=False)(
+        torch.from_numpy(o), torch.from_numpy(t))
+    np.testing.assert_allclose(float(v), float(ref), rtol=1e-4)
+
+
+# ---------------------------------------------------- behavioral checks
+
+
+def test_gradient_reversal_grad(rng):
+    m = nn.GradientReversal(1.5)
+    p, s = m.init(rng)
+    g = jax.grad(lambda x: jnp.sum(m.apply(p, x)[0]))(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(g), -1.5)
+
+
+def test_l1_penalty_grad(rng):
+    m = nn.L1Penalty(0.2)
+    p, s = m.init(rng)
+    g = jax.grad(lambda x: jnp.sum(m.apply(p, x)[0]))(jnp.asarray([2.0, -3.0]))
+    np.testing.assert_allclose(np.asarray(g), [1.2, 0.8])
+
+
+def test_rrelu_train_vs_eval(rng):
+    m = nn.RReLU()
+    p, s = m.init(rng)
+    x = jnp.asarray(_x(4, 5))
+    out_eval, _ = m.apply(p, x, state=s, training=False)
+    # eval slope is the mean of the range
+    exp = np.where(np.asarray(x) >= 0, np.asarray(x),
+                   np.asarray(x) * (1 / 8 + 1 / 3) / 2)
+    np.testing.assert_allclose(np.asarray(out_eval), exp, rtol=1e-5)
+    out_tr, _ = m.apply(p, x, state=s, training=True, rng=jax.random.key(0))
+    assert not np.allclose(np.asarray(out_tr), np.asarray(out_eval))
+
+
+def test_spatial_dropout_drops_whole_channels(rng):
+    m = nn.SpatialDropout2D(0.5)
+    p, s = m.init(rng)
+    x = jnp.ones((1, 16, 5, 5))
+    out, _ = m.apply(p, x, state=s, training=True, rng=jax.random.key(3))
+    out = np.asarray(out)
+    for c in range(16):
+        ch = out[0, c]
+        assert np.all(ch == 0) or np.all(ch == ch.flat[0])
+
+
+def test_locally_connected_2d_unshared(rng):
+    """Kernels differ per position: constant input must not give constant
+    output (unlike a conv)."""
+    m = nn.LocallyConnected2D(2, 6, 6, 3, 3, 3)
+    p, _ = m.init(rng)
+    x = jnp.ones((1, 2, 6, 6))
+    out, _ = m.apply(p, x)
+    out = np.asarray(out)
+    assert out.shape == (1, 3, 4, 4)
+    assert np.std(out) > 1e-4  # per-pixel kernels -> varying output
+
+
+def test_locally_connected_1d_shapes(rng):
+    m = nn.LocallyConnected1D(10, 4, 6, 3, 2)
+    p, _ = m.init(rng)
+    out, _ = m.apply(p, jnp.asarray(_x(2, 10, 4)))
+    assert out.shape == (2, 4, 6)
+
+
+def test_separable_conv_matches_composition(rng):
+    m = nn.SpatialSeparableConvolution(4, 6, 2, 3, 3)
+    p, _ = m.init(rng)
+    x = _x(2, 4, 8, 8)
+    out, _ = m.apply(p, jnp.asarray(x))
+    # compose torch depthwise + pointwise with the same weights
+    dw = F.conv2d(torch.from_numpy(x),
+                  torch.from_numpy(np.asarray(p["depthwise"]["weight"])), None,
+                  groups=4)
+    pw = F.conv2d(dw, torch.from_numpy(np.asarray(p["pointwise"]["weight"])),
+                  torch.from_numpy(np.asarray(p["pointwise"]["bias"])))
+    np.testing.assert_allclose(np.asarray(out), t2n(pw), rtol=1e-3, atol=1e-4)
+
+
+def test_masked_select_and_index(rng):
+    ms = nn.MaskedSelect()
+    p, _ = ms.init(rng)
+    t = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    mask = jnp.asarray([[1, 0], [0, 1]])
+    out, _ = ms.apply(p, (t, mask))
+    np.testing.assert_allclose(np.asarray(out), [[1.0, 0.0], [0.0, 4.0]])
+
+    ix = nn.Index(1)
+    p, _ = ix.init(rng)
+    out, _ = ix.apply(p, (t, jnp.asarray([1, 0])))
+    np.testing.assert_allclose(np.asarray(out), [[2.0, 1.0], [4.0, 3.0]])
+
+
+def test_scale_cmul_cadd(rng):
+    m = nn.Scale([1, 3])
+    p, s = m.init(rng)
+    x = jnp.asarray(_x(2, 3))
+    out, _ = m.apply(p, x, state=s)
+    exp = np.asarray(x) * np.asarray(p["cmul"]["weight"]) + np.asarray(p["cadd"]["bias"])
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-6)
+
+
+def test_srelu_identity_in_linear_region(rng):
+    m = nn.SReLU([4])
+    p, _ = m.init(rng)
+    x = jnp.asarray([[0.2, 0.5, 0.9, 0.01]])  # inside [t_left=0, t_right=1]
+    out, _ = m.apply(p, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_keras_3d_stack(rng):
+    from bigdl_tpu import keras
+
+    m = keras.Sequential()
+    m.add(keras.Convolution3D(4, 3, 3, 3, activation="relu", input_shape=(2, 8, 8, 8)))
+    m.add(keras.MaxPooling3D((2, 2, 2)))
+    m.add(keras.Flatten())
+    m.add(keras.Dense(5))
+    assert m.get_output_shape() == (5,)
+    params, state = m.init(rng)
+    out, _ = m.apply(params, _x(2, 2, 8, 8, 8), state=state)
+    assert out.shape == (2, 5)
+
+
+def test_keras_extra_wrappers_shapes(rng):
+    from bigdl_tpu import keras
+
+    cases = [
+        (keras.SeparableConvolution2D(6, 3, 3, depth_multiplier=2), (4, 8, 8)),
+        (keras.LocallyConnected2D(3, 3, 3), (2, 6, 6)),
+        (keras.LocallyConnected1D(6, 3, subsample_length=2), (10, 4)),
+        (keras.SReLU(), (5,)),
+        (keras.SpatialDropout2D(0.4), (3, 5, 5)),
+        (keras.ZeroPadding3D((1, 2, 1)), (2, 4, 4, 4)),
+        (keras.Cropping3D(((1, 1), (1, 1), (1, 1))), (2, 6, 6, 6)),
+        (keras.UpSampling3D((2, 1, 2)), (2, 3, 3, 3)),
+        (keras.GlobalMaxPooling3D(), (2, 4, 4, 4)),
+        (keras.AveragePooling3D((2, 2, 2)), (2, 6, 6, 6)),
+    ]
+    for layer, shape in cases:
+        layer.ensure_built(shape)
+        p, s = layer.init(rng)
+        out, _ = layer.apply(p, _x(2, *shape), state=s)
+        assert out.shape == (2,) + layer.get_output_shape(), type(layer).__name__
+
+
+def test_spatial_dropout_1d_drops_feature_channels(rng):
+    m = nn.SpatialDropout1D(0.5)
+    p, s = m.init(rng)
+    x = jnp.ones((1, 6, 16))  # (B, T, D): channels last
+    out, _ = m.apply(p, x, state=s, training=True, rng=jax.random.key(5))
+    out = np.asarray(out)
+    for d in range(16):  # each feature channel all-kept or all-dropped
+        ch = out[0, :, d]
+        assert np.all(ch == 0) or np.all(ch == ch[0])
+
+
+def test_class_simplex_is_regular():
+    import itertools
+
+    s = np.asarray(nn.ClassSimplexCriterion(5).simplex)
+    dists = [np.linalg.norm(s[i] - s[j]) for i, j in itertools.combinations(range(5), 2)]
+    np.testing.assert_allclose(dists, dists[0], rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(s, axis=1), 1.0, rtol=1e-5)

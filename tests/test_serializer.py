@@ -197,3 +197,17 @@ def test_keras_model_output_shape_survives_roundtrip(tmp_path, rng):
     save_module(f, m, p, s)
     m2, _, _ = load_module(f)
     assert m2.get_output_shape() == (2,)
+
+
+def test_no_double_encoding_of_ctor_children():
+    import json
+
+    spec = module_to_spec(nn.Sequential(nn.Sequential(nn.Linear(3, 2))))
+    assert json.dumps(spec).count("Linear") == 1
+
+
+def test_post_ctor_additions_inside_ctor_child_survive(tmp_path, rng):
+    outer = nn.Sequential(nn.Sequential(nn.Linear(4, 4)))
+    inner = outer.modules["0"]
+    inner.add(nn.ReLU())          # added AFTER outer's construction
+    roundtrip(tmp_path, outer, _x(2, 4), rng)
