@@ -1,0 +1,136 @@
+"""Mask R-CNN (inference).
+
+Reference: ``DL/models/maskrcnn/MaskRCNN.scala`` (768 LoC — ResNet-FPN
+backbone, RegionProposal, BoxHead, MaskHead over ImageFrame input).
+
+TPU-native design: the whole forward is ONE jittable program with static
+shapes — proposals/detections are fixed-size (post-NMS top-k + validity
+masks) instead of the reference's variable-length arrays, and the
+multi-level RoI pooling uses the one-hot ``Pooler`` blend. Single-image
+inference (B=1), matching the reference's per-partition predict path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models import resnet
+from bigdl_tpu.nn.layers.detection import (
+    Anchor, BoxHead, FPN, MaskHead, Pooler, RegionProposal, bbox_clip,
+    bbox_decode, nms,
+)
+from bigdl_tpu.nn.module import Context, Module
+
+
+class ResNetFPNBackbone(Module):
+    """ResNet stages C2-C5 + FPN (reference MaskRCNN backbone)."""
+
+    def __init__(self, depth: int = 50, out_channels: int = 256):
+        super().__init__()
+        kind, counts = resnet.IMAGENET_CFG[depth]
+        block = resnet.basic_block if kind == "basic" else resnet.bottleneck
+        expansion = 1 if kind == "basic" else 4
+        self.stem = nn.Sequential(
+            resnet._conv(3, 64, 7, 2, 3),
+            resnet._bn(64),
+            nn.ReLU(),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+        )
+        cin = 64
+        self.stage_channels = []
+        for stage, (planes, n_blocks) in enumerate(zip([64, 128, 256, 512], counts)):
+            s = nn.Sequential()
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                s.add(block(cin, planes, stride))
+                cin = planes * expansion
+            self.add(s, f"layer{stage + 1}")
+            self.stage_channels.append(cin)
+        self.fpn = FPN(self.stage_channels, out_channels)
+        self.out_channels = out_channels
+
+    def forward(self, ctx: Context, x):
+        h = self.run_child(ctx, "stem", x)
+        feats = []
+        for i in range(1, 5):
+            h = self.run_child(ctx, f"layer{i}", h)
+            feats.append(h)
+        return self.run_child(ctx, "fpn", tuple(feats))
+
+
+class MaskRCNN(Module):
+    """Full detector (reference ``MaskRCNN.scala``). ``forward(image)`` with
+    image (1, 3, H, W) returns a dict: boxes (K, 4), scores (K,),
+    labels (K,), masks (K, 28, 28) logits per detection (class-selected),
+    valid (K,) — fixed K = ``detections_per_img``."""
+
+    def __init__(self, num_classes: int = 81, depth: int = 50,
+                 out_channels: int = 256,
+                 post_nms_topn: int = 100, detections_per_img: int = 20,
+                 box_score_thresh: float = 0.05, box_nms_thresh: float = 0.5,
+                 resolution: int = 7, mask_resolution: int = 14):
+        super().__init__()
+        self.backbone = ResNetFPNBackbone(depth, out_channels)
+        self.rpn = RegionProposal(
+            out_channels, Anchor(scales=(8.0,)), post_nms_topn=post_nms_topn)
+        self.pooler = Pooler(resolution, scales=(1 / 4, 1 / 8, 1 / 16, 1 / 32))
+        self.box_head = BoxHead(out_channels, resolution, num_classes)
+        self.mask_pooler = Pooler(mask_resolution,
+                                  scales=(1 / 4, 1 / 8, 1 / 16, 1 / 32))
+        self.mask_head = MaskHead(out_channels, num_classes)
+        self.num_classes = num_classes
+        self.detections_per_img = detections_per_img
+        self.box_score_thresh = box_score_thresh
+        self.box_nms_thresh = box_nms_thresh
+
+    def forward(self, ctx: Context, x):
+        img_h, img_w = x.shape[2], x.shape[3]
+        feats = self.run_child(ctx, "backbone", x)
+        # RPN on the stride-16 level (P4), the reference runs per-level and
+        # merges; single-level keeps the program small (documented deviation)
+        rois, roi_scores, roi_valid = self.rpn.forward(
+            ctx.child("rpn"), feats[2], im_size=(img_h, img_w), stride=16.0)
+
+        pooled = self.pooler.forward(ctx.child("pooler"), (feats, rois))
+        cls_logits, box_deltas = self.box_head.forward(ctx.child("box_head"), pooled)
+        probs = jax.nn.softmax(cls_logits, axis=-1)
+
+        # best non-background class per roi
+        fg = probs[:, 1:]
+        best_c = jnp.argmax(fg, axis=-1) + 1
+        best_p = jnp.max(fg, axis=-1) * roi_valid
+        deltas = jnp.take_along_axis(
+            box_deltas.reshape(-1, self.num_classes, 4),
+            best_c[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        boxes = bbox_clip(bbox_decode(rois, deltas, weights=(10., 10., 5., 5.)),
+                          img_h, img_w)
+        keep, valid = nms(boxes, jnp.where(best_p > self.box_score_thresh,
+                                           best_p, -jnp.inf),
+                          self.box_nms_thresh, self.detections_per_img)
+        det_boxes = jnp.where(valid[:, None], boxes[keep], 0.0)
+        det_scores = jnp.where(valid, best_p[keep], 0.0)
+        det_labels = jnp.where(valid, best_c[keep], 0)
+
+        mask_feats = self.mask_pooler.forward(ctx.child("mask_pooler"),
+                                              (feats, det_boxes))
+        mask_logits = self.mask_head.forward(ctx.child("mask_head"), mask_feats)
+        det_masks = jnp.take_along_axis(
+            mask_logits,
+            det_labels[:, None, None, None].repeat(
+                mask_logits.shape[2], 2).repeat(mask_logits.shape[3], 3),
+            axis=1)[:, 0]
+        return {
+            "boxes": det_boxes,
+            "scores": det_scores,
+            "labels": det_labels,
+            "masks": det_masks,
+            "valid": valid,
+        }
+
+
+def build(num_classes: int = 81, depth: int = 50, **kw) -> MaskRCNN:
+    return MaskRCNN(num_classes=num_classes, depth=depth, **kw)

@@ -320,7 +320,8 @@ class SoftMarginCriterion(Criterion):
         self.size_average = size_average
 
     def forward(self, output, target):
-        return _reduce(jnp.log1p(jnp.exp(-target * output)), self.size_average)
+        # logaddexp(0, z) = stable log(1 + e^z)
+        return _reduce(jnp.logaddexp(0.0, -target * output), self.size_average)
 
 
 class L1HingeEmbeddingCriterion(Criterion):

@@ -1,0 +1,529 @@
+"""Object-detection stack: anchors, NMS, proposals, RoI pooling, FPN, heads.
+
+Reference (all under ``DL/nn/``): ``Anchor.scala``, ``Nms.scala``,
+``Proposal.scala`` / ``RegionProposal.scala``, ``RoiAlign.scala``,
+``RoiPooling.scala``, ``PriorBox.scala``, ``FPN.scala``, ``BoxHead.scala``,
+``MaskHead.scala``, ``Pooler.scala``, ``DetectionOutputSSD.scala`` /
+``DetectionOutputFrcnn.scala`` — hand-loop CPU implementations.
+
+TPU-native redesign principles:
+
+- **static shapes everywhere**: NMS returns a fixed ``max_output`` set of
+  indices plus a validity mask (XLA cannot produce data-dependent sizes;
+  the reference returns variable-length arrays);
+- **NMS as a bounded ``fori_loop``** over argmax-select-and-suppress — the
+  classic O(k·N) formulation that compiles to one XLA while loop;
+- **RoiAlign as vectorized bilinear gather** (one ``map_coordinates``-style
+  gather per level instead of per-RoI loops);
+- boxes are ``(x1, y1, x2, y2)`` in input-image coordinates, matching the
+  reference's convention.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.layers.conv import SpatialConvolution, SpatialFullConvolution
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Context, Module
+
+# --------------------------------------------------------- box utilities
+
+
+def bbox_iou(boxes_a: jax.Array, boxes_b: jax.Array) -> jax.Array:
+    """Pairwise IoU, (N, 4) x (M, 4) -> (N, M) (reference ``Bbox.scala``)."""
+    area_a = jnp.maximum(boxes_a[:, 2] - boxes_a[:, 0], 0) * \
+        jnp.maximum(boxes_a[:, 3] - boxes_a[:, 1], 0)
+    area_b = jnp.maximum(boxes_b[:, 2] - boxes_b[:, 0], 0) * \
+        jnp.maximum(boxes_b[:, 3] - boxes_b[:, 1], 0)
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def bbox_decode(boxes: jax.Array, deltas: jax.Array,
+                weights: Sequence[float] = (1.0, 1.0, 1.0, 1.0)) -> jax.Array:
+    """Apply (dx, dy, dw, dh) regression deltas to boxes
+    (reference ``Bbox.bboxTransformInv``)."""
+    wx, wy, ww, wh = weights
+    widths = boxes[:, 2] - boxes[:, 0]
+    heights = boxes[:, 3] - boxes[:, 1]
+    cx = boxes[:, 0] + 0.5 * widths
+    cy = boxes[:, 1] + 0.5 * heights
+    dx, dy, dw, dh = (deltas[:, 0] / wx, deltas[:, 1] / wy,
+                      deltas[:, 2] / ww, deltas[:, 3] / wh)
+    dw = jnp.clip(dw, -1e3, math.log(1000.0 / 16))
+    dh = jnp.clip(dh, -1e3, math.log(1000.0 / 16))
+    pred_cx = dx * widths + cx
+    pred_cy = dy * heights + cy
+    pred_w = jnp.exp(dw) * widths
+    pred_h = jnp.exp(dh) * heights
+    return jnp.stack([
+        pred_cx - 0.5 * pred_w, pred_cy - 0.5 * pred_h,
+        pred_cx + 0.5 * pred_w, pred_cy + 0.5 * pred_h,
+    ], axis=1)
+
+
+def bbox_clip(boxes: jax.Array, height: float, width: float) -> jax.Array:
+    """Clip to image bounds (reference ``Bbox.clipBoxes``)."""
+    return jnp.stack([
+        jnp.clip(boxes[:, 0], 0, width), jnp.clip(boxes[:, 1], 0, height),
+        jnp.clip(boxes[:, 2], 0, width), jnp.clip(boxes[:, 3], 0, height),
+    ], axis=1)
+
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+        max_output: int, score_threshold: float = -jnp.inf):
+    """Fixed-size NMS (reference ``Nms.scala``).
+
+    Returns ``(indices[max_output], valid[max_output])``: greedy
+    highest-score selection suppressing overlaps above ``iou_threshold``,
+    as one bounded XLA loop.
+    """
+    n = boxes.shape[0]
+    iou = bbox_iou(boxes, boxes)
+    live = scores > score_threshold
+
+    def step(i, carry):
+        sel_idx, sel_valid, live = carry
+        best = jnp.argmax(jnp.where(live, scores, -jnp.inf))
+        ok = live[best]
+        sel_idx = sel_idx.at[i].set(jnp.where(ok, best, -1))
+        sel_valid = sel_valid.at[i].set(ok)
+        suppress = iou[best] > iou_threshold
+        live = live & ~suppress & (jnp.arange(n) != best)
+        live = jnp.where(ok, live, jnp.zeros_like(live))
+        return sel_idx, sel_valid, live
+
+    sel_idx = jnp.full((max_output,), -1, jnp.int32)
+    sel_valid = jnp.zeros((max_output,), bool)
+    sel_idx, sel_valid, _ = lax.fori_loop(0, max_output, step,
+                                          (sel_idx, sel_valid, live))
+    return sel_idx, sel_valid
+
+
+class Nms(Module):
+    """Module wrapper over :func:`nms` (reference ``Nms.scala``)."""
+
+    def __init__(self, iou_threshold: float = 0.5, max_output: int = 100,
+                 score_threshold: float = -jnp.inf):
+        super().__init__()
+        self.iou_threshold = iou_threshold
+        self.max_output = max_output
+        self.score_threshold = score_threshold
+
+    def forward(self, ctx: Context, x):
+        boxes, scores = x
+        return nms(boxes, scores, self.iou_threshold, self.max_output,
+                   self.score_threshold)
+
+
+# ----------------------------------------------------------------- anchors
+
+
+class Anchor:
+    """Anchor generation (reference ``Anchor.scala``): base anchors from
+    (ratios x scales), shifted over the feature grid. Pure function-object,
+    not a Module (the reference also keeps it separate)."""
+
+    def __init__(self, ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8.0, 16.0, 32.0),
+                 base_size: float = 16.0):
+        self.ratios = tuple(ratios)
+        self.scales = tuple(scales)
+        self.base_size = base_size
+
+    @property
+    def num_anchors(self) -> int:
+        return len(self.ratios) * len(self.scales)
+
+    def base_anchors(self) -> jax.Array:
+        anchors = []
+        for r in self.ratios:
+            for s in self.scales:
+                size = self.base_size * s
+                w = size * math.sqrt(1.0 / r)
+                h = size * math.sqrt(r)
+                anchors.append([-w / 2, -h / 2, w / 2, h / 2])
+        return jnp.asarray(anchors, jnp.float32)
+
+    def generate(self, feat_h: int, feat_w: int, stride: float) -> jax.Array:
+        """(A * H * W, 4) anchors in image coordinates."""
+        base = self.base_anchors()  # (A, 4)
+        shift_x = (jnp.arange(feat_w) + 0.5) * stride
+        shift_y = (jnp.arange(feat_h) + 0.5) * stride
+        sx, sy = jnp.meshgrid(shift_x, shift_y)
+        shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (H*W, 4)
+        return (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+
+
+class PriorBox(Module):
+    """SSD prior boxes for one feature map (reference ``PriorBox.scala``).
+    forward(feature) -> (num_priors*H*W, 4) normalized [0,1] boxes."""
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Sequence[float] = (),
+                 aspect_ratios: Sequence[float] = (2.0,),
+                 flip: bool = True, clip: bool = False,
+                 img_size: int = 300, step: Optional[float] = None,
+                 offset: float = 0.5):
+        super().__init__()
+        self.min_sizes = tuple(min_sizes)
+        self.max_sizes = tuple(max_sizes)
+        ars = [1.0]
+        for ar in aspect_ratios:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+        self.aspect_ratios = ars
+        self.clip = clip
+        self.img_size = img_size
+        self.step = step
+        self.offset = offset
+
+    def forward(self, ctx: Context, x):
+        h, w = x.shape[-2], x.shape[-1]
+        step = self.step or self.img_size / h
+        whs = []
+        for mn in self.min_sizes:
+            whs.append((mn, mn))
+            for mx in self.max_sizes:
+                s = math.sqrt(mn * mx)
+                whs.append((s, s))
+            for ar in self.aspect_ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * math.sqrt(ar), mn / math.sqrt(ar)))
+        cx = (jnp.arange(w) + self.offset) * step / self.img_size
+        cy = (jnp.arange(h) + self.offset) * step / self.img_size
+        gx, gy = jnp.meshgrid(cx, cy)
+        centers = jnp.stack([gx, gy], -1).reshape(-1, 2)  # (H*W, 2)
+        wh = jnp.asarray(whs, jnp.float32) / self.img_size  # (P, 2)
+        boxes = jnp.concatenate([
+            (centers[:, None, :] - wh[None] / 2),
+            (centers[:, None, :] + wh[None] / 2),
+        ], axis=-1).reshape(-1, 4)
+        if self.clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes
+
+
+# ------------------------------------------------------------- RoI pooling
+
+
+def roi_align(features: jax.Array, rois: jax.Array, pooled_h: int,
+              pooled_w: int, spatial_scale: float,
+              sampling_ratio: int = 2, mode: str = "avg") -> jax.Array:
+    """RoIAlign (reference ``RoiAlign.scala``): bilinear sampling on a
+    regular grid inside each RoI bin, reduced by ``mode`` ("avg" or "max").
+
+    ``features``: (C, H, W); ``rois``: (R, 4) image-coord boxes.
+    Returns (R, C, pooled_h, pooled_w).
+    """
+    c, h, w = features.shape
+    boxes = rois * spatial_scale
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    roi_w = jnp.maximum(x2 - x1, 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1.0)
+    bin_w = roi_w / pooled_w
+    bin_h = roi_h / pooled_h
+    s = sampling_ratio
+
+    # sample positions: (R, pooled, s) per axis
+    def axis_points(start, bin_size, pooled):
+        grid = jnp.arange(pooled)[None, :, None]          # (1, P, 1)
+        sub = (jnp.arange(s)[None, None, :] + 0.5) / s    # (1, 1, s)
+        return start[:, None, None] + (grid + sub) * bin_size[:, None, None]
+
+    px = axis_points(x1, bin_w, pooled_w)  # (R, PW, s)
+    py = axis_points(y1, bin_h, pooled_h)  # (R, PH, s)
+
+    def bilinear(img, ys, xs):
+        """img (H, W); ys (R,PH,s), xs (R,PW,s) -> (R, PH, s, PW, s)."""
+        ys = jnp.clip(ys - 0.5, 0.0, h - 1.0)
+        xs = jnp.clip(xs - 0.5, 0.0, w - 1.0)
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy1 = ys - y0
+        wx1 = xs - x0
+        y0 = y0.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+
+        def gather(yi, xi):
+            return img[yi[:, :, :, None, None], xi[:, None, None, :, :]]
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x1i)
+        v10 = gather(y1i, x0)
+        v11 = gather(y1i, x1i)
+        wy1b = wy1[:, :, :, None, None]
+        wx1b = wx1[:, None, None, :, :]
+        return (v00 * (1 - wy1b) * (1 - wx1b) + v01 * (1 - wy1b) * wx1b
+                + v10 * wy1b * (1 - wx1b) + v11 * wy1b * wx1b)
+
+    sampled = jax.vmap(lambda img: bilinear(img, py, px))(features)
+    # (C, R, PH, s, PW, s) -> reduce over the s x s samples
+    reduce = jnp.max if mode == "max" else jnp.mean
+    return reduce(sampled, axis=(3, 5)).transpose(1, 0, 2, 3)
+
+
+class RoiAlign(Module):
+    """Module wrapper (reference ``RoiAlign.scala``). Input:
+    ``(features (B=1, C, H, W) or (C, H, W), rois (R, 4))``."""
+
+    def __init__(self, spatial_scale: float, sampling_ratio: int,
+                 pooled_h: int, pooled_w: int):
+        super().__init__()
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+        self.pooled_h = pooled_h
+        self.pooled_w = pooled_w
+
+    def forward(self, ctx: Context, x):
+        features, rois = x
+        if features.ndim == 4:
+            features = features[0]
+        return roi_align(features, rois, self.pooled_h, self.pooled_w,
+                         self.spatial_scale, self.sampling_ratio)
+
+
+class RoiPooling(Module):
+    """Quantized max RoI pooling (reference ``RoiPooling.scala``) — lowered
+    through the same bilinear sampler with MAX over a dense sample grid
+    (documented deviation: exact hard-quantized pooling is hostile to XLA
+    gathers; RoIAlign-max matches within quantization error)."""
+
+    def __init__(self, pooled_h: int, pooled_w: int, spatial_scale: float,
+                 sampling_ratio: int = 4):
+        super().__init__()
+        self.pooled_h = pooled_h
+        self.pooled_w = pooled_w
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+
+    def forward(self, ctx: Context, x):
+        features, rois = x
+        if features.ndim == 4:
+            features = features[0]
+        return roi_align(features, rois, self.pooled_h, self.pooled_w,
+                         self.spatial_scale, sampling_ratio=self.sampling_ratio,
+                         mode="max")
+
+
+class Pooler(Module):
+    """Multi-level RoIAlign dispatcher (reference ``Pooler.scala``): each
+    RoI is pooled from the FPN level matching its scale, blended by a
+    one-hot level assignment (XLA-friendly: every level pools every RoI;
+    the select keeps the right one — levels are few, RoIs dominate)."""
+
+    def __init__(self, resolution: int, scales: Sequence[float],
+                 sampling_ratio: int = 2):
+        super().__init__()
+        self.resolution = resolution
+        self.scales = tuple(scales)
+        self.sampling_ratio = sampling_ratio
+
+    def forward(self, ctx: Context, x):
+        features, rois = x  # features: list/tuple of (C,H,W) or (1,C,H,W)
+        k_min = -math.log2(self.scales[0])
+        areas = jnp.maximum(rois[:, 2] - rois[:, 0], 1e-6) * \
+            jnp.maximum(rois[:, 3] - rois[:, 1], 1e-6)
+        target = jnp.floor(4 + jnp.log2(jnp.sqrt(areas) / 224.0 + 1e-6))
+        target = jnp.clip(target, k_min, k_min + len(self.scales) - 1) - k_min
+        pooled = []
+        for lvl, (feat, scale) in enumerate(zip(features, self.scales)):
+            if feat.ndim == 4:
+                feat = feat[0]
+            p = roi_align(feat, rois, self.resolution, self.resolution,
+                          scale, self.sampling_ratio)
+            pooled.append(jnp.where((target == lvl)[:, None, None, None], p, 0.0))
+        return sum(pooled)
+
+
+# ------------------------------------------------------------------- FPN
+
+
+class FPN(Module):
+    """Feature Pyramid Network (reference ``FPN.scala``): lateral 1x1 convs
+    + top-down nearest upsampling + 3x3 smoothing convs."""
+
+    def __init__(self, in_channels_list: Sequence[int], out_channels: int,
+                 top_blocks: int = 0):
+        super().__init__()
+        self.in_channels_list = tuple(in_channels_list)
+        self.out_channels = out_channels
+        self.top_blocks = top_blocks
+        for i, cin in enumerate(self.in_channels_list):
+            self.add(SpatialConvolution(cin, out_channels, 1, 1), f"lateral{i}")
+            self.add(SpatialConvolution(out_channels, out_channels, 3, 3,
+                                        pad_w=1, pad_h=1), f"smooth{i}")
+
+    def forward(self, ctx: Context, x):
+        """x: tuple of (B, C_i, H_i, W_i), highest resolution first."""
+        n = len(self.in_channels_list)
+        laterals = [self.run_child(ctx, f"lateral{i}", f) for i, f in enumerate(x)]
+        outs = [None] * n
+        prev = laterals[-1]
+        outs[-1] = self.run_child(ctx, f"smooth{n-1}", prev)
+        for i in range(n - 2, -1, -1):
+            up = jnp.repeat(jnp.repeat(prev, 2, axis=2), 2, axis=3)
+            up = up[:, :, : laterals[i].shape[2], : laterals[i].shape[3]]
+            prev = laterals[i] + up
+            outs[i] = self.run_child(ctx, f"smooth{i}", prev)
+        if self.top_blocks:
+            extra = outs[-1]
+            for _ in range(self.top_blocks):
+                extra = -lax.reduce_window(-extra, -jnp.inf, lax.max,
+                                           (1, 1, 1, 1), (1, 1, 2, 2),
+                                           [(0, 0)] * 4)
+                outs.append(extra)
+        return tuple(outs)
+
+
+# ---------------------------------------------------------------- heads
+
+
+class RegionProposal(Module):
+    """RPN head + proposal generation (reference ``RegionProposal.scala`` /
+    ``Proposal.scala``): 3x3 conv trunk, 1x1 objectness + bbox-delta heads,
+    anchor decode, clip, top-k by score, NMS to ``post_nms_topn``."""
+
+    def __init__(self, in_channels: int, anchor: Optional[Anchor] = None,
+                 pre_nms_topn: int = 1000, post_nms_topn: int = 100,
+                 nms_thresh: float = 0.7, min_size: float = 0.0):
+        super().__init__()
+        self.anchor = anchor or Anchor()
+        a = self.anchor.num_anchors
+        self.conv = SpatialConvolution(in_channels, in_channels, 3, 3, pad_w=1, pad_h=1)
+        self.cls_logits = SpatialConvolution(in_channels, a, 1, 1)
+        self.bbox_pred = SpatialConvolution(in_channels, 4 * a, 1, 1)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.nms_thresh = nms_thresh
+        self.min_size = min_size
+
+    def forward(self, ctx: Context, x, im_size: Tuple[int, int] = None,
+                stride: float = 16.0):
+        """x: (1, C, H, W) feature map. Returns (rois (post_nms_topn, 4),
+        scores (post_nms_topn,), valid mask)."""
+        feat = jnp.maximum(self.run_child(ctx, "conv", x), 0.0)
+        logits = self.run_child(ctx, "cls_logits", feat)
+        deltas = self.run_child(ctx, "bbox_pred", feat)
+        _, a, fh, fw = logits.shape
+        anchors = self.anchor.generate(fh, fw, stride)          # (A*H*W, 4)
+        scores = logits[0].transpose(1, 2, 0).reshape(-1)        # H,W,A -> flat
+        deltas = deltas[0].reshape(a, 4, fh, fw).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = bbox_decode(anchors, deltas)
+        h_im, w_im = im_size if im_size is not None else (fh * stride, fw * stride)
+        boxes = bbox_clip(boxes, h_im, w_im)
+        if self.min_size > 0:
+            # reference Proposal.scala: drop degenerate small proposals
+            keep = ((boxes[:, 2] - boxes[:, 0]) >= self.min_size) & \
+                   ((boxes[:, 3] - boxes[:, 1]) >= self.min_size)
+            scores = jnp.where(keep, scores, -jnp.inf)
+        k = min(self.pre_nms_topn, scores.shape[0])
+        top_scores, top_idx = lax.top_k(scores, k)
+        top_boxes = boxes[top_idx]
+        keep_idx, valid = nms(top_boxes, top_scores, self.nms_thresh,
+                              self.post_nms_topn)
+        rois = jnp.where(valid[:, None], top_boxes[keep_idx], 0.0)
+        roi_scores = jnp.where(valid, top_scores[keep_idx], -jnp.inf)
+        return rois, jax.nn.sigmoid(roi_scores), valid
+
+
+class BoxHead(Module):
+    """Fast R-CNN box head (reference ``BoxHead.scala``): two FCs over
+    pooled RoIs + class scores + per-class box deltas."""
+
+    def __init__(self, in_channels: int, resolution: int, num_classes: int,
+                 representation: int = 1024):
+        super().__init__()
+        d = in_channels * resolution * resolution
+        self.fc1 = Linear(d, representation)
+        self.fc2 = Linear(representation, representation)
+        self.cls_score = Linear(representation, num_classes)
+        self.bbox_pred = Linear(representation, num_classes * 4)
+
+    def forward(self, ctx: Context, x):
+        r = x.shape[0]
+        h = x.reshape(r, -1)
+        h = jnp.maximum(self.run_child(ctx, "fc1", h), 0.0)
+        h = jnp.maximum(self.run_child(ctx, "fc2", h), 0.0)
+        return (self.run_child(ctx, "cls_score", h),
+                self.run_child(ctx, "bbox_pred", h))
+
+
+class MaskHead(Module):
+    """Mask R-CNN mask head (reference ``MaskHead.scala``): conv trunk +
+    deconv upsample + per-class 1x1 mask predictor."""
+
+    def __init__(self, in_channels: int, num_classes: int,
+                 dim_reduced: int = 256, n_convs: int = 4):
+        super().__init__()
+        self.n_convs = n_convs
+        c = in_channels
+        for i in range(n_convs):
+            self.add(SpatialConvolution(c, dim_reduced, 3, 3, pad_w=1, pad_h=1),
+                     f"mask_fcn{i}")
+            c = dim_reduced
+        self.deconv = SpatialFullConvolution(dim_reduced, dim_reduced, 2, 2, 2, 2)
+        self.predictor = SpatialConvolution(dim_reduced, num_classes, 1, 1)
+
+    def forward(self, ctx: Context, x):
+        h = x
+        for i in range(self.n_convs):
+            h = jnp.maximum(self.run_child(ctx, f"mask_fcn{i}", h), 0.0)
+        h = jnp.maximum(self.run_child(ctx, "deconv", h), 0.0)
+        return self.run_child(ctx, "predictor", h)
+
+
+class DetectionOutputSSD(Module):
+    """SSD final assembly (reference ``DetectionOutputSSD.scala``): decode
+    loc predictions against priors, per-class NMS, fixed-size output.
+
+    Input: (loc (N*4,) or (N,4), conf (N, num_classes) probabilities,
+    priors (N, 4)). Output: (boxes (K,4), scores (K,), labels (K,), valid)."""
+
+    def __init__(self, num_classes: int, nms_thresh: float = 0.45,
+                 keep_top_k: int = 100, conf_thresh: float = 0.01,
+                 variances: Sequence[float] = (0.1, 0.1, 0.2, 0.2)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.nms_thresh = nms_thresh
+        self.keep_top_k = keep_top_k
+        self.conf_thresh = conf_thresh
+        self.variances = tuple(variances)
+
+    def forward(self, ctx: Context, x):
+        loc, conf, priors = x
+        loc = loc.reshape(-1, 4)
+        vx, vy, vw, vh = self.variances
+        # variance weights fold into the decode (caffe SSD convention)
+        boxes = bbox_decode(priors, loc, weights=(1 / vx, 1 / vy, 1 / vw, 1 / vh))
+        # one vmapped NMS over the foreground classes (class 0 = background)
+        # instead of num_classes traced loops: boxes and the IoU matrix are
+        # shared, XLA compiles a single batched loop
+        fg_scores = conf[:, 1:].T  # (C-1, N)
+        idx, valid = jax.vmap(
+            lambda s: nms(boxes, s, self.nms_thresh, self.keep_top_k,
+                          self.conf_thresh)
+        )(fg_scores)
+        c = fg_scores.shape[0]
+        sel_boxes = jnp.where(valid[..., None], boxes[idx], 0.0).reshape(-1, 4)
+        sel_scores = jnp.where(valid, jnp.take_along_axis(fg_scores, jnp.maximum(idx, 0), 1),
+                               -jnp.inf).reshape(-1)
+        sel_labels = jnp.broadcast_to(
+            jnp.arange(1, c + 1, dtype=jnp.int32)[:, None], idx.shape).reshape(-1)
+        sel_valid = valid.reshape(-1)
+        top_scores, order = lax.top_k(sel_scores, self.keep_top_k)
+        return (sel_boxes[order], top_scores, sel_labels[order], sel_valid[order])

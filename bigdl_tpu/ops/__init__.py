@@ -9,10 +9,28 @@ jax.numpy and relies on XLA fusion (SURVEY.md §7 design translation table).
 
 from bigdl_tpu.ops.attention import dot_product_attention, attention_bias_from_padding, causal_bias
 from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops import tf_ops
+from bigdl_tpu.ops import control_flow
+from bigdl_tpu.ops.tf_ops import *  # noqa: F401,F403 (tf_ops defines __all__)
+from bigdl_tpu.ops.control_flow import (
+    AssignTo,
+    Cond,
+    TensorArrayScan,
+    Variable,
+    While,
+)
+from bigdl_tpu.ops import tf_ops as _tf_ops
 
 __all__ = [
     "dot_product_attention",
     "attention_bias_from_padding",
     "causal_bias",
     "flash_attention",
-]
+    "tf_ops",
+    "control_flow",
+    "AssignTo",
+    "Cond",
+    "TensorArrayScan",
+    "Variable",
+    "While",
+] + list(_tf_ops.__all__)

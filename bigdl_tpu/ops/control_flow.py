@@ -1,0 +1,207 @@
+"""Control-flow ops.
+
+Reference: ``DL/nn/tf/ControlOps.scala`` — TF-style dataflow control flow
+(``Switch``/``Merge``/``Enter``/``Exit``/``NextIteration``) executed by a
+dynamic ``Scheduler`` with ``FrameManager`` frames
+(``DL/nn/Scheduler.scala``, ``FrameManager.scala``), plus
+``StateOps.scala`` (Variable/Assign) and ``DataFlowOps.scala``
+(TensorArray).
+
+TPU-native redesign: under XLA there is no dynamic scheduler — control flow
+must be structured so the compiler sees a single static program. The
+Switch/Merge dataflow pair therefore collapses into :class:`Cond`
+(``lax.cond``), the Enter/Exit/NextIteration loop frame into :class:`While`
+(``lax.while_loop``), and TensorArray into :class:`TensorArrayScan`
+(``lax.scan`` with a preallocated output). Mutable ``Variable``/``Assign``
+state ops functionalize into the module state mechanism (``ctx.put_state``).
+
+State inside traced control flow: ``While`` and ``TensorArrayScan`` thread
+their body's state updates through the loop carry (so ``AssignTo``/BN-stats
+inside the loop behave like the reference's per-iteration mutation);
+``Cond`` branches must be stateless — a branch state write is rejected at
+trace time with a clear error, because the two branches generally have
+different state structures and XLA cannot select between them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Context, Module, _merge_updates
+
+
+def _sub_context(ctx: Context, name: str, state):
+    """Isolated child context whose updates do NOT leak into ctx (needed
+    inside lax-traced functions, where writes to the shared updates dict
+    would escape the trace as tracers)."""
+    return Context(
+        ctx.params.get(name, {}),
+        state,
+        ctx.training,
+        ctx._rng,
+        ctx.path + (name,),
+        updates={},
+        rng_count=[ctx._rng_count[0]],
+    )
+
+
+def _relative_updates(ctx: Context, name: str, updates):
+    """Absolute-path updates from a sub context -> paths relative to it."""
+    base = len(ctx.path) + 1
+    return {p[base:]: kv for p, kv in updates.items()}
+
+
+def _record_state(ctx: Context, name: str, st, base=()):
+    """Write a (possibly nested) state tree into ctx's update channel."""
+    for k, v in st.items():
+        if isinstance(v, dict):
+            _record_state(ctx, name, v, base + (k,))
+        else:
+            ctx._updates.setdefault(ctx.path + (name,) + base, {})[k] = v
+
+
+class Cond(Module):
+    """Structured Switch/Merge (reference ``ControlOps.scala`` switch/merge
+    pattern): ``Cond(then_module, else_module)`` applied to (pred, x).
+
+    Both branches see the same input and must produce identically-shaped
+    outputs (XLA requirement; the reference's dynamic graph skipped the
+    untaken branch at runtime instead). Branches must be stateless."""
+
+    def __init__(self, then_branch: Module, else_branch: Module):
+        super().__init__()
+        self.then_branch = then_branch
+        self.else_branch = else_branch
+
+    def forward(self, ctx: Context, x):
+        pred, data = x
+
+        def make_branch(mod, name):
+            def fn(d):
+                sub = _sub_context(ctx, name, ctx.state.get(name, {}))
+                out = mod.forward(sub, d)
+                if sub.updates:
+                    raise NotImplementedError(
+                        f"stateful module inside Cond branch '{name}' "
+                        f"(state write at {next(iter(sub.updates))}): branch "
+                        f"state cannot be selected under XLA — hoist the "
+                        f"stateful module out of the Cond"
+                    )
+                return out
+            return fn
+
+        return lax.cond(
+            pred,
+            make_branch(self.then_branch, "then_branch"),
+            make_branch(self.else_branch, "else_branch"),
+            data,
+        )
+
+
+class While(Module):
+    """Structured Enter/NextIteration/Exit loop frame
+    (reference ``ControlOps.scala``): ``While(cond_fn, body_module)``
+    iterates ``state = body(state)`` while ``cond_fn(state)`` holds.
+    Body-module state (Variable/BN stats) threads through the loop carry;
+    its structure must not change across iterations (XLA carry contract)."""
+
+    def __init__(self, cond_fn: Callable[[Any], jax.Array], body: Module,
+                 max_iterations: Optional[int] = None):
+        super().__init__()
+        self.cond_fn = cond_fn
+        self.body = body
+        self.max_iterations = max_iterations
+
+    def forward(self, ctx: Context, x):
+        init_state = ctx.state.get("body", {})
+
+        def body_fn(carry):
+            data, st = carry
+            sub = _sub_context(ctx, "body", st)
+            out = self.body.forward(sub, data)
+            new_st = _merge_updates(st, _relative_updates(ctx, "body", sub.updates))
+            return out, new_st
+
+        if self.max_iterations is None:
+            out, final_st = lax.while_loop(
+                lambda c: self.cond_fn(c[0]), body_fn, (x, init_state)
+            )
+        else:
+            # bounded variant keeps reverse-mode autodiff available
+            # (while_loop is not reverse-differentiable; fori over a static
+            # bound is)
+            def step(i, carry):
+                return lax.cond(self.cond_fn(carry[0]), body_fn,
+                                lambda c: c, carry)
+            out, final_st = lax.fori_loop(0, self.max_iterations, step,
+                                          (x, init_state))
+        if final_st:
+            _record_state(ctx, "body", final_st)
+        return out
+
+
+class TensorArrayScan(Module):
+    """TensorArray write-in-a-loop (reference ``DataFlowOps.scala``
+    TensorArray + scatter/gather ops): applies ``body`` to each timestep
+    and stacks results — the XLA-native equivalent of ``TensorArray.write``
+    inside a while frame. Body state threads through the scan carry."""
+
+    def __init__(self, body: Module, axis: int = 1):
+        super().__init__()
+        self.body = body
+        self.axis = axis
+
+    def forward(self, ctx: Context, x):
+        init_state = ctx.state.get("body", {})
+        xs = jnp.moveaxis(x, self.axis, 0)
+
+        def step(st, x_t):
+            sub = _sub_context(ctx, "body", st)
+            y = self.body.forward(sub, x_t)
+            new_st = _merge_updates(st, _relative_updates(ctx, "body", sub.updates))
+            return new_st, y
+
+        final_st, ys = lax.scan(step, init_state, xs)
+        if final_st:
+            _record_state(ctx, "body", final_st)
+        return jnp.moveaxis(ys, 0, self.axis)
+
+
+class Variable(Module):
+    """Functionalized mutable state (reference ``StateOps.scala``
+    Variable/Assign): holds a buffer in module state; ``forward`` returns
+    the current value; assignment goes through :class:`AssignTo`."""
+
+    def __init__(self, shape: Sequence[int], dtype=jnp.float32, init_value: float = 0.0):
+        super().__init__()
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.init_value = init_value
+
+    def build_state(self):
+        return {"value": jnp.full(self.shape, self.init_value, self.dtype)}
+
+    def forward(self, ctx: Context, x=None):
+        return ctx.get_state("value")
+
+
+class AssignTo(Module):
+    """Bound assign (reference ``StateOps.scala`` Assign): owns the Variable
+    as child 'var'; ``forward(x)`` writes x into it and returns x. The state
+    update propagates through ``apply``'s state tree like BN running stats."""
+
+    def __init__(self, shape: Sequence[int], dtype=jnp.float32, init_value: float = 0.0):
+        super().__init__()
+        self.var = Variable(shape, dtype, init_value)
+
+    def forward(self, ctx: Context, x):
+        var_ctx = ctx.child("var")
+        var_ctx.put_state("value", x)
+        return x
+
+    def read(self, ctx: Context):
+        return self.run_child(ctx, "var", None)

@@ -1,0 +1,369 @@
+"""TF-style operation set.
+
+Reference: ``DL/nn/ops/`` (71 files — ``Operation`` forward-only base,
+arithmetic/comparison/logical ops, ``BatchMatMul``, ``Gather``, ``OneHot``,
+``TopK``, ``Select``, feature-column ops) and ``DL/nn/tf/``
+(``StridedSlice``, ``Pad``/``Tile``/``Rank``/``Shape`` helpers).
+
+TPU-native: every op is a thin, jit-safe ``jnp``/``lax`` wrapper exposed as
+a :class:`Module` so graphs mix ops and layers freely (the reference runs
+these inside its Graph when loading TF GraphDefs). Forward-only semantics
+(the reference's ``Operation.updateGradInput`` throws) are natural here —
+an op with no params simply contributes its VJP via jax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Operation(Module):
+    """Forward-only module base (reference ``Operation.scala``)."""
+
+
+def _binary(name, fn, doc):
+    cls = type(name, (Operation,), {
+        "forward": lambda self, ctx, x: fn(*x),
+        "__doc__": doc,
+    })
+    return cls
+
+
+# -- arithmetic (reference DL/nn/ops/MathOps.scala et al.) --
+AddOp = _binary("AddOp", lambda a, b: a + b, "Reference ops/Add")
+SubOp = _binary("SubOp", lambda a, b: a - b, "Reference ops/Sub")
+MulOp = _binary("MulOp", lambda a, b: a * b, "Reference ops/Mul")
+DivOp = _binary("DivOp", lambda a, b: a / b, "Reference ops/RealDiv")
+FloorDivOp = _binary("FloorDivOp", lambda a, b: jnp.floor_divide(a, b), "Reference ops/FloorDiv")
+ModOp = _binary("ModOp", lambda a, b: jnp.mod(a, b), "Reference ops/FloorMod")
+PowOp = _binary("PowOp", lambda a, b: jnp.power(a, b), "Reference ops/Pow")
+MaximumOp = _binary("MaximumOp", jnp.maximum, "Reference ops/Maximum")
+MinimumOp = _binary("MinimumOp", jnp.minimum, "Reference ops/Minimum")
+SquaredDifference = _binary(
+    "SquaredDifference", lambda a, b: jnp.square(a - b), "Reference ops/SquaredDifference")
+TruncateDiv = _binary(
+    "TruncateDiv", lambda a, b: jnp.trunc(a / b).astype(a.dtype), "Reference ops/TruncateDiv")
+
+# -- comparison (reference ops/Equal.scala, Greater.scala, ...) --
+Equal = _binary("Equal", lambda a, b: a == b, "Reference ops/Equal")
+NotEqual = _binary("NotEqual", lambda a, b: a != b, "Reference ops/NotEqual")
+Greater = _binary("Greater", lambda a, b: a > b, "Reference ops/Greater")
+GreaterEqual = _binary("GreaterEqual", lambda a, b: a >= b, "Reference ops/GreaterEqual")
+Less = _binary("Less", lambda a, b: a < b, "Reference ops/Less")
+LessEqual = _binary("LessEqual", lambda a, b: a <= b, "Reference ops/LessEqual")
+ApproximateEqual = _binary(
+    "ApproximateEqual", lambda a, b: jnp.abs(a - b) < 1e-5, "Reference ops/ApproximateEqual")
+
+# -- logical (reference ops/LogicalAnd.scala, ...) --
+LogicalAnd = _binary("LogicalAnd", jnp.logical_and, "Reference ops/LogicalAnd")
+LogicalOr = _binary("LogicalOr", jnp.logical_or, "Reference ops/LogicalOr")
+
+
+class LogicalNot(Operation):
+    """Reference ops/LogicalNot."""
+
+    def forward(self, ctx, x):
+        return jnp.logical_not(x)
+
+
+class Select(Operation):
+    """Elementwise where(cond, a, b) (reference ``ops/Select.scala``)."""
+
+    def forward(self, ctx, x):
+        cond, a, b = x
+        return jnp.where(cond, a, b)
+
+
+class BatchMatMul(Operation):
+    """Reference ``ops/BatchMatMul.scala`` (adj_x/adj_y transposes)."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False):
+        super().__init__()
+        self.adj_x = adj_x
+        self.adj_y = adj_y
+
+    def forward(self, ctx, x):
+        a, b = x
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class Gather(Operation):
+    """Reference ``ops/Gather.scala``: take rows of x by index tensor."""
+
+    def __init__(self, axis: int = 0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, ctx, x):
+        t, idx = x
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.axis)
+
+
+class OneHot(Operation):
+    """Reference ``ops/OneHot.scala``."""
+
+    def __init__(self, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+                 axis: int = -1):
+        super().__init__()
+        self.depth = depth
+        self.on_value = on_value
+        self.off_value = off_value
+        self.axis = axis
+
+    def forward(self, ctx, x):
+        oh = jax.nn.one_hot(x.astype(jnp.int32), self.depth, axis=self.axis)
+        return oh * (self.on_value - self.off_value) + self.off_value
+
+
+class TopK(Operation):
+    """Reference ``ops/TopK.scala``: returns (values, indices)."""
+
+    def __init__(self, k: int, sorted: bool = True):
+        super().__init__()
+        self.k = k
+
+    def forward(self, ctx, x):
+        values, indices = lax.top_k(x, self.k)
+        return values, indices
+
+
+class ArgMax(Operation):
+    """Reference ``ops/ArgMax.scala``."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, ctx, x):
+        return jnp.argmax(x, axis=self.axis)
+
+
+class Cast(Operation):
+    """Reference ``ops/Cast.scala``."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.dtype = jnp.dtype(dtype)
+
+    def forward(self, ctx, x):
+        return x.astype(self.dtype)
+
+
+class Rank(Operation):
+    """Reference ``tf/Rank``: static rank as a scalar array."""
+
+    def forward(self, ctx, x):
+        return jnp.asarray(x.ndim, jnp.int32)
+
+
+class ShapeOp(Operation):
+    """Reference ``tf/Shape``: static shape as an int array."""
+
+    def forward(self, ctx, x):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+class SizeOp(Operation):
+    def forward(self, ctx, x):
+        return jnp.asarray(x.size, jnp.int32)
+
+
+class ExpandDims(Operation):
+    """Reference ``ops/ExpandDims.scala``."""
+
+    def __init__(self, axis: int):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, ctx, x):
+        return jnp.expand_dims(x, self.axis)
+
+
+class Tile(Operation):
+    """Reference ``ops/Tile.scala``."""
+
+    def __init__(self, multiples: Sequence[int]):
+        super().__init__()
+        self.multiples = tuple(multiples)
+
+    def forward(self, ctx, x):
+        return jnp.tile(x, self.multiples)
+
+
+class Pad(Operation):
+    """Reference ``ops/Pad.scala`` (constant mode)."""
+
+    def __init__(self, paddings: Sequence[Sequence[int]], value: float = 0.0):
+        super().__init__()
+        self.paddings = tuple(map(tuple, paddings))
+        self.value = value
+
+    def forward(self, ctx, x):
+        return jnp.pad(x, self.paddings, constant_values=self.value)
+
+
+class StridedSlice(Operation):
+    """Reference ``tf/StridedSlice.scala``: begin/end/stride per dim
+    (static — XLA requires static shapes)."""
+
+    def __init__(self, begin: Sequence[int], end: Sequence[int],
+                 strides: Optional[Sequence[int]] = None):
+        super().__init__()
+        self.begin = tuple(begin)
+        self.end = tuple(end)
+        self.strides = tuple(strides) if strides else (1,) * len(self.begin)
+
+    def forward(self, ctx, x):
+        slices = tuple(
+            slice(b, e, s) for b, e, s in zip(self.begin, self.end, self.strides)
+        )
+        return x[slices]
+
+
+class _Reduction(Operation):
+    fn = None
+
+    def __init__(self, axis=None, keep_dims: bool = False):
+        super().__init__()
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        self.keep_dims = keep_dims
+
+    def forward(self, ctx, x):
+        return type(self).fn(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class ReduceSum(_Reduction):
+    fn = staticmethod(jnp.sum)
+
+
+class ReduceMean(_Reduction):
+    fn = staticmethod(jnp.mean)
+
+
+class ReduceMax(_Reduction):
+    fn = staticmethod(jnp.max)
+
+
+class ReduceMin(_Reduction):
+    fn = staticmethod(jnp.min)
+
+
+class ReduceProd(_Reduction):
+    fn = staticmethod(jnp.prod)
+
+
+class ReduceAll(_Reduction):
+    fn = staticmethod(jnp.all)
+
+
+class ReduceAny(_Reduction):
+    fn = staticmethod(jnp.any)
+
+
+# -- unary math (reference ops/Erf.scala, Lgamma.scala, ...) --
+def _unary(name, fn, doc):
+    return type(name, (Operation,), {
+        "forward": lambda self, ctx, x: fn(x),
+        "__doc__": doc,
+    })
+
+
+Floor = _unary("Floor", jnp.floor, "Reference ops/Floor")
+Ceil = _unary("Ceil", jnp.ceil, "Reference ops/Ceil")
+Round = _unary("Round", jnp.round, "Reference ops/Round")
+Sign = _unary("Sign", jnp.sign, "Reference ops/Sign")
+Rsqrt = _unary("Rsqrt", lax.rsqrt, "Reference ops/Rsqrt")
+Inv = _unary("Inv", lambda x: 1.0 / x, "Reference ops/Inv")
+Log1p = _unary("Log1p", jnp.log1p, "Reference ops/Log1p")
+Expm1 = _unary("Expm1", jnp.expm1, "Reference ops/Expm1")
+Erf = _unary("Erf", lax.erf, "Reference ops/Erf")
+Erfc = _unary("Erfc", lax.erfc, "Reference ops/Erfc")
+Lgamma = _unary("Lgamma", lax.lgamma, "Reference ops/Lgamma")
+Digamma = _unary("Digamma", lax.digamma, "Reference ops/Digamma")
+IsFinite = _unary("IsFinite", jnp.isfinite, "Reference ops/IsFinite")
+IsInf = _unary("IsInf", jnp.isinf, "Reference ops/IsInf")
+IsNan = _unary("IsNan", jnp.isnan, "Reference ops/IsNan")
+
+
+class InTopK(Operation):
+    """Reference ``ops/InTopK.scala``: is the target among the top-k
+    predictions per row."""
+
+    def __init__(self, k: int):
+        super().__init__()
+        self.k = k
+
+    def forward(self, ctx, x):
+        predictions, targets = x
+        _, idx = lax.top_k(predictions, self.k)
+        return jnp.any(idx == targets[..., None].astype(idx.dtype), axis=-1)
+
+
+# ------------------------------------------------- feature-column ops
+
+
+class BucketizedCol(Operation):
+    """Bucketize by boundaries (reference ``ops/BucketizedCol.scala``)."""
+
+    def __init__(self, boundaries: Sequence[float]):
+        super().__init__()
+        self.boundaries = jnp.asarray(sorted(boundaries), jnp.float32)
+
+    def forward(self, ctx, x):
+        return jnp.searchsorted(self.boundaries, x.astype(jnp.float32), side="right")
+
+
+class CategoricalColHashBucket(Operation):
+    """Hash integer ids into buckets (reference
+    ``ops/CategoricalColHashBucket.scala``; strings must be pre-hashed to
+    ints host-side — XLA has no string type)."""
+
+    def __init__(self, hash_bucket_size: int):
+        super().__init__()
+        self.hash_bucket_size = hash_bucket_size
+
+    def forward(self, ctx, x):
+        h = x.astype(jnp.uint32) * jnp.uint32(2654435761)  # Knuth hash
+        return (h % jnp.uint32(self.hash_bucket_size)).astype(jnp.int32)
+
+
+class IndicatorCol(Operation):
+    """Multi-hot indicator of categorical ids (reference
+    ``ops/IndicatorCol.scala``)."""
+
+    def __init__(self, fea_len: int):
+        super().__init__()
+        self.fea_len = fea_len
+
+    def forward(self, ctx, x):
+        oh = jax.nn.one_hot(x.astype(jnp.int32), self.fea_len)
+        return jnp.max(oh, axis=-2) if x.ndim > 1 else oh
+
+
+class CrossCol(Operation):
+    """Hash-cross of multiple categorical columns (reference
+    ``ops/CrossCol.scala``)."""
+
+    def __init__(self, hash_bucket_size: int):
+        super().__init__()
+        self.hash_bucket_size = hash_bucket_size
+
+    def forward(self, ctx, x):
+        acc = jnp.zeros_like(x[0], dtype=jnp.uint32)
+        for col in x:
+            acc = acc * jnp.uint32(1000003) + col.astype(jnp.uint32)
+        return (acc % jnp.uint32(self.hash_bucket_size)).astype(jnp.int32)
+
+
+__all__ = [n for n, v in list(globals().items())
+           if isinstance(v, type) and issubclass(v, Operation)] + ["Operation"]

@@ -128,3 +128,146 @@ class NDCG(ValidationMethod):
         rank = jnp.sum(output > output[:, :1], axis=-1)
         gain = jnp.where(rank < self.k, 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0), 0.0)
         return jnp.sum(gain), output.shape[0]
+
+
+class PrecisionRecallAUC(ValidationMethod):
+    """Area under the precision-recall curve for binary scores
+    (reference: ``PrecisionRecallAUC.scala``). Host-side accumulation:
+    ``batch`` collects (scores, labels); ``result`` on the accumulated
+    ValidationResult is not used — call :meth:`compute` over all batches,
+    or use through ``Evaluator`` which sums the streamed trapezoid areas
+    per batch (approximation documented)."""
+
+    name = "PrecisionRecallAUC"
+
+    def batch(self, output, target):
+        import numpy as np
+
+        scores = np.asarray(output).reshape(-1)
+        labels = np.asarray(target).reshape(-1)
+        return float(self.compute(scores, labels)) * scores.size, scores.size
+
+    @staticmethod
+    def compute(scores, labels):
+        import numpy as np
+
+        order = np.argsort(-scores)
+        labels = np.asarray(labels)[order]
+        tp = np.cumsum(labels)
+        fp = np.cumsum(1 - labels)
+        total_pos = max(tp[-1], 1e-12) if len(tp) else 1e-12
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        recall = tp / total_pos
+        # prepend the recall-0 point so the first segment counts
+        precision = np.concatenate([[precision[0] if len(precision) else 1.0], precision])
+        recall = np.concatenate([[0.0], recall])
+        return float(np.trapz(precision, recall))
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the root prediction of a tree output (reference:
+    ``TreeNNAccuracy`` — used by TreeLSTM sentiment): output
+    (B, n_nodes, n_classes), root is node 0."""
+
+    name = "TreeNNAccuracy"
+
+    def batch(self, output, target):
+        root = output[:, 0] if output.ndim == 3 else output
+        pred = jnp.argmax(root, axis=-1)
+        t = target[:, 0] if target.ndim == 2 else target
+        return jnp.sum(pred == t.astype(pred.dtype)), root.shape[0]
+
+
+class MeanAveragePrecision(ValidationMethod):
+    """Classification mAP over k classes (reference:
+    ``MeanAveragePrecision``, ``ValidationMethod.scala:231``): average of
+    per-class average precision, one-vs-rest by predicted score."""
+
+    def __init__(self, k: int):
+        self.k = k
+        self.name = "MAP@" + str(k)
+
+    def batch(self, output, target):
+        import numpy as np
+
+        scores = np.asarray(output)
+        labels = np.asarray(target).astype(int)
+        aps = []
+        for c in range(self.k):
+            s = scores[:, c]
+            y = (labels == c).astype(np.float64)
+            if y.sum() == 0:
+                continue
+            order = np.argsort(-s)
+            y = y[order]
+            tp = np.cumsum(y)
+            precision = tp / (np.arange(len(y)) + 1)
+            ap = float((precision * y).sum() / max(y.sum(), 1))
+            aps.append(ap)
+        mean_ap = float(np.mean(aps)) if aps else 0.0
+        n = scores.shape[0]
+        return mean_ap * n, n
+
+
+def detection_average_precision(detections, groundtruths, iou_threshold=0.5,
+                                use_voc2007=False):
+    """AP for one class of detections over a dataset (reference:
+    ``MeanAveragePrecisionObjectDetection``, ``ValidationMethod.scala:675``).
+
+    ``detections``: list per-image of (boxes (N,4), scores (N,));
+    ``groundtruths``: list per-image of boxes (M,4). Host-side numpy.
+    """
+    import numpy as np
+
+    def np_iou(a, b):
+        area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+        area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+        lt = np.maximum(a[:, None, :2], b[None, :, :2])
+        rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+    records = []  # (score, is_tp)
+    total_gt = 0
+    for (boxes, scores), gt in zip(detections, groundtruths):
+        boxes = np.asarray(boxes).reshape(-1, 4)
+        scores = np.asarray(scores).reshape(-1)
+        gt = np.asarray(gt).reshape(-1, 4)
+        total_gt += len(gt)
+        if len(boxes) == 0:
+            continue
+        if len(gt) == 0:
+            records.extend((s, 0.0) for s in scores)
+            continue
+        iou = np_iou(boxes, gt)  # one (N, M) matrix per image, pure numpy
+        taken = np.zeros(len(gt), bool)
+        for i in np.argsort(-scores):
+            j = int(np.argmax(iou[i]))
+            if iou[i, j] >= iou_threshold and not taken[j]:
+                taken[j] = True
+                records.append((scores[i], 1.0))
+            else:
+                records.append((scores[i], 0.0))
+    if not records or total_gt == 0:
+        return 0.0
+    records.sort(key=lambda r: -r[0])
+    tps = np.asarray([r[1] for r in records])
+    tp = np.cumsum(tps)
+    fp = np.cumsum(1 - tps)
+    recall = tp / total_gt
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    if use_voc2007:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11
+        return float(ap)
+    # VOC2010+/COCO-style: area under the monotone precision envelope,
+    # with (0, p) and (1, 0) sentinels so every recall segment counts
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))

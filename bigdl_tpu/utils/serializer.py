@@ -35,6 +35,19 @@ from bigdl_tpu.nn.graph import Graph, Node
 from bigdl_tpu.nn.module import Criterion, LambdaLayer, Module
 
 _MAGIC = b"BDLTPU1\x00"
+# v2: container children encoded as post-ctor patches ({'spec'|'patch'})
+# instead of full nested specs
+FORMAT_VERSION = 2
+
+
+def _check_version(header, file):
+    v = header.get("format_version")
+    if v != FORMAT_VERSION:
+        raise ValueError(
+            f"{file} uses model format version {v}; this build reads "
+            f"version {FORMAT_VERSION} — re-save the model with the "
+            f"current library"
+        )
 
 
 class SerializationError(TypeError):
@@ -311,7 +324,7 @@ def save_module(file: str, module: Module, params=None, state=None,
     if os.path.exists(file) and not overwrite:
         raise FileExistsError(f"{file} exists (pass overwrite=True)")
     header = {
-        "format_version": 1,
+        "format_version": FORMAT_VERSION,
         "spec": module_to_spec(module),
         "has_weights": params is not None,
         "extra": extra or {},
@@ -344,6 +357,7 @@ def load_module(file: str) -> Tuple[Module, Any, Any]:
         (hlen,) = struct.unpack("<Q", fh.read(8))
         header = json.loads(fh.read(hlen).decode("utf-8"))
         blob = fh.read()
+    _check_version(header, file)
     module = module_from_spec(header["spec"])
     params = state = None
     if header.get("has_weights"):
@@ -363,7 +377,7 @@ def save_optim_method(file: str, method, state=None) -> str:
     """Reference: ``OptimMethod.save`` (Java serialization there; a spec +
     msgpack state blob here)."""
     header = {
-        "format_version": 1,
+        "format_version": FORMAT_VERSION,
         "spec": object_to_spec(method),
         "has_state": state is not None,
     }
@@ -388,6 +402,7 @@ def load_optim_method(file: str):
         (hlen,) = struct.unpack("<Q", fh.read(8))
         header = json.loads(fh.read(hlen).decode("utf-8"))
         blob = fh.read()
+    _check_version(header, file)
     method = object_from_spec(header["spec"])
     state = flax_ser.msgpack_restore(blob) if header.get("has_state") else None
     return method, state

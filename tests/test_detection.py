@@ -1,0 +1,216 @@
+"""Detection stack tests: NMS, anchors, RoiAlign, FPN, proposal/box/mask
+heads, MaskRCNN assembly, detection mAP (reference: ``DLT/nn`` detection
+specs + ``ValidationMethod.scala:675`` mAP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.layers import detection as D
+from bigdl_tpu.optim.validation import (
+    MeanAveragePrecision, PrecisionRecallAUC, TreeNNAccuracy,
+    detection_average_precision,
+)
+
+
+def test_bbox_iou():
+    a = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+    b = jnp.asarray([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], jnp.float32)
+    iou = np.asarray(D.bbox_iou(a, b))[0]
+    np.testing.assert_allclose(iou, [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+def test_bbox_decode_roundtrip():
+    boxes = jnp.asarray([[10, 10, 50, 30], [0, 0, 20, 40]], jnp.float32)
+    zero = jnp.zeros((2, 4))
+    np.testing.assert_allclose(np.asarray(D.bbox_decode(boxes, zero)),
+                               np.asarray(boxes), rtol=1e-5)
+    # dx shifts by width
+    d = jnp.asarray([[0.5, 0.0, 0.0, 0.0]] * 2, jnp.float32)
+    out = np.asarray(D.bbox_decode(boxes, d))
+    np.testing.assert_allclose(out[0, 0], 10 + 0.5 * 40, rtol=1e-5)
+
+
+def test_nms_greedy_suppression():
+    boxes = jnp.asarray(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30], [21, 21, 31, 31]],
+        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.95])
+    idx, valid = D.nms(boxes, scores, 0.5, 4)
+    assert list(np.asarray(idx)[:2]) == [3, 0]
+    assert list(np.asarray(valid)) == [True, True, False, False]
+
+
+def test_nms_score_threshold():
+    boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.1])
+    _, valid = D.nms(boxes, scores, 0.5, 2, score_threshold=0.5)
+    assert list(np.asarray(valid)) == [True, False]
+
+
+def test_nms_is_jittable():
+    f = jax.jit(lambda b, s: D.nms(b, s, 0.5, 3))
+    tl = jnp.asarray(np.random.RandomState(0).rand(10, 2) * 20, jnp.float32)
+    boxes = jnp.concatenate([tl, tl + 5], axis=1)
+    idx, valid = f(boxes, jnp.linspace(0, 1, 10))
+    assert idx.shape == (3,)
+
+
+def test_anchor_generation():
+    a = D.Anchor(ratios=(0.5, 1.0, 2.0), scales=(8.0,))
+    anchors = np.asarray(a.generate(4, 5, 16.0))
+    assert anchors.shape == (3 * 4 * 5, 4)
+    # center of first cell's anchors is (8, 8)
+    centers = (anchors[:3, :2] + anchors[:3, 2:]) / 2
+    np.testing.assert_allclose(centers, 8.0, atol=1e-4)
+    # ratio=1 anchor is square with side base*scale
+    w = anchors[1, 2] - anchors[1, 0]
+    h = anchors[1, 3] - anchors[1, 1]
+    np.testing.assert_allclose([w, h], 128.0, rtol=1e-5)
+
+
+def test_prior_box_normalized(rng):
+    pb = D.PriorBox(min_sizes=[30.0], max_sizes=[60.0], aspect_ratios=[2.0],
+                    img_size=300, clip=True)
+    p, s = pb.init(rng)
+    out, _ = pb.apply(p, jnp.zeros((1, 8, 4, 4)), state=s)
+    assert out.shape[1] == 4 and out.shape[0] % 16 == 0
+    o = np.asarray(out)
+    assert (o >= 0).all() and (o <= 1).all()
+
+
+def test_roi_align_constant_feature():
+    feat = jnp.full((3, 8, 8), 7.0)
+    rois = jnp.asarray([[1.0, 1.0, 6.0, 6.0]])
+    out = D.roi_align(feat, rois, 2, 2, 1.0)
+    np.testing.assert_allclose(np.asarray(out), 7.0, rtol=1e-6)
+
+
+def test_roi_align_linear_ramp_exact():
+    """Bilinear sampling of a linear function is exact."""
+    xs = jnp.arange(16, dtype=jnp.float32)
+    feat = jnp.broadcast_to(xs[None, None, :], (1, 16, 16))  # f(x,y) = x
+    rois = jnp.asarray([[2.0, 2.0, 10.0, 10.0]])
+    out = np.asarray(D.roi_align(feat, rois, 4, 4, 1.0, sampling_ratio=2))
+    # bin centers along x: 2 + (i + {0.25,0.75}) * 2 averaged -> 2 + 2i + 1
+    expected_cols = 2 + 2 * np.arange(4) + 1 - 0.5  # -0.5: pixel-center offset
+    np.testing.assert_allclose(out[0, 0, 0], expected_cols, rtol=1e-5)
+
+
+def test_roi_align_scale(rng):
+    m = D.RoiAlign(0.5, 2, 3, 3)
+    p, s = m.init(rng)
+    feat = jnp.asarray(np.random.rand(1, 4, 8, 8).astype("float32"))
+    rois = jnp.asarray([[0.0, 0.0, 16.0, 16.0]])
+    out, _ = m.apply(p, (feat, rois), state=s)
+    assert out.shape == (1, 4, 3, 3)
+
+
+def test_fpn_shapes(rng):
+    fpn = D.FPN([8, 16, 32], 8)
+    p, s = fpn.init(rng)
+    feats = (
+        jnp.zeros((1, 8, 32, 32)), jnp.zeros((1, 16, 16, 16)),
+        jnp.zeros((1, 32, 8, 8)),
+    )
+    outs, _ = fpn.apply(p, feats, state=s)
+    assert [o.shape for o in outs] == [
+        (1, 8, 32, 32), (1, 8, 16, 16), (1, 8, 8, 8)]
+
+
+def test_region_proposal_shapes(rng):
+    rp = D.RegionProposal(16, D.Anchor(scales=(4.0,)), pre_nms_topn=50,
+                          post_nms_topn=10)
+    p, s = rp.init(rng)
+    feat = jnp.asarray(np.random.rand(1, 16, 8, 8).astype("float32"))
+    (rois, scores, valid), _ = rp.apply(p, feat, state=s)
+    assert rois.shape == (10, 4) and scores.shape == (10,) and valid.shape == (10,)
+    r = np.asarray(rois)
+    assert (r >= 0).all() and (r[:, 2] <= 8 * 16).all()
+
+
+def test_box_and_mask_heads(rng):
+    bh = D.BoxHead(8, 4, num_classes=6, representation=32)
+    p, s = bh.init(rng)
+    pooled = jnp.asarray(np.random.rand(12, 8, 4, 4).astype("float32"))
+    (cls, deltas), _ = bh.apply(p, pooled, state=s)
+    assert cls.shape == (12, 6) and deltas.shape == (12, 24)
+
+    mh = D.MaskHead(8, num_classes=6, dim_reduced=8, n_convs=2)
+    p, s = mh.init(rng)
+    out, _ = mh.apply(p, pooled, state=s)
+    assert out.shape == (12, 6, 8, 8)
+
+
+def test_detection_output_ssd(rng):
+    n, k = 16, 5
+    do = D.DetectionOutputSSD(num_classes=3, keep_top_k=k)
+    p, s = do.init(rng)
+    priors = jnp.asarray(np.random.rand(n, 2).repeat(2, 1), jnp.float32)
+    priors = jnp.concatenate([priors[:, :2] * 0.5, priors[:, :2] * 0.5 + 0.3], 1)
+    loc = jnp.zeros((n, 4))
+    conf = jax.nn.softmax(jnp.asarray(np.random.rand(n, 3), jnp.float32), -1)
+    (boxes, scores, labels, valid), _ = do.apply(p, (loc, conf, priors), state=s)
+    assert boxes.shape == (k, 4) and scores.shape == (k,)
+    assert set(np.asarray(labels)[np.asarray(valid)]) <= {1, 2}
+
+
+def test_maskrcnn_end_to_end(rng):
+    from bigdl_tpu.models import maskrcnn
+
+    m = maskrcnn.MaskRCNN(num_classes=4, depth=18, post_nms_topn=10,
+                          detections_per_img=5)
+    p, s = m.init(rng)
+    x = jnp.asarray(np.random.rand(1, 3, 64, 64).astype("float32"))
+    out, _ = m.apply(p, x, state=s)
+    assert out["boxes"].shape == (5, 4)
+    assert out["masks"].shape == (5, 28, 28)
+    b = np.asarray(out["boxes"])
+    assert (b >= 0).all() and (b <= 64).all()
+
+
+# ------------------------------------------------------- validation metrics
+
+
+def test_detection_ap_perfect():
+    gt = [np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]])]
+    det = [(np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]]), np.asarray([0.9, 0.8]))]
+    assert detection_average_precision(det, gt) == pytest.approx(1.0)
+
+
+def test_detection_ap_half():
+    gt = [np.asarray([[0, 0, 10, 10], [20, 20, 30, 30]])]
+    det = [(np.asarray([[0, 0, 10, 10], [50, 50, 60, 60]]), np.asarray([0.9, 0.8]))]
+    ap = detection_average_precision(det, gt)
+    assert 0.4 < ap < 0.6
+
+
+def test_detection_ap_voc2007_style():
+    gt = [np.asarray([[0, 0, 10, 10]])]
+    det = [(np.asarray([[0, 0, 10, 10]]), np.asarray([0.9]))]
+    ap = detection_average_precision(det, gt, use_voc2007=True)
+    assert ap == pytest.approx(1.0)
+
+
+def test_pr_auc_perfect_separation():
+    scores = np.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = np.asarray([1, 1, 0, 0])
+    assert PrecisionRecallAUC.compute(scores, labels) == pytest.approx(1.0, abs=0.01)
+
+
+def test_map_classification():
+    m = MeanAveragePrecision(3)
+    out = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6]])
+    tgt = jnp.asarray([0, 1, 2])
+    v, n = m.batch(out, tgt)
+    assert v / n == pytest.approx(1.0)
+
+
+def test_tree_nn_accuracy():
+    m = TreeNNAccuracy()
+    out = jnp.asarray(np.eye(3, dtype="float32")[None].repeat(2, 0))  # (2,3,3)
+    tgt = jnp.asarray([[0, 1, 2], [1, 1, 2]])
+    v, n = m.batch(out, tgt)
+    assert n == 2 and int(v) == 1

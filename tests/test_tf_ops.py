@@ -1,0 +1,203 @@
+"""TF-op-set tests (reference: DL/nn/ops specs — op semantics vs numpy,
+control flow vs lax semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn import Linear, Sequential, ReLU
+from bigdl_tpu.ops import control_flow as cf
+from bigdl_tpu.ops import tf_ops as ops
+
+
+def run(op, x, **kw):
+    p, s = op.init(jax.random.key(0))
+    out, _ = op.apply(p, x, state=s, **kw)
+    return jax.tree_util.tree_map(np.asarray, out)
+
+
+def test_arithmetic_and_comparison():
+    a = np.array([3.0, -2.0, 7.0])
+    b = np.array([2.0, 2.0, -3.0])
+    assert np.allclose(run(ops.AddOp(), (a, b)), a + b)
+    assert np.allclose(run(ops.SubOp(), (a, b)), a - b)
+    assert np.allclose(run(ops.MulOp(), (a, b)), a * b)
+    assert np.allclose(run(ops.DivOp(), (a, b)), a / b)
+    assert np.allclose(run(ops.FloorDivOp(), (a, b)), a // b)
+    assert np.allclose(run(ops.ModOp(), (a, b)), np.mod(a, b))
+    assert np.allclose(run(ops.MaximumOp(), (a, b)), np.maximum(a, b))
+    assert np.allclose(run(ops.SquaredDifference(), (a, b)), (a - b) ** 2)
+    assert np.array_equal(run(ops.Greater(), (a, b)), a > b)
+    assert np.array_equal(run(ops.LessEqual(), (a, b)), a <= b)
+    assert np.array_equal(run(ops.Equal(), (a, a)), np.ones(3, bool))
+    t = np.array([True, False, True])
+    f = np.array([True, True, False])
+    assert np.array_equal(run(ops.LogicalAnd(), (t, f)), t & f)
+    assert np.array_equal(run(ops.LogicalOr(), (t, f)), t | f)
+    assert np.array_equal(run(ops.LogicalNot(), t), ~t)
+
+
+def test_select_gather_onehot_topk():
+    cond = np.array([True, False, True])
+    a, b = np.ones(3), np.zeros(3)
+    assert np.allclose(run(ops.Select(), (cond, a, b)), [1, 0, 1])
+
+    t = np.arange(12.0).reshape(3, 4)
+    assert np.allclose(run(ops.Gather(0), (t, np.array([2, 0]))), t[[2, 0]])
+    assert np.allclose(run(ops.Gather(1), (t, np.array([1, 3]))), t[:, [1, 3]])
+
+    oh = run(ops.OneHot(4, on_value=2.0, off_value=-1.0), np.array([1, 3]))
+    assert oh.shape == (2, 4) and oh[0, 1] == 2.0 and oh[0, 0] == -1.0
+
+    vals, idx = run(ops.TopK(2), np.array([[1.0, 5.0, 3.0], [9.0, 2.0, 4.0]]))
+    assert np.allclose(vals, [[5.0, 3.0], [9.0, 4.0]])
+    assert np.array_equal(idx, [[1, 2], [0, 2]])
+
+    intop = run(ops.InTopK(2), (np.array([[1.0, 5.0, 3.0]]), np.array([2])))
+    assert intop[0]
+
+
+def test_shape_ops_and_reductions():
+    x = np.arange(24.0).reshape(2, 3, 4)
+    assert int(run(ops.Rank(), x)) == 3
+    assert np.array_equal(run(ops.ShapeOp(), x), [2, 3, 4])
+    assert int(run(ops.SizeOp(), x)) == 24
+    assert run(ops.ExpandDims(1), x).shape == (2, 1, 3, 4)
+    assert run(ops.Tile((1, 2, 1)), x).shape == (2, 6, 4)
+    assert run(ops.Pad([(0, 0), (1, 1), (0, 2)]), x).shape == (2, 5, 6)
+    assert np.allclose(run(ops.StridedSlice((0, 1, 0), (2, 3, 4), (1, 1, 2)), x),
+                       x[0:2, 1:3, 0:4:2])
+    assert np.allclose(run(ops.ReduceSum(axis=1), x), x.sum(1))
+    assert np.allclose(run(ops.ReduceMean(axis=(0, 2), keep_dims=True), x),
+                       x.mean((0, 2), keepdims=True))
+    assert np.allclose(run(ops.ReduceProd(axis=0), x[:, :1, :1]), np.prod(x[:, :1, :1], 0))
+    assert run(ops.ReduceAll(), x > -1).item()
+
+
+def test_unary_math():
+    x = np.array([0.5, 1.5, 2.5])
+    assert np.allclose(run(ops.Rsqrt(), x), 1 / np.sqrt(x), rtol=1e-6)
+    assert np.allclose(run(ops.Log1p(), x), np.log1p(x), rtol=1e-6)
+    import scipy.special as sp
+    assert np.allclose(run(ops.Erf(), x), sp.erf(x), rtol=1e-5)
+    assert np.allclose(run(ops.Lgamma(), x), sp.gammaln(x), rtol=1e-5)
+    assert np.array_equal(run(ops.IsNan(), np.array([1.0, np.nan])), [False, True])
+
+
+def test_feature_columns():
+    b = run(ops.BucketizedCol([0.0, 10.0, 20.0]), np.array([-5.0, 5.0, 15.0, 25.0]))
+    assert np.array_equal(b, [0, 1, 2, 3])
+
+    h = run(ops.CategoricalColHashBucket(100), np.array([1, 2, 3, 1]))
+    assert h.shape == (4,) and (h >= 0).all() and (h < 100).all()
+    assert h[0] == h[3]
+
+    ind = run(ops.IndicatorCol(5), np.array([[1, 3], [0, 0]]))
+    assert np.array_equal(ind, [[0, 1, 0, 1, 0], [1, 0, 0, 0, 0]])
+
+    c = run(ops.CrossCol(50), (np.array([1, 2]), np.array([3, 4])))
+    assert c.shape == (2,) and (c >= 0).all() and (c < 50).all()
+
+
+def test_cond_branches(rng):
+    then_b = Sequential(Linear(4, 4), ReLU())
+    else_b = Sequential(Linear(4, 4))
+    cond = cf.Cond(then_b, else_b)
+    p, s = cond.init(rng)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    out_t, _ = cond.apply(p, (jnp.asarray(True), x), state=s)
+    out_f, _ = cond.apply(p, (jnp.asarray(False), x), state=s)
+    assert (np.asarray(out_t) >= 0).all()          # then branch has ReLU
+    assert not np.allclose(np.asarray(out_t), np.asarray(out_f))
+
+
+def test_while_loop(rng):
+    from bigdl_tpu.nn.module import LambdaLayer
+
+    body = LambdaLayer(lambda s: (s[0] + 1, s[1] * 2.0))
+    w = cf.While(lambda s: s[0] < 5, body)
+    p, s = w.init(rng)
+    out, _ = w.apply(p, (jnp.asarray(0), jnp.asarray(1.0)), state=s)
+    assert int(out[0]) == 5 and float(out[1]) == 32.0
+
+
+def test_while_bounded_is_differentiable(rng):
+    from bigdl_tpu.nn.module import LambdaLayer
+
+    body = LambdaLayer(lambda s: (s[0] + 1, s[1] * 2.0))
+    w = cf.While(lambda s: s[0] < 3, body, max_iterations=8)
+    p, s = w.init(rng)
+
+    def loss(x0):
+        out, _ = w.apply(p, (jnp.asarray(0), x0), state=s)
+        return out[1]
+
+    g = jax.grad(loss)(jnp.asarray(1.0))
+    assert float(g) == 8.0  # d(8x)/dx
+
+
+def test_tensor_array_scan(rng):
+    body = Sequential(Linear(4, 3))
+    ta = cf.TensorArrayScan(body, axis=1)
+    p, s = ta.init(rng)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 4).astype(np.float32))
+    out, _ = ta.apply(p, x, state=s)
+    assert out.shape == (2, 6, 3)
+    # scan result == applying per-timestep
+    direct, _ = body.apply(p["body"], x[:, 0])
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(direct), rtol=1e-5)
+
+
+def test_variable_assign_state(rng):
+    a = cf.AssignTo((3,), init_value=0.0)
+    p, s = a.init(rng)
+    assert np.allclose(np.asarray(s["var"]["value"]), 0.0)
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    out, new_s = a.apply(p, x, state=s)
+    np.testing.assert_allclose(np.asarray(new_s["var"]["value"]), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_stateful_body_inside_scan_threads_state(rng):
+    """Review regression: state written inside lax-traced control flow must
+    come back as concrete arrays, not leaked tracers."""
+    a = cf.AssignTo((2, 3))  # shape includes batch: one slot per timestep write
+    ta = cf.TensorArrayScan(a, axis=1)
+    p, s = ta.init(rng)
+    x = jnp.asarray(np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3))
+    out, new_s = ta.apply(p, x, state=s)
+    val = np.asarray(new_s["body"]["var"]["value"])  # must not be a tracer
+    np.testing.assert_allclose(val, np.asarray(x[:, -1]))  # last timestep
+
+
+def test_stateful_body_inside_while_threads_state(rng):
+    from bigdl_tpu.nn import Sequential
+    from bigdl_tpu.nn.module import LambdaLayer
+
+    body = Sequential()
+    body.add(LambdaLayer(lambda s: s + 1.0), "inc")
+    body.add(cf.AssignTo((1,)), "track")  # state write inside the loop frame
+    w = cf.While(lambda s: s[0] < 3.0, body)
+    p, s = w.init(rng)
+    out, new_s = w.apply(p, jnp.asarray([0.0]), state=s)
+    np.testing.assert_allclose(np.asarray(out), [3.0])
+    # the tracked state is concrete and equals the last written value
+    np.testing.assert_allclose(
+        np.asarray(new_s["body"]["track"]["var"]["value"]), [3.0])
+
+
+def test_cond_rejects_stateful_branches(rng):
+    then_b = cf.AssignTo((2,))
+    else_b = cf.AssignTo((2,))
+    c = cf.Cond(then_b, else_b)
+    p, s = c.init(rng)
+    with pytest.raises(NotImplementedError, match="stateful"):
+        c.apply(p, (jnp.asarray(True), jnp.ones((2,))), state=s)
+
+
+def test_ops_star_export_surface():
+    import bigdl_tpu.ops as O
+
+    for name in ("AddOp", "Gather", "TopK", "Cond", "While", "BatchMatMul"):
+        assert name in O.__all__ and hasattr(O, name)
