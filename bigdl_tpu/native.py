@@ -1,0 +1,213 @@
+"""ctypes bindings for the native runtime library (``native/``).
+
+Reference analogue: the JNI surface of BigDL-core (SURVEY.md §2.1) —
+here scoped to the runtime around XLA compute: CRC32C/record framing,
+aligned host buffers, a threaded prefetch ring, and hot uint8 image loops.
+
+The library auto-builds with ``make`` on first use (g++ is in the image);
+every entry point has a pure-python/numpy fallback so the package works
+even without a toolchain. ``native_available()`` reports which path is
+active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.bigdl_crc32c.restype = ctypes.c_uint32
+        lib.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.bigdl_masked_crc32c.restype = ctypes.c_uint32
+        lib.bigdl_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.bigdl_ring_new.restype = ctypes.c_void_p
+        lib.bigdl_ring_new.argtypes = [ctypes.c_uint64]
+        lib.bigdl_ring_free.argtypes = [ctypes.c_void_p]
+        lib.bigdl_ring_close.argtypes = [ctypes.c_void_p]
+        lib.bigdl_ring_push.restype = ctypes.c_int
+        lib.bigdl_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.bigdl_ring_pop.restype = ctypes.c_int64
+        lib.bigdl_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.bigdl_ring_peek_size.restype = ctypes.c_int64
+        lib.bigdl_ring_peek_size.argtypes = [ctypes.c_void_p]
+        lib.bigdl_ring_size.restype = ctypes.c_int64
+        lib.bigdl_ring_size.argtypes = [ctypes.c_void_p]
+        lib.bigdl_normalize_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float,
+            ctypes.c_int,
+        ]
+        lib.bigdl_hflip_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.bigdl_crop_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p] + \
+            [ctypes.c_int64] * 7
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------ crc
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.bigdl_crc32c(data, len(data), seed & 0xFFFFFFFF)
+    from bigdl_tpu.visualization.events import crc32c as py_crc
+
+    if seed:
+        raise NotImplementedError("python fallback supports seed=0 only")
+    return py_crc(data)
+
+
+def masked_crc32c(data: bytes) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.bigdl_masked_crc32c(data, len(data))
+    from bigdl_tpu.visualization.events import masked_crc32c as py_masked
+
+    return py_masked(data)
+
+
+# ---------------------------------------------------------- prefetch ring
+
+
+class PrefetchRing:
+    """Bounded byte-buffer queue backed by the native MPMC ring (python
+    ``queue.Queue`` fallback). The host-side staging stage between storage
+    reader threads and the device-infeed loop (reference analogue:
+    ``ThreadPool``-driven transformer pipelines)."""
+
+    def __init__(self, capacity: int = 8):
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.bigdl_ring_new(capacity)
+            self._q = None
+        else:
+            import queue
+
+            self._h = None
+            self._q = queue.Queue(maxsize=capacity)
+
+    def push(self, data: bytes) -> bool:
+        if self._h is not None:
+            return self._lib.bigdl_ring_push(self._h, data, len(data)) == 0
+        try:
+            self._q.put(data)
+            return True
+        except Exception:
+            return False
+
+    def pop(self) -> Optional[bytes]:
+        if self._h is not None:
+            n = self._lib.bigdl_ring_peek_size(self._h)
+            if n == 0:
+                return None
+            buf = ctypes.create_string_buffer(n)
+            got = self._lib.bigdl_ring_pop(self._h, buf, n)
+            if got == 0:
+                return None
+            return buf.raw[:got]
+        item = self._q.get()
+        return item
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.bigdl_ring_close(self._h)
+
+    def __len__(self) -> int:
+        if self._h is not None:
+            return int(self._lib.bigdl_ring_size(self._h))
+        return self._q.qsize()
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None and self._lib is not None:
+            try:
+                self._lib.bigdl_ring_free(self._h)
+            except Exception:
+                pass
+            self._h = None
+
+
+# ------------------------------------------------------------- image ops
+
+
+def normalize_u8(images: np.ndarray, mean, std, scale: float = 1.0,
+                 n_threads: int = 4) -> np.ndarray:
+    """(N, C, H, W) uint8 -> float32 ``(x/scale - mean[c]) / std[c]``."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, c, h, w = images.shape
+    mean = np.ascontiguousarray(np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    std = np.ascontiguousarray(np.broadcast_to(np.asarray(std, np.float32), (c,)))
+    lib = _load()
+    if lib is not None:
+        out = np.empty((n, c, h, w), np.float32)
+        lib.bigdl_normalize_u8(
+            images.ctypes.data, out.ctypes.data, n, c, h * w,
+            mean.ctypes.data, std.ctypes.data, ctypes.c_float(scale), n_threads,
+        )
+        return out
+    return ((images.astype(np.float32) / scale) - mean[None, :, None, None]) \
+        / std[None, :, None, None]
+
+
+def hflip_u8(images: np.ndarray, n_threads: int = 4) -> np.ndarray:
+    """In-place horizontal flip of (N, C, H, W) uint8; returns the array."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, c, h, w = images.shape
+    lib = _load()
+    if lib is not None:
+        lib.bigdl_hflip_u8(images.ctypes.data, n, c, h, w, n_threads)
+        return images
+    return images[..., ::-1].copy()
+
+
+def crop_u8(image: np.ndarray, y0: int, x0: int, ch: int, cw: int) -> np.ndarray:
+    """(C, H, W) uint8 crop."""
+    image = np.ascontiguousarray(image, dtype=np.uint8)
+    c, h, w = image.shape
+    if y0 < 0 or x0 < 0 or y0 + ch > h or x0 + cw > w:
+        raise ValueError("crop window out of bounds")
+    lib = _load()
+    if lib is not None:
+        out = np.empty((c, ch, cw), np.uint8)
+        lib.bigdl_crop_u8(image.ctypes.data, out.ctypes.data, c, h, w, y0, x0, ch, cw)
+        return out
+    return image[:, y0:y0 + ch, x0:x0 + cw].copy()

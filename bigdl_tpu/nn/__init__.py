@@ -63,3 +63,4 @@ from bigdl_tpu.nn.layers.recurrent import (
     GRU,
     SimpleRNN,
 )
+from bigdl_tpu.nn.quantized import QuantizedLinear, QuantizedSpatialConvolution, quantize

@@ -1,0 +1,203 @@
+"""Int8 post-training quantization for inference.
+
+Reference: ``DL/nn/quantized/`` — ``Quantizer``/``Quantizable`` module-tree
+rewrite, quantized ``SpatialConvolution``/``Linear`` holding int8 weights
+with per-output-channel scales (``Desc.scala`` quant params), entry point
+``AbstractModule.quantize()`` (``AbstractModule.scala:920``).
+
+TPU-native design:
+
+- weights are quantized **per output channel** to int8 symmetric
+  (``w_q = round(w / scale)``, ``scale = max|w| / 127``), like the
+  reference's per-output scales;
+- activations are quantized **dynamically per tensor** at runtime
+  (the reference computes input min/max per forward too);
+- the Linear matmul runs as a true int8 x int8 -> int32
+  ``lax.dot_general`` (``preferred_element_type=int32``) — on TPU this is
+  the MXU's native int8 path at double the bf16 throughput;
+- convolutions compute with the quantized integer values in float
+  (numerically identical: products ≤ 127², exactly representable), since
+  int8 ``conv_general_dilated`` support varies by backend — the XLA TPU
+  compiler still constant-folds the dequantization into the conv epilogue.
+
+``quantize(module, params)`` returns a NEW (module, params) pair; the
+original float model is untouched (reference semantics).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.containers import Sequential
+from bigdl_tpu.nn.graph import Graph, Node
+from bigdl_tpu.nn.layers.conv import SpatialConvolution
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Context, Module
+
+
+def _quantize_weight(w: jax.Array, channel_axis: int = 0):
+    """Symmetric per-output-channel int8 (reference ``Desc.scala`` scales)."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    wq = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return wq, scale.astype(jnp.float32)
+
+
+def _quantize_activation(x: jax.Array):
+    """Dynamic symmetric per-tensor int8."""
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return xq, scale
+
+
+class QuantizedLinear(Module):
+    """Int8 Linear (reference ``quantized/Linear.scala``): int8 GEMM with
+    int32 accumulation on the MXU, per-output-channel dequantization."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    @staticmethod
+    def convert_params(float_params: Dict[str, Any]) -> Dict[str, Any]:
+        w = jnp.asarray(float_params["weight"])  # (out, in) layout (x @ w.T)
+        wq, scale = _quantize_weight(w, channel_axis=0)
+        p = {"weight_q": wq, "scale": scale.reshape(1, -1)}
+        if "bias" in float_params:
+            p["bias"] = jnp.asarray(float_params["bias"], jnp.float32)
+        return p
+
+    def forward(self, ctx: Context, x):
+        wq = ctx.param("weight_q")  # (out, in)
+        scale_w = ctx.param("scale")  # (1, out)
+        orig_shape = x.shape
+        x2 = x.reshape(-1, orig_shape[-1]).astype(jnp.float32)
+        xq, scale_x = _quantize_activation(x2)
+        acc = lax.dot_general(
+            xq, wq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        y = acc.astype(jnp.float32) * (scale_x * scale_w)
+        if self.with_bias:
+            y = y + ctx.param("bias")
+        return y.reshape(orig_shape[:-1] + (self.output_size,)).astype(x.dtype)
+
+
+class QuantizedSpatialConvolution(Module):
+    """Int8 conv (reference ``quantized/SpatialConvolution.scala``).
+    Integer values computed in float (exact — see module docstring)."""
+
+    def __init__(self, src: SpatialConvolution):
+        super().__init__()
+        self.stride = src.stride
+        self.pad = src.pad
+        self.n_group = src.n_group
+        self.with_bias = src.with_bias
+        self.data_format = src.data_format
+        self.dilation = getattr(src, "dilation", (1, 1))
+        self.n_output_plane = src.n_output_plane
+
+    @staticmethod
+    def convert_params(float_params: Dict[str, Any]) -> Dict[str, Any]:
+        w = jnp.asarray(float_params["weight"])  # (O, I, kh, kw)
+        wq, scale = _quantize_weight(w, channel_axis=0)
+        p = {"weight_q": wq, "scale": scale.reshape(-1)}
+        if "bias" in float_params:
+            p["bias"] = jnp.asarray(float_params["bias"], jnp.float32)
+        return p
+
+    def forward(self, ctx: Context, x):
+        from bigdl_tpu.nn.layers.conv import _dimension_numbers, _padding
+
+        wq = ctx.param("weight_q").astype(jnp.float32)
+        scale_w = ctx.param("scale")
+        xf = x.astype(jnp.float32)
+        xq, scale_x = _quantize_activation(xf)
+        y = lax.conv_general_dilated(
+            xq.astype(jnp.float32), wq,
+            window_strides=self.stride,
+            padding=_padding(*self.pad),
+            rhs_dilation=self.dilation,
+            feature_group_count=self.n_group,
+            dimension_numbers=_dimension_numbers(self.data_format),
+        )
+        if self.data_format == "NCHW":
+            y = y * (scale_x * scale_w)[None, :, None, None]
+            if self.with_bias:
+                y = y + ctx.param("bias")[None, :, None, None]
+        else:
+            y = y * (scale_x * scale_w)
+            if self.with_bias:
+                y = y + ctx.param("bias")
+        return y.astype(x.dtype)
+
+
+def _quantize_node(module: Module, params) -> Tuple[Module, Any]:
+    if isinstance(module, Linear):
+        q = QuantizedLinear(module.input_size, module.output_size, module.with_bias)
+        return q, QuantizedLinear.convert_params(params)
+    if type(module) is SpatialConvolution:
+        q = QuantizedSpatialConvolution(module)
+        return q, QuantizedSpatialConvolution.convert_params(params)
+    return None, None
+
+
+def quantize(module: Module, params) -> Tuple[Module, Any]:
+    """Rewrite the module tree, quantizing every Linear / SpatialConvolution
+    (reference ``Quantizer.quantize`` / ``AbstractModule.quantize()``).
+    Returns a new (module, params); the original pair is untouched."""
+    q, qp = _quantize_node(module, params)
+    if q is not None:
+        return q, qp
+
+    if isinstance(module, Graph):
+        # rebuild the node DAG with quantized elements (shared modules stay
+        # shared — keyed by id)
+        mapping: Dict[int, Module] = {}
+        new_params: Dict[str, Any] = {}
+        for node in module._topo:
+            el = node.element
+            if el is None or id(el) in mapping:
+                continue
+            name = module._names[id(node)]
+            sub_params = params.get(name, {}) if params else {}
+            new_el, new_sub = quantize(el, sub_params)
+            # keep the old graph's node name so param keys stay aligned
+            # (a rewritten class would otherwise rename e.g. Linear_0 ->
+            # QuantizedLinear_0)
+            new_el.set_name(name)
+            mapping[id(el)] = new_el
+            if new_sub:
+                new_params[name] = new_sub
+        node_map: Dict[int, Node] = {}
+        for node in module._topo:
+            el = None if node.element is None else mapping[id(node.element)]
+            node_map[id(node)] = Node(el, [node_map[id(p)] for p in node.prev])
+        g = Graph([node_map[id(n)] for n in module.inputs],
+                  [node_map[id(n)] for n in module.outputs])
+        return g, new_params
+
+    # generic container / layer: shallow-copy, recurse into children
+    clone = copy.copy(module)
+    object.__setattr__(clone, "_modules", {})
+    new_params = dict(params) if isinstance(params, dict) else {}
+    for name, child in module.modules.items():
+        sub = params.get(name, {}) if isinstance(params, dict) else {}
+        new_child, new_sub = quantize(child, sub)
+        clone._modules[name] = new_child
+        # keep attribute aliases (e.g. self.fc1) pointing at the new child
+        for attr, val in vars(module).items():
+            if val is child:
+                object.__setattr__(clone, attr, new_child)
+        if new_sub:
+            new_params[name] = new_sub
+    return clone, new_params

@@ -1,0 +1,203 @@
+// Native runtime support library.
+//
+// The reference keeps its performance-critical non-XLA machinery in native
+// code behind JNI (BigDL-core submodule: MKL BLAS/VML kernels, MKL-DNN
+// primitives, aligned Memory allocator, CPU affinity — SURVEY.md §2.1) plus
+// Java-side CRC framing for TFRecord/TensorBoard files (Crc32c.java).
+//
+// On TPU the compute kernels belong to XLA/Pallas, so the native tier here
+// is the *runtime around the compute*: checksum/record framing for event &
+// record files, an aligned buffer pool (host staging buffers for infeed),
+// a multi-threaded prefetch ring (the analogue of the reference's
+// ThreadPool-driven data pipeline, DL/utils/ThreadPool.scala), and hot
+// uint8 image preprocessing loops (normalize/flip/crop — the analogue of
+// dataset/image/* transformers' inner loops).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c
+
+static uint32_t crc_table[256];
+static std::once_flag crc_once;
+
+static void crc_init() {
+  const uint32_t poly = 0x82f63b78u;  // Castagnoli, reflected
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+}
+
+uint32_t bigdl_crc32c(const uint8_t* data, uint64_t n, uint32_t seed) {
+  std::call_once(crc_once, crc_init);
+  uint32_t c = seed ^ 0xffffffffu;
+  for (uint64_t i = 0; i < n; i++) c = crc_table[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+// TFRecord / TensorBoard masked crc (Crc32c.java mask convention)
+uint32_t bigdl_masked_crc32c(const uint8_t* data, uint64_t n) {
+  uint32_t crc = bigdl_crc32c(data, n, 0);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+// ------------------------------------------------------ aligned buffers
+
+void* bigdl_aligned_alloc(uint64_t alignment, uint64_t size) {
+  void* p = nullptr;
+  if (posix_memalign(&p, alignment, size) != 0) return nullptr;
+  return p;
+}
+
+void bigdl_aligned_free(void* p) { free(p); }
+
+// ------------------------------------------------------- prefetch ring
+//
+// A bounded MPMC byte-buffer queue: producer threads (C++ or Python) push
+// filled buffers; the consumer pops in order. This is the host-side
+// staging stage between storage and device infeed.
+
+struct Ring {
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::queue<std::vector<uint8_t>> q;
+  size_t capacity;
+  std::atomic<bool> closed{false};
+};
+
+void* bigdl_ring_new(uint64_t capacity) {
+  Ring* r = new Ring();
+  r->capacity = capacity ? capacity : 1;
+  return r;
+}
+
+void bigdl_ring_free(void* h) { delete static_cast<Ring*>(h); }
+
+void bigdl_ring_close(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+}
+
+// returns 0 on success, -1 if closed
+int bigdl_ring_push(void* h, const uint8_t* data, uint64_t n) {
+  Ring* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_full.wait(lk, [&] { return r->q.size() < r->capacity || r->closed; });
+  if (r->closed) return -1;
+  r->q.emplace(data, data + n);
+  lk.unlock();
+  r->not_empty.notify_one();
+  return 0;
+}
+
+// returns payload size, 0 if closed-and-drained. Caller passes a buffer of
+// bigdl_ring_peek_size() bytes (call under the same single consumer).
+int64_t bigdl_ring_pop(void* h, uint8_t* out, uint64_t out_cap) {
+  Ring* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] { return !r->q.empty() || r->closed; });
+  if (r->q.empty()) return 0;
+  std::vector<uint8_t> buf = std::move(r->q.front());
+  r->q.pop();
+  lk.unlock();
+  r->not_full.notify_one();
+  uint64_t n = buf.size() < out_cap ? buf.size() : out_cap;
+  memcpy(out, buf.data(), n);
+  return static_cast<int64_t>(buf.size());
+}
+
+int64_t bigdl_ring_peek_size(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] { return !r->q.empty() || r->closed; });
+  if (r->q.empty()) return 0;
+  return static_cast<int64_t>(r->q.front().size());
+}
+
+int64_t bigdl_ring_size(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int64_t>(r->q.size());
+}
+
+// -------------------------------------------------- image preprocessing
+//
+// Hot inner loops of the reference's image transformers
+// (BGRImgNormalizer / HFlip / crop, DL/dataset/image/*), multi-threaded
+// over the batch dimension like Engine.default.invokeAndWait.
+
+static void parallel_for(int64_t n, int n_threads,
+                         const std::function<void(int64_t, int64_t)>& fn) {
+  if (n_threads <= 1 || n < 2) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// u8 (N, C, H, W) -> f32 normalized (x/scale - mean[c]) / std[c]
+void bigdl_normalize_u8(const uint8_t* src, float* dst, int64_t n, int64_t c,
+                        int64_t hw, const float* mean, const float* stdv,
+                        float scale, int n_threads) {
+  parallel_for(n, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      for (int64_t ch = 0; ch < c; ch++) {
+        const uint8_t* s = src + (i * c + ch) * hw;
+        float* d = dst + (i * c + ch) * hw;
+        float m = mean[ch], sd = stdv[ch];
+        for (int64_t k = 0; k < hw; k++) d[k] = (s[k] / scale - m) / sd;
+      }
+    }
+  });
+}
+
+// horizontal flip in place, u8 (N, C, H, W)
+void bigdl_hflip_u8(uint8_t* data, int64_t n, int64_t c, int64_t h, int64_t w,
+                    int n_threads) {
+  parallel_for(n * c, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      uint8_t* plane = data + i * h * w;
+      for (int64_t y = 0; y < h; y++) {
+        uint8_t* row = plane + y * w;
+        for (int64_t x = 0; x < w / 2; x++) std::swap(row[x], row[w - 1 - x]);
+      }
+    }
+  });
+}
+
+// crop u8 (C, H, W) -> (C, ch, cw) at offset (y0, x0)
+void bigdl_crop_u8(const uint8_t* src, uint8_t* dst, int64_t c, int64_t h,
+                   int64_t w, int64_t y0, int64_t x0, int64_t ch, int64_t cw) {
+  for (int64_t pc = 0; pc < c; pc++)
+    for (int64_t y = 0; y < ch; y++)
+      memcpy(dst + (pc * ch + y) * cw, src + (pc * h + (y0 + y)) * w + x0, cw);
+}
+
+}  // extern "C"

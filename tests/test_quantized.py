@@ -1,0 +1,95 @@
+"""Int8 quantization tests (reference: ``DL/nn/quantized`` +
+``AbstractModule.quantize()``): quantized models must track the float
+model closely and actually hold int8 weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Graph, Input
+from bigdl_tpu.nn.quantized import quantize
+
+
+def _rel_err(a, b):
+    return np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-9)
+
+
+def test_quantized_linear_close_and_int8(rng):
+    m = nn.Linear(32, 16)
+    p, s = m.init(rng)
+    qm, qp = quantize(m, p)
+    assert qp["weight_q"].dtype == jnp.int8
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 32).astype("float32"))
+    ref, _ = m.apply(p, x, state=s)
+    out, _ = qm.apply(qp, x)
+    assert _rel_err(np.asarray(out), np.asarray(ref)) < 0.05
+
+
+def test_quantized_conv_close(rng):
+    m = nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1)
+    p, s = m.init(rng)
+    qm, qp = quantize(m, p)
+    assert qp["weight_q"].dtype == jnp.int8
+    assert qp["scale"].shape == (8,)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 12, 12).astype("float32"))
+    ref, _ = m.apply(p, x, state=s)
+    out, _ = qm.apply(qp, x)
+    assert _rel_err(np.asarray(out), np.asarray(ref)) < 0.05
+
+
+def test_quantize_sequential_tree_rewrite(rng):
+    m = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+        nn.Reshape([4 * 6 * 6]), nn.Linear(4 * 6 * 6, 10), nn.LogSoftMax(),
+    )
+    p, s = m.init(rng)
+    qm, qp = quantize(m, p)
+    # originals untouched
+    assert isinstance(m.modules["0"], nn.SpatialConvolution)
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 1, 8, 8).astype("float32"))
+    ref, _ = m.apply(p, x, state=s)
+    out, _ = qm.apply(qp, x)
+    # same argmax class on nearly all rows
+    agree = np.mean(np.argmax(np.asarray(out), -1) == np.argmax(np.asarray(ref), -1))
+    assert agree >= 0.75
+    # int8 weights inside the rewritten tree
+    leaves = jax.tree_util.tree_leaves(qp)
+    assert any(l.dtype == jnp.int8 for l in leaves)
+
+
+def test_quantize_graph_preserves_sharing(rng):
+    inp = Input()
+    shared = nn.Linear(8, 8)
+    out = nn.LogSoftMax()(shared(nn.ReLU()(shared(inp))))
+    g = Graph(inp, out)
+    p, s = g.init(rng)
+    qg, qp = quantize(g, p)
+    assert len(qp) == 1  # still one shared params subtree
+    x = jnp.asarray(np.random.RandomState(3).randn(3, 8).astype("float32"))
+    ref, _ = g.apply(p, x, state=s)
+    o, _ = qg.apply(qp, x)
+    assert _rel_err(np.asarray(o), np.asarray(ref)) < 0.1
+
+
+def test_quantized_resnet_block_runs(rng):
+    from bigdl_tpu.models import resnet
+
+    m = resnet.build_cifar(depth=8, class_num=10)
+    p, s = m.init(rng)
+    qm, qp = quantize(m, p)
+    x = jnp.asarray(np.random.RandomState(4).rand(2, 3, 32, 32).astype("float32"))
+    ref, _ = m.apply(p, x, state=s)
+    out, _ = qm.apply(qp, x, state=s)
+    assert out.shape == ref.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quantized_model_is_jittable(rng):
+    m = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 4))
+    p, _ = m.init(rng)
+    qm, qp = quantize(m, p)
+    f = jax.jit(lambda qp, x: qm.apply(qp, x)[0])
+    out = f(qp, jnp.ones((2, 16)))
+    assert out.shape == (2, 4)
