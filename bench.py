@@ -34,19 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _fetch_timed(fn, *args, reps=3):
-    """Best-of-reps wall time of fn(*args) including a host fetch."""
-    float(fn(*args))  # warmup (compile + first fetch)
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        float(fn(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best
+def measure_peak_flops(dtype=jnp.bfloat16, n=4096, short=128, long=512):
+    """Empirical peak FLOP/s: dependency-chained n x n matmuls, differential.
 
-
-def measure_peak_flops(dtype=jnp.bfloat16, n=4096, short=64, long=256):
-    """Empirical peak FLOP/s: dependency-chained n x n matmuls, differential."""
+    The differential is taken per-rep and the MEDIAN is reported — a single
+    contaminated short-run (tunnel jitter inflating t_short) would otherwise
+    report an impossibly high peak.
+    """
     w = (jax.random.normal(jax.random.key(1), (n, n), jnp.float32) / np.sqrt(n)).astype(dtype)
     x = (jax.random.normal(jax.random.key(2), (n, n), jnp.float32) / np.sqrt(n)).astype(dtype)
 
@@ -58,10 +52,14 @@ def measure_peak_flops(dtype=jnp.bfloat16, n=4096, short=64, long=256):
 
         return f
 
-    t_short = _fetch_timed(chain(short), x, w)
-    t_long = _fetch_timed(chain(long), x, w)
-    dt = (t_long - t_short) / (long - short)
-    return 2 * n**3 / dt
+    f_short, f_long = chain(short), chain(long)
+    float(f_short(x, w)); float(f_long(x, w))  # compile
+    peaks = []
+    for _ in range(5):
+        t0 = time.perf_counter(); float(f_short(x, w)); ts = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(f_long(x, w)); tl = time.perf_counter() - t0
+        peaks.append(2 * n**3 * (long - short) / (tl - ts))
+    return float(np.median(peaks))
 
 
 # bf16 peak FLOP/s per chip by TPU generation (spec sheet) — reported for

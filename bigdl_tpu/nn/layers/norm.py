@@ -14,15 +14,84 @@ cross-replica statistics rather than replica-0's local view.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.core.rng import fold_in_str
 from bigdl_tpu.nn.init import InitializationMethod, Ones, Zeros
 from bigdl_tpu.nn.module import Context, Module
+
+
+def _bcast(v, ndim, axis):
+    shape = [1] * ndim
+    shape[axis] = v.shape[0]
+    return v.reshape(shape)
+
+
+def _bn_apply(x, mean, var, gamma, beta, eps, ch):
+    """y = (x - mean) * rsqrt(var + eps) * gamma + beta, folded into one
+    fused scale/shift in x.dtype (per-channel factors stay fp32)."""
+    inv = lax.rsqrt(var + eps)
+    scale = inv * gamma
+    shift = beta - mean * scale
+    y = x * _bcast(scale, x.ndim, ch).astype(x.dtype) + _bcast(shift, x.ndim, ch).astype(x.dtype)
+    return y, inv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bn_train(x, gamma, beta, axes, eps):
+    """Training-mode batch norm with a hand-fused backward.
+
+    Autodiff of the naive formulation materializes full-size fp32
+    activation tensors in the backward (fp32 cotangents through the fp32
+    stats path), roughly doubling HBM traffic of the bandwidth-bound BN
+    stages. This custom_vjp keeps every full-size tensor in ``x.dtype``
+    (bf16 under the mixed policy) and uses fp32 only for the per-channel
+    reductions — the textbook fused BN backward.
+
+    Returns ``(y, mean, var)``; mean/var feed the running-stat update and
+    are treated as non-differentiable (their cotangents are ignored —
+    nothing differentiates through running statistics).
+    """
+    (y, mean, var), _ = _bn_train_fwd(x, gamma, beta, axes, eps)
+    return y, mean, var
+
+
+def _bn_train_fwd(x, gamma, beta, axes, eps):
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    mean_sq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, dtype=jnp.float32)
+    var = jnp.maximum(mean_sq - mean * mean, 0.0)
+    ch = [i for i in range(x.ndim) if i not in axes][0]
+    y, inv = _bn_apply(x, mean, var, gamma, beta, eps, ch)
+    return (y, mean, var), (x, gamma, mean, inv)
+
+
+def _bn_train_bwd(axes, eps, res, cts):
+    x, gamma, mean, inv = res
+    ch = [i for i in range(x.ndim) if i not in axes][0]
+    g, _, _ = cts  # cotangents for mean/var outputs are ignored (see doc)
+    n = float(np.prod([x.shape[i] for i in axes]))
+    mean_c = _bcast(mean, x.ndim, ch).astype(x.dtype)
+    inv_c = _bcast(inv, x.ndim, ch).astype(x.dtype)
+    xhat = (x - mean_c) * inv_c
+    # both reductions read (g, xhat) once; XLA fuses them into one pass
+    sum_g = jnp.sum(g, axis=axes, dtype=jnp.float32)
+    sum_g_xhat = jnp.sum((g * xhat), axis=axes, dtype=jnp.float32)
+    dgamma = sum_g_xhat
+    dbeta = sum_g
+    k1 = _bcast(inv * gamma, x.ndim, ch).astype(x.dtype)
+    mg = _bcast(sum_g / n, x.ndim, ch).astype(x.dtype)
+    mgx = _bcast(sum_g_xhat / n, x.ndim, ch).astype(x.dtype)
+    dx = k1 * (g - mg - xhat * mgx)
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+
+bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 class BatchNormalization(Module):
@@ -72,37 +141,25 @@ class BatchNormalization(Module):
 
     def forward(self, ctx: Context, x):
         axes = tuple(i for i in range(x.ndim) if i != 1)
+        if self.affine:
+            gamma = ctx.param("weight").astype(jnp.float32)
+            beta = ctx.param("bias").astype(jnp.float32)
+        else:
+            gamma = jnp.ones((self.n_output,), jnp.float32)
+            beta = jnp.zeros((self.n_output,), jnp.float32)
         if ctx.training:
-            # one-pass stats: E[x] and E[x^2] reduce over the same read of x,
-            # so XLA fuses both into a single HBM pass (vs. mean-then-var's
-            # two sequential passes) — the BN stages at 56x56 resolution are
-            # bandwidth-bound, and this halves their stats traffic. Reducing
-            # with dtype=float32 accumulates in fp32 WITHOUT materializing
-            # (or saving as an autodiff residual) an fp32 copy of the
-            # activation: the only residual is the bf16 x itself.
-            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-            mean_sq = jnp.mean(
-                jnp.square(x.astype(jnp.float32)), axis=axes, dtype=jnp.float32
-            )
-            var = jnp.maximum(mean_sq - mean * mean, 0.0)
+            y, mean, var = bn_train(x, gamma, beta, axes, self.eps)
+            mean = lax.stop_gradient(mean)
+            var = lax.stop_gradient(var)
             m = self.momentum
             n = float(np.prod([x.shape[i] for i in axes]))
             unbiased = var * (n / max(1.0, n - 1.0))
             ctx.put_state("running_mean", (1 - m) * ctx.get_state("running_mean") + m * mean)
             ctx.put_state("running_var", (1 - m) * ctx.get_state("running_var") + m * unbiased)
-        else:
-            mean = ctx.get_state("running_mean")
-            var = ctx.get_state("running_var")
-        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
-        if self.affine:
-            scale = inv * ctx.param("weight")
-            shift = ctx.param("bias") - mean * scale
-        else:
-            scale = inv
-            shift = -mean * scale
-        y = x * self._broadcast(scale, x.ndim).astype(x.dtype) + self._broadcast(
-            shift, x.ndim
-        ).astype(x.dtype)
+            return y
+        mean = ctx.get_state("running_mean")
+        var = ctx.get_state("running_var")
+        y, _ = _bn_apply(x, mean, var, gamma, beta, self.eps, 1)
         return y
 
 
