@@ -119,23 +119,28 @@ class KerasModel(Module):
         """Returns [(name, value)] for loss + compiled metrics."""
         from bigdl_tpu.optim.validation import Loss, ValidationResult
 
+        from bigdl_tpu.optim.validation import accumulate_batch, split_methods
+
         params, state = self._require_params()
         methods = [Loss(self._criterion)] + list(self._metrics or [])
+        jit_idx, host_idx = split_methods(methods)
 
         if self._jit_eval is None:
             def eval_fn(p, s, xb, yb):
                 out, _ = self.apply(p, xb, state=s, training=False)
-                return [m.batch(out, yb) for m in methods]
+                # host-side (non-jit-safe) metrics run on the materialized
+                # output outside the jit (see accumulate_batch)
+                return out, [methods[i].batch(out, yb) for i in jit_idx]
 
             self._jit_eval = jax.jit(eval_fn)
         eval_step = self._jit_eval
         x, y = np.asarray(x), np.asarray(y)
         totals = [ValidationResult(0.0, 0, m.name) for m in methods]
         for i in range(0, len(x), batch_size):
-            outs = eval_step(params, state, jnp.asarray(x[i:i + batch_size]),
-                             jnp.asarray(y[i:i + batch_size]))
-            for j, (v, n) in enumerate(outs):
-                totals[j] = totals[j] + ValidationResult(float(v), int(n), totals[j].name)
+            yb = y[i:i + batch_size]
+            out, jit_outs = eval_step(params, state, jnp.asarray(x[i:i + batch_size]),
+                                      jnp.asarray(yb))
+            accumulate_batch(totals, methods, jit_idx, host_idx, jit_outs, out, yb)
         return [(t.name, t.result()[0]) for t in totals]
 
     # -- weights access ----------------------------------------------------

@@ -117,6 +117,7 @@ class PrefetchRing:
 
     def __init__(self, capacity: int = 8):
         self._lib = _load()
+        self._closed = False
         if self._lib is not None:
             self._h = self._lib.bigdl_ring_new(capacity)
             self._q = None
@@ -129,28 +130,45 @@ class PrefetchRing:
     def push(self, data: bytes) -> bool:
         if self._h is not None:
             return self._lib.bigdl_ring_push(self._h, data, len(data)) == 0
-        try:
-            self._q.put(data)
-            return True
-        except Exception:
-            return False
+        import queue
+
+        # poll so a producer blocked on a full ring observes close(), like
+        # the native ring where close() wakes blocked pushers
+        while not self._closed:
+            try:
+                self._q.put(data, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def pop(self) -> Optional[bytes]:
+        """Next payload, or None once the ring is closed AND drained.
+        Zero-length payloads are legal records, not end-of-stream."""
         if self._h is not None:
             n = self._lib.bigdl_ring_peek_size(self._h)
-            if n == 0:
+            if n < 0:  # closed-and-drained (-1); 0 is a legal empty record
                 return None
-            buf = ctypes.create_string_buffer(n)
+            buf = ctypes.create_string_buffer(max(int(n), 1))
             got = self._lib.bigdl_ring_pop(self._h, buf, n)
-            if got == 0:
+            if got < 0:
                 return None
             return buf.raw[:got]
-        item = self._q.get()
-        return item
+        import queue
+
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return None  # closed and drained
+                continue
+            return item
 
     def close(self) -> None:
         if self._h is not None:
             self._lib.bigdl_ring_close(self._h)
+        self._closed = True
 
     def __len__(self) -> int:
         if self._h is not None:
@@ -189,13 +207,17 @@ def normalize_u8(images: np.ndarray, mean, std, scale: float = 1.0,
 
 
 def hflip_u8(images: np.ndarray, n_threads: int = 4) -> np.ndarray:
-    """In-place horizontal flip of (N, C, H, W) uint8; returns the array."""
-    images = np.ascontiguousarray(images, dtype=np.uint8)
-    n, c, h, w = images.shape
+    """Horizontal flip of (N, C, H, W) uint8; always returns a NEW array
+    and leaves the input untouched (both native and numpy paths)."""
     lib = _load()
     if lib is not None:
+        # np.array copies exactly once (ascontiguousarray + .copy() would
+        # copy twice for non-contiguous / non-uint8 inputs)
+        images = np.array(images, dtype=np.uint8, order="C")
+        n, c, h, w = images.shape
         lib.bigdl_hflip_u8(images.ctypes.data, n, c, h, w, n_threads)
         return images
+    images = np.asarray(images, dtype=np.uint8)
     return images[..., ::-1].copy()
 
 

@@ -142,7 +142,10 @@ class QuantizedSpatialConvolution(Module):
 
 
 def _quantize_node(module: Module, params) -> Tuple[Module, Any]:
-    if isinstance(module, Linear):
+    # exact type checks (not isinstance): parallel subclasses like
+    # ColumnParallelLinear carry sharding specs and collectives that a
+    # plain QuantizedLinear would silently drop
+    if type(module) is Linear:
         q = QuantizedLinear(module.input_size, module.output_size, module.with_bias)
         return q, QuantizedLinear.convert_params(params)
     if type(module) is SpatialConvolution:

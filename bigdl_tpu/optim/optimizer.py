@@ -217,9 +217,12 @@ class Optimizer:
         return jax.jit(step, donate_argnums=(0, 1, 2)), data_sharding
 
     def _build_eval_step(self):
+        from bigdl_tpu.optim.validation import split_methods
+
         model = self.model
         dtypes = self.config.dtypes
         methods = self.val_methods
+        jit_idx, _ = split_methods(methods)
 
         def eval_step(params, mstate, x, y):
             out, _ = model.apply(params, dtypes.cast_compute(x), state=mstate, training=False)
@@ -229,7 +232,8 @@ class Optimizer:
                 else a,
                 out,
             )
-            return [m.batch(out, y) for m in methods]
+            # host-side (non-jit-safe) methods consume `out` after the step
+            return out, [methods[i].batch(out, y) for i in jit_idx]
 
         return jax.jit(eval_step)
 
@@ -361,7 +365,9 @@ class Optimizer:
 
     # ------------------------------------------------ validation ---------
     def _run_validation(self):
-        from bigdl_tpu.optim.validation import ValidationResult
+        from bigdl_tpu.optim.validation import (
+            ValidationResult, accumulate_batch, split_methods,
+        )
         from bigdl_tpu.dataset.prefetch import device_put_batch
         from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 
@@ -372,6 +378,7 @@ class Optimizer:
         dp = 1
         if data_sharding is not None:
             dp = int(data_sharding.mesh.shape.get(self.config.dp_axis, 1))
+        jit_idx, host_idx = split_methods(self.val_methods)
         results = [ValidationResult(0.0, 0, m.name) for m in self.val_methods]
         batch_size = self.val_batch_size or self.batch_size
         it = SampleToMiniBatch(batch_size, partial_batch=True).apply(
@@ -381,9 +388,9 @@ class Optimizer:
             # a trailing partial batch may not divide the mesh: replicate it
             sharding = data_sharding if batch.size() % dp == 0 else None
             x, y = device_put_batch(batch, sharding)
-            outs = eval_fn(self._params, self._module_state, x, y)
-            for i, (v, n) in enumerate(outs):
-                results[i] = results[i] + ValidationResult(float(v), int(n), results[i].name)
+            out, jit_outs = eval_fn(self._params, self._module_state, x, y)
+            accumulate_batch(results, self.val_methods, jit_idx, host_idx,
+                             jit_outs, out, y)
         for r in results:
             v, n = r.result()
             log.info("%s is %.6f (count %d)", r.name, v, n)

@@ -110,12 +110,17 @@ class Evaluator:
         self.batch_size = batch_size or 32 * max(1, jax.device_count())
 
     def test(self, data, methods: Sequence[ValidationMethod]) -> List[ValidationResult]:
+        from bigdl_tpu.optim.validation import accumulate_batch, split_methods
+
         methods = list(methods)
+        jit_idx, host_idx = split_methods(methods)
 
         @jax.jit
         def eval_step(params, state, x, y):
             out, _ = self.model.apply(params, x, state=state, training=False)
-            return [m.batch(out, y) for m in methods]
+            # host-side metrics (numpy sorts/cumsums) consume the raw output
+            # outside the jit; jit-safe ones reduce on device
+            return out, [methods[i].batch(out, y) for i in jit_idx]
 
         totals = [ValidationResult(0.0, 0, m.name) for m in methods]
         ds = _as_dataset(data)
@@ -126,9 +131,8 @@ class Evaluator:
             x, y = device_put_batch(batch)
             if y is None:
                 raise ValueError("evaluation data must carry labels")
-            outs = eval_step(self.params, self.state, x, y)
-            for i, (v, n) in enumerate(outs):
-                totals[i] = totals[i] + ValidationResult(float(v), int(n), totals[i].name)
+            out, jit_outs = eval_step(self.params, self.state, x, y)
+            accumulate_batch(totals, methods, jit_idx, host_idx, jit_outs, out, y)
         return totals
 
 

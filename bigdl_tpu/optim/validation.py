@@ -45,14 +45,47 @@ class ValidationMethod:
     """Computes a per-batch (sum, count); host wraps into ValidationResult."""
 
     name = "method"
+    #: False for metrics whose ``batch`` runs host-side numpy (sorting,
+    #: cumsums) — Evaluator/KerasModel must call those OUTSIDE the jitted
+    #: eval step, on materialized outputs (np.asarray on a tracer raises)
+    jit_safe = True
 
     def batch(self, output, target):
-        """Return (value_sum, count) as jnp scalars — jit-safe."""
+        """Return (value_sum, count); jit-safe jnp math iff ``jit_safe``."""
         raise NotImplementedError
 
     def __call__(self, output, target) -> ValidationResult:
         v, n = self.batch(output, target)
         return ValidationResult(float(v), int(n), self.name)
+
+
+def split_methods(methods):
+    """Positional indices of jit-safe vs host-side methods. Positional (not
+    name-keyed) so two metrics sharing a name accumulate separately."""
+    jit_idx = [i for i, m in enumerate(methods) if m.jit_safe]
+    host_idx = [i for i, m in enumerate(methods) if not m.jit_safe]
+    return jit_idx, host_idx
+
+
+def accumulate_batch(totals, methods, jit_idx, host_idx, jit_outs, out, y):
+    """Fold one batch's metric outputs into the positional ``totals`` list.
+
+    ``jit_outs`` are the (sum, count) pairs computed inside the jitted eval
+    step for ``jit_idx``; host-side methods consume the materialized
+    ``out``/``y`` here, outside any trace. Shared by Optimizer validation,
+    Evaluator.test and KerasModel.evaluate.
+    """
+    import numpy as np
+
+    for i, (v, n) in zip(jit_idx, jit_outs):
+        totals[i] = totals[i] + ValidationResult(float(v), int(n), methods[i].name)
+    if host_idx:
+        out_np = jax.tree_util.tree_map(np.asarray, out)
+        y_np = np.asarray(y)
+        for i in host_idx:
+            v, n = methods[i].batch(out_np, y_np)
+            totals[i] = totals[i] + ValidationResult(float(v), int(n), methods[i].name)
+    return totals
 
 
 class Top1Accuracy(ValidationMethod):
@@ -136,9 +169,11 @@ class PrecisionRecallAUC(ValidationMethod):
     ``batch`` collects (scores, labels); ``result`` on the accumulated
     ValidationResult is not used — call :meth:`compute` over all batches,
     or use through ``Evaluator`` which sums the streamed trapezoid areas
-    per batch (approximation documented)."""
+    per batch (approximation documented). Host-side: Evaluator applies it
+    outside the jitted step (``jit_safe = False``)."""
 
     name = "PrecisionRecallAUC"
+    jit_safe = False
 
     def batch(self, output, target):
         import numpy as np
@@ -182,6 +217,8 @@ class MeanAveragePrecision(ValidationMethod):
     """Classification mAP over k classes (reference:
     ``MeanAveragePrecision``, ``ValidationMethod.scala:231``): average of
     per-class average precision, one-vs-rest by predicted score."""
+
+    jit_safe = False
 
     def __init__(self, k: int):
         self.k = k

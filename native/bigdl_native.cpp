@@ -110,13 +110,14 @@ int bigdl_ring_push(void* h, const uint8_t* data, uint64_t n) {
   return 0;
 }
 
-// returns payload size, 0 if closed-and-drained. Caller passes a buffer of
-// bigdl_ring_peek_size() bytes (call under the same single consumer).
+// returns payload size (>= 0; zero-length records are legal), or -1 if
+// closed-and-drained. Caller passes a buffer of bigdl_ring_peek_size()
+// bytes (call under the same single consumer).
 int64_t bigdl_ring_pop(void* h, uint8_t* out, uint64_t out_cap) {
   Ring* r = static_cast<Ring*>(h);
   std::unique_lock<std::mutex> lk(r->mu);
   r->not_empty.wait(lk, [&] { return !r->q.empty() || r->closed; });
-  if (r->q.empty()) return 0;
+  if (r->q.empty()) return -1;
   std::vector<uint8_t> buf = std::move(r->q.front());
   r->q.pop();
   lk.unlock();
@@ -126,11 +127,13 @@ int64_t bigdl_ring_pop(void* h, uint8_t* out, uint64_t out_cap) {
   return static_cast<int64_t>(buf.size());
 }
 
+// returns the front payload size (>= 0), or -1 if closed-and-drained —
+// distinct values so a legal zero-length record is not read as end-of-stream
 int64_t bigdl_ring_peek_size(void* h) {
   Ring* r = static_cast<Ring*>(h);
   std::unique_lock<std::mutex> lk(r->mu);
   r->not_empty.wait(lk, [&] { return !r->q.empty() || r->closed; });
-  if (r->q.empty()) return 0;
+  if (r->q.empty()) return -1;
   return static_cast<int64_t>(r->q.front().size());
 }
 

@@ -43,6 +43,79 @@ def test_ring_fifo_and_close():
     assert r.pop() is None
 
 
+@pytest.fixture
+def fallback_ring(monkeypatch):
+    """Force the pure-python queue fallback path."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_build_failed", True)
+    yield
+    # monkeypatch restores; next _load() re-binds the cached lib
+
+
+@pytest.mark.parametrize("use_fallback", [False, True])
+def test_ring_zero_length_record_is_not_eof(use_fallback, monkeypatch):
+    """A legal zero-length payload must not terminate the stream, and
+    close() must end it even on the fallback path (ADVICE round 1)."""
+    if use_fallback:
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_build_failed", True)
+    r = native.PrefetchRing(4)
+    r.push(b"a")
+    r.push(b"")
+    r.push(b"b")
+    r.close()
+    assert [r.pop(), r.pop(), r.pop(), r.pop()] == [b"a", b"", b"b", None]
+    assert not r.push(b"after-close")
+
+
+def test_fallback_ring_close_unblocks_consumer(fallback_ring):
+    r = native.PrefetchRing(2)
+    got = []
+
+    def consume():
+        while True:
+            item = r.pop()
+            if item is None:
+                return
+            got.append(item)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(10):
+        r.push(str(i).encode())
+    r.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "fallback consumer still blocked after close()"
+    assert [g.decode() for g in got] == [str(i) for i in range(10)]
+
+
+def test_fallback_ring_close_unblocks_producer(fallback_ring):
+    r = native.PrefetchRing(1)
+    assert r.push(b"fill")
+    result = {}
+
+    def produce():
+        result["pushed"] = r.push(b"blocked")
+
+    t = threading.Thread(target=produce)
+    t.start()
+    import time
+
+    time.sleep(0.1)  # let the producer block on the full ring
+    r.close()
+    t.join(timeout=5)
+    assert not t.is_alive(), "fallback producer still blocked after close()"
+    assert result["pushed"] is False
+
+
+def test_hflip_does_not_mutate_input():
+    x = np.arange(2 * 3 * 4 * 6, dtype=np.uint8).reshape(2, 3, 4, 6)
+    orig = x.copy()
+    out = native.hflip_u8(x)
+    assert (x == orig).all(), "hflip_u8 mutated its input"
+    assert (out == x[..., ::-1]).all()
+
+
 def test_ring_blocking_producer_consumer():
     r = native.PrefetchRing(2)
     got = []

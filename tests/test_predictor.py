@@ -65,6 +65,88 @@ def test_evaluator_counts_all_records(setup):
     assert abs(acc - expected) < 1e-6
 
 
+def test_evaluator_runs_host_side_metrics(setup):
+    """PRAUC / MAP run host-side numpy in .batch — Evaluator must apply
+    them outside the jitted eval step (ADVICE round 1: calling them inside
+    jit raised TracerArrayConversionError)."""
+    from bigdl_tpu.optim.validation import MeanAveragePrecision, PrecisionRecallAUC
+
+    model, params, state, x, y = setup
+    ev = Evaluator(model, params, state, batch_size=8)
+    res = ev.test(
+        DataSet.tensors(x, y),
+        [Top1Accuracy(), MeanAveragePrecision(4)],
+    )
+    assert [r.name for r in res] == ["Top1Accuracy", "MAP@4"]
+    for r in res:
+        v, n = r.result()
+        assert n == 37 and np.isfinite(v)
+
+    # PRAUC is binary: one score per sample
+    bin_model = Sequential().add(Linear(8, 1))
+    bp, bs = bin_model.init(jax.random.key(1))
+    yb = (y % 2).astype("float32")
+    bev = Evaluator(bin_model, bp, bs, batch_size=8)
+    (prauc,) = bev.test(DataSet.tensors(x, yb), [PrecisionRecallAUC()])
+    v, n = prauc.result()
+    assert n == 37 and 0.0 <= v <= 1.0
+
+
+def test_keras_evaluate_host_side_metric():
+    from bigdl_tpu import keras
+    from bigdl_tpu.optim.validation import MeanAveragePrecision
+
+    m = keras.Sequential()
+    m.add(keras.Dense(8, input_shape=(6,), activation="relu"))
+    m.add(keras.Dense(3, activation="log_softmax"))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              metrics=[MeanAveragePrecision(3)])
+    rs = np.random.RandomState(1)
+    x = rs.rand(20, 6).astype("float32")
+    y = rs.randint(0, 3, 20)
+    out = m.evaluate(x, y, batch_size=8)
+    names = [n for n, _ in out]
+    assert "MAP@3" in names
+    assert all(np.isfinite(v) for _, v in out)
+
+
+def test_optimizer_validation_with_host_side_metric(setup):
+    """Host-side metrics must also work in training-time validation
+    (Optimizer._build_eval_step), not just Evaluator/evaluate."""
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.optim.validation import MeanAveragePrecision
+
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+    model, _, _, x, y = setup
+    ds = DataSet.tensors(x, y) >> SampleToMiniBatch(8)
+    opt = LocalOptimizer(model, ds, ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    opt.set_validation(Trigger.several_iteration(1), DataSet.tensors(x, y),
+                       [Top1Accuracy(), MeanAveragePrecision(4)])
+    opt.set_end_when(Trigger.max_iteration(2))
+    opt.optimize()
+    results = opt._run_validation()
+    assert [r.name for r in results] == ["Top1Accuracy", "MAP@4"]
+    for r in results:
+        assert np.isfinite(r.result()[0])
+
+
+def test_duplicate_metric_names_accumulate_separately(setup):
+    from bigdl_tpu.nn import CrossEntropyCriterion
+
+    model, params, state, x, y = setup
+    ev = Evaluator(model, params, state, batch_size=8)
+    # both are named "Loss" but compute different values (the model emits
+    # log-probs; CrossEntropyCriterion applies its own log-softmax on top)
+    res = ev.test(DataSet.tensors(x, y),
+                  [Loss(ClassNLLCriterion()), Loss(CrossEntropyCriterion())])
+    v0, v1 = res[0].result()[0], res[1].result()[0]
+    assert v0 != v1, "two different Loss metrics were merged by name"
+
+
 def test_evaluator_requires_labels(setup):
     model, params, state, x, _ = setup
     ev = Evaluator(model, params, state)
