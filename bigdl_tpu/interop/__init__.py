@@ -1,0 +1,5 @@
+"""Interop tier: loaders/savers for foreign model formats.
+
+Reference: ``DL/utils/caffe/`` (Caffe bridge), ``DL/utils/tf/`` (TensorFlow
+GraphDef bridge), ``DL/nn/onnx`` + ``PY/contrib/onnx`` (ONNX ops/loader).
+"""

@@ -1,0 +1,8 @@
+"""Caffe bridge (reference: ``DL/utils/caffe/`` — CaffeLoader 2,995 LoC).
+
+``load_caffe(prototxt, caffemodel)`` -> (Graph, params, state);
+``save_caffe(model, params, state, prototxt, caffemodel)``.
+"""
+
+from bigdl_tpu.interop.caffe.loader import CaffeLoader, load_caffe  # noqa: F401
+from bigdl_tpu.interop.caffe.persister import CaffePersister, save_caffe  # noqa: F401

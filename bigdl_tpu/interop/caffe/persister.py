@@ -1,0 +1,251 @@
+"""Caffe model persister: (model, params, state) -> prototxt + caffemodel.
+
+Reference: ``DL/utils/caffe/CaffePersister.scala`` — walk the module graph,
+emit one caffe ``LayerParameter`` per module with its weight blobs, write
+the definition as text prototxt and the weights as a binary caffemodel.
+
+Supports the same module set the loader consumes, so
+``persist -> load`` round-trips: SpatialConvolution, Linear (with its
+implicit flatten), poolings, ReLU/Sigmoid/Tanh/Abs/Power, SoftMax,
+Dropout, SpatialCrossMapLRN, SpatialBatchNormalization (emitted as the
+caffe BatchNorm + Scale pair), CAdd/CMul/CMaxTable, JoinTable, Reshape,
+Identity. Containers (Sequential / Graph) are walked recursively.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.caffe import caffe_pb2 as pb
+from bigdl_tpu.nn.graph import Graph
+
+
+def _np(x):
+    return np.asarray(x, dtype=np.float32)
+
+
+def _add_blob(layer_msg, arr: np.ndarray):
+    blob = layer_msg.blobs.add()
+    blob.shape.dim.extend(int(d) for d in arr.shape)
+    blob.data.extend(_np(arr).reshape(-1).tolist())
+
+
+class CaffePersister:
+    """Reference ``CaffePersister.persist``."""
+
+    def __init__(self, model, params, state=None,
+                 input_shape: Optional[Tuple[int, ...]] = None):
+        self.model = model
+        self.params = params
+        self.state = state or {}
+        self.input_shape = input_shape
+
+    def persist(self, prototxt_path: str, caffemodel_path: str) -> None:
+        net = self.to_netparameter()
+        from google.protobuf import text_format
+
+        # prototxt carries the definition only (no blobs)
+        defn = pb.NetParameter()
+        defn.CopyFrom(net)
+        for layer in defn.layer:
+            del layer.blobs[:]
+        with open(prototxt_path, "w") as f:
+            f.write(text_format.MessageToString(defn))
+        with open(caffemodel_path, "wb") as f:
+            f.write(net.SerializeToString())
+
+    # ------------------------------------------------------------------
+    def to_netparameter(self) -> "pb.NetParameter":
+        net = pb.NetParameter(name=type(self.model).__name__)
+        inp = net.layer.add(name="data", type="Input", top=["data"])
+        if self.input_shape is not None:
+            inp.input_param.shape.add().dim.extend(int(d) for d in self.input_shape)
+        self._seq = 0
+        self._emit(self.model, self.params, self.state, net, "data")
+        return net
+
+    def _next_name(self, base: str) -> str:
+        self._seq += 1
+        return f"{base}{self._seq}"
+
+    def _emit(self, module, params, state, net, bottom: str) -> str:
+        """Emit layers for `module`; returns the top blob name."""
+        if isinstance(module, Graph):
+            return self._emit_graph(module, params, state, net, bottom)
+        if isinstance(module, nn.Sequential):
+            for name, child in module._modules.items():
+                bottom = self._emit(child, (params or {}).get(name, {}),
+                                    (state or {}).get(name, {}), net, bottom)
+            return bottom
+        return self._emit_leaf(module, params, state, net, [bottom])
+
+    def _emit_graph(self, graph: Graph, params, state, net, bottom: str) -> str:
+        if len(graph.inputs) != 1:
+            raise ValueError("caffe export supports single-input graphs")
+        tops = {id(graph.inputs[0]): bottom}
+        for node in graph._topo:
+            if node.element is None:
+                continue
+            name = graph._names[id(node)]
+            bottoms = [tops[id(p)] for p in node.prev]
+            top = self._emit_leaf(node.element, (params or {}).get(name, {}),
+                                  (state or {}).get(name, {}), net, bottoms,
+                                  preferred_name=name)
+            tops[id(node)] = top
+        return tops[id(graph.outputs[0])]
+
+    def _emit_leaf(self, m, p, s, net, bottoms: List[str],
+                   preferred_name: Optional[str] = None) -> str:
+        p = p or {}
+        s = s or {}
+
+        def add(type_: str, base: str, n_bottom=1):
+            name = preferred_name or self._next_name(base)
+            layer = net.layer.add(name=name, type=type_,
+                                  bottom=bottoms[:n_bottom] if n_bottom else bottoms,
+                                  top=[name])
+            return name, layer
+
+        if isinstance(m, nn.Sequential):
+            bottom = bottoms[0]
+            for cname, child in m._modules.items():
+                bottom = self._emit(child, p.get(cname, {}), s.get(cname, {}), net, bottom)
+            return bottom
+
+        if isinstance(m, Graph):
+            return self._emit_graph(m, p, s, net, bottoms[0])
+
+        if type(m) is nn.SpatialConvolution:
+            name, layer = add("Convolution", "conv")
+            cp = layer.convolution_param
+            cp.num_output = m.n_output_plane
+            kh, kw = m.kernel
+            sh, sw = m.stride
+            ph, pw = m.pad
+            cp.kernel_h, cp.kernel_w = kh, kw
+            cp.stride_h, cp.stride_w = sh, sw
+            cp.pad_h, cp.pad_w = max(ph, 0), max(pw, 0)
+            cp.group = m.n_group
+            cp.bias_term = m.with_bias
+            _add_blob(layer, _np(p["weight"]))
+            if m.with_bias:
+                _add_blob(layer, _np(p["bias"]))
+            return name
+
+        if type(m) is nn.Linear:
+            name, layer = add("InnerProduct", "fc")
+            ip = layer.inner_product_param
+            ip.num_output = m.output_size
+            ip.bias_term = m.with_bias
+            _add_blob(layer, _np(p["weight"]))
+            if m.with_bias:
+                _add_blob(layer, _np(p["bias"]))
+            return name
+
+        if isinstance(m, nn.SpatialMaxPooling) or isinstance(m, nn.SpatialAveragePooling):
+            name, layer = add("Pooling", "pool")
+            pp = layer.pooling_param
+            pp.pool = (pb.PoolingParameter.AVE
+                       if isinstance(m, nn.SpatialAveragePooling)
+                       else pb.PoolingParameter.MAX)
+            kh, kw = m.kernel
+            sh, sw = m.stride
+            ph, pw = m.pad
+            pp.kernel_h, pp.kernel_w = kh, kw
+            pp.stride_h, pp.stride_w = sh, sw
+            pp.pad_h, pp.pad_w = ph, pw
+            if not m.ceil_mode:  # caffe defaults to ceil; record floor mode
+                pp.round_mode = pb.PoolingParameter.FLOOR
+            return name
+
+        if isinstance(m, nn.GlobalAveragePooling2D):
+            name, layer = add("Pooling", "pool")
+            layer.pooling_param.pool = pb.PoolingParameter.AVE
+            layer.pooling_param.global_pooling = True
+            return name
+        if isinstance(m, nn.GlobalMaxPooling2D):
+            name, layer = add("Pooling", "pool")
+            layer.pooling_param.global_pooling = True
+            return name
+
+        if isinstance(m, nn.SpatialBatchNormalization):
+            # caffe convention: BatchNorm (stats) + Scale (affine)
+            bn_name, bn = add("BatchNorm", "bn")
+            bn.batch_norm_param.use_global_stats = True
+            bn.batch_norm_param.eps = float(m.eps)
+            _add_blob(bn, _np(s.get("running_mean", np.zeros(m.n_output))))
+            _add_blob(bn, _np(s.get("running_var", np.ones(m.n_output))))
+            _add_blob(bn, np.asarray([1.0], np.float32))  # scale factor
+            if m.affine:
+                sc = net.layer.add(name=bn_name + "_scale", type="Scale",
+                                   bottom=[bn_name], top=[bn_name + "_scale"])
+                sc.scale_param.bias_term = True
+                _add_blob(sc, _np(p["weight"]))
+                _add_blob(sc, _np(p["bias"]))
+                return bn_name + "_scale"
+            return bn_name
+
+        if isinstance(m, nn.SpatialCrossMapLRN):
+            name, layer = add("LRN", "lrn")
+            lp = layer.lrn_param
+            lp.local_size = int(m.size)
+            lp.alpha = float(m.alpha)
+            lp.beta = float(m.beta)
+            lp.k = float(m.k)
+            return name
+
+        if isinstance(m, nn.Dropout):
+            name, layer = add("Dropout", "drop")
+            layer.dropout_param.dropout_ratio = float(m.p)
+            return name
+
+        simple = {
+            nn.ReLU: "ReLU", nn.Sigmoid: "Sigmoid", nn.Tanh: "TanH",
+            nn.Abs: "AbsVal", nn.SoftMax: "Softmax", nn.Identity: "Split",
+        }
+        for cls, caffe_type in simple.items():
+            if type(m) is cls:
+                name, _ = add(caffe_type, caffe_type.lower())
+                return name
+
+        if isinstance(m, nn.Power):
+            name, layer = add("Power", "power")
+            layer.power_param.power = float(m.power)
+            layer.power_param.scale = float(m.scale)
+            layer.power_param.shift = float(m.shift)
+            return name
+
+        if isinstance(m, nn.CAddTable):
+            name, _ = add("Eltwise", "add", n_bottom=None)
+            return name
+        if isinstance(m, nn.CMulTable):
+            name, layer = add("Eltwise", "mul", n_bottom=None)
+            layer.eltwise_param.operation = pb.EltwiseParameter.PROD
+            return name
+        if isinstance(m, nn.CMaxTable):
+            name, layer = add("Eltwise", "max", n_bottom=None)
+            layer.eltwise_param.operation = pb.EltwiseParameter.MAX
+            return name
+        if isinstance(m, nn.JoinTable):
+            name, layer = add("Concat", "concat", n_bottom=None)
+            layer.concat_param.axis = int(m.dimension)
+            return name
+
+        if isinstance(m, nn.Reshape):
+            name, layer = add("Reshape", "reshape")
+            layer.reshape_param.shape.dim.append(0)  # keep batch
+            layer.reshape_param.shape.dim.extend(int(d) for d in m.size)
+            return name
+
+        raise ValueError(
+            f"caffe export does not support module type {type(m).__name__}"
+        )
+
+
+def save_caffe(model, params, state, prototxt_path: str, caffemodel_path: str,
+               input_shape: Optional[Tuple[int, ...]] = None) -> None:
+    CaffePersister(model, params, state, input_shape).persist(
+        prototxt_path, caffemodel_path)

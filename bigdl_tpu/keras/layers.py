@@ -660,7 +660,7 @@ class GlobalAveragePooling1D(KerasLayer):
 
 class GlobalMaxPooling2D(KerasLayer):
     def build(self, input_shape):
-        return LambdaLayer(lambda x: jnp.max(x, axis=(2, 3)))
+        return L.GlobalMaxPooling2D()
 
     def compute_output_shape(self, input_shape):
         return (input_shape[0],)

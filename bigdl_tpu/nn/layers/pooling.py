@@ -130,3 +130,15 @@ class GlobalAveragePooling2D(Module):
 
     def forward(self, ctx: Context, x):
         return x.mean(axis=self.axes)
+
+
+class GlobalMaxPooling2D(Module):
+    """Max over spatial dims (keras ``GlobalMaxPooling2D``; also the
+    caffe ``Pooling(global_pooling=true, pool=MAX)`` mapping)."""
+
+    def __init__(self, data_format="NCHW"):
+        super().__init__()
+        self.axes = (2, 3) if data_format == "NCHW" else (1, 2)
+
+    def forward(self, ctx: Context, x):
+        return x.max(axis=self.axes)

@@ -1,0 +1,245 @@
+"""Caffe bridge tests (reference: ``DL/utils/caffe/CaffeLoader.scala``,
+``CaffePersister.scala``; reference tests load fixture prototxts from
+``spark/dl/src/test/resources/caffe``).
+
+The round-trip strategy replaces the reference's live-Caffe oracle: persist
+a randomly-initialized model to prototxt+caffemodel, reload through the
+loader, and require numerically identical predictions.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.caffe import CaffeLoader, load_caffe, save_caffe
+from bigdl_tpu.models import vgg
+
+
+def _predict(model, params, state, x):
+    out, _ = model.apply(params, jax.numpy.asarray(x), state=state, training=False)
+    return np.asarray(out)
+
+
+@pytest.fixture(scope="module")
+def small_net():
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(8),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(8, 12, 3, 3, 1, 1, 1, 1, n_group=2),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0),
+        nn.Dropout(0.4),
+        nn.Linear(12 * 8 * 8, 10),
+    )
+    # Linear needs flattened input; mirror caffe's implicit flatten
+    model = nn.Sequential(*list(model._modules.values())[:-1]) \
+        .add(nn.Reshape([12 * 8 * 8])).add(nn.Linear(12 * 8 * 8, 10)) \
+        .add(nn.SoftMax())
+    params, state = model.init(jax.random.key(7))
+    # non-trivial running stats so the BatchNorm path is actually exercised
+    rs = np.random.RandomState(3)
+    state = dict(state)
+    bn_key = [k for k in state if "BatchNorm" in k or k == "1"][0]
+    state[bn_key] = {
+        "running_mean": rs.randn(8).astype("float32") * 0.1,
+        "running_var": (rs.rand(8).astype("float32") * 0.5 + 0.5),
+    }
+    return model, params, state
+
+
+def test_roundtrip_small_net(tmp_path, small_net):
+    model, params, state, = small_net
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 16, 16).astype("float32")
+    want = _predict(model, params, state, x)
+
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    save_caffe(model, params, state, proto, weights, input_shape=(1, 3, 16, 16))
+
+    graph, gparams, gstate = load_caffe(proto, weights)
+    got = _predict(graph, gparams, gstate, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_prototxt_text_format_parses(tmp_path, small_net):
+    model, params, state = small_net
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    save_caffe(model, params, state, proto, weights, input_shape=(1, 3, 16, 16))
+    text = open(proto).read()
+    assert "Convolution" in text and "blobs" not in text
+    net = CaffeLoader.parse_prototxt(proto)
+    assert net.layer[0].type == "Input"
+    # definition-only load (random weights) must still build the graph
+    graph, p, s = load_caffe(proto)
+    out = _predict(graph, p, s, np.zeros((1, 3, 16, 16), "float32"))
+    assert out.shape == (1, 10)
+
+
+def test_eltwise_concat_graph_roundtrip(tmp_path):
+    """Graph export/import with fan-out, Eltwise SUM and Concat."""
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    inp = Input()
+    c1 = Node(nn.SpatialConvolution(4, 6, 1, 1).set_name("branch_a"), [inp])
+    c2 = Node(nn.SpatialConvolution(4, 6, 1, 1).set_name("branch_b"), [inp])
+    add = Node(nn.CAddTable().set_name("sum"), [c1, c2])
+    cat = Node(nn.JoinTable(1).set_name("cat"), [add, c1])
+    out = Node(nn.ReLU().set_name("out_relu"), [cat])
+    g = Graph(inp, out)
+    params, state = g.init(jax.random.key(1))
+
+    rs = np.random.RandomState(5)
+    x = rs.rand(2, 4, 5, 5).astype("float32")
+    want = _predict(g, params, state, x)
+
+    proto = str(tmp_path / "g.prototxt")
+    weights = str(tmp_path / "g.caffemodel")
+    save_caffe(g, params, state, proto, weights, input_shape=(1, 4, 5, 5))
+    g2, p2, s2 = load_caffe(proto, weights)
+    got = _predict(g2, p2, s2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_v1_legacy_layers_load(tmp_path):
+    """V1LayerParameter nets (enum-typed `layers`) must load too
+    (reference ``V1LayerConverter``)."""
+    from bigdl_tpu.interop.caffe import caffe_pb2 as pb
+
+    net = pb.NetParameter(name="legacy")
+    net.input.append("data")
+    net.input_dim.extend([1, 2, 6, 6])
+    conv = net.layers.add(name="c1", type=pb.V1LayerParameter.CONVOLUTION,
+                          bottom=["data"], top=["c1"])
+    conv.convolution_param.num_output = 3
+    conv.convolution_param.kernel_size.append(3)
+    w = np.arange(3 * 2 * 3 * 3, dtype=np.float32).reshape(3, 2, 3, 3) * 0.01
+    blob = conv.blobs.add()
+    blob.num, blob.channels, blob.height, blob.width = 3, 2, 3, 3  # legacy dims
+    blob.data.extend(w.reshape(-1).tolist())
+    blob2 = conv.blobs.add()
+    blob2.num = blob2.channels = blob2.height = 1
+    blob2.width = 3
+    blob2.data.extend([0.1, 0.2, 0.3])
+    net.layers.add(name="r1", type=pb.V1LayerParameter.RELU,
+                   bottom=["c1"], top=["c1"])
+
+    proto = str(tmp_path / "v1.prototxt")
+    weights = str(tmp_path / "v1.caffemodel")
+    from google.protobuf import text_format
+    with open(proto, "w") as f:
+        f.write(text_format.MessageToString(net))
+    with open(weights, "wb") as f:
+        f.write(net.SerializeToString())
+
+    g, p, s = load_caffe(proto, weights)
+    x = np.random.RandomState(0).rand(1, 2, 6, 6).astype("float32")
+    out = _predict(g, p, s, x)
+    assert out.shape == (1, 3, 4, 4)
+    # weights really came from the caffemodel
+    np.testing.assert_allclose(np.asarray(p["c1"]["weight"]), w, rtol=1e-6)
+    assert (out >= 0).all()  # in-place ReLU applied
+
+
+def test_floor_mode_pooling_roundtrips(tmp_path):
+    """Floor-mode pooling must survive persist->load (round_mode=FLOOR);
+    caffe's default is ceil."""
+    model = nn.Sequential(nn.SpatialMaxPooling(3, 3, 2, 2))  # floor by default
+    params, state = model.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(1, 2, 8, 8).astype("float32")
+    want = _predict(model, params, state, x)
+    assert want.shape == (1, 2, 3, 3)
+
+    proto = str(tmp_path / "p.prototxt")
+    weights = str(tmp_path / "p.caffemodel")
+    save_caffe(model, params, state, proto, weights, input_shape=(1, 2, 8, 8))
+    g, p, s = load_caffe(proto, weights)
+    got = _predict(g, p, s, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want)
+
+
+def test_anisotropic_kernel_and_dilation(tmp_path):
+    from google.protobuf import text_format
+
+    from bigdl_tpu.interop.caffe import caffe_pb2 as pb
+
+    net = pb.NetParameter(name="aniso")
+    inp = net.layer.add(name="data", type="Input", top=["data"])
+    inp.input_param.shape.add().dim.extend([1, 2, 9, 9])
+    c = net.layer.add(name="c", type="Convolution", bottom=["data"], top=["c"])
+    c.convolution_param.num_output = 3
+    c.convolution_param.kernel_size.extend([3, 5])  # kh=3, kw=5
+    d = net.layer.add(name="d", type="Convolution", bottom=["c"], top=["d"])
+    d.convolution_param.num_output = 3
+    d.convolution_param.kernel_size.append(3)
+    d.convolution_param.dilation.append(2)
+
+    proto = str(tmp_path / "a.prototxt")
+    with open(proto, "w") as f:
+        f.write(text_format.MessageToString(net))
+    g, p, s = load_caffe(proto)
+    assert p["c"]["weight"].shape == (3, 2, 3, 5)
+    x = np.zeros((1, 2, 9, 9), "float32")
+    out = _predict(g, p, s, x)
+    # c: (9-3+1, 9-5+1) = (7, 5); d dilated 3x3 (eff 5): (3, 1)
+    assert out.shape == (1, 3, 3, 1)
+
+
+def test_standalone_scale_layer(tmp_path):
+    from google.protobuf import text_format
+
+    from bigdl_tpu.interop.caffe import caffe_pb2 as pb
+
+    net = pb.NetParameter(name="scalenet")
+    inp = net.layer.add(name="data", type="Input", top=["data"])
+    inp.input_param.shape.add().dim.extend([1, 3, 4, 4])
+    sc = net.layer.add(name="sc", type="Scale", bottom=["data"], top=["sc"])
+    sc.scale_param.bias_term = True
+    gamma = np.asarray([2.0, 3.0, 4.0], np.float32)
+    beta = np.asarray([0.5, -0.5, 0.0], np.float32)
+    for arr in (gamma, beta):
+        blob = sc.blobs.add()
+        blob.shape.dim.append(3)
+        blob.data.extend(arr.tolist())
+
+    proto = str(tmp_path / "s.prototxt")
+    weights = str(tmp_path / "s.caffemodel")
+    with open(proto, "w") as f:
+        f.write(text_format.MessageToString(net))
+    with open(weights, "wb") as f:
+        f.write(net.SerializeToString())
+    g, p, s = load_caffe(proto, weights)
+    x = np.ones((1, 3, 4, 4), "float32")
+    out = _predict(g, p, s, x)
+    np.testing.assert_allclose(out[0, :, 0, 0], gamma + beta, rtol=1e-6)
+
+
+def test_vgg16_caffe_roundtrip(tmp_path):
+    """The BASELINE 'VGG-16 Caffe-loaded inference' config: persist our
+    VGG-16 (width-reduced for CPU test speed via the same builder code
+    path), reload from caffemodel, predictions must agree exactly."""
+    model = vgg.build_vgg16(class_num=10)
+    params, state = model.init(jax.random.key(0))
+
+    proto = str(tmp_path / "vgg16.prototxt")
+    weights = str(tmp_path / "vgg16.caffemodel")
+    save_caffe(model, params, state, proto, weights, input_shape=(1, 3, 224, 224))
+
+    net = CaffeLoader.parse_prototxt(proto)
+    conv_layers = [l for l in net.layer if l.type == "Convolution"]
+    fc_layers = [l for l in net.layer if l.type == "InnerProduct"]
+    pools = [l for l in net.layer if l.type == "Pooling"]
+    assert len(conv_layers) == 13 and len(fc_layers) == 3 and len(pools) == 5
+
+    graph, gparams, gstate = load_caffe(proto, weights)
+    rs = np.random.RandomState(1)
+    x = rs.rand(1, 3, 224, 224).astype("float32")
+    want = _predict(model, params, state, x)
+    got = _predict(graph, gparams, gstate, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert int(np.argmax(got)) == int(np.argmax(want))
