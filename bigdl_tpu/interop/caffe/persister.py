@@ -59,46 +59,24 @@ class CaffePersister:
 
     # ------------------------------------------------------------------
     def to_netparameter(self) -> "pb.NetParameter":
+        from bigdl_tpu.interop.walker import walk_model
+
         net = pb.NetParameter(name=type(self.model).__name__)
         inp = net.layer.add(name="data", type="Input", top=["data"])
         if self.input_shape is not None:
             inp.input_param.shape.add().dim.extend(int(d) for d in self.input_shape)
         self._seq = 0
-        self._emit(self.model, self.params, self.state, net, "data")
+        self._net = net
+        walk_model(self.model, self.params, self.state, "data", self._emit_leaf)
         return net
 
     def _next_name(self, base: str) -> str:
         self._seq += 1
         return f"{base}{self._seq}"
 
-    def _emit(self, module, params, state, net, bottom: str) -> str:
-        """Emit layers for `module`; returns the top blob name."""
-        if isinstance(module, Graph):
-            return self._emit_graph(module, params, state, net, bottom)
-        if isinstance(module, nn.Sequential):
-            for name, child in module._modules.items():
-                bottom = self._emit(child, (params or {}).get(name, {}),
-                                    (state or {}).get(name, {}), net, bottom)
-            return bottom
-        return self._emit_leaf(module, params, state, net, [bottom])
-
-    def _emit_graph(self, graph: Graph, params, state, net, bottom: str) -> str:
-        if len(graph.inputs) != 1:
-            raise ValueError("caffe export supports single-input graphs")
-        tops = {id(graph.inputs[0]): bottom}
-        for node in graph._topo:
-            if node.element is None:
-                continue
-            name = graph._names[id(node)]
-            bottoms = [tops[id(p)] for p in node.prev]
-            top = self._emit_leaf(node.element, (params or {}).get(name, {}),
-                                  (state or {}).get(name, {}), net, bottoms,
-                                  preferred_name=name)
-            tops[id(node)] = top
-        return tops[id(graph.outputs[0])]
-
-    def _emit_leaf(self, m, p, s, net, bottoms: List[str],
+    def _emit_leaf(self, m, p, s, bottoms: List[str],
                    preferred_name: Optional[str] = None) -> str:
+        net = self._net
         p = p or {}
         s = s or {}
 
@@ -108,15 +86,6 @@ class CaffePersister:
                                   bottom=bottoms[:n_bottom] if n_bottom else bottoms,
                                   top=[name])
             return name, layer
-
-        if isinstance(m, nn.Sequential):
-            bottom = bottoms[0]
-            for cname, child in m._modules.items():
-                bottom = self._emit(child, p.get(cname, {}), s.get(cname, {}), net, bottom)
-            return bottom
-
-        if isinstance(m, Graph):
-            return self._emit_graph(m, p, s, net, bottoms[0])
 
         if type(m) is nn.SpatialConvolution:
             name, layer = add("Convolution", "conv")

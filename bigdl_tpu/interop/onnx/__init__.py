@@ -1,0 +1,9 @@
+"""ONNX bridge (reference: ``DL/nn/onnx/`` + ``PY/contrib/onnx``).
+
+``load_onnx(path)`` -> (ONNXModule, params, state);
+``save_onnx(model, params, state, path)``; module ops in ``ops``.
+"""
+
+from bigdl_tpu.interop.onnx.loader import ONNXModule, load_onnx  # noqa: F401
+from bigdl_tpu.interop.onnx.exporter import ONNXExporter, save_onnx  # noqa: F401
+from bigdl_tpu.interop.onnx.ops import Gemm, Reshape, Shape  # noqa: F401

@@ -1,0 +1,159 @@
+"""ONNX exporter: (model, params, state) -> .onnx.
+
+Reference: the ONNX direction the reference lacks an exporter for; coverage
+mirrors the TF/Caffe persisters so the three interop tiers stay in sync.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.onnx import onnx_pb2 as pb
+from bigdl_tpu.interop.onnx.loader import numpy_to_tensor
+from bigdl_tpu.nn.graph import Graph
+
+
+class ONNXExporter:
+    def __init__(self, model, params, state=None):
+        self.model = model
+        self.params = params
+        self.state = state or {}
+        self.g = pb.GraphProto(name=type(model).__name__)
+        self._seq = 0
+
+    def _name(self, base):
+        self._seq += 1
+        return f"{base}_{self._seq}"
+
+    def _init(self, arr, base) -> str:
+        name = self._name(base)
+        self.g.initializer.append(numpy_to_tensor(np.asarray(arr, np.float32), name))
+        return name
+
+    def _init_i64(self, vals, base) -> str:
+        name = self._name(base)
+        self.g.initializer.append(
+            numpy_to_tensor(np.asarray(vals, np.int64), name))
+        return name
+
+    def _node(self, op, inputs, base, **attrs) -> str:
+        out = self._name(base)
+        node = self.g.node.add(op_type=op, name=out)
+        node.input.extend(inputs)
+        node.output.append(out)
+        for k, v in attrs.items():
+            a = node.attribute.add(name=k)
+            if isinstance(v, float):
+                a.type = pb.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, int):
+                a.type = pb.AttributeProto.INT
+                a.i = v
+            elif isinstance(v, (list, tuple)):
+                a.type = pb.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            elif isinstance(v, str):
+                a.type = pb.AttributeProto.STRING
+                a.s = v.encode()
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        return out
+
+    def save(self, path: str, input_shape: Optional[Tuple[int, ...]] = None):
+        from bigdl_tpu.interop.walker import walk_model
+
+        vi = self.g.input.add(name="input")
+        vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+        if input_shape:
+            for d in input_shape:
+                vi.type.tensor_type.shape.dim.add().dim_value = d
+        out = walk_model(self.model, self.params, self.state, "input",
+                         self._emit_leaf)
+        self.g.output.add(name=out).type.tensor_type.elem_type = pb.TensorProto.FLOAT
+        model = pb.ModelProto(ir_version=8, producer_name="bigdl_tpu", graph=self.g)
+        model.opset_import.add(domain="", version=13)
+        with open(path, "wb") as f:
+            f.write(model.SerializeToString())
+
+    def _emit_leaf(self, m, p, s, ins: List[str], name=None) -> str:
+        x = ins[0] if ins else None
+
+        if type(m) is nn.Linear:
+            w = self._init(p["weight"], "weight")  # (out, in), transB=1
+            inputs = [x, w]
+            if m.with_bias:
+                inputs.append(self._init(p["bias"], "bias"))
+            return self._node("Gemm", inputs, "gemm", transB=1)
+
+        if type(m) is nn.SpatialConvolution:
+            w = self._init(p["weight"], "weight")  # OIHW — onnx native
+            inputs = [x, w]
+            if m.with_bias:
+                inputs.append(self._init(p["bias"], "bias"))
+            kh, kw = m.kernel
+            sh, sw = m.stride
+            ph, pw = m.pad
+            return self._node("Conv", inputs, "conv",
+                              kernel_shape=[kh, kw], strides=[sh, sw],
+                              pads=[ph, pw, ph, pw], group=m.n_group)
+
+        if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            if m.ceil_mode:
+                raise ValueError("onnx export: ceil-mode pooling unsupported")
+            kh, kw = m.kernel
+            sh, sw = m.stride
+            ph, pw = m.pad
+            if isinstance(m, nn.SpatialMaxPooling):
+                return self._node("MaxPool", [x], "maxpool",
+                                  kernel_shape=[kh, kw], strides=[sh, sw],
+                                  pads=[ph, pw, ph, pw])
+            return self._node("AveragePool", [x], "averagepool",
+                              kernel_shape=[kh, kw], strides=[sh, sw],
+                              pads=[ph, pw, ph, pw],
+                              count_include_pad=int(m.count_include_pad))
+
+        if isinstance(m, nn.SpatialBatchNormalization):
+            mean = np.asarray(s["running_mean"])
+            var = np.asarray(s["running_var"])
+            gamma = np.asarray(p["weight"]) if m.affine else np.ones_like(mean)
+            beta = np.asarray(p["bias"]) if m.affine else np.zeros_like(mean)
+            return self._node(
+                "BatchNormalization",
+                [x, self._init(gamma, "gamma"), self._init(beta, "beta"),
+                 self._init(mean, "mean"), self._init(var, "var")],
+                "bn", epsilon=float(m.eps))
+
+        if isinstance(m, nn.GlobalAveragePooling2D):
+            y = self._node("GlobalAveragePool", [x], "gap")
+            return self._node("Flatten", [y], "flatten", axis=1)
+
+        if isinstance(m, nn.Reshape):
+            shape = self._init_i64([0] + list(m.size), "shape")
+            return self._node("Reshape", [x, shape], "reshape")
+
+        if isinstance(m, (nn.Dropout, nn.Identity)):
+            return self._node("Identity", [x], "identity")
+
+        simple = {nn.ReLU: "Relu", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
+                  nn.SoftMax: "Softmax", nn.LogSoftMax: "LogSoftmax"}
+        for cls, op in simple.items():
+            if type(m) is cls:
+                return self._node(op, [x], op.lower())
+
+        if isinstance(m, nn.CAddTable):
+            out = ins[0]
+            for other in ins[1:]:
+                out = self._node("Add", [out, other], "add")
+            return out
+        if isinstance(m, nn.JoinTable):
+            return self._node("Concat", ins, "concat", axis=int(m.dimension))
+
+        raise ValueError(f"onnx export does not support {type(m).__name__}")
+
+
+def save_onnx(model, params, state, path: str,
+              input_shape: Optional[Tuple[int, ...]] = None) -> None:
+    ONNXExporter(model, params, state).save(path, input_shape)

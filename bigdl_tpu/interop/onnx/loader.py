@@ -1,0 +1,289 @@
+"""ONNX model importer.
+
+Reference: ``PY/contrib/onnx/onnx_loader.py`` (node-by-node mapping) and
+``DL/nn/onnx/`` (Gemm / Reshape / Shape modules). Same functional design
+as the TF importer: each ONNX node lowers to a jnp/lax expression inside
+one pure Module; initializers become params.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.interop.onnx import onnx_pb2 as pb
+from bigdl_tpu.nn.module import Context, Module
+
+_NP_DTYPES = {
+    pb.TensorProto.FLOAT: np.float32,
+    pb.TensorProto.DOUBLE: np.float64,
+    pb.TensorProto.INT32: np.int32,
+    pb.TensorProto.INT64: np.int64,
+    pb.TensorProto.INT8: np.int8,
+    pb.TensorProto.UINT8: np.uint8,
+    pb.TensorProto.BOOL: np.bool_,
+    pb.TensorProto.FLOAT16: np.float16,
+}
+
+
+def tensor_to_numpy(t: "pb.TensorProto") -> np.ndarray:
+    dt = _NP_DTYPES.get(t.data_type)
+    if dt is None:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.data_type}")
+    dims = [int(d) for d in t.dims]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(dims)
+    if t.data_type == pb.TensorProto.FLOAT16 and len(t.int32_data):
+        # spec: fp16 typed data is stored as uint16 BIT PATTERNS in
+        # int32_data — reinterpret, don't value-cast
+        bits = np.asarray(list(t.int32_data), dtype=np.uint16)
+        return bits.view(np.float16).reshape(dims)
+    for field in ("float_data", "int32_data", "int64_data", "double_data"):
+        vals = getattr(t, field)
+        if len(vals):
+            return np.asarray(list(vals), dtype=dt).reshape(dims)
+    return np.zeros(dims, dtype=dt)
+
+
+def numpy_to_tensor(arr: np.ndarray, name: str = "") -> "pb.TensorProto":
+    arr = np.asarray(arr)
+    rev = {v: k for k, v in _NP_DTYPES.items()}
+    t = pb.TensorProto(name=name, data_type=rev[arr.dtype.type])
+    t.dims.extend(int(d) for d in arr.shape)
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _attrs(node) -> Dict[str, object]:
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == pb.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.TENSOR:
+            out[a.name] = tensor_to_numpy(a.t)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = [float(v) for v in a.floats]
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = [int(v) for v in a.ints]
+    return out
+
+
+def _conv(inp, attrs):
+    x, w = inp[0], inp[1]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])  # [top, left, bottom, right]
+    dil = attrs.get("dilations", [1, 1])
+    group = attrs.get("group", 1)
+    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
+        pad = attrs["auto_pad"].replace("_UPPER", "").replace("_LOWER", "")
+        padding = "SAME" if pad == "SAME" else "VALID"
+    else:
+        padding = [(pads[0], pads[2]), (pads[1], pads[3])]
+    y = lax.conv_general_dilated(
+        x, w, tuple(strides), padding, rhs_dilation=tuple(dil),
+        feature_group_count=group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if len(inp) > 2 and inp[2] is not None:
+        y = y + inp[2][None, :, None, None]
+    return y
+
+
+def _gemm(inp, attrs):
+    """Reference module: ``DL/nn/onnx/Gemm.scala`` — alpha*A'B' + beta*C."""
+    a, b = inp[0], inp[1]
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    y = attrs.get("alpha", 1.0) * (a @ b)
+    if len(inp) > 2 and inp[2] is not None:
+        y = y + attrs.get("beta", 1.0) * inp[2]
+    return y
+
+
+def _pool(inp, attrs, reducer, init, is_avg=False):
+    (x,) = inp
+    k = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * len(k))
+    pads = attrs.get("pads", [0] * 2 * len(k))
+    n = len(k)
+    window = (1, 1) + tuple(k)
+    stride = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((pads[i], pads[i + n]) for i in range(n))
+    s = lax.reduce_window(x, init, reducer, window, stride, pad)
+    if is_avg:
+        if attrs.get("count_include_pad", 0):
+            return s / float(np.prod(k))
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride, pad)
+        return s / cnt
+    return s
+
+
+def _batch_norm(inp, attrs):
+    x, scale, b, mean, var = inp
+    eps = attrs.get("epsilon", 1e-5)
+    inv = lax.rsqrt(var + eps) * scale
+    sh = [1, -1] + [1] * (x.ndim - 2)
+    return x * inv.reshape(sh) + (b - mean * inv).reshape(sh)
+
+
+def _slice(inp, attrs):
+    x = inp[0]
+    if len(inp) > 1:  # opset 10+: starts/ends/axes/steps as inputs
+        starts = np.asarray(inp[1]).tolist()
+        ends = np.asarray(inp[2]).tolist()
+        axes = (np.asarray(inp[3]).tolist()
+                if len(inp) > 3 and inp[3] is not None else list(range(len(starts))))
+        steps = (np.asarray(inp[4]).tolist()
+                 if len(inp) > 4 and inp[4] is not None else [1] * len(starts))
+    else:
+        starts = attrs["starts"]
+        ends = attrs["ends"]
+        axes = attrs.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        idx[int(ax)] = slice(int(st), None if en >= 2**31 - 1 else int(en), int(sp))
+    return x[tuple(idx)]
+
+
+_OPS: Dict[str, Callable] = {
+    "Conv": _conv,
+    "Gemm": _gemm,
+    "MatMul": lambda i, a: jnp.matmul(i[0], i[1]),
+    "Add": lambda i, a: i[0] + i[1],
+    "Sub": lambda i, a: i[0] - i[1],
+    "Mul": lambda i, a: i[0] * i[1],
+    "Div": lambda i, a: i[0] / i[1],
+    "Pow": lambda i, a: i[0] ** i[1],
+    "Neg": lambda i, a: -i[0],
+    "Sqrt": lambda i, a: jnp.sqrt(i[0]),
+    "Exp": lambda i, a: jnp.exp(i[0]),
+    "Log": lambda i, a: jnp.log(i[0]),
+    "Abs": lambda i, a: jnp.abs(i[0]),
+    "Relu": lambda i, a: jax.nn.relu(i[0]),
+    "LeakyRelu": lambda i, a: jax.nn.leaky_relu(i[0], a.get("alpha", 0.01)),
+    "Sigmoid": lambda i, a: jax.nn.sigmoid(i[0]),
+    "Tanh": lambda i, a: jnp.tanh(i[0]),
+    "Elu": lambda i, a: jax.nn.elu(i[0], a.get("alpha", 1.0)),
+    "Softmax": lambda i, a: jax.nn.softmax(i[0], axis=a.get("axis", -1)),
+    "LogSoftmax": lambda i, a: jax.nn.log_softmax(i[0], axis=a.get("axis", -1)),
+    "Clip": lambda i, a: jnp.clip(
+        i[0],
+        i[1] if len(i) > 1 and i[1] is not None else a.get("min"),
+        i[2] if len(i) > 2 and i[2] is not None else a.get("max")),
+    "MaxPool": lambda i, a: _pool(i, a, lax.max, -jnp.inf),
+    "AveragePool": lambda i, a: _pool(i, a, lax.add, 0.0, is_avg=True),
+    "GlobalAveragePool": lambda i, a: jnp.mean(i[0], axis=(2, 3), keepdims=True),
+    "GlobalMaxPool": lambda i, a: jnp.max(i[0], axis=(2, 3), keepdims=True),
+    "BatchNormalization": _batch_norm,
+    "Flatten": lambda i, a: i[0].reshape(
+        int(np.prod(i[0].shape[:a.get("axis", 1)])), -1),
+    "Reshape": lambda i, a: jnp.reshape(
+        i[0], _resolve_reshape(i[0], np.asarray(i[1]).tolist())),
+    "Shape": lambda i, a: jnp.asarray(i[0].shape, jnp.int64),
+    "Squeeze": lambda i, a: jnp.squeeze(
+        i[0], axis=tuple(a.get("axes", [])) or None),
+    "Unsqueeze": lambda i, a: _unsqueeze(i[0], a.get(
+        "axes", np.asarray(i[1]).tolist() if len(i) > 1 else [])),
+    "Transpose": lambda i, a: jnp.transpose(i[0], a.get("perm")),
+    "Concat": lambda i, a: jnp.concatenate(i, axis=a["axis"]),
+    "Identity": lambda i, a: i[0],
+    "Dropout": lambda i, a: i[0],
+    "Constant": lambda i, a: jnp.asarray(a["value"]),
+    "Gather": lambda i, a: jnp.take(i[0], i[1].astype(jnp.int32),
+                                    axis=a.get("axis", 0)),
+    "Slice": _slice,
+    "ReduceMean": lambda i, a: jnp.mean(
+        i[0], axis=tuple(a.get("axes", [])) or None,
+        keepdims=bool(a.get("keepdims", 1))),
+    "ReduceSum": lambda i, a: jnp.sum(
+        i[0], axis=tuple(a.get("axes", [])) or None,
+        keepdims=bool(a.get("keepdims", 1))),
+    "Cast": lambda i, a: i[0].astype(_NP_DTYPES[a["to"]]),
+}
+
+
+def _resolve_reshape(x, dims):
+    # ONNX: 0 means copy input dim, -1 infers
+    return [x.shape[i] if d == 0 else d for i, d in enumerate(dims)]
+
+
+def _unsqueeze(x, axes):
+    for ax in sorted(int(a) for a in axes):
+        x = jnp.expand_dims(x, ax)
+    return x
+
+
+_PARAM_THRESHOLD = 32
+
+
+class ONNXModule(Module):
+    """An ONNX graph as a pure Module; initializers live in the params
+    pytree (reference: ``PY/contrib/onnx`` loader builds a BigDL Graph)."""
+
+    def __init__(self, model: "pb.ModelProto"):
+        super().__init__()
+        g = model.graph
+        self.graph_proto = g
+        self._init: Dict[str, np.ndarray] = {
+            t.name: tensor_to_numpy(t) for t in g.initializer
+        }
+        self._param_names = [
+            n for n, a in self._init.items()
+            if a.size >= _PARAM_THRESHOLD and np.issubdtype(a.dtype, np.floating)
+        ]
+        self.input_names = [v.name for v in g.input if v.name not in self._init]
+        self.output_names = [v.name for v in g.output]
+
+    def build_params(self, rng):
+        return {n.replace("/", "__").replace(".", "__"): jnp.asarray(self._init[n])
+                for n in self._param_names}
+
+    def forward(self, ctx: Context, x):
+        xs = (x,) if len(self.input_names) == 1 else tuple(x)
+        values: Dict[str, object] = {}
+        param_set = set(self._param_names)
+        for name, arr in self._init.items():
+            if name in param_set:
+                values[name] = ctx.param(name.replace("/", "__").replace(".", "__"))
+            else:
+                values[name] = arr
+        for name, xi in zip(self.input_names, xs):
+            values[name] = xi
+        for node in self.graph_proto.node:
+            fn = _OPS.get(node.op_type)
+            if fn is None:
+                raise NotImplementedError(
+                    f"ONNX op {node.op_type!r} (node {node.name!r}) unsupported")
+            # "" marks an omitted OPTIONAL input positionally — keep the slot
+            # as None (dropping it would shift later inputs left); trailing
+            # Nones are trimmed so len(args) checks keep working
+            args = [values[i] if i else None for i in node.input]
+            while args and args[-1] is None:
+                args.pop()
+            out = fn(args, _attrs(node))
+            outs = out if isinstance(out, tuple) else (out,)
+            for oname, val in zip(node.output, outs):
+                values[oname] = val
+        res = [values[n] for n in self.output_names]
+        return res[0] if len(res) == 1 else tuple(res)
+
+
+def load_onnx(path: str):
+    """Returns ``(module, params, state)`` from an .onnx file."""
+    model = pb.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    module = ONNXModule(model)
+    params, state = module.init(jax.random.key(0))
+    return module, params, state

@@ -1,0 +1,50 @@
+"""ONNX op modules (reference: ``DL/nn/onnx/Gemm.scala``, ``Reshape.scala``,
+``Shape.scala`` — the reference's tiny ONNX module tier)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Context, Module
+
+
+class Gemm(Module):
+    """y = alpha * A' B' + beta * C (reference ``DL/nn/onnx/Gemm.scala``).
+    Takes a table (A, B, C) like the reference's three-input graph node."""
+
+    def __init__(self, alpha: float = 1.0, beta: float = 1.0,
+                 trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.alpha = alpha
+        self.beta = beta
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def forward(self, ctx: Context, x):
+        a, b, c = x
+        if self.trans_a:
+            a = a.T
+        if self.trans_b:
+            b = b.T
+        return self.alpha * (a @ b) + self.beta * c
+
+
+class Reshape(Module):
+    """ONNX Reshape semantics: 0 copies the input dim, -1 infers
+    (reference ``DL/nn/onnx/Reshape.scala``)."""
+
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = list(shape)
+
+    def forward(self, ctx: Context, x):
+        dims = [x.shape[i] if d == 0 else d for i, d in enumerate(self.shape)]
+        return jnp.reshape(x, dims)
+
+
+class Shape(Module):
+    """Returns the input's shape as an int64 vector (reference
+    ``DL/nn/onnx/Shape.scala``)."""
+
+    def forward(self, ctx: Context, x):
+        return jnp.asarray(x.shape, jnp.int64)

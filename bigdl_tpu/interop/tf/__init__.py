@@ -1,0 +1,11 @@
+"""TensorFlow bridge (reference: ``DL/utils/tf/`` — TensorflowLoader 4,206
+LoC + 161 per-op loaders, TensorflowSaver, Session).
+
+``load_tf_graph(path, inputs, outputs)`` -> (TFGraphModule, params, state);
+``save_tf_graph(model, params, state, path)``; ``TFSession(path).run(...)``.
+"""
+
+from bigdl_tpu.interop.tf.loader import (  # noqa: F401
+    TFGraphModule, TFSession, TensorflowLoader, load_tf_graph,
+)
+from bigdl_tpu.interop.tf.saver import TensorflowSaver, save_tf_graph  # noqa: F401

@@ -1,0 +1,526 @@
+"""TensorFlow GraphDef importer.
+
+Reference: ``DL/utils/tf/TensorflowLoader.scala:43`` — parse a (frozen)
+GraphDef, map nodes to BigDL modules via 161 per-op loader classes, build a
+Graph. ``DL/utils/tf/Session.scala:43`` drives a loaded graph.
+
+TPU-native redesign: instead of pattern-matching TF subgraphs onto a layer
+zoo (the reference needs this because its layers own their backward), the
+importer evaluates the GraphDef **node by node as a pure jax function** —
+each op maps to a jnp/lax expression, the whole graph jits into one XLA
+program, and autodiff works through it for free. Large ``Const`` tensors
+(the frozen weights) are lifted into the params pytree so they behave like
+ordinary module parameters (donation, sharding, checkpointing).
+
+``TFGraphModule`` is a regular :class:`Module`: ``load_tf_graph(pb_path,
+inputs=[...], outputs=[...])`` then ``model.apply(params, x)``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.interop.tf import tensorflow_pb2 as pb
+from bigdl_tpu.nn.module import Context, Module
+
+_NP_DTYPES = {
+    pb.DT_FLOAT: np.float32,
+    pb.DT_DOUBLE: np.float64,
+    pb.DT_INT32: np.int32,
+    pb.DT_UINT8: np.uint8,
+    pb.DT_INT16: np.int16,
+    pb.DT_INT8: np.int8,
+    pb.DT_INT64: np.int64,
+    pb.DT_BOOL: np.bool_,
+    pb.DT_HALF: np.float16,
+    pb.DT_BFLOAT16: None,  # handled explicitly (ml_dtypes)
+    pb.DT_UINT16: np.uint16,
+    pb.DT_UINT32: np.uint32,
+    pb.DT_UINT64: np.uint64,
+}
+
+
+def tensor_to_numpy(t: "pb.TensorProto") -> np.ndarray:
+    shape = [int(d.size) for d in t.tensor_shape.dim]
+    if t.dtype == pb.DT_BFLOAT16:
+        import ml_dtypes
+
+        dt = ml_dtypes.bfloat16
+    else:
+        dt = _NP_DTYPES.get(t.dtype)
+        if dt is None:
+            raise ValueError(f"unsupported TensorProto dtype {t.dtype}")
+    if t.tensor_content:
+        arr = np.frombuffer(t.tensor_content, dtype=dt)
+        return arr.reshape(shape) if shape else arr.reshape(())
+    for field in ("float_val", "double_val", "int_val", "int64_val", "bool_val"):
+        vals = getattr(t, field)
+        if len(vals):
+            arr = np.asarray(list(vals), dtype=dt)
+            n = int(np.prod(shape)) if shape else 1
+            if arr.size == 1 and n > 1:  # splat encoding
+                arr = np.full(n, arr[0], dtype=dt)
+            return arr.reshape(shape)
+    return np.zeros(shape, dtype=dt)
+
+
+def numpy_to_tensor(arr: np.ndarray) -> "pb.TensorProto":
+    arr = np.asarray(arr)
+    rev = {v: k for k, v in _NP_DTYPES.items() if v is not None}
+    t = pb.TensorProto()
+    if arr.dtype.name == "bfloat16":
+        t.dtype = pb.DT_BFLOAT16
+    else:
+        t.dtype = rev.get(arr.dtype.type, pb.DT_FLOAT)
+    for d in arr.shape:
+        t.tensor_shape.dim.add().size = d
+    t.tensor_content = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _ref(name: str) -> Tuple[str, int]:
+    """'node:2' -> ('node', 2); control inputs '^node' -> ('node', -1)."""
+    if name.startswith("^"):
+        return name[1:], -1
+    if ":" in name:
+        base, idx = name.rsplit(":", 1)
+        return base, int(idx)
+    return name, 0
+
+
+def _nhwc_pool_args(node):
+    ksize = list(node.attr["ksize"].list.i)
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    fmt = node.attr["data_format"].s.decode() or "NHWC"
+    return ksize, strides, padding, fmt
+
+
+# ---------------------------------------------------------------- op set
+# Each op: fn(inputs: list, node: NodeDef, ctx) -> output (or tuple).
+
+def _conv2d(inp, node, ctx):
+    x, w = inp  # x NHWC (or NCHW), w HWIO
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    fmt = node.attr["data_format"].s.decode() or "NHWC"
+    dil = list(node.attr["dilations"].list.i) or [1, 1, 1, 1]
+    if fmt == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        window_strides = strides[1:3]
+        rhs_dil = dil[1:3]
+    else:
+        dn = ("NCHW", "HWIO", "NCHW")
+        window_strides = strides[2:4]
+        rhs_dil = dil[2:4]
+    return lax.conv_general_dilated(
+        x, w, window_strides, padding, rhs_dilation=rhs_dil, dimension_numbers=dn)
+
+
+def _depthwise_conv2d(inp, node, ctx):
+    x, w = inp  # w (kh, kw, in, multiplier)
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    kh, kw, cin, mult = w.shape
+    w2 = w.reshape(kh, kw, 1, cin * mult)
+    return lax.conv_general_dilated(
+        x, w2, strides[1:3], padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=cin)
+
+
+def _bias_add(inp, node, ctx):
+    x, b = inp
+    fmt = node.attr["data_format"].s.decode() or "NHWC"
+    if fmt == "NCHW" and x.ndim == 4:
+        return x + b[None, :, None, None]
+    return x + b
+
+
+def _max_pool(inp, node, ctx):
+    (x,) = inp
+    ksize, strides, padding, fmt = _nhwc_pool_args(node)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, tuple(ksize), tuple(strides), padding)
+
+
+def _avg_pool(inp, node, ctx):
+    (x,) = inp
+    ksize, strides, padding, fmt = _nhwc_pool_args(node)
+    s = lax.reduce_window(x, 0.0, lax.add, tuple(ksize), tuple(strides), padding)
+    ones = jnp.ones(x.shape, x.dtype)
+    n = lax.reduce_window(ones, 0.0, lax.add, tuple(ksize), tuple(strides), padding)
+    return s / n
+
+
+def _attr_f(node, name, default):
+    """Float attr with explicit-presence check (0.0 is a legal value)."""
+    return float(node.attr[name].f) if name in node.attr else default
+
+
+def _fused_batch_norm(inp, node, ctx):
+    x, scale, offset, mean, var = inp
+    eps = _attr_f(node, "epsilon", 1e-3)
+    fmt = node.attr["data_format"].s.decode() or "NHWC"
+    if len(mean) == 0:  # training-mode graphs carry empty mean/var
+        axes = (0, 1, 2) if fmt == "NHWC" else (0, 2, 3)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    inv = lax.rsqrt(var + eps) * scale
+    shift = offset - mean * inv
+    if fmt == "NCHW":
+        y = x * inv[None, :, None, None] + shift[None, :, None, None]
+    else:
+        y = x * inv + shift
+    return y, mean, var, mean, var  # (y, batch_mean, batch_var, r1, r2)
+
+
+def _matmul(inp, node, ctx):
+    a, b = inp
+    if node.attr["transpose_a"].b:
+        a = a.T
+    if node.attr["transpose_b"].b:
+        b = b.T
+    return a @ b
+
+
+def _batch_matmul(inp, node, ctx):
+    a, b = inp
+    if node.attr["adj_x"].b:
+        a = jnp.swapaxes(a, -1, -2)
+    if node.attr["adj_y"].b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+def _concat_v2(inp, node, ctx):
+    *xs, axis = inp
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+def _concat(inp, node, ctx):
+    axis, *xs = inp
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+def _split(inp, node, ctx):
+    axis, x = inp
+    n = int(node.attr["num_split"].i)
+    return tuple(jnp.split(x, n, axis=int(axis)))
+
+
+def _pad(inp, node, ctx):
+    x, paddings = inp
+    pads = [(int(a), int(b)) for a, b in np.asarray(paddings)]
+    return jnp.pad(x, pads)
+
+def _strided_slice(inp, node, ctx):
+    x, begin, end, strides = inp
+    begin, end, strides = (np.asarray(v).tolist() for v in (begin, end, strides))
+    bm = int(node.attr["begin_mask"].i)
+    em = int(node.attr["end_mask"].i)
+    sm = int(node.attr["shrink_axis_mask"].i)
+    nm = int(node.attr["new_axis_mask"].i)
+    elm = int(node.attr["ellipsis_mask"].i)
+    if nm:
+        raise NotImplementedError("StridedSlice new_axis_mask")
+    if elm:
+        raise NotImplementedError("StridedSlice ellipsis_mask")
+    idx = []
+    for ax in range(len(begin)):
+        if sm & (1 << ax):
+            idx.append(int(begin[ax]))
+            continue
+        b = None if bm & (1 << ax) else int(begin[ax])
+        e = None if em & (1 << ax) else int(end[ax])
+        idx.append(slice(b, e, int(strides[ax])))
+    return x[tuple(idx)]
+
+
+def _cast(inp, node, ctx):
+    (x,) = inp
+    dst = node.attr["DstT"].type
+    if dst == pb.DT_BFLOAT16:
+        return x.astype(jnp.bfloat16)
+    return x.astype(_NP_DTYPES[dst])
+
+
+def _one_hot(inp, node, ctx):
+    indices, depth, on, off = inp
+    return jax.nn.one_hot(indices, int(depth)) * (on - off) + off
+
+
+def _reduction(fn):
+    def op(inp, node, ctx):
+        x, axes = inp
+        axes = tuple(np.asarray(axes).reshape(-1).tolist())
+        return fn(x, axis=axes or None, keepdims=bool(node.attr["keep_dims"].b))
+    return op
+
+
+_OPS: Dict[str, Callable] = {
+    "Const": None,        # handled in build
+    "Placeholder": None,  # handled in build
+    "PlaceholderWithDefault": lambda i, n, c: i[0],
+    "Identity": lambda i, n, c: i[0],
+    "StopGradient": lambda i, n, c: lax.stop_gradient(i[0]),
+    "NoOp": lambda i, n, c: None,
+    "Add": lambda i, n, c: i[0] + i[1],
+    "AddV2": lambda i, n, c: i[0] + i[1],
+    "AddN": lambda i, n, c: sum(i[1:], i[0]),
+    "Sub": lambda i, n, c: i[0] - i[1],
+    "Mul": lambda i, n, c: i[0] * i[1],
+    "Div": lambda i, n, c: i[0] / i[1],
+    "RealDiv": lambda i, n, c: i[0] / i[1],
+    "FloorDiv": lambda i, n, c: i[0] // i[1],
+    "FloorMod": lambda i, n, c: i[0] % i[1],
+    "Pow": lambda i, n, c: i[0] ** i[1],
+    "SquaredDifference": lambda i, n, c: (i[0] - i[1]) ** 2,
+    "Maximum": lambda i, n, c: jnp.maximum(i[0], i[1]),
+    "Minimum": lambda i, n, c: jnp.minimum(i[0], i[1]),
+    "Neg": lambda i, n, c: -i[0],
+    "Abs": lambda i, n, c: jnp.abs(i[0]),
+    "Square": lambda i, n, c: jnp.square(i[0]),
+    "Sqrt": lambda i, n, c: jnp.sqrt(i[0]),
+    "Rsqrt": lambda i, n, c: lax.rsqrt(i[0]),
+    "Exp": lambda i, n, c: jnp.exp(i[0]),
+    "Log": lambda i, n, c: jnp.log(i[0]),
+    "Log1p": lambda i, n, c: jnp.log1p(i[0]),
+    "Tanh": lambda i, n, c: jnp.tanh(i[0]),
+    "Sigmoid": lambda i, n, c: jax.nn.sigmoid(i[0]),
+    "Relu": lambda i, n, c: jax.nn.relu(i[0]),
+    "Relu6": lambda i, n, c: jnp.clip(i[0], 0, 6),
+    "Elu": lambda i, n, c: jax.nn.elu(i[0]),
+    "Selu": lambda i, n, c: jax.nn.selu(i[0]),
+    "Softplus": lambda i, n, c: jax.nn.softplus(i[0]),
+    "Softsign": lambda i, n, c: jax.nn.soft_sign(i[0]),
+    "LeakyRelu": lambda i, n, c: jax.nn.leaky_relu(
+        i[0], negative_slope=_attr_f(n, "alpha", 0.2)),
+    "Softmax": lambda i, n, c: jax.nn.softmax(i[0], axis=-1),
+    "LogSoftmax": lambda i, n, c: jax.nn.log_softmax(i[0], axis=-1),
+    "Sin": lambda i, n, c: jnp.sin(i[0]),
+    "Cos": lambda i, n, c: jnp.cos(i[0]),
+    "Floor": lambda i, n, c: jnp.floor(i[0]),
+    "Ceil": lambda i, n, c: jnp.ceil(i[0]),
+    "Round": lambda i, n, c: jnp.round(i[0]),
+    "Sign": lambda i, n, c: jnp.sign(i[0]),
+    "Reciprocal": lambda i, n, c: 1.0 / i[0],
+    "Greater": lambda i, n, c: i[0] > i[1],
+    "GreaterEqual": lambda i, n, c: i[0] >= i[1],
+    "Less": lambda i, n, c: i[0] < i[1],
+    "LessEqual": lambda i, n, c: i[0] <= i[1],
+    "Equal": lambda i, n, c: i[0] == i[1],
+    "NotEqual": lambda i, n, c: i[0] != i[1],
+    "LogicalAnd": lambda i, n, c: jnp.logical_and(i[0], i[1]),
+    "LogicalOr": lambda i, n, c: jnp.logical_or(i[0], i[1]),
+    "LogicalNot": lambda i, n, c: jnp.logical_not(i[0]),
+    "Select": lambda i, n, c: jnp.where(i[0], i[1], i[2]),
+    "SelectV2": lambda i, n, c: jnp.where(i[0], i[1], i[2]),
+    "MatMul": _matmul,
+    "BatchMatMul": _batch_matmul,
+    "BatchMatMulV2": _batch_matmul,
+    "Conv2D": _conv2d,
+    "DepthwiseConv2dNative": _depthwise_conv2d,
+    "BiasAdd": _bias_add,
+    "MaxPool": _max_pool,
+    "AvgPool": _avg_pool,
+    "FusedBatchNorm": _fused_batch_norm,
+    "FusedBatchNormV2": _fused_batch_norm,
+    "FusedBatchNormV3": _fused_batch_norm,
+    "Reshape": lambda i, n, c: jnp.reshape(i[0], [int(d) for d in np.asarray(i[1])]),
+    "Squeeze": lambda i, n, c: jnp.squeeze(
+        i[0], axis=tuple(int(d) for d in n.attr["squeeze_dims"].list.i) or None),
+    "ExpandDims": lambda i, n, c: jnp.expand_dims(i[0], int(i[1])),
+    "Transpose": lambda i, n, c: jnp.transpose(i[0], np.asarray(i[1]).tolist()),
+    "Shape": lambda i, n, c: jnp.asarray(i[0].shape, jnp.int32),
+    "Size": lambda i, n, c: jnp.asarray(i[0].size, jnp.int32),
+    "Rank": lambda i, n, c: jnp.asarray(i[0].ndim, jnp.int32),
+    "Fill": lambda i, n, c: jnp.full([int(d) for d in np.asarray(i[0])], i[1]),
+    "Range": lambda i, n, c: jnp.arange(int(i[0]), int(i[1]), int(i[2])),
+    "Tile": lambda i, n, c: jnp.tile(i[0], np.asarray(i[1]).tolist()),
+    "Pack": lambda i, n, c: jnp.stack(i, axis=int(n.attr["axis"].i)),
+    "Unpack": lambda i, n, c: tuple(
+        jnp.moveaxis(i[0], int(n.attr["axis"].i), 0)),
+    "Gather": lambda i, n, c: jnp.take(i[0], i[1].astype(jnp.int32), axis=0),
+    "GatherV2": lambda i, n, c: jnp.take(i[0], i[1].astype(jnp.int32), axis=int(i[2])),
+    "ConcatV2": _concat_v2,
+    "Concat": _concat,
+    "Split": _split,
+    "Pad": _pad,
+    "StridedSlice": _strided_slice,
+    "Slice": lambda i, n, c: lax.dynamic_slice(
+        i[0], [int(b) for b in np.asarray(i[1])],
+        [int(s) if s >= 0 else int(d) - int(b) for b, s, d in
+         zip(np.asarray(i[1]), np.asarray(i[2]), i[0].shape)]),
+    "Cast": _cast,
+    "OneHot": _one_hot,
+    "ArgMax": lambda i, n, c: jnp.argmax(i[0], axis=int(i[1])),
+    "ArgMin": lambda i, n, c: jnp.argmin(i[0], axis=int(i[1])),
+    "TopKV2": lambda i, n, c: lax.top_k(i[0], int(i[1])),
+    "Sum": _reduction(jnp.sum),
+    "Mean": _reduction(jnp.mean),
+    "Max": _reduction(jnp.max),
+    "Min": _reduction(jnp.min),
+    "Prod": _reduction(jnp.prod),
+    "All": _reduction(jnp.all),
+    "Any": _reduction(jnp.any),
+    "ZerosLike": lambda i, n, c: jnp.zeros_like(i[0]),
+    "OnesLike": lambda i, n, c: jnp.ones_like(i[0]),
+}
+
+# weights smaller than this stay inline constants; larger ones are lifted
+# into the params tree
+_PARAM_THRESHOLD = 32
+
+
+class TFGraphModule(Module):
+    """A frozen TF graph as a pure Module (reference ``Session.scala`` /
+    ``TensorflowLoader``). Inputs are fed positionally in ``inputs`` order;
+    ``forward`` returns the ``outputs`` values (tuple if several)."""
+
+    def __init__(self, graph_def: "pb.GraphDef", inputs: Sequence[str],
+                 outputs: Sequence[str]):
+        super().__init__()
+        self.graph_def = graph_def
+        self.input_names = [_ref(i)[0] for i in inputs]
+        self.output_refs = [_ref(o) for o in outputs]
+        self.nodes: Dict[str, "pb.NodeDef"] = {n.name: n for n in graph_def.node}
+        self._consts: Dict[str, np.ndarray] = {}
+        self._param_names: List[str] = []
+        for n in graph_def.node:
+            if n.op == "Const":
+                arr = tensor_to_numpy(n.attr["value"].tensor)
+                if arr.size >= _PARAM_THRESHOLD and np.issubdtype(arr.dtype, np.floating):
+                    self._param_names.append(n.name)
+                self._consts[n.name] = arr
+            elif n.op in ("Variable", "VariableV2"):
+                raise ValueError(
+                    f"graph is not frozen: variable node {n.name!r}; freeze "
+                    "it (convert variables to consts) before import"
+                )
+        # needed set: nodes reachable from outputs
+        self._order = self._topo()
+
+    def _topo(self) -> List[str]:
+        # iterative DFS: real frozen graphs (ResNets, unrolled RNNs) have
+        # input chains far deeper than Python's recursion limit
+        order: List[str] = []
+        state: Dict[str, int] = {}  # 0 visiting, 1 done
+        for root, _ in self.output_refs:
+            stack: List[Tuple[str, bool]] = [(root, False)]
+            while stack:
+                name, processed = stack.pop()
+                if processed:
+                    state[name] = 1
+                    order.append(name)
+                    continue
+                st = state.get(name)
+                if st == 1:
+                    continue
+                if st == 0:
+                    raise ValueError(
+                        f"cycle at node {name!r} (control flow is not "
+                        "supported in frozen-graph import)")
+                state[name] = 0
+                stack.append((name, True))
+                for ref in self.nodes[name].input:
+                    base, idx = _ref(ref)
+                    if idx >= 0 and state.get(base) != 1:  # skip control deps
+                        stack.append((base, False))
+        return order
+
+    def build_params(self, rng):
+        return {name.replace("/", "__"): jnp.asarray(self._consts[name])
+                for name in self._param_names}
+
+    def forward(self, ctx: Context, x):
+        xs = (x,) if len(self.input_names) == 1 else tuple(x)
+        if len(xs) != len(self.input_names):
+            raise ValueError(
+                f"expected {len(self.input_names)} inputs, got {len(xs)}")
+        values: Dict[str, object] = {}
+        for name, xi in zip(self.input_names, xs):
+            values[name] = xi
+        param_set = set(self._param_names)
+        for name in self._order:
+            if name in values:
+                continue
+            node = self.nodes[name]
+            if node.op == "Const":
+                if name in param_set:
+                    values[name] = ctx.param(name.replace("/", "__"))
+                else:
+                    values[name] = self._consts[name]
+                continue
+            if node.op in ("Placeholder", "PlaceholderWithDefault") and not node.input:
+                raise ValueError(
+                    f"placeholder {name!r} was not listed in inputs")
+            fn = _OPS.get(node.op)
+            if fn is None:
+                raise NotImplementedError(
+                    f"TF op {node.op!r} (node {name!r}) is not supported")
+            args = []
+            for ref in node.input:
+                base, idx = _ref(ref)
+                if idx < 0:
+                    continue
+                v = values[base]
+                args.append(v[idx] if isinstance(v, tuple) else v)
+            values[name] = fn(args, node, ctx)
+        outs = []
+        for base, idx in self.output_refs:
+            v = values[base]
+            outs.append(v[idx] if isinstance(v, tuple) else v)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class TensorflowLoader:
+    """Reference ``TensorflowLoader.scala:43``."""
+
+    @staticmethod
+    def parse(path: str) -> "pb.GraphDef":
+        g = pb.GraphDef()
+        with open(path, "rb") as f:
+            g.ParseFromString(f.read())
+        return g
+
+    @staticmethod
+    def load(path: str, inputs: Sequence[str], outputs: Sequence[str]):
+        """Returns ``(module, params, state)`` for a frozen GraphDef file."""
+        module = TFGraphModule(TensorflowLoader.parse(path), inputs, outputs)
+        params, state = module.init(jax.random.key(0))
+        return module, params, state
+
+
+def load_tf_graph(path: str, inputs: Sequence[str], outputs: Sequence[str]):
+    return TensorflowLoader.load(path, inputs, outputs)
+
+
+class TFSession:
+    """Minimal Session.run over a frozen graph (reference
+    ``DL/utils/tf/Session.scala:43`` BigDLSessionImpl; queue-runner input
+    emulation is out of scope — feed host arrays directly)."""
+
+    def __init__(self, graph_def_or_path, jit: bool = True):
+        if isinstance(graph_def_or_path, str):
+            self.graph_def = TensorflowLoader.parse(graph_def_or_path)
+        else:
+            self.graph_def = graph_def_or_path
+        self._jit = jit
+        self._cache: Dict[Tuple, Tuple] = {}
+
+    def run(self, fetches: Sequence[str], feed_dict: Dict[str, np.ndarray]):
+        feeds = list(feed_dict.keys())
+        key = (tuple(fetches), tuple(feeds))
+        if key not in self._cache:
+            module = TFGraphModule(self.graph_def, feeds, fetches)
+            params, _ = module.init(jax.random.key(0))
+            fn = (lambda p, *xs: module.apply(p, xs if len(xs) > 1 else xs[0])[0])
+            self._cache[key] = (jax.jit(fn) if self._jit else fn, params)
+        fn, params = self._cache[key]
+        out = fn(params, *[jnp.asarray(v) for v in feed_dict.values()])
+        return [np.asarray(o) for o in (out if isinstance(out, tuple) else (out,))]

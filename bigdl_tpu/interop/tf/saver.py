@@ -1,0 +1,196 @@
+"""TensorFlow GraphDef exporter.
+
+Reference: ``DL/utils/tf/TensorflowSaver.scala`` / ``BigDLToTensorflow.scala``
+— map each module to TF nodes, weights as ``Const``, write a frozen
+GraphDef. Same module coverage philosophy as the Caffe persister; exported
+graphs reload through :mod:`bigdl_tpu.interop.tf.loader` for a round-trip
+guarantee and load in stock TensorFlow.
+
+All tensors are emitted in the model's native NCHW layout (TF supports
+``data_format: "NCHW"``); explicit paddings become ``Pad`` nodes since TF
+convs/pools only know SAME/VALID.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.tf import tensorflow_pb2 as pb
+from bigdl_tpu.interop.tf.loader import numpy_to_tensor
+from bigdl_tpu.nn.graph import Graph
+
+
+class TensorflowSaver:
+    def __init__(self, model, params, state=None):
+        self.model = model
+        self.params = params
+        self.state = state or {}
+        self.graph = pb.GraphDef()
+        self.graph.versions.producer = 27
+        self._seq = 0
+
+    # -- node helpers ------------------------------------------------------
+    def _name(self, base: str) -> str:
+        self._seq += 1
+        return f"{base}_{self._seq}"
+
+    _TYPE_ATTRS = frozenset(
+        {"dtype", "T", "DstT", "SrcT", "Tidx", "Tshape", "Tpaddings", "out_type"})
+
+    def _node(self, op: str, name: str, inputs: List[str], **attrs) -> str:
+        node = self.graph.node.add(name=name, op=op, input=inputs)
+        for k, v in attrs.items():
+            a = node.attr[k]
+            if k in self._TYPE_ATTRS:
+                a.type = v  # DataType enum values are ints; dispatch by key
+            elif isinstance(v, bool):
+                a.b = v
+            elif isinstance(v, int):
+                a.i = v
+            elif isinstance(v, float):
+                a.f = v
+            elif isinstance(v, bytes):
+                a.s = v
+            elif isinstance(v, str):
+                a.s = v.encode()
+            elif isinstance(v, list) and all(isinstance(x, int) for x in v):
+                a.list.i.extend(v)
+            elif isinstance(v, pb.TensorProto):
+                a.tensor.CopyFrom(v)
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        return name
+
+    def _const(self, arr, base: str = "const") -> str:
+        name = self._name(base)
+        t = numpy_to_tensor(np.asarray(arr))
+        return self._node("Const", name, [], value=t, dtype=t.dtype)
+
+    def _pad(self, x: str, pads: List[Tuple[int, int]]) -> str:
+        if all(p == (0, 0) for p in pads):
+            return x
+        p = self._const(np.asarray(pads, np.int32), "paddings")
+        return self._node("Pad", self._name("pad"), [x, p],
+                          T=pb.DT_FLOAT, Tpaddings=pb.DT_INT32)
+
+    # -- model walk --------------------------------------------------------
+    def save(self, path: str, input_name: str = "input",
+             input_shape: Optional[Tuple[int, ...]] = None) -> "pb.GraphDef":
+        from bigdl_tpu.interop.walker import walk_model
+
+        node = self.graph.node.add(name=input_name, op="Placeholder")
+        node.attr["dtype"].type = pb.DT_FLOAT
+        if input_shape is not None:
+            for d in input_shape:
+                node.attr["shape"].shape.dim.add().size = d
+        out = walk_model(self.model, self.params, self.state, input_name,
+                         self._emit_leaf)
+        self._node("Identity", "output", [out], T=pb.DT_FLOAT)
+        with open(path, "wb") as f:
+            f.write(self.graph.SerializeToString())
+        return self.graph
+
+    def _emit_leaf(self, m, p, s, ins: List[str], name=None) -> str:
+        x = ins[0] if ins else None
+
+        if type(m) is nn.Linear:
+            w = self._const(np.asarray(p["weight"]).T, "weight")  # (in, out)
+            y = self._node("MatMul", self._name("matmul"), [x, w], T=pb.DT_FLOAT)
+            if m.with_bias:
+                b = self._const(np.asarray(p["bias"]), "bias")
+                y = self._node("BiasAdd", self._name("bias_add"), [y, b],
+                               T=pb.DT_FLOAT)
+            return y
+
+        if type(m) is nn.SpatialConvolution:
+            if m.n_group != 1:
+                raise ValueError("tf export: grouped conv unsupported")
+            ph, pw = m.pad
+            x = self._pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+            # our OIHW -> TF HWIO
+            w = self._const(np.asarray(p["weight"]).transpose(2, 3, 1, 0), "weight")
+            sh, sw = m.stride
+            y = self._node("Conv2D", self._name("conv"), [x, w],
+                           strides=[1, 1, sh, sw], padding=b"VALID",
+                           data_format=b"NCHW", T=pb.DT_FLOAT)
+            if m.with_bias:
+                b_ = self._const(np.asarray(p["bias"]), "bias")
+                y = self._node("BiasAdd", self._name("bias_add"), [y, b_],
+                               data_format=b"NCHW", T=pb.DT_FLOAT)
+            return y
+
+        if isinstance(m, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+            if m.ceil_mode:
+                raise ValueError("tf export: ceil-mode pooling unsupported")
+            ph, pw = m.pad
+            if isinstance(m, nn.SpatialMaxPooling) and (ph or pw):
+                # -inf padding must not win the max: pad AFTER clamping via
+                # explicit Pad with zeros is wrong for negative activations,
+                # so reject instead of silently corrupting
+                raise ValueError("tf export: padded max-pooling unsupported")
+            if isinstance(m, nn.SpatialAveragePooling) and (ph or pw) \
+                    and not m.count_include_pad:
+                # explicit zero Pad + VALID makes padded cells count in the
+                # divisor, i.e. count_include_pad=True semantics only
+                raise ValueError(
+                    "tf export: padded avg-pooling with count_include_pad="
+                    "False unsupported")
+            x = self._pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+            kh, kw = m.kernel
+            sh, sw = m.stride
+            op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
+            return self._node(op, self._name(op.lower()), [x],
+                              ksize=[1, 1, kh, kw], strides=[1, 1, sh, sw],
+                              padding=b"VALID", data_format=b"NCHW",
+                              T=pb.DT_FLOAT)
+
+        if isinstance(m, nn.SpatialBatchNormalization):
+            mean = np.asarray(s["running_mean"])
+            var = np.asarray(s["running_var"])
+            gamma = np.asarray(p["weight"]) if m.affine else np.ones_like(mean)
+            beta = np.asarray(p["bias"]) if m.affine else np.zeros_like(mean)
+            inv = gamma / np.sqrt(var + m.eps)
+            shift = beta - mean * inv
+            scale = self._const(inv.reshape(1, -1, 1, 1).astype(np.float32), "bn_scale")
+            off = self._const(shift.reshape(1, -1, 1, 1).astype(np.float32), "bn_shift")
+            y = self._node("Mul", self._name("bn_mul"), [x, scale], T=pb.DT_FLOAT)
+            return self._node("Add", self._name("bn_add"), [y, off], T=pb.DT_FLOAT)
+
+        if isinstance(m, nn.GlobalAveragePooling2D):
+            axes = self._const(np.asarray([2, 3], np.int32), "axes")
+            return self._node("Mean", self._name("mean"), [x, axes],
+                              keep_dims=False, T=pb.DT_FLOAT, Tidx=pb.DT_INT32)
+
+        if isinstance(m, nn.Reshape):
+            shape = self._const(np.asarray([-1] + list(m.size), np.int32), "shape")
+            return self._node("Reshape", self._name("reshape"), [x, shape],
+                              T=pb.DT_FLOAT, Tshape=pb.DT_INT32)
+
+        if isinstance(m, nn.Dropout):
+            return self._node("Identity", self._name("dropout"), [x], T=pb.DT_FLOAT)
+        if isinstance(m, nn.Identity):
+            return self._node("Identity", self._name("identity"), [x], T=pb.DT_FLOAT)
+
+        simple = {nn.ReLU: "Relu", nn.Tanh: "Tanh", nn.Sigmoid: "Sigmoid",
+                  nn.SoftMax: "Softmax", nn.LogSoftMax: "LogSoftmax"}
+        for cls, op in simple.items():
+            if type(m) is cls:
+                return self._node(op, self._name(op.lower()), [x], T=pb.DT_FLOAT)
+
+        if isinstance(m, nn.CAddTable):
+            return self._node("AddN", self._name("addn"), ins, N=len(ins),
+                              T=pb.DT_FLOAT)
+        if isinstance(m, nn.JoinTable):
+            ax = self._const(np.asarray(m.dimension, np.int32), "axis")
+            return self._node("ConcatV2", self._name("concat"), ins + [ax],
+                              N=len(ins), T=pb.DT_FLOAT, Tidx=pb.DT_INT32)
+
+        raise ValueError(f"tf export does not support {type(m).__name__}")
+
+
+def save_tf_graph(model, params, state, path: str,
+                  input_shape: Optional[Tuple[int, ...]] = None) -> None:
+    TensorflowSaver(model, params, state).save(path, input_shape=input_shape)

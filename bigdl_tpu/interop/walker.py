@@ -1,0 +1,50 @@
+"""Shared module-tree walker for the format exporters.
+
+The Caffe/TF/ONNX exporters all fold a Sequential/Graph tree into a chain
+of per-leaf emissions; this is the one implementation they share. Each
+exporter supplies ``emit_leaf(module, params, state, inputs, name)`` which
+returns an opaque token (the emitted node's output name) for downstream
+wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Graph
+
+
+def walk_model(model, params, state, x, emit_leaf: Callable,
+               name: Optional[str] = None):
+    """Emit ``model`` (token-in ``x`` -> token-out). Containers recurse;
+    leaves go to ``emit_leaf``."""
+    params = params or {}
+    state = state or {}
+    if isinstance(model, Graph):
+        if len(model.inputs) != 1:
+            raise ValueError("export supports single-input graphs only")
+        tops = {id(model.inputs[0]): x}
+        for node in model._topo:
+            if node.element is None:
+                continue
+            nname = model._names[id(node)]
+            ins = [tops[id(p)] for p in node.prev]
+            tops[id(node)] = _walk_node(
+                node.element, params.get(nname, {}), state.get(nname, {}),
+                ins, emit_leaf, nname)
+        return tops[id(model.outputs[0])]
+    if isinstance(model, nn.Sequential):
+        for cname, child in model._modules.items():
+            x = walk_model(child, params.get(cname, {}), state.get(cname, {}),
+                           x, emit_leaf, cname)
+        return x
+    return emit_leaf(model, params, state, [x], name)
+
+
+def _walk_node(module, params, state, ins: List, emit_leaf, name):
+    """A graph node: containers with a single input recurse; real leaves
+    (possibly multi-input) emit directly."""
+    if isinstance(module, (nn.Sequential, Graph)) and len(ins) == 1:
+        return walk_model(module, params, state, ins[0], emit_leaf, name)
+    return emit_leaf(module, params, state, ins, name)
