@@ -94,9 +94,13 @@ class CaffePersister:
             kh, kw = m.kernel
             sh, sw = m.stride
             ph, pw = m.pad
+            if ph == -1 or pw == -1:
+                raise ValueError(
+                    "caffe export: TF-style SAME padding (pad = -1) has no "
+                    "caffe equivalent; use explicit padding")
             cp.kernel_h, cp.kernel_w = kh, kw
             cp.stride_h, cp.stride_w = sh, sw
-            cp.pad_h, cp.pad_w = max(ph, 0), max(pw, 0)
+            cp.pad_h, cp.pad_w = ph, pw
             cp.group = m.n_group
             cp.bias_term = m.with_bias
             _add_blob(layer, _np(p["weight"]))

@@ -96,6 +96,10 @@ class ONNXExporter:
             kh, kw = m.kernel
             sh, sw = m.stride
             ph, pw = m.pad
+            if ph == -1 or pw == -1:  # TF-style SAME padding mode
+                return self._node("Conv", inputs, "conv",
+                                  kernel_shape=[kh, kw], strides=[sh, sw],
+                                  group=m.n_group, auto_pad="SAME_UPPER")
             return self._node("Conv", inputs, "conv",
                               kernel_shape=[kh, kw], strides=[sh, sw],
                               pads=[ph, pw, ph, pw], group=m.n_group)

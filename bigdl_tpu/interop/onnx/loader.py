@@ -82,9 +82,14 @@ def _conv(inp, attrs):
     pads = attrs.get("pads", [0, 0, 0, 0])  # [top, left, bottom, right]
     dil = attrs.get("dilations", [1, 1])
     group = attrs.get("group", 1)
-    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
-        pad = attrs["auto_pad"].replace("_UPPER", "").replace("_LOWER", "")
-        padding = "SAME" if pad == "SAME" else "VALID"
+    auto_pad = attrs.get("auto_pad", "NOTSET")
+    if auto_pad not in ("NOTSET", ""):
+        if auto_pad == "SAME_UPPER":
+            padding = "SAME"
+        elif auto_pad == "SAME_LOWER":
+            padding = "SAME_LOWER"  # lax supports it natively
+        else:  # VALID
+            padding = "VALID"
     else:
         padding = [(pads[0], pads[2]), (pads[1], pads[3])]
     y = lax.conv_general_dilated(
@@ -192,9 +197,8 @@ _OPS: Dict[str, Callable] = {
         i[0], _resolve_reshape(i[0], np.asarray(i[1]).tolist())),
     "Shape": lambda i, a: jnp.asarray(i[0].shape, jnp.int64),
     "Squeeze": lambda i, a: jnp.squeeze(
-        i[0], axis=tuple(a.get("axes", [])) or None),
-    "Unsqueeze": lambda i, a: _unsqueeze(i[0], a.get(
-        "axes", np.asarray(i[1]).tolist() if len(i) > 1 else [])),
+        i[0], axis=tuple(_axes_arg(i, a)) or None),
+    "Unsqueeze": lambda i, a: _unsqueeze(i[0], _axes_arg(i, a)),
     "Transpose": lambda i, a: jnp.transpose(i[0], a.get("perm")),
     "Concat": lambda i, a: jnp.concatenate(i, axis=a["axis"]),
     "Identity": lambda i, a: i[0],
@@ -204,13 +208,21 @@ _OPS: Dict[str, Callable] = {
                                     axis=a.get("axis", 0)),
     "Slice": _slice,
     "ReduceMean": lambda i, a: jnp.mean(
-        i[0], axis=tuple(a.get("axes", [])) or None,
+        i[0], axis=tuple(_axes_arg(i, a)) or None,
         keepdims=bool(a.get("keepdims", 1))),
     "ReduceSum": lambda i, a: jnp.sum(
-        i[0], axis=tuple(a.get("axes", [])) or None,
+        i[0], axis=tuple(_axes_arg(i, a)) or None,
         keepdims=bool(a.get("keepdims", 1))),
     "Cast": lambda i, a: i[0].astype(_NP_DTYPES[a["to"]]),
 }
+
+
+def _axes_arg(inp, attrs):
+    """Axes from attrs (opset <13) or from the second input (opset 13+) —
+    Squeeze/ReduceSum/ReduceMean moved axes into an input tensor."""
+    if len(inp) > 1 and inp[1] is not None:
+        return [int(v) for v in np.asarray(inp[1]).reshape(-1)]
+    return list(attrs.get("axes", []))
 
 
 def _resolve_reshape(x, dims):

@@ -109,12 +109,16 @@ class TensorflowSaver:
             if m.n_group != 1:
                 raise ValueError("tf export: grouped conv unsupported")
             ph, pw = m.pad
-            x = self._pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+            tf_padding = b"VALID"
+            if ph == -1 or pw == -1:  # TF-style SAME padding mode
+                tf_padding = b"SAME"
+            else:
+                x = self._pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
             # our OIHW -> TF HWIO
             w = self._const(np.asarray(p["weight"]).transpose(2, 3, 1, 0), "weight")
             sh, sw = m.stride
             y = self._node("Conv2D", self._name("conv"), [x, w],
-                           strides=[1, 1, sh, sw], padding=b"VALID",
+                           strides=[1, 1, sh, sw], padding=tf_padding,
                            data_format=b"NCHW", T=pb.DT_FLOAT)
             if m.with_bias:
                 b_ = self._const(np.asarray(p["bias"]), "bias")

@@ -16,9 +16,14 @@ from bigdl_tpu.nn.graph import Graph
 
 
 def walk_model(model, params, state, x, emit_leaf: Callable,
-               name: Optional[str] = None):
+               name: Optional[str] = None, _prefix: str = ""):
     """Emit ``model`` (token-in ``x`` -> token-out). Containers recurse;
-    leaves go to ``emit_leaf``."""
+    leaves go to ``emit_leaf``.
+
+    Leaf names are path-qualified ("block1_0_conv") so nested containers
+    never produce duplicate names; a top-level Graph's node names pass
+    through exactly (loaders key params by them).
+    """
     params = params or {}
     state = state or {}
     if isinstance(model, Graph):
@@ -29,15 +34,17 @@ def walk_model(model, params, state, x, emit_leaf: Callable,
             if node.element is None:
                 continue
             nname = model._names[id(node)]
+            qual = f"{_prefix}{nname}"
             ins = [tops[id(p)] for p in node.prev]
             tops[id(node)] = _walk_node(
                 node.element, params.get(nname, {}), state.get(nname, {}),
-                ins, emit_leaf, nname)
+                ins, emit_leaf, qual)
         return tops[id(model.outputs[0])]
     if isinstance(model, nn.Sequential):
         for cname, child in model._modules.items():
             x = walk_model(child, params.get(cname, {}), state.get(cname, {}),
-                           x, emit_leaf, cname)
+                           x, emit_leaf, f"{_prefix}{cname}",
+                           _prefix=f"{_prefix}{cname}_")
         return x
     return emit_leaf(model, params, state, [x], name)
 
@@ -46,5 +53,6 @@ def _walk_node(module, params, state, ins: List, emit_leaf, name):
     """A graph node: containers with a single input recurse; real leaves
     (possibly multi-input) emit directly."""
     if isinstance(module, (nn.Sequential, Graph)) and len(ins) == 1:
-        return walk_model(module, params, state, ins[0], emit_leaf, name)
+        return walk_model(module, params, state, ins[0], emit_leaf, name,
+                          _prefix=f"{name}_" if name else "")
     return emit_leaf(module, params, state, ins, name)

@@ -134,3 +134,111 @@ class MaskRCNN(Module):
 
 def build(num_classes: int = 81, depth: int = 50, **kw) -> MaskRCNN:
     return MaskRCNN(num_classes=num_classes, depth=depth, **kw)
+
+
+def paste_masks(masks: "np.ndarray", boxes: "np.ndarray", valid: "np.ndarray",
+                im_h: int, im_w: int, threshold: float = 0.5):
+    """Paste per-detection mask logits into full-image binary masks
+    (reference ``MaskRCNN.scala`` postprocessing / ``MaskUtils``): sigmoid
+    the (K, M, M) logits, bilinear-resize each to its box, threshold, and
+    write into a (K, im_h, im_w) canvas. Host-side numpy."""
+    import numpy as np
+
+    from bigdl_tpu.vision.augmentation import resize_image
+
+    masks = np.asarray(masks, np.float32)
+    boxes = np.asarray(boxes, np.float32)
+    probs = 1.0 / (1.0 + np.exp(-masks))
+    out = np.zeros((masks.shape[0], im_h, im_w), bool)
+    for k in range(masks.shape[0]):
+        if not valid[k]:
+            continue
+        x1, y1, x2, y2 = boxes[k]
+        x1i, y1i = int(np.floor(x1)), int(np.floor(y1))
+        x2i, y2i = int(np.ceil(x2)), int(np.ceil(y2))
+        # resize to the FULL (possibly out-of-image) box extent, then crop
+        # the in-image window — clipping first would squash the mask
+        bw, bh = x2i - x1i, y2i - y1i
+        if bw <= 0 or bh <= 0:
+            continue
+        m = resize_image(probs[k][..., None], bh, bw)[..., 0] > threshold
+        x0, y0 = max(x1i, 0), max(y1i, 0)
+        x1c, y1c = min(x2i, im_w), min(y2i, im_h)
+        if x1c <= x0 or y1c <= y0:
+            continue
+        out[k, y0:y1c, x0:x1c] = m[y0 - y1i:y1c - y1i, x0 - x1i:x1c - x1i]
+    return out
+
+
+class MaskRCNNPredictor:
+    """Raw image in, detections out (reference: the full
+    ``DL/models/maskrcnn`` path over ImageFrame — normalization, aspect
+    resize, forward, box rescale, mask pasting).
+
+    ``predict(image_hwc)`` takes one HWC RGB image (uint8 or float) and
+    returns a dict with ``boxes`` (K, 4 in ORIGINAL pixel coords),
+    ``scores`` (K,), ``labels`` (K,), ``valid`` (K,) and ``masks``
+    (K, H, W) full-resolution booleans.
+    """
+
+    def __init__(self, model: MaskRCNN, params, state,
+                 min_size: int = 800, max_size: int = 1333,
+                 means=(122.7717, 115.9465, 102.9801), stds=(1.0, 1.0, 1.0),
+                 pad_multiple: int = 32):
+        import jax as _jax
+
+        self.model = model
+        self.params = params
+        self.state = state or {}
+        self.min_size = min_size
+        self.max_size = max_size
+        self.means = means
+        self.stds = stds
+        self.pad_multiple = pad_multiple
+        self._fwd = _jax.jit(
+            lambda p, s, x: model.apply(p, x, state=s, training=False)[0])
+
+    def preprocess(self, image):
+        """HWC image -> (padded NCHW batch-of-1, scale, (oh, ow))."""
+        import numpy as np
+
+        from bigdl_tpu.vision import (
+            AspectScale, ChannelNormalize, ImageFeature, MatToTensor,
+        )
+
+        feat = ImageFeature(np.asarray(image, np.float32))
+        oh, ow = feat.image.shape[:2]
+        AspectScale(self.min_size, self.max_size)(feat)
+        # per-axis ratios: AspectScale rounds h and w independently
+        scale = (feat.image.shape[1] / ow, feat.image.shape[0] / oh)
+        ChannelNormalize(self.means, self.stds)(feat)
+        MatToTensor()(feat)
+        chw = feat["tensor"]
+        _, h, w = chw.shape
+        ph = (h + self.pad_multiple - 1) // self.pad_multiple * self.pad_multiple
+        pw = (w + self.pad_multiple - 1) // self.pad_multiple * self.pad_multiple
+        padded = np.zeros((1, chw.shape[0], ph, pw), np.float32)
+        padded[0, :, :h, :w] = chw
+        return padded, scale, (oh, ow)
+
+    def predict(self, image):
+        import numpy as np
+
+        batch, (sx, sy), (oh, ow) = self.preprocess(image)
+        out = self._fwd(self.params, self.state, batch)
+        boxes = np.array(out["boxes"], np.float32)  # writable host copy
+        boxes[:, 0::2] /= sx
+        boxes[:, 1::2] /= sy
+        valid = np.asarray(out["valid"])
+        # paste against the UNCLIPPED boxes (a detection may extend into
+        # the pad margin); clip only the reported coordinates
+        masks = paste_masks(np.asarray(out["masks"]), boxes, valid, oh, ow)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ow)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, oh)
+        return {
+            "boxes": boxes,
+            "scores": np.asarray(out["scores"]),
+            "labels": np.asarray(out["labels"]),
+            "masks": masks,
+            "valid": valid,
+        }

@@ -1,0 +1,18 @@
+"""Vision pipeline (reference: ``DL/transform/vision/`` — ImageFrame +
+FeatureTransformer chains + OpenCV augmentation ops + ROI label
+transforms, 4,591 LoC / 31 files)."""
+
+from bigdl_tpu.vision.image_frame import ImageFeature, ImageFrame  # noqa: F401
+from bigdl_tpu.vision.transformer import (  # noqa: F401
+    ChainedFeatureTransformer, FeatureTransformer, Pipeline, RandomTransformer,
+)
+from bigdl_tpu.vision.augmentation import (  # noqa: F401
+    AspectScale, Brightness, CenterCrop, ChannelNormalize, ChannelOrder,
+    ChannelScaledNormalizer, ColorJitter, Contrast, Expand, Filler, FixedCrop,
+    HFlip, Hue, Lighting, PixelBytesToMat, PixelNormalizer, RandomAspectScale,
+    RandomCrop, Resize, Saturation, resize_image,
+)
+from bigdl_tpu.vision.roi import (  # noqa: F401
+    RoiHFlip, RoiLabel, RoiNormalize, RoiProject, RoiResize, attach_roi,
+)
+from bigdl_tpu.vision.to_tensor import ImageFrameToSample, MatToTensor  # noqa: F401

@@ -1,0 +1,374 @@
+"""Vision augmentation ops.
+
+Reference: ``DL/transform/vision/image/augmentation/`` — Resize,
+AspectScale, RandomAspectScale, CenterCrop/RandomCrop/FixedCrop, Expand,
+HFlip, Brightness, Contrast, Saturation, Hue, ChannelOrder, ColorJitter,
+Lighting, ChannelNormalize, ChannelScaledNormalizer, Filler,
+PixelNormalizer. OpenCV Mats become numpy HWC float32 arrays; bilinear
+resampling via scipy.ndimage (the JavaCPP-OpenCV codec/resize analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.vision.image_frame import ImageFeature
+from bigdl_tpu.vision.transformer import FeatureTransformer
+
+
+def resize_image(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear HWC resize (reference uses cv2.resize INTER_LINEAR)."""
+    from scipy import ndimage
+
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img.astype(np.float32, copy=False)
+    zoom = (h / ih, w / iw) + (1,) * (img.ndim - 2)
+    return ndimage.zoom(img.astype(np.float32), zoom, order=1,
+                        grid_mode=True, mode="nearest")
+
+
+class PixelBytesToMat(FeatureTransformer):
+    """Decode feature[BYTES] into feature[MAT] (reference
+    ``BytesToMat.scala``; PIL replaces the OpenCV codec)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        import io
+
+        from PIL import Image
+
+        raw = feature[ImageFeature.BYTES]
+        img = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"), np.float32)
+        feature[ImageFeature.MAT] = img
+        feature[ImageFeature.ORIGINAL_SIZE] = img.shape
+        return feature
+
+
+class Resize(FeatureTransformer):
+    """Resize to (resize_h, resize_w) (reference ``Resize.scala``)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.image = resize_image(feature.image, self.h, self.w)
+
+
+class AspectScale(FeatureTransformer):
+    """Scale so the short side is ``min_size`` capped by ``max_size``,
+    preserving aspect (reference ``AspectScale.scala``; the Mask R-CNN
+    preprocessing scale)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.min_size = min_size
+        self.max_size = max_size
+        self.multiple = scale_multiple_of
+
+    def _target(self, h: int, w: int) -> Tuple[int, int]:
+        short, long = min(h, w), max(h, w)
+        scale = self.min_size / short
+        if long * scale > self.max_size:
+            scale = self.max_size / long
+        th, tw = int(round(h * scale)), int(round(w * scale))
+        if self.multiple > 1:
+            th = ((th + self.multiple - 1) // self.multiple) * self.multiple
+            tw = ((tw + self.multiple - 1) // self.multiple) * self.multiple
+        return th, tw
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.image.shape[:2]
+        th, tw = self._target(h, w)
+        feature.image = resize_image(feature.image, th, tw)
+
+
+class RandomAspectScale(AspectScale):
+    """Pick min_size randomly from ``scales`` (reference
+    ``RandomAspectScale.scala``)."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000,
+                 rng: Optional[RandomGenerator] = None):
+        super().__init__(scales[0], max_size)
+        self.scales = list(scales)
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        self.min_size = int(self.rng.numpy().choice(self.scales))
+        super().transform_mat(feature)
+
+
+class CenterCrop(FeatureTransformer):
+    def __init__(self, crop_w: int, crop_h: int):
+        self.cw, self.ch = crop_w, crop_h
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.image.shape[:2]
+        y = max(0, (h - self.ch) // 2)
+        x = max(0, (w - self.cw) // 2)
+        feature["crop_box"] = (x, y, x + self.cw, y + self.ch)
+        feature.image = feature.image[y:y + self.ch, x:x + self.cw]
+
+
+class RandomCrop(FeatureTransformer):
+    def __init__(self, crop_w: int, crop_h: int,
+                 rng: Optional[RandomGenerator] = None):
+        self.cw, self.ch = crop_w, crop_h
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.image.shape[:2]
+        r = self.rng.numpy()
+        y = int(r.integers(0, max(1, h - self.ch + 1)))
+        x = int(r.integers(0, max(1, w - self.cw + 1)))
+        feature["crop_box"] = (x, y, x + self.cw, y + self.ch)
+        feature.image = feature.image[y:y + self.ch, x:x + self.cw]
+
+
+class FixedCrop(FeatureTransformer):
+    """Crop a fixed box, absolute pixels or normalized [0,1] coords
+    (reference ``Crop.scala`` FixedCrop)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = False):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        x1, y1, x2, y2 = (int(round(v)) for v in (x1, y1, x2, y2))
+        feature["crop_box"] = (x1, y1, x2, y2)
+        feature.image = feature.image[y1:y2, x1:x2]
+
+
+class Expand(FeatureTransformer):
+    """Place the image on a larger mean-filled canvas (reference
+    ``Expand.scala``; SSD-style zoom-out augmentation)."""
+
+    def __init__(self, means: Sequence[float] = (123.0, 117.0, 104.0),
+                 max_expand_ratio: float = 4.0,
+                 rng: Optional[RandomGenerator] = None):
+        self.means = np.asarray(means, np.float32)
+        self.max_ratio = max_expand_ratio
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        r = self.rng.numpy()
+        ratio = float(r.uniform(1.0, self.max_ratio))
+        h, w, c = feature.image.shape
+        nh, nw = int(h * ratio), int(w * ratio)
+        y = int(r.integers(0, nh - h + 1))
+        x = int(r.integers(0, nw - w + 1))
+        canvas = np.empty((nh, nw, c), np.float32)
+        canvas[:] = self.means[:c]
+        canvas[y:y + h, x:x + w] = feature.image
+        feature["expand_offset"] = (x, y)
+        feature["expand_ratio"] = ratio
+        feature.image = canvas
+
+
+class HFlip(FeatureTransformer):
+    """Deterministic horizontal flip (reference ``HFlip.scala``); wrap in
+    RandomTransformer for the usual coin toss."""
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.image = feature.image[:, ::-1].copy()
+        feature["flipped"] = True
+
+
+class Brightness(FeatureTransformer):
+    """Add a uniform delta (reference ``Brightness.scala``)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 rng: Optional[RandomGenerator] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        delta = float(self.rng.numpy().uniform(self.low, self.high))
+        feature.image = feature.image + delta
+
+
+class Contrast(FeatureTransformer):
+    """Scale around zero (reference ``Contrast.scala``)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 rng: Optional[RandomGenerator] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        scale = float(self.rng.numpy().uniform(self.low, self.high))
+        feature.image = feature.image * scale
+
+
+def _rgb_to_hsv(img: np.ndarray) -> np.ndarray:
+    x = img / 255.0
+    mx = x.max(-1)
+    mn = x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) * 60
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return np.stack([h, s, mx], -1)
+
+
+def _hsv_to_rgb(hsv: np.ndarray) -> np.ndarray:
+    h, s, v = hsv[..., 0] / 60.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    r = np.select([i == 0, i == 1, i == 2, i == 3, i == 4], [v, q, p, p, t], v)
+    g = np.select([i == 0, i == 1, i == 2, i == 3, i == 4], [t, v, v, q, p], p)
+    b = np.select([i == 0, i == 1, i == 2, i == 3, i == 4], [p, p, t, v, v], q)
+    return np.stack([r, g, b], -1) * 255.0
+
+
+class Saturation(FeatureTransformer):
+    """Scale HSV saturation (reference ``Saturation.scala``)."""
+
+    def __init__(self, delta_low: float, delta_high: float,
+                 rng: Optional[RandomGenerator] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        scale = float(self.rng.numpy().uniform(self.low, self.high))
+        hsv = _rgb_to_hsv(np.clip(feature.image, 0, 255))
+        hsv[..., 1] = np.clip(hsv[..., 1] * scale, 0, 1)
+        feature.image = _hsv_to_rgb(hsv)
+
+
+class Hue(FeatureTransformer):
+    """Rotate HSV hue by a uniform delta in degrees (reference
+    ``Hue.scala``)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 rng: Optional[RandomGenerator] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        delta = float(self.rng.numpy().uniform(self.low, self.high))
+        hsv = _rgb_to_hsv(np.clip(feature.image, 0, 255))
+        hsv[..., 0] = (hsv[..., 0] + delta) % 360
+        feature.image = _hsv_to_rgb(hsv)
+
+
+class ChannelOrder(FeatureTransformer):
+    """Randomly permute channels (reference ``ChannelOrder.scala``)."""
+
+    def __init__(self, rng: Optional[RandomGenerator] = None):
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        perm = self.rng.numpy().permutation(feature.image.shape[-1])
+        feature.image = feature.image[..., perm]
+
+
+class ColorJitter(FeatureTransformer):
+    """Random brightness/contrast/saturation in random order (reference
+    ``ColorJitter.scala``; also the ImageNet-recipe jitter)."""
+
+    def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
+                 saturation: float = 0.5, shuffle: bool = True,
+                 rng: Optional[RandomGenerator] = None):
+        self.rng = rng or RandomGenerator.default()
+        self.ops = [
+            Brightness(-brightness, brightness, self.rng),
+            Contrast(1 - contrast, 1 + contrast, self.rng),
+            Saturation(1 - saturation, 1 + saturation, self.rng),
+        ]
+        self.shuffle = shuffle
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        order = (self.rng.numpy().permutation(len(self.ops))
+                 if self.shuffle else range(len(self.ops)))
+        for i in order:
+            feature = self.ops[int(i)](feature)
+        feature.image = np.clip(feature.image, 0, 255)
+        return feature
+
+
+class Lighting(FeatureTransformer):
+    """AlexNet-style PCA lighting noise (reference ``Lighting.scala`` with
+    the same ImageNet eigen decomposition constants)."""
+
+    EIG_VAL = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    EIG_VEC = np.asarray([
+        [-0.5675, 0.7192, 0.4009],
+        [-0.5808, -0.0045, -0.8140],
+        [-0.5836, -0.6948, 0.4203],
+    ], np.float32)
+
+    def __init__(self, alphastd: float = 0.1,
+                 rng: Optional[RandomGenerator] = None):
+        self.alphastd = alphastd
+        self.rng = rng or RandomGenerator.default()
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        alpha = self.rng.numpy().normal(0.0, self.alphastd, 3).astype(np.float32)
+        noise = (self.EIG_VEC * alpha * self.EIG_VAL).sum(axis=1)
+        feature.image = feature.image + noise
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(x - mean) / std per channel (reference
+    ``ChannelNormalize.scala``)."""
+
+    def __init__(self, means: Sequence[float], stds: Sequence[float] = (1, 1, 1)):
+        self.means = np.asarray(means, np.float32)
+        self.stds = np.asarray(stds, np.float32)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.image = (feature.image - self.means) / self.stds
+
+
+class ChannelScaledNormalizer(ChannelNormalize):
+    """Mean subtraction + global scale (reference
+    ``ChannelScaledNormalizer.scala``)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 scale: float = 1.0):
+        super().__init__((mean_r, mean_g, mean_b))
+        self.scale = scale
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.image = (feature.image - self.means) * self.scale
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a full per-pixel mean image (reference
+    ``PixelNormalizer.scala``)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.image = feature.image - self.means.reshape(feature.image.shape)
+
+
+class Filler(FeatureTransformer):
+    """Fill a (normalized-coordinate) region with a constant (reference
+    ``Filler.scala``; random-erasing style)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 value: float = 255.0):
+        self.box = (x1, y1, x2, y2)
+        self.value = value
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        img = feature.image.copy()
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        feature.image = img

@@ -1,0 +1,152 @@
+"""ImageFrame / ImageFeature — the vision pipeline's data model.
+
+Reference: ``DL/transform/vision/image/ImageFrame.scala:36`` (trait with
+``LocalImageFrame`` :185 / ``DistributedImageFrame`` :212) and
+``ImageFeature.scala`` (a string-keyed hash of image/bytes/label/metadata).
+
+TPU-native redesign: one host-side ``ImageFrame`` (a list of features —
+the reference's Distributed variant is an RDD of the same thing; here
+distribution happens at the batch-sharding level, not the container
+level). Images are numpy HWC float32 arrays (the reference's OpenCV Mat);
+PIL stands in for the JavaCPP OpenCV codec.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class ImageFeature(dict):
+    """String-keyed feature hash (reference ``ImageFeature.scala``).
+
+    Well-known keys mirror the reference: ``bytes`` (raw file content),
+    ``mat`` (decoded HWC float32 image), ``label``, ``uri``,
+    ``original_size`` ((h, w, c) at decode time), ``size`` (current),
+    ``sample`` (converted Sample), ``prediction``.
+    """
+
+    BYTES = "bytes"
+    MAT = "mat"
+    LABEL = "label"
+    URI = "uri"
+    ORIGINAL_SIZE = "original_size"
+    SAMPLE = "sample"
+    PREDICTION = "prediction"
+
+    def __init__(self, image=None, label=None, uri: Optional[str] = None,
+                 **kw):
+        super().__init__(**kw)
+        if image is not None:
+            if isinstance(image, (bytes, bytearray)):
+                self[self.BYTES] = bytes(image)
+            else:
+                mat = np.asarray(image)
+                self[self.MAT] = mat
+                self[self.ORIGINAL_SIZE] = mat.shape
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self) -> Optional[np.ndarray]:
+        return self.get(self.MAT)
+
+    @image.setter
+    def image(self, mat: np.ndarray) -> None:
+        self[self.MAT] = mat
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+    def size(self):
+        """(h, w, c) of the current image (reference ``getSize``)."""
+        mat = self.get(self.MAT)
+        return None if mat is None else mat.shape
+
+    def width(self) -> int:
+        return self.size()[1]
+
+    def height(self) -> int:
+        return self.size()[0]
+
+
+class ImageFrame:
+    """A collection of ImageFeatures with ``transform`` chaining
+    (reference ``ImageFrame.scala:36``; local variant :185)."""
+
+    def __init__(self, features: Sequence[ImageFeature]):
+        self.features: List[ImageFeature] = list(features)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def read(path: str, with_label: bool = False) -> "ImageFrame":
+        """Read image file(s) (reference ``ImageFrame.read``). ``path`` may
+        be a file or a directory; with_label=True uses subdirectory names
+        as integer class labels (ImageFolder convention)."""
+        from PIL import Image
+
+        feats = []
+        if os.path.isdir(path):
+            if with_label:
+                classes = sorted(
+                    d for d in os.listdir(path)
+                    if os.path.isdir(os.path.join(path, d)))
+                for ci, cls in enumerate(classes):
+                    cdir = os.path.join(path, cls)
+                    for fn in sorted(os.listdir(cdir)):
+                        fp = os.path.join(cdir, fn)
+                        img = np.asarray(Image.open(fp).convert("RGB"), np.float32)
+                        feats.append(ImageFeature(img, label=ci, uri=fp))
+            else:
+                for fn in sorted(os.listdir(path)):
+                    fp = os.path.join(path, fn)
+                    img = np.asarray(Image.open(fp).convert("RGB"), np.float32)
+                    feats.append(ImageFeature(img, uri=fp))
+        else:
+            img = np.asarray(Image.open(path).convert("RGB"), np.float32)
+            feats.append(ImageFeature(img, uri=path))
+        return ImageFrame(feats)
+
+    @staticmethod
+    def from_arrays(images: Iterable[np.ndarray], labels=None) -> "ImageFrame":
+        labels = list(labels) if labels is not None else None
+        feats = []
+        for i, img in enumerate(images):
+            feats.append(ImageFeature(
+                np.asarray(img, np.float32),
+                label=None if labels is None else labels[i]))
+        return ImageFrame(feats)
+
+    # -- transformation ----------------------------------------------------
+    def transform(self, transformer) -> "ImageFrame":
+        """Apply a FeatureTransformer to every feature (reference
+        ``ImageFrame.transform``). Returns self for chaining."""
+        self.features = [transformer(f) for f in self.features]
+        return self
+
+    def __rshift__(self, transformer) -> "ImageFrame":
+        return self.transform(transformer)
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    def __iter__(self):
+        return iter(self.features)
+
+    def __getitem__(self, i) -> ImageFeature:
+        return self.features[i]
+
+    # -- conversion --------------------------------------------------------
+    def to_samples(self):
+        """Collected Samples (features must have passed ImageFrameToSample)."""
+        return [f[ImageFeature.SAMPLE] for f in self.features]
+
+    def to_dataset(self):
+        from bigdl_tpu.dataset.dataset import DataSet
+
+        return DataSet.array(self.to_samples())

@@ -1,0 +1,122 @@
+"""ROI label transforms — keep detection labels consistent with image
+augmentation.
+
+Reference: ``DL/transform/vision/image/label/roi/`` — ``RoiLabel`` (class
++ bbox (+ masks) ground truth), ``RoiNormalize``, ``RoiHFlip``,
+``RoiResize``, ``RoiProject`` (crop/expand coordinate projection).
+Boxes are (N, 4) xyxy pixel coordinates unless normalized.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.vision.image_frame import ImageFeature
+from bigdl_tpu.vision.transformer import FeatureTransformer
+
+LABEL_KEY = "roi_label"
+
+
+class RoiLabel:
+    """Ground truth for one image (reference ``RoiLabel.scala``):
+    ``classes`` (N,), ``bboxes`` (N, 4) xyxy, optional ``masks``
+    (list of (H, W) binary arrays or polygon lists)."""
+
+    def __init__(self, classes: np.ndarray, bboxes: np.ndarray, masks=None):
+        self.classes = np.asarray(classes)
+        self.bboxes = np.asarray(bboxes, np.float32).reshape(-1, 4)
+        self.masks = masks
+
+    def __len__(self):
+        return len(self.classes)
+
+    def copy(self) -> "RoiLabel":
+        return RoiLabel(self.classes.copy(), self.bboxes.copy(),
+                        None if self.masks is None else list(self.masks))
+
+
+def attach_roi(feature: ImageFeature, label: RoiLabel) -> ImageFeature:
+    feature[LABEL_KEY] = label
+    return feature
+
+
+class RoiNormalize(FeatureTransformer):
+    """Pixel xyxy -> normalized [0, 1] (reference ``RoiNormalize.scala``)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        roi: Optional[RoiLabel] = feature.get(LABEL_KEY)
+        if roi is not None:
+            h, w = feature.image.shape[:2]
+            roi = roi.copy()
+            roi.bboxes[:, 0::2] /= w
+            roi.bboxes[:, 1::2] /= h
+            feature[LABEL_KEY] = roi
+        return feature
+
+
+class RoiHFlip(FeatureTransformer):
+    """Mirror boxes (and masks) after HFlip (reference
+    ``RoiHFlip.scala``). ``normalized`` selects coordinate space."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        roi: Optional[RoiLabel] = feature.get(LABEL_KEY)
+        if roi is not None:
+            width = 1.0 if self.normalized else feature.image.shape[1]
+            roi = roi.copy()
+            x1 = roi.bboxes[:, 0].copy()
+            roi.bboxes[:, 0] = width - roi.bboxes[:, 2]
+            roi.bboxes[:, 2] = width - x1
+            if roi.masks is not None:
+                roi.masks = [np.asarray(m)[:, ::-1].copy() for m in roi.masks]
+            feature[LABEL_KEY] = roi
+        return feature
+
+
+class RoiResize(FeatureTransformer):
+    """Scale pixel boxes to the current image size after a Resize
+    (reference ``RoiResize.scala``). Requires ORIGINAL_SIZE."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        roi: Optional[RoiLabel] = feature.get(LABEL_KEY)
+        if roi is not None:
+            oh, ow = feature[ImageFeature.ORIGINAL_SIZE][:2]
+            h, w = feature.image.shape[:2]
+            roi = roi.copy()
+            roi.bboxes[:, 0::2] *= w / ow
+            roi.bboxes[:, 1::2] *= h / oh
+            if roi.masks is not None:
+                from bigdl_tpu.vision.augmentation import resize_image
+
+                roi.masks = [
+                    (resize_image(np.asarray(m, np.float32), h, w) > 0.5)
+                    for m in roi.masks
+                ]
+            feature[LABEL_KEY] = roi
+        return feature
+
+
+class RoiProject(FeatureTransformer):
+    """Project boxes through a crop recorded in feature['crop_box']
+    (reference ``RoiProject.scala``): shift, clip, drop empty boxes."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        roi: Optional[RoiLabel] = feature.get(LABEL_KEY)
+        crop = feature.get("crop_box")
+        if roi is not None and crop is not None:
+            x1, y1, x2, y2 = crop
+            roi = roi.copy()
+            roi.bboxes[:, 0::2] = np.clip(roi.bboxes[:, 0::2] - x1, 0, x2 - x1)
+            roi.bboxes[:, 1::2] = np.clip(roi.bboxes[:, 1::2] - y1, 0, y2 - y1)
+            keep = ((roi.bboxes[:, 2] > roi.bboxes[:, 0]) &
+                    (roi.bboxes[:, 3] > roi.bboxes[:, 1]))
+            roi.bboxes = roi.bboxes[keep]
+            roi.classes = roi.classes[keep]
+            if roi.masks is not None:
+                roi.masks = [m for m, k in zip(roi.masks, keep) if k]
+            feature[LABEL_KEY] = roi
+        return feature

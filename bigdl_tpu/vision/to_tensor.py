@@ -1,0 +1,48 @@
+"""Image -> tensor conversion (reference:
+``DL/transform/vision/image/MatToTensor.scala``, ``ImageFrameToSample``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.vision.image_frame import ImageFeature
+from bigdl_tpu.vision.transformer import FeatureTransformer
+
+
+class MatToTensor(FeatureTransformer):
+    """HWC float image -> CHW float32 tensor under feature['tensor']
+    (reference ``MatToTensor.scala``; to_chw mirrors ``toRGB``/format
+    knobs)."""
+
+    def __init__(self, to_chw: bool = True, key: str = "tensor"):
+        self.to_chw = to_chw
+        self.key = key
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        img = np.asarray(feature.image, np.float32)
+        if self.to_chw and img.ndim == 3:
+            img = img.transpose(2, 0, 1)
+        feature[self.key] = np.ascontiguousarray(img)
+        return feature
+
+
+class ImageFrameToSample(FeatureTransformer):
+    """Pack feature['tensor'] (+ label) into a Sample under SAMPLE
+    (reference ``ImageFrameToSample.scala``)."""
+
+    def __init__(self, input_keys=("tensor",), target_keys=("label",)):
+        self.input_keys = list(input_keys)
+        self.target_keys = list(target_keys)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        feats = [np.asarray(feature[k], np.float32) for k in self.input_keys]
+        targets = [
+            np.asarray(feature[k]) for k in self.target_keys
+            if feature.get(k) is not None
+        ]
+        feature[ImageFeature.SAMPLE] = Sample(
+            feats[0] if len(feats) == 1 else tuple(feats),
+            (targets[0] if len(targets) == 1 else tuple(targets)) if targets else None,
+        )
+        return feature

@@ -1,0 +1,83 @@
+"""FeatureTransformer — per-feature transform with ``>>`` chaining.
+
+Reference: ``DL/transform/vision/image/FeatureTransformer.scala`` (chains
+via ``->``; failures logged and the feature passed through when
+``ignoreImageException`` is set).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.rng import RandomGenerator
+from bigdl_tpu.vision.image_frame import ImageFeature
+
+log = logging.getLogger(__name__)
+
+
+class FeatureTransformer:
+    """Base class: override :meth:`transform_mat` (image-only transforms)
+    or :meth:`transform` (full feature access)."""
+
+    ignore_image_exception = False
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        """Mutate feature[MAT] in place (most augmentations)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        try:
+            self.transform_mat(feature)
+        except Exception:
+            if not self.ignore_image_exception:
+                raise
+            log.exception("transformer %s failed; passing feature through",
+                          type(self).__name__)
+        return feature
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        return self.transform(feature)
+
+    def __rshift__(self, other: "FeatureTransformer") -> "ChainedFeatureTransformer":
+        return ChainedFeatureTransformer([self, other])
+
+    def apply_frame(self, frame):
+        return frame.transform(self)
+
+
+class ChainedFeatureTransformer(FeatureTransformer):
+    """Reference: ``FeatureTransformer.->`` composition."""
+
+    def __init__(self, transformers: Sequence[FeatureTransformer]):
+        self.transformers = list(transformers)
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        for t in self.transformers:
+            feature = t(feature)
+        return feature
+
+    def __rshift__(self, other: FeatureTransformer) -> "ChainedFeatureTransformer":
+        return ChainedFeatureTransformer(self.transformers + [other])
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply ``inner`` with probability ``prob`` (reference
+    ``augmentation/RandomTransformer.scala``)."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float,
+                 rng: Optional[RandomGenerator] = None):
+        self.inner = inner
+        self.prob = prob
+        self.rng = rng or RandomGenerator.default()
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if self.rng.numpy().random() < self.prob:
+            return self.inner(feature)
+        return feature
+
+
+class Pipeline(ChainedFeatureTransformer):
+    """Alias matching the reference python API naming (``Pipeline`` in
+    ``PY/transform/vision/image.py``)."""

@@ -145,6 +145,27 @@ def test_v1_legacy_layers_load(tmp_path):
     assert (out >= 0).all()  # in-place ReLU applied
 
 
+def test_nested_sequential_roundtrip(tmp_path):
+    """Nested Sequentials must export with unique layer names
+    (walker path-qualified naming) and round-trip numerically."""
+    block = lambda cin, cout: nn.Sequential(  # noqa: E731
+        nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1), nn.ReLU())
+    model = nn.Sequential(block(3, 4), block(4, 5))
+    params, state = model.init(jax.random.key(2))
+    x = np.random.RandomState(0).rand(2, 3, 6, 6).astype("float32")
+    want = _predict(model, params, state, x)
+
+    proto = str(tmp_path / "n.prototxt")
+    weights = str(tmp_path / "n.caffemodel")
+    save_caffe(model, params, state, proto, weights, input_shape=(1, 3, 6, 6))
+    net = CaffeLoader.parse_prototxt(proto)
+    names = [l.name for l in net.layer]
+    assert len(names) == len(set(names)), f"duplicate layer names: {names}"
+    g, p, s = load_caffe(proto, weights)
+    got = _predict(g, p, s, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_floor_mode_pooling_roundtrips(tmp_path):
     """Floor-mode pooling must survive persist->load (round_mode=FLOOR);
     caffe's default is ceil."""

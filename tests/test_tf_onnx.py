@@ -245,6 +245,52 @@ def test_onnx_resnet_block_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_same_padding_conv_exports(tmp_path):
+    """SAME-mode convs (pad = -1) must export as SAME/auto_pad, not as
+    negative explicit pads."""
+    model = nn.Sequential(nn.SpatialConvolution(1, 2, 3, 3, 1, 1, -1, -1))
+    params, state = model.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(1, 1, 8, 8).astype("float32")
+    want = _predict(model, params, state, x)
+    assert want.shape == (1, 2, 8, 8)
+
+    opath = str(tmp_path / "same.onnx")
+    save_onnx(model, params, state, opath, input_shape=(1, 1, 8, 8))
+    mod, p, s = load_onnx(opath)
+    np.testing.assert_allclose(_predict(mod, p, s, x), want, rtol=1e-5, atol=1e-6)
+
+    tpath = str(tmp_path / "same.pb")
+    save_tf_graph(model, params, state, tpath, input_shape=(-1, 1, 8, 8))
+    mod, p, s = load_tf_graph(tpath, inputs=["input"], outputs=["output"])
+    np.testing.assert_allclose(_predict(mod, p, s, x), want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_opset13_axes_as_inputs():
+    """Squeeze/ReduceSum with axes as an INPUT tensor (opset 13)."""
+    from bigdl_tpu.interop.onnx import onnx_pb2 as opb
+    from bigdl_tpu.interop.onnx.loader import ONNXModule, numpy_to_tensor
+
+    g = opb.GraphProto(name="g")
+    g.input.add(name="x")
+    g.initializer.append(numpy_to_tensor(np.asarray([0], np.int64), "axes0"))
+    n1 = g.node.add(op_type="Squeeze", name="sq")
+    n1.input.extend(["x", "axes0"])
+    n1.output.append("sq_out")
+    g.initializer.append(numpy_to_tensor(np.asarray([1], np.int64), "axes1"))
+    n2 = g.node.add(op_type="ReduceSum", name="rs")
+    n2.input.extend(["sq_out", "axes1"])
+    n2.output.append("out")
+    g.output.add(name="out")
+    model = opb.ModelProto(ir_version=8, graph=g)
+    mod = ONNXModule(model)
+    params, state = mod.init(jax.random.key(0))
+    x = np.arange(6, dtype=np.float32).reshape(1, 3, 2)
+    out = _predict(mod, params, state, x)
+    # squeeze axis 0 only -> (3, 2); reduce over axis 1 keepdims -> (3, 1)
+    assert out.shape == (3, 1)
+    np.testing.assert_allclose(out[:, 0], x[0].sum(axis=1))
+
+
 def test_onnx_gemm_module():
     """Reference DL/nn/onnx/Gemm parity: alpha*A'B' + beta*C."""
     gemm = onnx_ops.Gemm(alpha=0.5, beta=2.0, trans_b=True)
