@@ -85,11 +85,59 @@ def build_step(model, criterion, method):
     return step
 
 
+def run_host_pipeline(model, criterion, method, batch, n_iters, compute_dtype):
+    """Measured data->device training throughput: batches come from the
+    host input pipeline (TensorDataSet sliced fast path + background
+    feeder thread + async device_put), NOT a resident device batch."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.prefetch import device_prefetch
+
+    n = 4 * batch
+    # feed uint8 images and normalize ON DEVICE — 4x fewer host->device
+    # bytes than fp32, exactly how the image pipeline feeds real training
+    x = (np.random.rand(n, 3, 224, 224) * 255).astype(np.uint8)
+    y = np.random.randint(0, 1000, (n,)).astype(np.int32)
+    ds = DataSet.tensors(x, y)
+
+    params, mstate = model.init(jax.random.key(0))
+    ostate = method.init_state(params)
+    step = build_step(model, criterion, method)
+
+    @jax.jit
+    def one(params, mstate, ostate, xb, yb):
+        xb = (xb.astype(compute_dtype) - 127.0) / 128.0
+        (p, ms, os), loss = step((params, mstate, ostate), (xb, yb))
+        return p, ms, os, loss
+
+    def run(iters):
+        nonlocal params, mstate, ostate
+        it = device_prefetch(ds.batches(batch, train=True), host_depth=4)
+        t0 = None
+        loss = None
+        for i, (xb, yb) in enumerate(it):
+            params, mstate, ostate, loss = one(params, mstate, ostate, xb, yb)
+            if i == 0:
+                float(loss)  # compile boundary: start timing after warmup
+                t0 = time.perf_counter()
+            if i >= iters:
+                break
+        float(loss)
+        return time.perf_counter() - t0
+
+    t1 = run(n_iters // 4)
+    t2 = run(n_iters)
+    dt = (t2 - t1) / (n_iters - n_iters // 4)
+    return batch / dt
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--short", type=int, default=4)
     ap.add_argument("--long", type=int, default=20)
+    ap.add_argument("--host-pipeline", action="store_true",
+                    help="also measure data->device throughput fed from the "
+                         "host input pipeline (extra JSON field)")
     args = ap.parse_args()
 
     from bigdl_tpu.models import resnet
@@ -170,9 +218,29 @@ def main():
     else:
         peak_measured, mfu, mfu_spec = None, None, None
 
+    host_rate = xfer_bw = None
+    if args.host_pipeline:
+        host_rate = run_host_pipeline(
+            model, criterion, method, batch, n2 * 2, compute_dtype)
+        # measured host->device bandwidth: on this tunneled runner it is
+        # ~40-70 MB/s (the wall for any host-fed mode); a real TPU-VM PCIe
+        # link does GB/s and closes the gap to the resident-batch number
+        probe = (np.random.rand(batch, 3, 224, 224) * 255).astype(np.uint8)
+        fetch = jax.jit(lambda a: jnp.float32(a).sum())
+        float(fetch(jax.device_put(probe)))  # warmup: compiles cast+sum too
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fetch(jax.device_put(probe)))
+            best = min(best, time.perf_counter() - t0)
+        xfer_bw = probe.nbytes / best
+
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
+        **({"host_pipeline_images_per_sec": round(host_rate, 2),
+            "host_to_device_MBps": round(xfer_bw / 1e6, 1)}
+           if host_rate is not None else {}),
         "unit": "images/sec/chip",
         "vs_baseline": round(imgs_per_sec / 3000.0, 4),
         "batch": batch,

@@ -40,6 +40,16 @@ class AbstractDataSet:
     def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
         return self.transform(transformer)
 
+    def batches(self, batch_size: int, train: bool,
+                partial_batch: bool = False) -> Iterator[Any]:
+        """MiniBatch iterator. Default: group ``data()`` samples via
+        SampleToMiniBatch; array-backed datasets override with a sliced
+        fast path (no per-sample Python objects)."""
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+        return SampleToMiniBatch(batch_size, partial_batch=partial_batch).apply(
+            self.data(train))
+
 
 class ArrayDataSet(AbstractDataSet):
     """In-memory dataset of Samples or arbitrary elements
@@ -95,6 +105,43 @@ class TensorDataSet(AbstractDataSet):
             perm = self.rng.permutation(len(self.features))
             for i in perm:
                 yield Sample(self.features[i], None if self.labels is None else self.labels[i])
+
+    def batches(self, batch_size: int, train: bool,
+                partial_batch: bool = False) -> Iterator["MiniBatch"]:
+        """Sliced fast path: one vectorized fancy-index gather per batch —
+        no per-sample Sample objects, no re-stacking (the reference's
+        ``MTLabeledBGRImgToBatch`` multi-threaded batcher exists to get the
+        same effect on the JVM)."""
+        from bigdl_tpu.dataset.sample import MiniBatch
+
+        n = len(self.features)
+
+        def eval_batches():
+            for i in range(0, n, batch_size):
+                if i + batch_size > n and not partial_batch:
+                    return
+                idx = slice(i, min(i + batch_size, n))
+                yield MiniBatch(
+                    self.features[idx],
+                    None if self.labels is None else self.labels[idx],
+                )
+
+        if train and batch_size > n:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds dataset size {n}: the "
+                "drop-last training stream would never yield a batch")
+
+        def train_batches():
+            while True:
+                perm = self.rng.permutation(n)
+                for i in range(0, n - batch_size + 1, batch_size):
+                    idx = perm[i:i + batch_size]
+                    yield MiniBatch(
+                        self.features[idx],
+                        None if self.labels is None else self.labels[idx],
+                    )
+
+        return train_batches() if train else eval_batches()
 
 
 class TransformedDataSet(AbstractDataSet):

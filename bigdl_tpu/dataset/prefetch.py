@@ -32,9 +32,14 @@ def device_prefetch(
     batches: Iterator[MiniBatch],
     sharding=None,
     buffer_size: int = 2,
+    host_depth: int = 0,
 ):
     """Yield (input, target) device trees, keeping a small pipeline of
-    transfers in flight ahead of compute."""
+    transfers in flight ahead of compute. ``host_depth > 0`` additionally
+    runs the host pipeline in a background thread (see
+    :func:`host_prefetch`) so decode/augment overlaps device compute."""
+    if host_depth > 0:
+        batches = host_prefetch(batches, host_depth)
     queue = collections.deque()
     batches = iter(batches)
     for batch in itertools.islice(batches, buffer_size):
@@ -45,3 +50,58 @@ def device_prefetch(
         if nxt is not None:
             queue.append(device_put_batch(nxt, sharding))
         yield out
+
+
+def host_prefetch(items: Iterator, depth: int = 4) -> Iterator:
+    """Run the producing iterator in a background thread, buffering up to
+    ``depth`` ready items (the host-side staging stage between the input
+    pipeline and device infeed — reference analogue: the ThreadPool-driven
+    ``MTLabeledBGRImgToBatch`` batcher).
+
+    Items (MiniBatches / arrays) cross threads by reference through a
+    bounded ``queue.Queue`` — no serialization. (Byte-record streams have
+    their own native-ring staging in ``TFRecordPrefetcher``.) The producer
+    thread shuts down promptly when the consumer abandons the generator
+    (the normal way training loops exit an infinite batch stream).
+    """
+    import queue as _queue
+    import threading
+
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    stop = threading.Event()
+    err: list = []
+
+    def produce():
+        try:
+            for item in items:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surface pipeline errors to the consumer
+            err.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(_SENTINEL, timeout=0.05)
+                    break
+                except _queue.Full:
+                    continue
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()  # unblock and retire the producer on early exit

@@ -89,6 +89,8 @@ class Optimizer:
         self._params = None
         self._module_state = None
         self._optim_state = None
+        # background host-pipeline depth (0 disables the feeder thread)
+        self.host_prefetch_depth = 2
         self._rng = jax.random.key(self.config.seed)
 
     # ------------------------------------------------ builder setters ----
@@ -303,16 +305,27 @@ class Optimizer:
             records_processed_this_epoch=meta.get("records", 0),
         )
 
+    def _train_batches(self):
+        """Training MiniBatch stream. Array-backed datasets take the
+        sliced fast path (one fancy-index gather per batch); datasets
+        already composed with ``>> SampleToMiniBatch`` stream as built."""
+        from bigdl_tpu.dataset.dataset import TensorDataSet
+
+        if isinstance(self.dataset, TensorDataSet):
+            return self.dataset.batches(self.batch_size, train=True)
+        return self.dataset.data(train=True)
+
     def _optimize_impl(self):
         self._ensure_initialized()
         step_fn, data_sharding = self._build_step()
         self._data_sharding = data_sharding
         self._eval_fn = None  # rebuilt lazily, once per optimize run
         train_size = self.dataset.size()
-        batches = self.dataset.data(train=True)
+        batches = self._train_batches()
         state = self.state
 
-        for x, y in device_prefetch(batches, data_sharding):
+        for x, y in device_prefetch(batches, data_sharding,
+                                    host_depth=self.host_prefetch_depth):
             if self.end_when(state):
                 break
             t0 = time.time()

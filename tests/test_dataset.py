@@ -104,3 +104,73 @@ def test_device_prefetch():
     assert len(out) == 4
     x, y = out[0]
     assert x.shape == (8, 4) and y.shape == (8,)
+
+
+def test_tensor_dataset_sliced_batches_fast_path():
+    """TensorDataSet.batches slices batches directly (no per-sample
+    objects) and matches the sample-path content."""
+    rs = np.random.RandomState(0)
+    x = rs.rand(20, 3).astype(np.float32)
+    y = (np.arange(20) % 4).astype(np.int32)
+    ds = DataSet.tensors(x, y)
+
+    evs = list(ds.batches(8, train=False, partial_batch=True))
+    assert [b.size() for b in evs] == [8, 8, 4]
+    np.testing.assert_allclose(np.concatenate([b.input for b in evs]), x)
+    np.testing.assert_array_equal(np.concatenate([b.target for b in evs]), y)
+
+    it = ds.batches(8, train=True)
+    seen = [next(it) for _ in range(5)]  # crosses an epoch boundary (2/epoch)
+    for b in seen:
+        assert b.input.shape == (8, 3)
+        # each batch row must be an original row with its own label
+        for row, lab in zip(b.input, b.target):
+            j = np.where((x == row).all(axis=1))[0][0]
+            assert y[j] == lab
+
+
+def test_host_prefetch_thread_and_errors():
+    from bigdl_tpu.dataset.prefetch import host_prefetch
+
+    # arrays pass through in order
+    items = [np.full((2,), i) for i in range(10)]
+    out = list(host_prefetch(iter(items), depth=3))
+    assert len(out) == 10
+    np.testing.assert_array_equal(out[7], items[7])
+
+    # abandoning the consumer retires the producer thread promptly
+    import threading
+    import time as _time
+    before = threading.active_count()
+    gen = host_prefetch(iter(np.zeros((100, 2))), depth=2)
+    next(gen)
+    gen.close()  # consumer walks away (optimizer break path)
+    _time.sleep(0.3)
+    assert threading.active_count() <= before + 1
+
+    # producer exceptions surface in the consumer
+    def boom():
+        yield np.zeros(1)
+        raise RuntimeError("pipeline exploded")
+
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="pipeline exploded"):
+        list(host_prefetch(boom(), depth=2))
+
+
+def test_optimizer_uses_fast_path_for_tensor_dataset():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(64, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.int32)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2), nn.LogSoftMax())
+    # pass the RAW TensorDataSet (not pre-batched): optimizer takes the
+    # sliced fast path and still trains
+    opt = optim.LocalOptimizer(model, DataSet.tensors(x, y), nn.ClassNLLCriterion(),
+                               batch_size=16)
+    opt.set_optim_method(optim.SGD(learning_rate=0.5))
+    opt.set_end_when(optim.Trigger.max_iteration(30))
+    params, _ = opt.optimize()
+    assert opt.state.loss < 0.5
