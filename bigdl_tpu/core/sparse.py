@@ -1,0 +1,111 @@
+"""Sparse tensor support.
+
+Reference: ``DL/tensor/SparseTensor.scala`` (1,467 LoC COO tensor) +
+``SparseTensorBLAS``/``SparseTensorMath``, consumed by
+``LookupTableSparse``/``SparseLinear`` and ``SparseMiniBatch``
+(``MiniBatch.scala:588``).
+
+TPU-native redesign: XLA wants static shapes, so the device-side format is
+**padded COO** — every bag/row padded to a fixed ``max_nnz`` with a
+validity mask; gathers + masked reductions replace the reference's sparse
+BLAS loops and map onto the MXU/VPU cleanly. The host-side
+:class:`SparseTensor` is a plain numpy COO container with dense
+round-trips and CSR views; ``to_padded`` produces the device layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SparseTensor:
+    """Host-side COO tensor (reference ``SparseTensor.scala``):
+    ``indices`` (nnz, ndim) int32, ``values`` (nnz,), ``shape``."""
+
+    def __init__(self, indices: np.ndarray, values: np.ndarray,
+                 shape: Sequence[int]):
+        self.indices = np.asarray(indices, np.int32).reshape(-1, len(shape))
+        self.values = np.asarray(values)
+        self.shape = tuple(int(d) for d in shape)
+        if len(self.indices) != len(self.values):
+            raise ValueError("indices/values length mismatch")
+
+    @property
+    def nnz(self) -> int:
+        return len(self.values)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_dense(dense: np.ndarray) -> "SparseTensor":
+        dense = np.asarray(dense)
+        idx = np.argwhere(dense != 0)
+        return SparseTensor(idx, dense[tuple(idx.T)], dense.shape)
+
+    @staticmethod
+    def from_bags(bags: Sequence[Sequence[int]], n_cols: int,
+                  weights: Optional[Sequence[Sequence[float]]] = None) -> "SparseTensor":
+        """Ragged id-bags -> 2-D sparse (reference python API takes
+        (indices, values) pairs per row)."""
+        rows, cols, vals = [], [], []
+        for r, bag in enumerate(bags):
+            for j, c in enumerate(bag):
+                rows.append(r)
+                cols.append(int(c))
+                vals.append(1.0 if weights is None else float(weights[r][j]))
+        idx = np.stack([rows, cols], -1) if rows else np.zeros((0, 2), np.int32)
+        return SparseTensor(idx, np.asarray(vals, np.float32),
+                            (len(bags), n_cols))
+
+    # -- conversions -------------------------------------------------------
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.shape, self.values.dtype)
+        if self.nnz:
+            np.add.at(out, tuple(self.indices.T), self.values)
+        return out
+
+    def to_csr(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(indptr, col_indices, values) for a 2-D tensor."""
+        if self.ndim != 2:
+            raise ValueError("CSR view requires a 2-D sparse tensor")
+        order = np.lexsort((self.indices[:, 1], self.indices[:, 0]))
+        rows = self.indices[order, 0]
+        cols = self.indices[order, 1]
+        vals = self.values[order]
+        indptr = np.zeros(self.shape[0] + 1, np.int32)
+        np.add.at(indptr, rows + 1, 1)
+        return np.cumsum(indptr).astype(np.int32), cols, vals
+
+    def to_padded(self, max_nnz: Optional[int] = None):
+        """Device layout for a 2-D (batch x feature) sparse tensor:
+        ``(ids (B, max_nnz) int32, weights (B, max_nnz) f32,
+        mask (B, max_nnz) f32)`` — the static-shape padded-COO format every
+        sparse module consumes."""
+        if self.ndim != 2:
+            raise ValueError("to_padded requires a 2-D sparse tensor")
+        b = self.shape[0]
+        counts = np.zeros(b, np.int64)
+        if self.nnz:
+            np.add.at(counts, self.indices[:, 0], 1)
+        width = int(max_nnz if max_nnz is not None else max(1, counts.max()))
+        if counts.max() > width:
+            raise ValueError(f"row has {counts.max()} nnz > max_nnz={width}")
+        ids = np.zeros((b, width), np.int32)
+        weights = np.zeros((b, width), np.float32)
+        mask = np.zeros((b, width), np.float32)
+        cursor = np.zeros(b, np.int64)
+        for (r, c), v in zip(self.indices, self.values):
+            k = cursor[r]
+            ids[r, k] = c
+            weights[r, k] = v
+            mask[r, k] = 1.0
+            cursor[r] += 1
+        return ids, weights, mask
+
+    def __repr__(self):
+        return f"SparseTensor(shape={self.shape}, nnz={self.nnz})"

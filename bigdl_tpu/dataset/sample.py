@@ -102,3 +102,69 @@ def _stack_padded(arrays, padding: Optional[PaddingParam]):
             a = a[:target]
         out.append(a)
     return np.stack(out)
+
+
+class SparseMiniBatch(MiniBatch):
+    """MiniBatch over sparse features (reference ``SparseMiniBatch``,
+    ``MiniBatch.scala:588``): stacks per-sample (ids, weights) bags into
+    the padded-COO device layout (ids, weights, mask), reusing
+    ``core.sparse.SparseTensor`` for the packing (raises on max_nnz
+    overflow rather than silently truncating).
+
+    ``stack(samples, max_nnz)``: each sample's feature is a
+    ``(ids, weights)`` pair (weights may be None) or a single-row
+    ``core.sparse.SparseTensor``.
+    """
+
+    @staticmethod
+    def stack(samples: Sequence[Sample], max_nnz: Optional[int] = None) -> "SparseMiniBatch":
+        from bigdl_tpu.core.sparse import SparseTensor
+
+        bags, weights, n_cols = [], [], 1
+        for s in samples:
+            f = s.feature
+            if isinstance(f, SparseTensor):
+                if f.shape[0] != 1:
+                    raise ValueError(
+                        f"sample feature SparseTensor must be single-row, got shape {f.shape}")
+                bags.append([int(c) for c in f.indices[:, 1]])
+                weights.append([float(v) for v in f.values])
+                n_cols = max(n_cols, f.shape[1])
+            else:
+                ids_, w_ = (f if isinstance(f, tuple) else (f, None))
+                ids_ = [int(i) for i in np.asarray(ids_, np.int64).reshape(-1)]
+                bags.append(ids_)
+                weights.append(
+                    [1.0] * len(ids_) if w_ is None
+                    else [float(v) for v in np.asarray(w_, np.float32).reshape(-1)])
+                n_cols = max(n_cols, (max(ids_) + 1) if ids_ else 1)
+        st = SparseTensor.from_bags(bags, n_cols, weights)
+        ids, vals, mask = st.to_padded(max_nnz)
+        target = None
+        if samples[0].label is not None:
+            target = np.stack([np.asarray(s.label) for s in samples])
+        return SparseMiniBatch((ids, vals, mask), target)
+
+
+class SampleToSparseMiniBatch:
+    """Transformer: group sparse-feature samples into SparseMiniBatches
+    (reference pairs ``SparseMiniBatch`` with ``SampleToMiniBatch``)."""
+
+    def __init__(self, batch_size: int, max_nnz: Optional[int] = None,
+                 partial_batch: bool = False):
+        self.batch_size = batch_size
+        self.max_nnz = max_nnz
+        self.partial_batch = partial_batch
+
+    def apply(self, it):
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield SparseMiniBatch.stack(buf, self.max_nnz)
+                buf = []
+        if buf and self.partial_batch:
+            yield SparseMiniBatch.stack(buf, self.max_nnz)
+
+    def __call__(self, it):
+        return self.apply(iter(it))

@@ -1,0 +1,135 @@
+"""Sparse layers: embedding bags and sparse-input linear.
+
+Reference: ``DL/nn/LookupTableSparse.scala`` (embedding over a
+SparseTensor of ids with sum/mean/sqrtn combiners),
+``DL/nn/SparseLinear.scala``, ``DL/nn/SparseJoinTable.scala``.
+
+TPU-native: inputs arrive in the padded-COO device layout
+``(ids, weights, mask)`` produced by ``SparseTensor.to_padded`` /
+``SparseMiniBatch`` — gathers over the embedding matrix plus masked
+reductions, all static-shaped so XLA tiles them; no sparse BLAS loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, RandomNormal, Xavier, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+class LookupTableSparse(Module):
+    """Embedding bag (reference ``LookupTableSparse.scala``).
+
+    Input: ``(ids, weights, mask)`` each (B, max_nnz); output
+    (B, n_output). ``combiner``: "sum" | "mean" | "sqrtn" — identical
+    semantics to the reference / TF ``embedding_lookup_sparse``: weights
+    multiply the gathered rows; mean divides by ``sum(weights)`` and
+    sqrtn by ``sqrt(sum(weights^2))`` over the VALID entries.
+    """
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: Optional[float] = None,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"unknown combiner {combiner!r}")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+
+    def build_params(self, rng):
+        return {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"), (self.n_index, self.n_output),
+                self.n_index, self.n_output,
+            )
+        }
+
+    def forward(self, ctx: Context, x):
+        ids, weights, mask = x
+        table = ctx.param("weight")
+        rows = table[ids]  # (B, nnz, out)
+        if self.max_norm is not None:
+            # clip only the GATHERED rows — norming the whole table would
+            # touch n_index * n_output elements to use B * max_nnz rows
+            norms = jnp.linalg.norm(rows, axis=-1, keepdims=True)
+            rows = rows * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        wv = weights * mask
+        summed = (rows * wv[..., None].astype(rows.dtype)).sum(axis=1)
+        if self.combiner == "sum":
+            return summed
+        if self.combiner == "mean":
+            denom = wv.sum(axis=1, keepdims=True)
+        else:  # sqrtn
+            denom = jnp.sqrt(jnp.square(wv).sum(axis=1, keepdims=True))
+        return summed / jnp.maximum(denom, 1e-12)
+
+
+class SparseLinear(Module):
+    """Linear over a padded-COO sparse input (reference
+    ``SparseLinear.scala``): y = W_sparse-gather + b, i.e. for each row,
+    sum_j v_j * W[:, id_j]."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or Xavier()
+        self.bias_init = bias_init or Zeros()
+
+    def build_params(self, rng):
+        p = {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"), (self.output_size, self.input_size),
+                self.input_size, self.output_size,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.bias_init(
+                fold_in_str(rng, "bias"), (self.output_size,),
+                self.input_size, self.output_size,
+            )
+        return p
+
+    def forward(self, ctx: Context, x):
+        ids, weights, mask = x
+        w = ctx.param("weight")  # (out, in)
+        cols = w.T[ids]  # (B, nnz, out) — gather input columns
+        v = (weights * mask)[..., None].astype(cols.dtype)
+        y = (cols * v).sum(axis=1)
+        if self.with_bias:
+            y = y + ctx.param("bias").astype(y.dtype)
+        return y
+
+
+class SparseJoinTable(Module):
+    """Concatenate padded-COO inputs along the nnz axis with column
+    offsets (reference ``SparseJoinTable.scala`` joins 2-D sparse tensors
+    along dim 2)."""
+
+    def __init__(self, input_sizes):
+        super().__init__()
+        self.input_sizes = list(input_sizes)
+
+    def forward(self, ctx: Context, xs):
+        ids_parts, w_parts, m_parts = [], [], []
+        offset = 0
+        for (ids, weights, mask), width in zip(xs, self.input_sizes):
+            ids_parts.append(ids + offset)
+            w_parts.append(weights)
+            m_parts.append(mask)
+            offset += width
+        return (
+            jnp.concatenate(ids_parts, axis=1),
+            jnp.concatenate(w_parts, axis=1),
+            jnp.concatenate(m_parts, axis=1),
+        )

@@ -1,0 +1,159 @@
+"""Sparse tier tests (reference: ``DL/tensor/SparseTensor.scala``,
+``DL/nn/LookupTableSparse.scala``, ``DL/nn/SparseLinear.scala``,
+``SparseMiniBatch`` at ``MiniBatch.scala:588``).
+
+Oracle strategy: every sparse op is checked against its dense
+equivalent (one-hot matmul / dense gather-sum)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.sparse import SparseTensor
+from bigdl_tpu.dataset.sample import Sample, SampleToSparseMiniBatch, SparseMiniBatch
+
+
+def test_sparse_tensor_dense_roundtrip():
+    rs = np.random.RandomState(0)
+    dense = rs.rand(5, 7) * (rs.rand(5, 7) > 0.6)
+    st = SparseTensor.from_dense(dense)
+    assert st.nnz == int((dense != 0).sum())
+    np.testing.assert_allclose(st.to_dense(), dense)
+
+
+def test_sparse_tensor_csr():
+    dense = np.asarray([[0, 2, 0], [1, 0, 3], [0, 0, 0]], np.float32)
+    st = SparseTensor.from_dense(dense)
+    indptr, cols, vals = st.to_csr()
+    np.testing.assert_array_equal(indptr, [0, 1, 3, 3])
+    np.testing.assert_array_equal(cols, [1, 0, 2])
+    np.testing.assert_allclose(vals, [2, 1, 3])
+
+
+def test_sparse_tensor_padded_layout():
+    st = SparseTensor.from_bags([[3, 1], [2], []], n_cols=10,
+                                weights=[[0.5, 2.0], [1.5], []])
+    ids, w, m = st.to_padded()
+    assert ids.shape == (3, 2)
+    np.testing.assert_array_equal(ids[0], [3, 1])
+    np.testing.assert_allclose(w[0], [0.5, 2.0])
+    np.testing.assert_allclose(m, [[1, 1], [1, 0], [0, 0]])
+    with pytest.raises(ValueError, match="max_nnz"):
+        st.to_padded(max_nnz=1)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+def test_lookup_table_sparse_matches_dense_oracle(combiner):
+    rs = np.random.RandomState(1)
+    n_index, n_out = 12, 6
+    st = SparseTensor.from_bags([[0, 3, 7], [5], [2, 2]], n_index,
+                                weights=[[1.0, 0.5, 2.0], [1.0], [1.0, 1.0]])
+    emb = nn.LookupTableSparse(n_index, n_out, combiner=combiner)
+    params, state = emb.init(jax.random.key(0))
+    ids, w, m = st.to_padded()
+    out, _ = emb.apply(params, (jnp.asarray(ids), jnp.asarray(w), jnp.asarray(m)))
+
+    table = np.asarray(params["weight"])
+    want = np.zeros((3, n_out), np.float32)
+    bags_ws = [([0, 3, 7], [1.0, 0.5, 2.0]), ([5], [1.0]), ([2, 2], [1.0, 1.0])]
+    for r, (bag, ws) in enumerate(bags_ws):
+        for c, v in zip(bag, ws):
+            want[r] += v * table[c]
+    # TF embedding_lookup_sparse semantics: mean = /sum(w), sqrtn = /sqrt(sum(w^2))
+    if combiner == "mean":
+        want /= np.asarray([sum(ws) for _, ws in bags_ws], np.float32)[:, None]
+    elif combiner == "sqrtn":
+        want /= np.sqrt([sum(v * v for v in ws) for _, ws in bags_ws]).astype(
+            np.float32)[:, None]
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_linear_matches_dense_linear():
+    rs = np.random.RandomState(2)
+    dense = (rs.rand(4, 9) * (rs.rand(4, 9) > 0.5)).astype(np.float32)
+    st = SparseTensor.from_dense(dense)
+    ids, w, m = st.to_padded()
+
+    sl = nn.SparseLinear(9, 5)
+    params, _ = sl.init(jax.random.key(3))
+    out, _ = sl.apply(params, (jnp.asarray(ids), jnp.asarray(w), jnp.asarray(m)))
+
+    W = np.asarray(params["weight"])
+    b = np.asarray(params["bias"])
+    np.testing.assert_allclose(np.asarray(out), dense @ W.T + b, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_join_table_offsets_columns():
+    a = SparseTensor.from_bags([[1], [0]], 4).to_padded()
+    b = SparseTensor.from_bags([[2, 0], [1]], 5).to_padded()
+    join = nn.SparseJoinTable([4, 5])
+    params, _ = join.init(jax.random.key(0))
+    (ids, w, m), _ = join.apply(params, (tuple(map(jnp.asarray, a)),
+                                         tuple(map(jnp.asarray, b))))
+    # second input's column 2 becomes 4 + 2 = 6
+    row0 = set(np.asarray(ids)[0][np.asarray(m)[0] > 0].tolist())
+    assert row0 == {1, 6, 4}
+
+
+def test_sparse_minibatch_overflow_raises():
+    samples = [Sample(([0, 1, 2], None), np.float32(1))]
+    with pytest.raises(ValueError, match="max_nnz"):
+        SparseMiniBatch.stack(samples, max_nnz=2)
+
+
+def test_sparse_minibatch_stack():
+    samples = [
+        Sample(([0, 2], [1.0, 0.5]), np.float32(1)),
+        Sample(([1], None), np.float32(0)),
+    ]
+    mb = SparseMiniBatch.stack(samples)
+    ids, w, m = mb.input
+    assert ids.shape == (2, 2)
+    np.testing.assert_allclose(w, [[1.0, 0.5], [1.0, 0.0]])
+    np.testing.assert_allclose(m, [[1, 1], [1, 0]])
+    np.testing.assert_allclose(mb.target, [1, 0])
+
+
+def test_embedding_bag_model_trains_on_sparse_features():
+    """An embedding-bag recommender-style model trains end-to-end with
+    sparse id features (the VERDICT round-1 item 7 done-criterion)."""
+    rs = np.random.RandomState(4)
+    n_items, n_samples, max_nnz = 30, 128, 4
+    bags = [list(rs.choice(n_items, rs.randint(1, max_nnz + 1), replace=False))
+            for _ in range(n_samples)]
+    # label: whether the bag contains any "positive" item (< 10)
+    labels = np.asarray([int(any(i < 10 for i in b)) for b in bags], np.int32)
+
+    samples = [Sample((b, None), labels[i]) for i, b in enumerate(bags)]
+    batches = list(SampleToSparseMiniBatch(32, max_nnz=max_nnz)(samples))
+    assert len(batches) == 4
+
+    model = nn.Sequential(
+        nn.LookupTableSparse(n_items, 16, combiner="mean"),
+        nn.ReLU(),
+        nn.Linear(16, 2),
+        nn.LogSoftMax(),
+    )
+    crit = nn.ClassNLLCriterion()
+    params, state = model.init(jax.random.key(5))
+
+    @jax.jit
+    def step(params, ids, w, m, y):
+        def loss_fn(p):
+            out, _ = model.apply(p, (ids, w, m))
+            return crit.forward(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return jax.tree_util.tree_map(lambda a, g: a - 0.5 * g, params, grads), loss
+
+    first = last = None
+    for epoch in range(60):
+        for mb in batches:
+            ids, w, m = (jnp.asarray(a) for a in mb.input)
+            params, loss = step(params, ids, w, m, jnp.asarray(mb.target))
+            if first is None:
+                first = float(loss)
+    last = float(loss)
+    assert first > 0.4 and last < 0.1, (first, last)
