@@ -2,14 +2,17 @@
 
 Reference: ``DL/models/inception/Inception_v1.scala`` (graph builders,
 1,208 LoC) — inception modules as a 4-tower ``Concat`` (1x1 / 1x1-3x3 /
-1x1-5x5 / pool-1x1). This builds the no-aux-head variant
-(``Inception_v1_NoAuxClassifier.apply``); the aux-classifier training
-heads are a later addition alongside the multi-loss training recipe.
+1x1-5x5 / pool-1x1). ``build`` is the no-aux variant
+(``Inception_v1_NoAuxClassifier.apply``); ``build_with_aux`` is the full
+training network with the two auxiliary classifier heads after 4a and 4d
+(``Inception_v1.apply``), trained with the (1.0, 0.3, 0.3)-weighted
+multi-loss recipe (see :func:`aux_criterion`).
 """
 
 from __future__ import annotations
 
 import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.graph import Graph, Input, Node
 from bigdl_tpu.nn.init import Xavier
 
 
@@ -44,30 +47,85 @@ def inception_module(cin: int, config, name: str = "") -> nn.Concat:
     )
 
 
-def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
-    """Inception-v1 without aux heads (reference
-    ``Inception_v1_NoAuxClassifier.apply``)."""
-    model = nn.Sequential(
+def _stem() -> nn.Sequential:
+    """Shared input->4a trunk (reference ``Inception_v1.scala``)."""
+    return nn.Sequential(
         _conv(3, 64, 7, 2, 3, "conv1/7x7_s2"),
         nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
         _conv(64, 64, 1, name="conv2/3x3_reduce"),
         _conv(64, 192, 3, pad=1, name="conv2/3x3"),
         nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_module(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"),
+        inception_module(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_module(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"),
+    ).set_name("stem")
+
+
+def _mid() -> nn.Sequential:
+    """Shared 4a->4d trunk."""
+    return nn.Sequential(
+        inception_module(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"),
+        inception_module(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"),
+        inception_module(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"),
+    ).set_name("mid")
+
+
+def _top(class_num: int, has_dropout: bool) -> nn.Sequential:
+    """Shared 4d->classifier trunk."""
+    return nn.Sequential(
+        inception_module(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_module(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"),
+        inception_module(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"),
+        nn.GlobalAveragePooling2D(),
+        *([nn.Dropout(0.4)] if has_dropout else []),
+        nn.Linear(1024, class_num, weight_init=Xavier()).set_name("loss3/classifier"),
+        nn.LogSoftMax(),
+    ).set_name("top")
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """Inception-v1 without aux heads (reference
+    ``Inception_v1_NoAuxClassifier.apply``)."""
+    return nn.Sequential(_stem(), _mid(), _top(class_num, has_dropout))
+
+
+def _aux_head(cin: int, class_num: int, name: str, has_dropout: bool) -> nn.Sequential:
+    """Auxiliary classifier (reference ``Inception_v1.scala`` loss1/loss2
+    branches): AvgPool 5x5/3 -> 1x1 conv 128 -> FC 1024 -> ReLU ->
+    Dropout(0.7) (when enabled, :224/:240) -> FC class_num -> LogSoftMax."""
+    return nn.Sequential(
+        nn.SpatialAveragePooling(5, 5, 3, 3).ceil(),
+        _conv(cin, 128, 1, name=name + "/conv"),
+        nn.Reshape([-1]),
+        nn.Linear(128 * 4 * 4, 1024, weight_init=Xavier()).set_name(name + "/fc"),
+        nn.ReLU(),
+        *([nn.Dropout(0.7)] if has_dropout else []),
+        nn.Linear(1024, class_num, weight_init=Xavier()).set_name(name + "/classifier"),
+        nn.LogSoftMax(),
     )
-    model.add(inception_module(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
-    model.add(inception_module(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
-    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
-    model.add(inception_module(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
-    model.add(inception_module(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"))
-    model.add(inception_module(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"))
-    model.add(inception_module(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"))
-    model.add(inception_module(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"))
-    model.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
-    model.add(inception_module(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"))
-    model.add(inception_module(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"))
-    model.add(nn.GlobalAveragePooling2D())
-    if has_dropout:
-        model.add(nn.Dropout(0.4))
-    model.add(nn.Linear(1024, class_num, weight_init=Xavier()).set_name("loss3/classifier"))
-    model.add(nn.LogSoftMax())
-    return model
+
+
+def build_with_aux(class_num: int = 1000, has_dropout: bool = True) -> Graph:
+    """Full Inception-v1 training graph with aux heads (reference
+    ``Inception_v1.apply``): returns a Graph whose forward yields
+    ``(main, aux1, aux2)`` log-probabilities."""
+    inp = Input()
+    n4a = Node(_stem(), [inp])
+    n4d = Node(_mid(), [n4a])
+    main = Node(_top(class_num, has_dropout), [n4d])
+    aux1 = Node(_aux_head(512, class_num, "loss1", has_dropout).set_name("aux1"), [n4a])
+    aux2 = Node(_aux_head(528, class_num, "loss2", has_dropout).set_name("aux2"), [n4d])
+    return Graph(inp, [main, aux1, aux2])
+
+
+def aux_criterion() -> nn.ParallelCriterion:
+    """The multi-loss training recipe (reference ``Train.scala`` inception:
+    main + 0.3*aux1 + 0.3*aux2 over ClassNLL on log-probs). Apply to the
+    (main, aux1, aux2) output tuple with a shared integer target."""
+    crit = nn.ParallelCriterion(repeat_target=True)
+    crit.add(nn.ClassNLLCriterion(), 1.0)
+    crit.add(nn.ClassNLLCriterion(), 0.3)
+    crit.add(nn.ClassNLLCriterion(), 0.3)
+    return crit

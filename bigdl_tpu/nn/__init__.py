@@ -45,6 +45,15 @@ from bigdl_tpu.nn.criterion import (
     ClassSimplexCriterion,
     CategoricalCrossEntropy,
     TransformerCriterion,
+    CosineDistanceCriterion,
+    DotProductCriterion,
+    PGCriterion,
+    KullbackLeiblerDivergenceCriterion,
+    MeanAbsolutePercentageCriterion,
+    MeanSquaredLogarithmicCriterion,
+    SmoothL1CriterionWithWeights,
+    SoftmaxWithCriterion,
+    TimeDistributedMaskCriterion,
 )
 from bigdl_tpu.nn import init
 from bigdl_tpu.nn.layers.recurrent import (

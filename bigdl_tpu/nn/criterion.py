@@ -457,3 +457,173 @@ class TransformerCriterion(Criterion):
         if self.target_transformer is not None:
             target = self.target_transformer(target)
         return self.criterion.forward(output, target)
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(output, target) (reference:
+    ``CosineDistanceCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        t = target.astype(output.dtype)
+        dot = jnp.sum(output * t, axis=-1)
+        denom = jnp.linalg.norm(output, axis=-1) * jnp.linalg.norm(t, axis=-1)
+        loss = 1.0 - dot / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, self.size_average)
+
+
+class DotProductCriterion(Criterion):
+    """Dot product of output and target (reference:
+    ``DotProductCriterion.scala``; the PG building block). Positive —
+    maximizing semantics come from the PGCriterion wrapper."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        dot = jnp.sum(output * target.astype(output.dtype))
+        if self.size_average and output.ndim == 2:
+            return dot / output.shape[0]
+        return dot
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient loss: sum(-log(pi(a|s)) * advantage) (reference:
+    ``PGCriterion.scala`` = TransformerCriterion(Log >> MulConstant(-1),
+    DotProductCriterion)). ``output`` are action probabilities, ``target``
+    carries the (one-hot x advantage) credit."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        neg_logp = -jnp.log(jnp.clip(output, 1e-12))
+        dot = jnp.sum(neg_logp * target.astype(output.dtype))
+        if self.size_average and output.ndim == 2:
+            return dot / output.shape[0]
+        return dot
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Keras-style KL divergence over probability rows (reference:
+    ``KullbackLeiblerDivergenceCriterion.scala``): mean over samples of
+    sum(y_true * log(y_true / y_pred)) with [eps, 1] clipping."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, output, target):
+        eps = 1e-7
+        y_t = jnp.clip(target.astype(output.dtype), eps, 1.0)
+        y_p = jnp.clip(output, eps, 1.0)
+        loss = jnp.sum(y_t * jnp.log(y_t / y_p), axis=-1)
+        return _reduce(loss, self.size_average)
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    """Keras MAPE (reference: ``MeanAbsolutePercentageCriterion.scala``):
+    100 * mean(|y_t - y_p| / clip(|y_t|, eps, inf))."""
+
+    def forward(self, output, target):
+        t = target.astype(output.dtype)
+        diff = jnp.abs(t - output) / jnp.clip(jnp.abs(t), 1e-7)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    """Keras MSLE (reference: ``MeanSquaredLogarithmicCriterion.scala``):
+    mean((log(y_p + 1) - log(y_t + 1))^2) with [eps, inf) clipping."""
+
+    def forward(self, output, target):
+        eps = 1e-7
+        t = jnp.log1p(jnp.clip(target.astype(output.dtype), eps))
+        p = jnp.log1p(jnp.clip(output, eps))
+        return jnp.mean((p - t) ** 2)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Fast-RCNN bbox regression loss (reference:
+    ``SmoothL1CriterionWithWeights.scala``): smooth-L1 of
+    (output - gt) * w_inside, scaled by w_outside, with transition point
+    1/sigma^2. ``target`` is (gt,) or (gt, inside_w, outside_w)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def forward(self, output, target):
+        if isinstance(target, (tuple, list)):
+            gt = target[0]
+            inside = target[1] if len(target) > 1 else None
+            outside = target[2] if len(target) > 2 else None
+        else:
+            gt, inside, outside = target, None, None
+        d = output - gt.astype(output.dtype)
+        if inside is not None:
+            d = d * inside
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * d * d,
+                         ad - 0.5 / self.sigma2)
+        if outside is not None:
+            loss = loss * outside
+        total = jnp.sum(loss)
+        return total / self.num if self.num > 0 else total
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe SoftmaxWithLoss over (N, C, ...) maps (reference:
+    ``SoftmaxWithCriterion.scala``): per-pixel CE with optional
+    ignore_label and normalize mode VALID (default) | FULL | BATCH_SIZE |
+    NONE. Labels 0-based (repo-wide deviation)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def forward(self, output, target):
+        logp = jax.nn.log_softmax(output, axis=1)
+        t = target.astype(jnp.int32)
+        # clamp before the gather: an ignore_label outside [0, C) would
+        # otherwise hit take_along_axis's NaN fill mode
+        t_safe = jnp.clip(t, 0, output.shape[1] - 1)
+        picked = jnp.take_along_axis(logp, t_safe[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            valid = (t != self.ignore_label).astype(output.dtype)
+        else:
+            valid = jnp.ones_like(picked, output.dtype)
+        total = -jnp.sum(picked * valid)
+        n, inner = output.shape[0], picked[0].size
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(jnp.sum(valid), 1.0)
+        if self.normalize_mode == "FULL":
+            return total / (n * inner)
+        if self.normalize_mode == "BATCH_SIZE":
+            return total / n
+        return total  # NONE
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Time-distributed criterion with a padding mask (reference:
+    ``TimeDistributedMaskCriterion.scala``): apply the inner criterion per
+    step, ignoring positions where target == padding_value, and normalize
+    by the number of unmasked positions."""
+
+    def __init__(self, criterion: Criterion, padding_value: int = 0):
+        self.criterion = criterion
+        self.padding_value = padding_value
+
+    def forward(self, output, target):
+        b, t = output.shape[0], output.shape[1]
+        flat_out = output.reshape((b * t,) + output.shape[2:])
+        flat_tgt = target.reshape((b * t,) + target.shape[2:])
+        mask = (flat_tgt != self.padding_value).astype(flat_out.dtype)
+        mask = mask.reshape(b * t, -1)[:, 0]
+        losses = jax.vmap(
+            lambda o, tt: self.criterion.forward(o[None], tt[None])
+        )(flat_out, flat_tgt)
+        total = jnp.sum(losses * mask)
+        return total / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,140 @@
+"""Sequence beam search decoder.
+
+Reference: ``DL/nn/SequenceBeamSearch.scala`` (the Transformer tier's beam
+decoder: beam_size candidates, ((5 + len)/6)^alpha length normalization,
+EOS-terminated finished set — itself a port of the TF official
+implementation).
+
+TPU-native: one ``lax.scan`` over ``max_decode_length`` steps with fully
+static shapes; alive/finished sets are fixed-size (beam_size) arrays with
+scores, so the whole decode jits into a single XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Context, Module
+
+_NEG_INF = -1.0e7
+
+
+def _length_penalty(alpha: float, length) -> jnp.ndarray:
+    return jnp.power((5.0 + jnp.asarray(length, jnp.float32)) / 6.0, alpha)
+
+
+def _gather_beams(x, beam_indices):
+    """x: (B, k, ...); beam_indices: (B, new_k) -> (B, new_k, ...)."""
+    return jax.vmap(lambda row, idx: row[idx])(x, beam_indices)
+
+
+def beam_search(
+    symbols_to_logits_fn: Callable,
+    initial_ids: jnp.ndarray,
+    beam_size: int,
+    vocab_size: int,
+    alpha: float,
+    max_decode_length: int,
+    eos_id: int,
+    states=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns ``(sequences (B, k, L+1), scores (B, k))`` sorted best-first.
+
+    ``symbols_to_logits_fn(ids, i, states) -> (logits (B*k, vocab),
+    states)`` where ``ids`` is (B*k, i+1) decoded so far.
+    """
+    batch = initial_ids.shape[0]
+    k = beam_size
+    L = max_decode_length
+
+    alive_seq = jnp.tile(initial_ids[:, None, None], (1, k, 1))  # (B, k, 1)
+    alive_seq = jnp.pad(alive_seq, ((0, 0), (0, 0), (0, L)))     # (B, k, L+1)
+    # only beam 0 is live initially (all beams identical otherwise)
+    alive_log_probs = jnp.tile(
+        jnp.asarray([[0.0] + [_NEG_INF] * (k - 1)]), (batch, 1))
+    finished_seq = jnp.zeros_like(alive_seq)
+    finished_scores = jnp.full((batch, k), _NEG_INF)
+    finished_flags = jnp.zeros((batch, k), bool)
+
+    def step(carry, i):
+        alive_seq, alive_log_probs, fin_seq, fin_scores, fin_flags, states = carry
+
+        flat_ids = alive_seq.reshape(batch * k, L + 1)
+        logits, new_states = symbols_to_logits_fn(flat_ids, i, states)
+        log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        log_probs = log_probs.reshape(batch, k, vocab_size) + alive_log_probs[..., None]
+
+        flat = log_probs.reshape(batch, k * vocab_size)
+        # 2k candidates so enough non-EOS survivors exist
+        topk_lp, topk_idx = lax.top_k(flat, 2 * k)
+        beam_idx = topk_idx // vocab_size
+        token_idx = topk_idx % vocab_size
+
+        cand_seq = _gather_beams(alive_seq, beam_idx)  # (B, 2k, L+1)
+        cand_seq = jax.vmap(
+            lambda s, t, pos: jax.vmap(
+                lambda row, tok: lax.dynamic_update_index_in_dim(row, tok, pos, 0)
+            )(s, t),
+            in_axes=(0, 0, None),
+        )(cand_seq, token_idx.astype(cand_seq.dtype), i + 1)
+        cand_is_eos = token_idx == eos_id
+
+        # alive set: best k non-EOS candidates
+        alive_cand_lp = jnp.where(cand_is_eos, _NEG_INF, topk_lp)
+        new_alive_lp, alive_pick = lax.top_k(alive_cand_lp, k)
+        new_alive_seq = _gather_beams(cand_seq, alive_pick)
+
+        # finished set: EOS candidates join, keep best k by normalized score
+        # (penalty length i+1 = decoded tokens, reference
+        # SequenceBeamSearch.scala:437)
+        cand_scores = topk_lp / _length_penalty(alpha, i + 1)
+        cand_scores = jnp.where(cand_is_eos, cand_scores, _NEG_INF)
+        all_scores = jnp.concatenate([fin_scores, cand_scores], axis=1)
+        all_flags = jnp.concatenate(
+            [fin_flags, cand_is_eos], axis=1)
+        all_seq = jnp.concatenate([fin_seq, cand_seq], axis=1)
+        new_fin_scores, fin_pick = lax.top_k(all_scores, k)
+        new_fin_seq = _gather_beams(all_seq, fin_pick)
+        new_fin_flags = jnp.take_along_axis(all_flags, fin_pick, axis=1)
+
+        return (new_alive_seq, new_alive_lp, new_fin_seq, new_fin_scores,
+                new_fin_flags, new_states), None
+
+    carry = (alive_seq, alive_log_probs, finished_seq, finished_scores,
+             finished_flags, states)
+    (alive_seq, alive_log_probs, finished_seq, finished_scores,
+     finished_flags, _), _ = lax.scan(step, carry, jnp.arange(L))
+
+    # fall back to alive beams where nothing finished (penalty at
+    # max_decode_length, reference :151)
+    alive_scores = alive_log_probs / _length_penalty(alpha, L)
+    any_finished = finished_flags.any(axis=1, keepdims=True)
+    seq = jnp.where(any_finished[..., None], finished_seq, alive_seq)
+    scores = jnp.where(any_finished, finished_scores, alive_scores)
+    return seq, scores
+
+
+class SequenceBeamSearch(Module):
+    """Module wrapper (reference ``SequenceBeamSearch.scala`` ctor args:
+    vocab_size, beam_size, alpha, max_decode_length, eos_id). The
+    ``symbols_to_logits_fn`` closes over the decoder model."""
+
+    def __init__(self, symbols_to_logits_fn: Callable, vocab_size: int,
+                 beam_size: int, alpha: float, max_decode_length: int,
+                 eos_id: int):
+        super().__init__()
+        self.fn = symbols_to_logits_fn
+        self.vocab_size = vocab_size
+        self.beam_size = beam_size
+        self.alpha = alpha
+        self.max_decode_length = max_decode_length
+        self.eos_id = eos_id
+
+    def forward(self, ctx: Context, initial_ids):
+        return beam_search(
+            self.fn, initial_ids, self.beam_size, self.vocab_size,
+            self.alpha, self.max_decode_length, self.eos_id)

@@ -1,0 +1,117 @@
+"""Binary tree-LSTM.
+
+Reference: ``DL/nn/BinaryTreeLSTM.scala`` (binary-constituency TreeLSTM,
+Tai et al. 2015 — leaf nodes embed input tokens, internal nodes compose
+their two children with separate left/right gate weights; used by the
+``treeLSTMSentiment`` example with ``TreeNNAccuracy``).
+
+TPU-native encoding: the tree arrives as index arrays in TOPOLOGICAL
+order (children before parents) with static shapes —
+``left[i]``/``right[i]`` are child node ids (0 = none => leaf) and
+``leaf_index[i]`` points into the embedding sequence for leaves.
+``lax.scan`` walks the node list once; a whole batch of trees vmaps.
+This replaces the reference's recursive ``composer``/``leafModule``
+graph-cloning walk with one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.nn.init import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.module import Context, Module
+
+
+class BinaryTreeLSTM(Module):
+    """forward input: ``(embeddings, tree)`` where
+
+    - ``embeddings``: (B, n_tokens, input_size) leaf token embeddings,
+    - ``tree``: int32 (B, n_nodes, 3) rows ``[left, right, leaf_index]``
+      in topological order; node ids are 1-based within the tree (0 means
+      "no child"); for leaves left == right == 0 and leaf_index is the
+      1-based position in ``embeddings`` (0-padded rows are ignored).
+
+    Output: (B, n_nodes, hidden_size) node hidden states (node order as
+    given; the root is the last non-padding node — ``TreeNNAccuracy``
+    reads whichever node the caller selects).
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_init = weight_init or Xavier()
+
+    def build_params(self, rng):
+        h, d = self.hidden_size, self.input_size
+        wi = self.weight_init
+
+        def mk(name, shape, fan_in, fan_out):
+            return wi(fold_in_str(rng, name), shape, fan_in, fan_out)
+
+        return {
+            # leaf: input -> (i, o, u) gates (leaf cells see no children)
+            "leaf_w": mk("leaf_w", (d, 3 * h), d, 3 * h),
+            "leaf_b": jnp.zeros((3 * h,), jnp.float32),
+            # composer: left/right child h -> (i, lf, rf, o, u)
+            "comp_wl": mk("comp_wl", (h, 5 * h), h, 5 * h),
+            "comp_wr": mk("comp_wr", (h, 5 * h), h, 5 * h),
+            "comp_b": jnp.zeros((5 * h,), jnp.float32),
+        }
+
+    def _leaf(self, p, x):
+        gates = x @ p["leaf_w"] + p["leaf_b"]
+        i, o, u = jnp.split(gates, 3, axis=-1)
+        c = jax.nn.sigmoid(i) * jnp.tanh(u)
+        hstate = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return hstate, c
+
+    def _compose(self, p, hl, hr, cl, cr):
+        gates = hl @ p["comp_wl"] + hr @ p["comp_wr"] + p["comp_b"]
+        i, lf, rf, o, u = jnp.split(gates, 5, axis=-1)
+        c = (jax.nn.sigmoid(i) * jnp.tanh(u)
+             + jax.nn.sigmoid(lf) * cl + jax.nn.sigmoid(rf) * cr)
+        hstate = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return hstate, c
+
+    def forward(self, ctx: Context, x):
+        embeddings, tree = x
+        p = {k: ctx.param(k) for k in
+             ("leaf_w", "leaf_b", "comp_wl", "comp_wr", "comp_b")}
+        h = self.hidden_size
+        n_nodes = tree.shape[1]
+
+        def one_tree(emb, nodes):
+            # slot 0 = "absent child": zeros
+            h0 = jnp.zeros((n_nodes + 1, h), emb.dtype)
+            c0 = jnp.zeros((n_nodes + 1, h), emb.dtype)
+            emb_padded = jnp.concatenate(
+                [jnp.zeros((1,) + emb.shape[1:], emb.dtype), emb], axis=0)
+
+            def step(carry, idx):
+                hs, cs = carry
+                left, right, leaf = nodes[idx, 0], nodes[idx, 1], nodes[idx, 2]
+                is_leaf = (left == 0) & (right == 0)
+                leaf_h, leaf_c = self._leaf(p, emb_padded[leaf])
+                comp_h, comp_c = self._compose(
+                    p, hs[left], hs[right], cs[left], cs[right])
+                node_h = jnp.where(is_leaf, leaf_h, comp_h)
+                node_c = jnp.where(is_leaf, leaf_c, comp_c)
+                # padding rows (leaf == 0 and no children) stay zero
+                is_pad = is_leaf & (leaf == 0)
+                node_h = jnp.where(is_pad, 0.0, node_h)
+                node_c = jnp.where(is_pad, 0.0, node_c)
+                hs = hs.at[idx + 1].set(node_h)
+                cs = cs.at[idx + 1].set(node_c)
+                return (hs, cs), node_h
+
+            (_, _), out = lax.scan(step, (h0, c0), jnp.arange(n_nodes))
+            return out  # (n_nodes, hidden)
+
+        return jax.vmap(one_tree)(embeddings, tree.astype(jnp.int32))

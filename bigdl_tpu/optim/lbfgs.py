@@ -1,0 +1,173 @@
+"""L-BFGS with Wolfe line search.
+
+Reference: ``DL/optim/LBFGS.scala`` (two-loop recursion over an
+``nCorrection``-deep (s, y) history, optional ``lswolfe`` line search from
+``DL/optim/LineSearch.scala``, tolFun/tolX stopping rules).
+
+TPU-native shape: the objective ``feval(x)`` is a jitted pure function of
+a FLAT parameter vector (use ``jax.flatten_util.ravel_pytree`` to get one
+from a params pytree); the outer iteration and line search are host-side
+control flow exactly like the reference's driver loop — each feval is one
+XLA execution.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def ls_wolfe(feval, x, t, d, f, g, gtd, c1=1e-4, c2=0.9, tol_x=1e-9,
+             max_iter=25):
+    """Strong-Wolfe cubic-interpolation line search (reference
+    ``LineSearch.lswolfe``). Returns (f_new, g_new, x_new, t, n_evals)."""
+    d_norm = float(jnp.abs(d).max())
+    g = jnp.asarray(g)
+    # bracket phase
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+    ls_iter = 0
+    bracket = None
+    while ls_iter < max_iter:
+        f_new, g_new = feval(x + t * d)
+        ls_iter += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        if f_new > f + c1 * t * gtd or (ls_iter > 1 and f_new >= f_prev):
+            bracket = (t_prev, t, f_prev, f_new, g_prev, g_new, gtd_prev, gtd_new)
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            return f_new, g_new, x + t * d, t, ls_iter
+        if gtd_new >= 0:
+            bracket = (t_prev, t, f_prev, f_new, g_prev, g_new, gtd_prev, gtd_new)
+            break
+        t_prev, f_prev, g_prev, gtd_prev = t, f_new, g_new, gtd_new
+        t = t * 2.0
+    else:
+        return f_new, g_new, x + t * d, t, ls_iter
+
+    # zoom phase on [lo, hi]
+    t_lo, t_hi, f_lo, f_hi, g_lo, g_hi, gtd_lo, gtd_hi = bracket
+    for _ in range(max_iter - ls_iter):
+        # cubic interpolation (reference polyinterp); fall back to bisection
+        d1 = gtd_lo + gtd_hi - 3 * (f_lo - f_hi) / (t_lo - t_hi + 1e-30)
+        sq = d1 * d1 - gtd_lo * gtd_hi
+        if sq >= 0:
+            d2 = np.sqrt(sq) * (1.0 if t_hi >= t_lo else -1.0)
+            t = t_hi - (t_hi - t_lo) * (gtd_hi + d2 - d1) / (
+                gtd_hi - gtd_lo + 2 * d2 + 1e-30)
+            lo, hi = min(t_lo, t_hi), max(t_lo, t_hi)
+            if not (lo < t < hi):
+                t = (t_lo + t_hi) / 2.0
+        else:
+            t = (t_lo + t_hi) / 2.0
+        if abs(t_hi - t_lo) * d_norm < tol_x:
+            break
+        f_new, g_new = feval(x + t * d)
+        ls_iter += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        if f_new > f + c1 * t * gtd or f_new >= f_lo:
+            t_hi, f_hi, g_hi, gtd_hi = t, f_new, g_new, gtd_new
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                return f_new, g_new, x + t * d, t, ls_iter
+            if gtd_new * (t_hi - t_lo) >= 0:
+                t_hi, f_hi, g_hi, gtd_hi = t_lo, f_lo, g_lo, gtd_lo
+            t_lo, f_lo, g_lo, gtd_lo = t, f_new, g_new, gtd_new
+    f_new, g_new = feval(x + t_lo * d)
+    return f_new, g_new, x + t_lo * d, t_lo, ls_iter + 1
+
+
+class LBFGS:
+    """Reference ``LBFGS.scala`` driver. ``optimize(feval, x0)`` where
+    ``feval(x) -> (loss, grad)`` over a flat vector; returns (x, [f...])."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: Optional[Callable] = ls_wolfe):
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.tol_fun = tol_fun
+        self.tol_x = tol_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def optimize(self, feval, x) -> Tuple[jnp.ndarray, List[float]]:
+        x = jnp.asarray(x)
+        f, g = feval(x)
+        f = float(f)
+        fs = [f]
+        n_eval = 1
+        if float(jnp.abs(g).max()) <= 1e-10:  # already optimal
+            return x, fs
+
+        S: List[jnp.ndarray] = []  # param diffs
+        Y: List[jnp.ndarray] = []  # grad diffs
+        rho: List[float] = []
+        h_diag = 1.0
+        g_prev = None
+        t = None
+
+        for it in range(self.max_iter):
+            # two-loop recursion: d = -H g
+            if not S:
+                d = -g
+            else:
+                q = -g
+                alphas = []
+                for s_i, y_i, r_i in zip(reversed(S), reversed(Y), reversed(rho)):
+                    a = r_i * float(jnp.vdot(s_i, q))
+                    alphas.append(a)
+                    q = q - a * y_i
+                q = q * h_diag
+                for s_i, y_i, r_i, a in zip(S, Y, rho, reversed(alphas)):
+                    b = r_i * float(jnp.vdot(y_i, q))
+                    q = q + (a - b) * s_i
+                d = q
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -self.tol_x:  # not a descent direction
+                break
+
+            # step size: first iteration scales by gradient magnitude
+            if it == 0:
+                t = min(1.0, 1.0 / float(jnp.abs(g).sum())) * self.learning_rate
+            else:
+                t = self.learning_rate
+
+            g_prev = g
+            x_prev = x
+            if self.line_search is not None:
+                f, g, x, t, evals = self.line_search(feval, x, t, d, f, g, gtd)
+                f = float(f)
+                n_eval += evals
+            else:
+                x = x + t * d
+                f, g = feval(x)
+                f = float(f)
+                n_eval += 1
+            fs.append(f)
+
+            s = x - x_prev
+            y = g - g_prev
+            ys = float(jnp.vdot(y, s))
+            if ys > 1e-10:
+                if len(S) == self.n_correction:
+                    S.pop(0)
+                    Y.pop(0)
+                    rho.pop(0)
+                S.append(s)
+                Y.append(y)
+                rho.append(1.0 / ys)
+                h_diag = ys / float(jnp.vdot(y, y))
+
+            if n_eval >= self.max_eval:
+                break
+            if float(jnp.abs(g).max()) <= 1e-10:
+                break
+            if float(jnp.abs(t * d).max()) <= self.tol_x:
+                break
+            if len(fs) > 1 and abs(fs[-1] - fs[-2]) < self.tol_fun:
+                break
+        return x, fs

@@ -1,0 +1,298 @@
+"""Tests for round-2 gap closures: new criterions, LBFGS(+LineSearch),
+SequenceBeamSearch, BinaryTreeLSTM, Inception aux heads
+(reference: ``DL/nn/BinaryTreeLSTM.scala``, ``DL/nn/SequenceBeamSearch.scala``,
+``DL/optim/LBFGS.scala``, ``DL/models/inception/Inception_v1.scala``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+# ------------------------------------------------------------ criterions
+
+def test_cosine_distance_criterion():
+    a = jnp.asarray([[1.0, 0.0], [0.0, 2.0]])
+    b = jnp.asarray([[1.0, 0.0], [0.0, -1.0]])
+    loss = nn.CosineDistanceCriterion().forward(a, b)
+    np.testing.assert_allclose(float(loss), (0.0 + 2.0) / 2, rtol=1e-6)
+
+
+def test_dot_product_and_pg_criterion():
+    out = jnp.asarray([[0.2, 0.8], [0.5, 0.5]])
+    t = jnp.asarray([[0.0, 1.0], [1.0, 0.0]])
+    dp = nn.DotProductCriterion().forward(out, t)
+    np.testing.assert_allclose(float(dp), 0.8 + 0.5, rtol=1e-6)
+    pg = nn.PGCriterion().forward(out, t)
+    np.testing.assert_allclose(float(pg), -(np.log(0.8) + np.log(0.5)), rtol=1e-5)
+
+
+def test_keras_style_criterions_match_formulas():
+    rs = np.random.RandomState(0)
+    p = jnp.asarray(rs.rand(4, 3).astype(np.float32) + 0.1)
+    t = jnp.asarray(rs.rand(4, 3).astype(np.float32) + 0.1)
+
+    kl = nn.KullbackLeiblerDivergenceCriterion().forward(p / p.sum(-1, keepdims=True),
+                                                        t / t.sum(-1, keepdims=True))
+    assert float(kl) >= 0
+
+    mape = nn.MeanAbsolutePercentageCriterion().forward(p, t)
+    want = 100.0 * np.mean(np.abs(t - p) / np.clip(np.abs(t), 1e-7, None))
+    np.testing.assert_allclose(float(mape), want, rtol=1e-5)
+
+    msle = nn.MeanSquaredLogarithmicCriterion().forward(p, t)
+    want = np.mean((np.log1p(p) - np.log1p(t)) ** 2)
+    np.testing.assert_allclose(float(msle), want, rtol=1e-5)
+
+
+def test_smooth_l1_with_weights():
+    sigma = 2.0
+    out = jnp.asarray([0.1, 2.0, -0.05])
+    gt = jnp.zeros(3)
+    inside = jnp.asarray([1.0, 1.0, 2.0])
+    outside = jnp.asarray([1.0, 0.5, 1.0])
+    loss = nn.SmoothL1CriterionWithWeights(sigma, num=3).forward(
+        out, (gt, inside, outside))
+    d = np.asarray([0.1, 2.0, -0.1])
+    s2 = sigma * sigma
+    per = np.where(np.abs(d) < 1 / s2, 0.5 * s2 * d * d, np.abs(d) - 0.5 / s2)
+    want = (per * np.asarray([1.0, 0.5, 1.0])).sum() / 3
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+
+def test_softmax_with_criterion_ignore_label():
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0], [1.0, 1.0]])
+    target = jnp.asarray([0, 1, 255])
+    full = nn.SoftmaxWithCriterion().forward(logits, jnp.asarray([0, 1, 0]))
+    ign = nn.SoftmaxWithCriterion(ignore_label=255).forward(logits, target)
+    # ignoring the third sample must equal averaging over first two only
+    want = -np.log(np.exp(2) / (np.exp(2) + 1))
+    np.testing.assert_allclose(float(ign), want, rtol=1e-5)
+    assert float(full) != float(ign)
+
+
+def test_time_distributed_mask_criterion():
+    # (B=1, T=3) with padding_value 0 masking the last step
+    out = jnp.log(jnp.asarray([[[0.9, 0.1], [0.2, 0.8], [0.5, 0.5]]]))
+    tgt = jnp.asarray([[1, 1, 0]])
+    crit = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion(), padding_value=0)
+    loss = crit.forward(out, tgt)
+    want = -(np.log(0.1) + np.log(0.8)) / 2
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+
+
+# ------------------------------------------------------------ LBFGS
+
+def test_lbfgs_rosenbrock():
+    from bigdl_tpu.optim.lbfgs import LBFGS
+
+    @jax.jit
+    def feval_impl(x):
+        f = (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+        return f, jax.grad(lambda v: (1 - v[0]) ** 2 + 100 * (v[1] - v[0] ** 2) ** 2)(x)
+
+    def feval(x):
+        f, g = feval_impl(x)
+        return float(f), g
+
+    opt = LBFGS(max_iter=100, max_eval=400, tol_fun=0, tol_x=1e-12)
+    x, fs = opt.optimize(feval, jnp.asarray([-1.2, 1.0]))
+    np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-4)
+    assert fs[-1] < 1e-8 and fs[0] > 1.0
+
+
+def test_lbfgs_trains_logistic_regression():
+    from jax.flatten_util import ravel_pytree
+
+    from bigdl_tpu.optim.lbfgs import LBFGS
+
+    rs = np.random.RandomState(0)
+    X = jnp.asarray(rs.randn(64, 5).astype(np.float32))
+    w_true = rs.randn(5).astype(np.float32)
+    y = jnp.asarray((np.asarray(X) @ w_true > 0).astype(np.float32))
+
+    params = {"w": jnp.zeros(5), "b": jnp.zeros(())}
+    flat, unravel = ravel_pytree(params)
+
+    @jax.jit
+    def loss_grad(flat):
+        def loss(flat):
+            p = unravel(flat)
+            logits = X @ p["w"] + p["b"]
+            return jnp.mean(jnp.maximum(logits, 0) - logits * y
+                            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return loss(flat), jax.grad(loss)(flat)
+
+    def feval(x):
+        f, g = loss_grad(x)
+        return float(f), g
+
+    x, fs = LBFGS(max_iter=50).optimize(feval, flat)
+    assert fs[-1] < 0.1 < fs[0]
+    p = unravel(x)
+    acc = float(jnp.mean(((X @ p["w"] + p["b"]) > 0) == (y > 0.5)))
+    assert acc > 0.95
+
+
+# ------------------------------------------------------ beam search
+
+def test_beam_search_finds_best_path():
+    """Deterministic logits: token probabilities depend only on position.
+    Beam search must return the argmax sequence with the right score."""
+    vocab, L, k = 5, 4, 3
+    step_logits = np.full((L, vocab), -4.0, np.float32)
+    best = [2, 4, 1, 3]
+    for i, tok in enumerate(best):
+        step_logits[i, tok] = 2.0
+
+    step_logits_j = jnp.asarray(step_logits)
+
+    def fn(ids, i, states):
+        return jnp.tile(step_logits_j[i][None], (ids.shape[0], 1)), states
+
+    from bigdl_tpu.nn.layers.beam_search import beam_search
+
+    seq, scores = beam_search(fn, jnp.zeros((2,), jnp.int32), k, vocab,
+                              alpha=0.0, max_decode_length=L, eos_id=vocab - 1)
+    assert seq.shape == (2, k, L + 1)
+    # no EOS in best path until position 3 (token 3 != eos 4)... top beam:
+    top = np.asarray(seq[0, 0, 1:])
+    lp = jax.nn.log_softmax(jnp.asarray(step_logits), -1)
+    # the best FINISHED sequence ends at eos (token 4) at its best slot
+    assert top[1] == 4 or list(top) == best
+
+
+def test_beam_search_eos_termination_and_scores():
+    """All mass on EOS at step 0: every beam finishes immediately."""
+    vocab, L, k = 4, 3, 2
+    eos = 3
+
+    def fn(ids, i, states):
+        logits = jnp.full((ids.shape[0], vocab), -10.0)
+        return logits.at[:, eos].set(5.0), states
+
+    from bigdl_tpu.nn.layers.beam_search import beam_search
+
+    seq, scores = beam_search(fn, jnp.zeros((1,), jnp.int32), k, vocab,
+                              alpha=0.6, max_decode_length=L, eos_id=eos)
+    assert int(seq[0, 0, 1]) == eos
+    assert float(scores[0, 0]) > float(scores[0, 1]) - 1e-6
+
+
+def test_sequence_beam_search_module():
+    from bigdl_tpu.nn.layers.beam_search import SequenceBeamSearch
+
+    vocab = 4
+
+    def fn(ids, i, states):
+        return jnp.ones((ids.shape[0], vocab)), states
+
+    m = SequenceBeamSearch(fn, vocab, beam_size=2, alpha=0.0,
+                           max_decode_length=3, eos_id=3)
+    params, _ = m.init(jax.random.key(0))
+    (seq, scores), _ = m.apply(params, jnp.zeros((2,), jnp.int32))
+    assert seq.shape == (2, 2, 4) and scores.shape == (2, 2)
+
+
+# ------------------------------------------------------ BinaryTreeLSTM
+
+def _tree_fixture():
+    # tree: tokens [t0, t1]; node1 = leaf(t0), node2 = leaf(t1),
+    # node3 = compose(node1, node2)   (rows are [left, right, leaf_index])
+    tree = np.asarray([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]], np.int32)
+    emb = np.random.RandomState(0).randn(1, 2, 4).astype(np.float32)
+    return emb, tree
+
+
+def test_binary_tree_lstm_forward_semantics():
+    emb, tree = _tree_fixture()
+    m = nn.BinaryTreeLSTM(4, 6)
+    params, _ = m.init(jax.random.key(1))
+    out, _ = m.apply(params, (jnp.asarray(emb), jnp.asarray(tree)))
+    assert out.shape == (1, 3, 6)
+    # root state differs from leaves and depends on both children
+    assert not np.allclose(out[0, 2], out[0, 0])
+    # swapping the children changes the root (left/right weights differ)
+    tree_sw = tree.copy()
+    tree_sw[0, 2] = [2, 1, 0]
+    out_sw, _ = m.apply(params, (jnp.asarray(emb), jnp.asarray(tree_sw)))
+    assert not np.allclose(out[0, 2], out_sw[0, 2], atol=1e-6)
+    # padding rows stay zero
+    tree_pad = np.concatenate([tree, np.zeros((1, 2, 3), np.int32)], axis=1)
+    out_pad, _ = m.apply(params, (jnp.asarray(emb), jnp.asarray(tree_pad)))
+    np.testing.assert_allclose(out_pad[0, 3:], 0.0)
+
+
+def test_binary_tree_lstm_trains_toy_sentiment():
+    """Tree-structured sentiment: the root must classify whether the tree
+    contains the 'positive' token — requires information flow leaf->root."""
+    rs = np.random.RandomState(3)
+    vocab = np.eye(6, dtype=np.float32)
+    trees, embs, labels = [], [], []
+    for _ in range(48):
+        t0, t1 = rs.randint(0, 6, 2)
+        embs.append(np.stack([vocab[t0], vocab[t1]]))
+        trees.append([[0, 0, 1], [0, 0, 2], [1, 2, 0]])
+        labels.append(int(t0 == 0 or t1 == 0))
+    embs = jnp.asarray(np.stack(embs))
+    trees = jnp.asarray(np.asarray(trees, np.int32))
+    labels = jnp.asarray(np.asarray(labels, np.int32))
+
+    tree_lstm = nn.BinaryTreeLSTM(6, 8)
+    head = nn.Sequential(nn.Linear(8, 2), nn.LogSoftMax())
+    tp, _ = tree_lstm.init(jax.random.key(0))
+    hp, _ = head.init(jax.random.key(1))
+    crit = nn.ClassNLLCriterion()
+
+    @jax.jit
+    def step(tp, hp):
+        def loss_fn(tp, hp):
+            states, _ = tree_lstm.apply(tp, (embs, trees))
+            logp, _ = head.apply(hp, states[:, 2])  # root node
+            return crit.forward(logp, labels)
+
+        loss, (gt, gh) = jax.value_and_grad(loss_fn, argnums=(0, 1))(tp, hp)
+        upd = lambda p, g: jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g)
+        return upd(tp, gt), upd(hp, gh), loss
+
+    first = None
+    for _ in range(150):
+        tp, hp, loss = step(tp, hp)
+        if first is None:
+            first = float(loss)
+    assert first > 0.4 and float(loss) < 0.1, (first, float(loss))
+
+
+# ------------------------------------------------------ Inception aux
+
+def test_inception_aux_heads_and_multiloss():
+    from bigdl_tpu.models import inception
+
+    model = inception.build_with_aux(class_num=7)
+    # no-dropout variant must skip dropout in aux heads too
+    nd = inception.build_with_aux(class_num=7, has_dropout=False)
+    flat = []
+    def walk(m):
+        import bigdl_tpu.nn as _nn
+        for c in getattr(m, "_modules", {}).values():
+            flat.append(type(c).__name__)
+            walk(c)
+    walk(nd)
+    from bigdl_tpu.nn.graph import Graph as _G
+    for node in nd._topo:
+        if node.element is not None:
+            walk(node.element)
+    assert "Dropout" not in flat
+    params, state = model.init(jax.random.key(0))
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 224, 224), jnp.float32)
+    (main, aux1, aux2), _ = model.apply(params, x, state=state, training=True,
+                                        rng=jax.random.key(2))
+    assert main.shape == (2, 7) and aux1.shape == (2, 7) and aux2.shape == (2, 7)
+
+    crit = inception.aux_criterion()
+    y = jnp.asarray([1, 3])
+    loss = crit.forward((main, aux1, aux2), y)
+    # three untrained heads: ~ (1 + 0.3 + 0.3) * ln(7)
+    np.testing.assert_allclose(float(loss), 1.6 * np.log(7), rtol=0.25)
