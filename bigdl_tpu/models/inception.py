@@ -129,3 +129,44 @@ def aux_criterion() -> nn.ParallelCriterion:
     crit.add(nn.ClassNLLCriterion(), 0.3)
     crit.add(nn.ClassNLLCriterion(), 0.3)
     return crit
+
+
+def main(argv=None):
+    """Train CLI (reference: ``inception/Train.scala`` + ``Options.scala``)."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.datasets import _synthetic_images
+    from bigdl_tpu.models.cli import fit, make_parser
+    from bigdl_tpu.optim import SGD, Trigger, optimizer
+    from bigdl_tpu.optim.schedules import Poly
+
+    parser = make_parser("inception-train", batch_size=32, max_epoch=10,
+                         learning_rate=0.01,
+                         folder_help="imagenet dir (synthetic data if absent)")
+    parser.add_argument("--classNum", type=int, default=1000)
+    parser.add_argument("--weightDecay", type=float, default=0.0002)
+    parser.add_argument("--no-aux", action="store_true",
+                        help="train the NoAuxClassifier variant")
+    args = parser.parse_args(argv)
+
+    x, y = _synthetic_images(max(64, args.batchSize * 2), (3, 224, 224),
+                             args.classNum, seed=2)
+    ds = DataSet.tensors(x.astype("float32"), y)
+
+    if args.no_aux:
+        model = build(args.classNum)
+        criterion = nn.ClassNLLCriterion()
+    else:
+        model = build_with_aux(args.classNum)
+        criterion = aux_criterion()
+
+    opt = optimizer(model, ds, criterion, batch_size=args.batchSize)
+    # reference recipe: poly(0.5) decay over the iteration budget
+    decay_span = args.maxIteration or 62000
+    opt.set_optim_method(SGD(learning_rate=args.learningRate,
+                             weight_decay=args.weightDecay,
+                             schedule=Poly(0.5, decay_span)))
+    return fit(opt, args, checkpoint_trigger=Trigger.several_iteration(620))
+
+
+if __name__ == "__main__":
+    main()

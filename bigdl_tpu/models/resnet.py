@@ -158,3 +158,47 @@ def build(depth: int = 50, class_num: int = 1000, dataset: str = "imagenet",
     if dataset.lower() in ("imagenet", "i"):
         return build_imagenet(depth, class_num, shortcut_type or "B")
     return build_cifar(depth, class_num, shortcut_type or "A")
+
+
+def main(argv=None):
+    """Train CLI (reference: ``resnet/Train.scala`` CIFAR recipe /
+    ``TrainImageNet.scala``)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.datasets import _synthetic_images, load_cifar10
+    from bigdl_tpu.models.cli import fit, make_parser
+    from bigdl_tpu.optim import SGD, optimizer
+    from bigdl_tpu.optim.schedules import MultiStep
+
+    parser = make_parser("resnet-train", batch_size=128, max_epoch=10,
+                         learning_rate=0.1,
+                         folder_help="cifar-10 dir (synthetic data if absent)")
+    parser.add_argument("--depth", type=int, default=20)
+    parser.add_argument("--dataset", default="cifar10", choices=["cifar10", "imagenet"])
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--weightDecay", type=float, default=1e-4)
+    args = parser.parse_args(argv)
+
+    if args.dataset == "imagenet":
+        model = build_imagenet(args.depth if args.depth in IMAGENET_CFG else 50, 1000)
+        x, y = _synthetic_images(64, (3, 224, 224), 1000, seed=1)
+    else:
+        model = build_cifar(args.depth, 10)
+        x, y = load_cifar10(args.folder, train=True)
+        mean = np.asarray([125.3, 123.0, 113.9], np.float32).reshape(3, 1, 1)
+        std = np.asarray([63.0, 62.1, 66.7], np.float32).reshape(3, 1, 1)
+        x = (x - mean) / std
+    ds = DataSet.tensors(x.astype("float32"), y)
+
+    # reference CIFAR recipe: momentum SGD with multi-step decay
+    opt = optimizer(model, ds, nn.CrossEntropyCriterion(), batch_size=args.batchSize)
+    opt.set_optim_method(SGD(learning_rate=args.learningRate,
+                             momentum=args.momentum,
+                             weight_decay=args.weightDecay,
+                             schedule=MultiStep([32000, 48000], 0.1)))
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

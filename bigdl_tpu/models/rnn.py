@@ -40,3 +40,48 @@ def build_ptb_lstm(vocab_size: int = 10000, embed_size: int = 650,
         nn.LogSoftMax(),
     )
     return model
+
+
+def ptb_windows(stream, seq_len: int):
+    """Token stream -> (inputs (N, T), targets (N, T)) next-token pairs."""
+    import numpy as np
+
+    n = (len(stream) - 1) // seq_len
+    x = stream[: n * seq_len].reshape(n, seq_len)
+    y = stream[1 : n * seq_len + 1].reshape(n, seq_len)
+    return x.astype(np.int32), y.astype(np.int32)
+
+
+def main(argv=None):
+    """Train CLI (reference: ``rnn/Train.scala`` PTB LM with
+    TimeDistributedCriterion(CrossEntropy))."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.datasets import load_ptb
+    from bigdl_tpu.models.cli import fit, make_parser
+    from bigdl_tpu.optim import Adagrad, optimizer
+
+    parser = make_parser("rnn-train", batch_size=20, max_epoch=2,
+                         learning_rate=0.1,
+                         folder_help="ptb dir (synthetic stream if absent)")
+    parser.add_argument("--seqLength", type=int, default=20)
+    parser.add_argument("--vocabSize", type=int, default=1000)
+    parser.add_argument("--hiddenSize", type=int, default=64)
+    parser.add_argument("--numLayers", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    stream = load_ptb(args.folder, "train", vocab_size=args.vocabSize)
+    vocab = int(stream.max()) + 1
+    x, y = ptb_windows(stream, args.seqLength)
+    ds = DataSet.tensors(x, y)
+
+    model = build_ptb_lstm(vocab, args.hiddenSize, args.hiddenSize,
+                           args.numLayers, dropout=0.0)
+    criterion = nn.TimeDistributedCriterion(
+        nn.ClassNLLCriterion(), size_average=True)
+    opt = optimizer(model, ds, criterion, batch_size=args.batchSize)
+    opt.set_optim_method(Adagrad(learning_rate=args.learningRate))
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

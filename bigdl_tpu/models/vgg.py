@@ -72,3 +72,63 @@ def build_vgg16(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequentia
 def build_vgg19(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
     """ImageNet VGG-19 (reference ``Vgg_19.scala``)."""
     return _vgg_imagenet(VGG19_CFG, class_num, has_dropout)
+
+
+def main(argv=None):
+    """Train/inference CLI (reference: ``vgg/Train.scala`` CIFAR recipe;
+    ``example/loadmodel`` for the Caffe-loaded VGG-16 inference config —
+    the BASELINE \'VGG-16 Caffe-loaded inference\' benchmark path)."""
+    import logging
+    import time
+
+    import numpy as np
+
+    from bigdl_tpu.models.cli import fit, make_parser
+
+    parser = make_parser("vgg", batch_size=112, max_epoch=5,
+                         learning_rate=0.01,
+                         folder_help="cifar-10 dir (synthetic data if absent)")
+    parser.add_argument("--from-caffe", nargs=2, metavar=("PROTOTXT", "CAFFEMODEL"),
+                        help="run Caffe-loaded VGG inference instead of training")
+    parser.add_argument("--iters", type=int, default=10,
+                        help="inference iterations for --from-caffe")
+    args = parser.parse_args(argv)
+
+    if args.from_caffe:
+        from bigdl_tpu.interop.caffe import load_caffe
+        from bigdl_tpu.optim.predictor import Predictor
+
+        logging.basicConfig(level=logging.INFO)
+        graph, params, state = load_caffe(*args.from_caffe)
+        shape = getattr(graph, "caffe_input_shapes", {}) or {}
+        in_shape = next(iter(shape.values()), (1, 3, 224, 224))
+        x = np.random.rand(args.batchSize, *in_shape[1:]).astype("float32")
+        pred = Predictor(graph, params, state, batch_size=args.batchSize)
+        outs = pred.predict(x, flatten=False)  # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            outs = pred.predict(x, flatten=False)
+        dt = (time.perf_counter() - t0) / args.iters
+        top1 = np.argmax(np.asarray(outs[0]), -1)
+        logging.info("caffe-vgg inference: %.1f images/sec (batch %d)",
+                     args.batchSize / dt, args.batchSize)
+        return top1
+
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.datasets import load_cifar10
+    from bigdl_tpu.optim import SGD, optimizer
+    from bigdl_tpu.optim.schedules import EpochStep
+
+    x, y = load_cifar10(args.folder, train=True)
+    x = (x / 255.0 - 0.5) / 0.25
+    ds = DataSet.tensors(x.astype("float32"), y)
+    model = build_cifar(10)
+    opt = optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=args.batchSize)
+    # reference recipe: lr decayed 0.4x every 25 epochs
+    opt.set_optim_method(SGD(learning_rate=args.learningRate, momentum=0.9,
+                             weight_decay=5e-4, schedule=EpochStep(25, 0.4)))
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -612,7 +612,9 @@ class TimeDistributedMaskCriterion(Criterion):
     step, ignoring positions where target == padding_value, and normalize
     by the number of unmasked positions."""
 
-    def __init__(self, criterion: Criterion, padding_value: int = 0):
+    def __init__(self, criterion: Criterion, padding_value: int = -1):
+        # NOTE: labels here are 0-based (unlike the 1-based reference where
+        # padding 0 is safe), so the default padding marker is -1
         self.criterion = criterion
         self.padding_value = padding_value
 

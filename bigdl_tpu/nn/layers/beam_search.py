@@ -12,7 +12,7 @@ scores, so the whole decode jits into a single XLA program.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +45,10 @@ def beam_search(
     """Returns ``(sequences (B, k, L+1), scores (B, k))`` sorted best-first.
 
     ``symbols_to_logits_fn(ids, i, states) -> (logits (B*k, vocab),
-    states)`` where ``ids`` is (B*k, i+1) decoded so far.
+    states)``. ``ids`` is the FULL fixed-width (B*k, L+1) buffer (static
+    shapes under scan): positions 0..i hold the decoded prefix, the rest
+    are zero padding — read the latest token as ``ids[:, i]``, NOT
+    ``ids[:, -1]``.
     """
     batch = initial_ids.shape[0]
     k = beam_size

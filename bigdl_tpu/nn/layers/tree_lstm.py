@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.core.rng import fold_in_str
-from bigdl_tpu.nn.init import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.init import InitializationMethod, Xavier
 from bigdl_tpu.nn.module import Context, Module
 
 
