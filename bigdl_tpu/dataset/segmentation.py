@@ -1,0 +1,203 @@
+"""COCO segmentation dataset + mask utilities.
+
+Reference: ``DL/dataset/segmentation/COCODataset.scala`` (annotation-JSON
+parse into per-image ROI labels) and ``MaskUtils.scala`` (1,052 LoC total:
+COCO-style uncompressed RLE, the compressed LEB128-ish string encoding,
+polygon -> binary mask rasterization, RLE area/merge).
+
+Host-side numpy; the masks feed ``vision.roi.RoiLabel`` and the
+masked-mAP metric path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.vision.roi import RoiLabel
+
+
+# ------------------------------------------------------------- RLE codec
+
+def rle_encode(mask: np.ndarray) -> Dict:
+    """Binary (H, W) mask -> COCO uncompressed RLE dict {counts, size}.
+    COCO RLE is column-major with counts alternating 0-runs/1-runs
+    starting from a 0-run (reference ``MaskUtils.binaryToRLE``)."""
+    mask = np.asarray(mask, np.uint8)
+    h, w = mask.shape
+    flat = mask.T.reshape(-1)  # column-major
+    # run lengths
+    change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    runs = np.diff(np.concatenate([[0], change, [flat.size]]))
+    counts = list(map(int, runs))
+    if flat.size and flat[0] == 1:  # must start with a zero-run
+        counts = [0] + counts
+    return {"counts": counts, "size": [int(h), int(w)]}
+
+
+def rle_decode(rle: Dict) -> np.ndarray:
+    """COCO uncompressed RLE -> binary (H, W) mask."""
+    h, w = rle["size"]
+    counts = rle["counts"]
+    if isinstance(counts, str):
+        counts = rle_from_string(counts, h, w)["counts"]
+    flat = np.zeros(h * w, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape(w, h).T
+
+
+def rle_area(rle: Dict) -> int:
+    """Foreground pixel count (reference ``MaskUtils.rleArea``); accepts
+    plain or compressed-string counts like :func:`rle_decode`."""
+    counts = rle["counts"]
+    if isinstance(counts, str):
+        counts = rle_from_string(counts, *rle["size"])["counts"]
+    return int(sum(counts[1::2]))
+
+
+def rle_to_string(rle: Dict) -> str:
+    """COCO compressed RLE string (LEB128-style with delta encoding,
+    reference ``MaskUtils.rleToString`` / pycocotools rleToString)."""
+    counts = rle["counts"]
+    out = []
+    for i, x in enumerate(counts):
+        if i > 2:
+            x = x - counts[i - 2]
+        more = True
+        while more:
+            c = x & 0x1F
+            x >>= 5
+            more = not (x == 0 and (c & 0x10) == 0 or x == -1 and (c & 0x10))
+            if more:
+                c |= 0x20
+            out.append(chr(c + 48))
+    return "".join(out)
+
+
+def rle_from_string(s: str, h: int, w: int) -> Dict:
+    """Inverse of :func:`rle_to_string`."""
+    counts: List[int] = []
+    i = 0
+    while i < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = ord(s[i]) - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            i += 1
+            k += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * k)
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(int(x))
+    return {"counts": counts, "size": [int(h), int(w)]}
+
+
+# ------------------------------------------------------- polygon -> mask
+
+def polygons_to_mask(polygons: Sequence[Sequence[float]], h: int, w: int) -> np.ndarray:
+    """Rasterize COCO polygon segmentation ([x0, y0, x1, y1, ...] lists)
+    into a binary (H, W) mask (reference ``MaskUtils.mergePolysToMask``;
+    PIL's polygon fill replaces the reference's hand-written scanline)."""
+    from PIL import Image, ImageDraw
+
+    img = Image.new("L", (int(w), int(h)), 0)
+    draw = ImageDraw.Draw(img)
+    for poly in polygons:
+        pts = [(float(poly[i]), float(poly[i + 1]))
+               for i in range(0, len(poly) - 1, 2)]
+        if len(pts) >= 3:
+            draw.polygon(pts, outline=1, fill=1)
+    return np.asarray(img, np.uint8)
+
+
+def segmentation_to_mask(seg, h: int, w: int) -> np.ndarray:
+    """Any COCO segmentation form -> binary mask: polygon list,
+    uncompressed RLE dict, or compressed-string RLE dict."""
+    if isinstance(seg, dict):
+        return rle_decode(seg)
+    return polygons_to_mask(seg, h, w)
+
+
+# --------------------------------------------------------- COCO dataset
+
+class COCODataset:
+    """COCO instance-annotation reader (reference ``COCODataset.scala``:
+    deserialized JSON -> per-image annotations with category remapping).
+
+    ``images``: list of dicts {id, file_name, height, width, annotations:
+    [{bbox (xyxy), category_id, label, segmentation, area, iscrowd}]}.
+    """
+
+    def __init__(self, annotation_path: str, image_dir: Optional[str] = None):
+        with open(annotation_path) as f:
+            root = json.load(f)
+        self.image_dir = image_dir
+        cats = sorted(root.get("categories", []), key=lambda c: c["id"])
+        # contiguous 0-based labels in category-id order (reference remaps
+        # sparse COCO ids to 1..80; 0-based here per repo convention)
+        self.cat_to_label = {c["id"]: i for i, c in enumerate(cats)}
+        self.label_names = [c["name"] for c in cats]
+
+        by_image: Dict[int, List[Dict]] = {}
+        for ann in root.get("annotations", []):
+            by_image.setdefault(ann["image_id"], []).append(ann)
+
+        self.images: List[Dict] = []
+        for img in root.get("images", []):
+            anns = []
+            for a in by_image.get(img["id"], []):
+                x, y, bw, bh = a["bbox"]
+                anns.append({
+                    "bbox": (float(x), float(y), float(x + bw), float(y + bh)),
+                    "category_id": a["category_id"],
+                    "label": self.cat_to_label.get(a["category_id"], -1),
+                    "segmentation": a.get("segmentation"),
+                    "area": a.get("area", bw * bh),
+                    "iscrowd": int(a.get("iscrowd", 0)),
+                })
+            self.images.append({
+                "id": img["id"],
+                "file_name": img.get("file_name"),
+                "height": img["height"],
+                "width": img["width"],
+                "annotations": anns,
+            })
+
+    def __len__(self):
+        return len(self.images)
+
+    def roi_label(self, index: int, with_masks: bool = True) -> RoiLabel:
+        """Ground truth of one image as a RoiLabel (bboxes xyxy + labels +
+        binary masks), the detection-training input format."""
+        img = self.images[index]
+        h, w = img["height"], img["width"]
+        boxes, labels, masks = [], [], []
+        any_mask = False
+        for a in img["annotations"]:
+            boxes.append(a["bbox"])
+            labels.append(a["label"])
+            if with_masks:
+                # keep masks 1:1 with boxes (RoiLabel contract): a blank
+                # mask stands in for segmentation-less annotations
+                if a["segmentation"] is not None:
+                    masks.append(segmentation_to_mask(a["segmentation"], h, w))
+                    any_mask = True
+                else:
+                    masks.append(np.zeros((h, w), np.uint8))
+        return RoiLabel(
+            np.asarray(labels, np.int32),
+            np.asarray(boxes, np.float32).reshape(-1, 4),
+            masks if (with_masks and any_mask) else None,
+        )

@@ -1,0 +1,170 @@
+"""Text pipeline: tokenization, vocabulary, sentence -> sample.
+
+Reference: ``DL/dataset/text/`` (846 LoC) — ``SentenceTokenizer`` (+ the
+``utils/`` treebank tokenizer), ``Dictionary`` (vocab with discard
+threshold and UNK), ``SentenceBiPadding``, ``TextToLabeledSentence``,
+``LabeledSentenceToSample``, ``LabeledSentence``.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+SENTENCE_START = "SENTENCE_START"
+SENTENCE_END = "SENTENCE_END"
+UNKNOWN = "<unk>"
+
+_TOKEN_RE = re.compile(r"[A-Za-z]+|[0-9]+|[^\sA-Za-z0-9]")
+
+
+def tokenize(sentence: str, lower: bool = True) -> List[str]:
+    """Simple treebank-style word/punct splitter (reference
+    ``SentenceTokenizer.scala`` wraps a java tokenizer; same contract:
+    words, numbers and punctuation as separate tokens)."""
+    if lower:
+        sentence = sentence.lower()
+    return _TOKEN_RE.findall(sentence)
+
+
+class SentenceTokenizer(Transformer):
+    """sentence string -> token list (reference ``SentenceTokenizer``)."""
+
+    def __init__(self, lower: bool = True):
+        self.lower = lower
+
+    def apply(self, it: Iterator[str]) -> Iterator[List[str]]:
+        for sentence in it:
+            yield tokenize(sentence, self.lower)
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap token lists with start/end markers (reference
+    ``SentenceBiPadding.scala``)."""
+
+    def __init__(self, start: bool = True, end: bool = True):
+        self.start = start
+        self.end = end
+
+    def apply(self, it):
+        for tokens in it:
+            out = list(tokens)
+            if self.start:
+                out = [SENTENCE_START] + out
+            if self.end:
+                out = out + [SENTENCE_END]
+            yield out
+
+
+class Dictionary:
+    """Vocabulary with frequency-ranked indices and UNK handling
+    (reference ``Dictionary.scala``: built from a corpus with
+    ``vocabSize`` cap; ``getIndex``/``getWord``; unknown -> vocab size)."""
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = collections.Counter()
+            for tokens in sentences:
+                counts.update(tokens)
+            ordered = [w for w, _ in counts.most_common(vocab_size)]
+            for w in ordered:
+                self.word2index[w] = len(self.index2word)
+                self.index2word.append(w)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def unk_index(self) -> int:
+        return self.vocab_size  # reference: unknown maps past the vocab
+
+    def get_index(self, word: str) -> int:
+        return self.word2index.get(word, self.unk_index())
+
+    def get_word(self, index: int) -> str:
+        if 0 <= index < self.vocab_size:
+            return self.index2word[index]
+        return UNKNOWN
+
+    def indices(self, tokens: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.get_index(t) for t in tokens], np.int32)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for w in self.index2word:
+                f.write(w + "\n")
+
+    @staticmethod
+    def load(path: str) -> "Dictionary":
+        d = Dictionary()
+        with open(path) as f:
+            for line in f:
+                w = line.rstrip("\n")
+                d.word2index[w] = len(d.index2word)
+                d.index2word.append(w)
+        return d
+
+
+class LabeledSentence:
+    """Token-index sequence with per-step labels (reference
+    ``LabeledSentence.scala``)."""
+
+    def __init__(self, data: np.ndarray, labels: np.ndarray):
+        self.data = np.asarray(data)
+        self.labels = np.asarray(labels)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class TextToLabeledSentence(Transformer):
+    """Token list -> LabeledSentence for next-word LM training: data =
+    tokens[:-1], label = tokens[1:] (reference
+    ``TextToLabeledSentence.scala``)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it):
+        for tokens in it:
+            idx = self.dictionary.indices(tokens)
+            if len(idx) < 2:
+                continue
+            yield LabeledSentence(idx[:-1], idx[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> Sample, padded/truncated to ``fixed_length``
+    when given (reference ``LabeledSentenceToSample.scala``). Padded label
+    positions get -1 so mask criterions skip them; pass the dictionary's
+    ``unk_index()`` as ``pad_data`` to pad inputs with UNK (default 0)."""
+
+    def __init__(self, fixed_length: Optional[int] = None,
+                 pad_data: int = 0, pad_label: int = -1):
+        self.fixed_length = fixed_length
+        self.pad_data = pad_data
+        self.pad_label = pad_label
+
+    def apply(self, it):
+        for ls in it:
+            data, labels = ls.data, ls.labels
+            if self.fixed_length is not None:
+                n = self.fixed_length
+                if len(data) >= n:
+                    data, labels = data[:n], labels[:n]
+                else:
+                    data = np.concatenate(
+                        [data, np.full(n - len(data), self.pad_data, data.dtype)])
+                    labels = np.concatenate(
+                        [labels, np.full(n - len(labels), self.pad_label,
+                                         labels.dtype)])
+            yield Sample(data.astype(np.int32), labels.astype(np.int32))

@@ -1,0 +1,281 @@
+"""Keras 1.2 model converter: JSON architecture + HDF5 weights -> the
+Keras tier.
+
+Reference: ``PY/keras/converter.py`` (DefinitionLoader / WeightLoader for
+Keras 1.2.2 models) + ``PY/keras/backend.py`` (KerasModelWrapper).
+
+Scope mirrors the reference's supported set for Sequential models: Dense,
+Activation, Dropout, Flatten, Convolution2D, MaxPooling2D,
+AveragePooling2D, GlobalAveragePooling2D, Embedding, SimpleRNN, LSTM, GRU,
+BatchNormalization, ZeroPadding2D. Keras 1.2 config field names
+(``output_dim``, ``nb_filter``/``nb_row``/``nb_col``, ``subsample``,
+``border_mode``, ``dim_ordering``) are translated to the Keras-tier ctor
+args; HDF5 weights follow the Keras 1.x layout
+(``f.attrs['layer_names']`` -> per-layer ``weight_names`` datasets, the
+same layout tf.keras's ``save_weights`` h5 path still writes).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu import keras
+
+
+def _tuple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+class DefinitionLoader:
+    """JSON -> keras-tier Sequential (reference ``DefinitionLoader``)."""
+
+    @staticmethod
+    def from_json_path(path: str) -> "keras.Sequential":
+        with open(path) as f:
+            return DefinitionLoader.from_json_str(f.read())
+
+    @staticmethod
+    def from_json_str(text: str) -> "keras.Sequential":
+        spec = json.loads(text)
+        if spec.get("class_name") != "Sequential":
+            raise ValueError(
+                f"only Sequential models are supported, got "
+                f"{spec.get('class_name')!r} (reference converter scope)")
+        layers_cfg = spec["config"]
+        if isinstance(layers_cfg, dict):  # keras 2.x nests under "layers"
+            layers_cfg = layers_cfg["layers"]
+        model = keras.Sequential()
+        for lc in layers_cfg:
+            layer = DefinitionLoader._convert_layer(lc)
+            if layer is not None:
+                model.add(layer)
+        return model
+
+    @staticmethod
+    def _convert_layer(lc: Dict):
+        cls = lc["class_name"]
+        cfg = dict(lc.get("config", {}))
+        name = cfg.get("name")
+        input_shape = None
+        bis = cfg.get("batch_input_shape")
+        if bis is not None:
+            input_shape = tuple(int(d) for d in bis[1:])
+        kw = {}
+        if input_shape is not None:
+            kw["input_shape"] = input_shape
+
+        def named(layer):
+            if name:
+                layer.set_name(name)
+            return layer
+
+        if cls == "Dense":
+            units = cfg.get("output_dim", cfg.get("units"))
+            return named(keras.Dense(int(units),
+                                     activation=cfg.get("activation", "linear")
+                                     if cfg.get("activation") != "linear" else None,
+                                     **kw))
+        if cls == "Activation":
+            return named(keras.Activation(cfg["activation"], **kw))
+        if cls == "Dropout":
+            return named(keras.Dropout(float(cfg.get("p", cfg.get("rate", 0.5))), **kw))
+        if cls == "Flatten":
+            return named(keras.Flatten(**kw))
+        if cls in ("Convolution2D", "Conv2D"):
+            nb = cfg.get("nb_filter", cfg.get("filters"))
+            if "nb_row" in cfg:
+                kh, kw_ = int(cfg["nb_row"]), int(cfg["nb_col"])
+            else:
+                kh, kw_ = _tuple(cfg["kernel_size"])
+            stride = _tuple(cfg.get("subsample", cfg.get("strides", (1, 1))))
+            border = cfg.get("border_mode", cfg.get("padding", "valid"))
+            return named(keras.Convolution2D(
+                int(nb), kh, kw_, subsample=tuple(int(s) for s in stride),
+                border_mode=border,
+                activation=cfg.get("activation") if cfg.get("activation") != "linear" else None,
+                **kw))
+        if cls in ("MaxPooling2D", "AveragePooling2D"):
+            pool = _tuple(cfg.get("pool_size", (2, 2)))
+            stride = cfg.get("strides") or pool
+            k = keras.MaxPooling2D if cls == "MaxPooling2D" else keras.AveragePooling2D
+            return named(k(pool_size=tuple(int(p) for p in pool),
+                           strides=tuple(int(s) for s in stride), **kw))
+        if cls == "GlobalAveragePooling2D":
+            return named(keras.GlobalAveragePooling2D(**kw))
+        if cls == "Embedding":
+            vocab = cfg.get("input_dim")
+            dim = cfg.get("output_dim")
+            kw.setdefault("input_shape", (int(cfg["input_length"]),)
+                          if cfg.get("input_length") else None)
+            if kw.get("input_shape") is None:
+                kw.pop("input_shape", None)
+            return named(keras.Embedding(int(vocab), int(dim), **kw))
+        if cls in ("SimpleRNN", "LSTM", "GRU"):
+            units = cfg.get("output_dim", cfg.get("units"))
+            k = getattr(keras, cls)
+            return named(k(int(units),
+                           return_sequences=bool(cfg.get("return_sequences", False)),
+                           **kw))
+        if cls == "BatchNormalization":
+            return named(keras.BatchNormalization(
+                epsilon=float(cfg.get("epsilon", 1e-3)),
+                momentum=float(cfg.get("momentum", 0.99)), **kw))
+        if cls == "ZeroPadding2D":
+            return named(keras.ZeroPadding2D(
+                padding=tuple(int(p) for p in _tuple(cfg.get("padding", (1, 1)))), **kw))
+        if cls == "InputLayer":
+            return None  # shape already captured via batch_input_shape
+        raise ValueError(f"unsupported Keras layer {cls!r} "
+                         "(reference converter scope)")
+
+
+class WeightLoader:
+    """HDF5 -> params overlay (reference ``WeightLoader``)."""
+
+    @staticmethod
+    def read_hdf5(path: str) -> List[Dict]:
+        """[{name, weights: [arrays...]}] in model order (Keras 1.x
+        layout: attrs['layer_names'] / per-group attrs['weight_names'])."""
+        import h5py
+
+        out = []
+        with h5py.File(path, "r") as f:
+            g = f["model_weights"] if "model_weights" in f else f
+            if "layer_names" in g.attrs:  # Keras 1.x / tf.keras legacy h5
+                layer_names = [n.decode() if isinstance(n, bytes) else n
+                               for n in g.attrs["layer_names"]]
+                for lname in layer_names:
+                    grp = g[lname]
+                    wnames = [n.decode() if isinstance(n, bytes) else n
+                              for n in grp.attrs.get("weight_names", [])]
+                    out.append({
+                        "name": lname,
+                        "weights": [np.asarray(grp[w]) for w in wnames],
+                        "weight_names": wnames,
+                    })
+                return out
+            if "layers" in g:  # Keras 3 .weights.h5 layout
+                for key in g["layers"]:
+                    grp = g["layers"][key]
+                    if "vars" not in grp:
+                        continue
+                    vars_grp = grp["vars"]
+                    name = vars_grp.attrs.get("name", key)
+                    name = name.decode() if isinstance(name, bytes) else name
+                    keys = sorted(vars_grp.keys(), key=lambda k: int(k))
+                    out.append({
+                        "name": name,
+                        "weights": [np.asarray(vars_grp[k]) for k in keys],
+                        "weight_names": keys,
+                    })
+                return out
+        raise ValueError(f"unrecognized Keras weight file layout in {path}")
+
+    @staticmethod
+    def convert(kind: str, weights: List[np.ndarray], dim_ordering: str = "th"):
+        """Keras-1.2 weight layout -> this repo's param dict(s).
+        ``dim_ordering``: 'th' stores conv kernels OIHW (our native layout),
+        'tf' (and tf.keras h5 files) stores HWIO."""
+        if kind == "Dense":
+            w = weights[0].T  # keras (in, out) -> Linear (out, in)
+            p = {"weight": w}
+            if len(weights) > 1:
+                p["bias"] = weights[1]
+            return p
+        if kind in ("Convolution2D", "Conv2D"):
+            w = weights[0]
+            if dim_ordering in ("tf", "channels_last"):
+                w = w.transpose(3, 2, 0, 1)  # HWIO -> OIHW
+            p = {"weight": w}
+            if len(weights) > 1:
+                p["bias"] = weights[1]
+            return p
+        if kind == "Embedding":
+            return {"weight": weights[0]}
+        if kind == "BatchNormalization":
+            # keras order: gamma, beta, moving_mean, moving_variance
+            p = {"weight": weights[0], "bias": weights[1]}
+            s = {"running_mean": weights[2], "running_var": weights[3]}
+            return p, s
+        raise ValueError(f"no weight conversion for {kind!r}")
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None,
+               json_str: Optional[str] = None):
+    """Build the keras-tier model and load Keras-1.2 weights (reference
+    ``KerasModelWrapper``/``load_keras``). Returns the compiled-less
+    Sequential with weights set; call ``compile`` to train or ``predict``
+    directly."""
+    if json_str is None:
+        if json_path is None:
+            raise ValueError("need json_path or json_str")
+        with open(json_path) as f:
+            json_str = f.read()
+    spec = json.loads(json_str)
+    model = DefinitionLoader.from_json_str(json_str)
+    params, state = model._require_params()
+
+    if hdf5_path is None:
+        return model
+
+    layers_cfg = spec["config"]
+    if isinstance(layers_cfg, dict):
+        layers_cfg = layers_cfg["layers"]
+    cls_by_name = {lc["config"].get("name"): lc["class_name"]
+                   for lc in layers_cfg}
+    h5_layers = {l["name"]: l for l in WeightLoader.read_hdf5(hdf5_path)}
+
+    def overlay(tree, name, converted):
+        """Find the subtree for keras layer `name` and merge weights into
+        the first dict level that holds 'weight'."""
+        def merge(node):
+            if isinstance(node, dict):
+                is_leaf_dict = node and all(
+                    not isinstance(v, dict) for v in node.values())
+                if is_leaf_dict and any(k in node for k in converted):
+                    node.update({k: np.asarray(v) for k, v in converted.items()})
+                    return True
+                for v in node.values():
+                    if merge(v):
+                        return True
+            return False
+
+        sub = tree
+        for part in ("seq", name):
+            if isinstance(sub, dict) and part in sub:
+                sub = sub[part]
+            elif part != "seq":
+                return False
+        return merge(sub)
+
+    import jax
+
+    params = jax.tree_util.tree_map(np.asarray, params)
+    state = jax.tree_util.tree_map(np.asarray, state)
+    for lname, info in h5_layers.items():
+        if not info["weights"]:
+            continue
+        kind = cls_by_name.get(lname)
+        if kind is None:
+            continue
+        cfg = next((lc["config"] for lc in layers_cfg
+                    if lc["config"].get("name") == lname), {})
+        # legacy Convolution2D defaults to 'th' (OIHW); Keras-2+ Conv2D
+        # defaults to channels_last (HWIO)
+        default_ordering = "channels_last" if kind == "Conv2D" else "th"
+        ordering = cfg.get("dim_ordering",
+                           cfg.get("data_format", default_ordering))
+        conv = WeightLoader.convert(kind, info["weights"], ordering)
+        if isinstance(conv, tuple):
+            pconv, sconv = conv
+            overlay(params, lname, pconv)
+            overlay(state, lname, sconv)
+        else:
+            overlay(params, lname, conv)
+    model.set_weights(jax.tree_util.tree_map(np.asarray, params),
+                      jax.tree_util.tree_map(np.asarray, state))
+    return model

@@ -67,17 +67,14 @@ def mnist_train_pipeline(folder=None, batch_size=128, train=True):
 
 def main(argv=None):
     """Train CLI (reference: ``lenet/Train.scala``)."""
-    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger, optimizer
+    from bigdl_tpu.models.cli import fit, make_parser
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger, optimizer
 
-    parser = argparse.ArgumentParser("lenet-train")
-    parser.add_argument("-f", "--folder", default=None, help="mnist dir (synthetic if absent)")
-    parser.add_argument("-b", "--batchSize", type=int, default=128)
-    parser.add_argument("-e", "--maxEpoch", type=int, default=5)
-    parser.add_argument("--learningRate", type=float, default=0.05)
-    parser.add_argument("--checkpoint", default=None)
+    parser = make_parser("lenet-train", batch_size=128, max_epoch=5,
+                         learning_rate=0.05,
+                         folder_help="mnist dir (synthetic if absent)")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO)
     model = build()
     criterion = nn.ClassNLLCriterion()
     train_ds = mnist_train_pipeline(args.folder, args.batchSize, train=True)
@@ -85,12 +82,8 @@ def main(argv=None):
 
     opt = optimizer(model, train_ds, criterion, batch_size=args.batchSize)
     opt.set_optim_method(SGD(learning_rate=args.learningRate, momentum=0.9))
-    opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
     opt.set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()], args.batchSize)
-    if args.checkpoint:
-        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
-    params, state = opt.optimize()
-    return params, state
+    return fit(opt, args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+"""Model format converter CLI.
+
+Reference: ``DL/utils/ConvertModel.scala`` — converts models between
+bigdl / caffe / tensorflow / torch formats from the command line.
+
+Usage::
+
+    python -m bigdl_tpu.utils.convert_model \
+        --from caffe --input deploy.prototxt,weights.caffemodel \
+        --to bigdl --output model.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("convert-model")
+    parser.add_argument("--from", dest="src", required=True,
+                        choices=["bigdl", "caffe", "tensorflow", "onnx"])
+    parser.add_argument("--to", dest="dst", required=True,
+                        choices=["bigdl", "caffe", "tensorflow", "onnx"])
+    parser.add_argument("--input", required=True,
+                        help="source path; caffe takes 'prototxt,caffemodel', "
+                             "tensorflow takes 'graph.pb,input:output'")
+    parser.add_argument("--output", required=True,
+                        help="destination path; caffe writes "
+                             "'prototxt,caffemodel'")
+    parser.add_argument("--input-shape", default=None,
+                        help="comma ints, e.g. 1,3,224,224 (needed for "
+                             "caffe/tf/onnx export)")
+    args = parser.parse_args(argv)
+
+    shape = (tuple(int(d) for d in args.input_shape.split(","))
+             if args.input_shape else None)
+
+    # -- load ------------------------------------------------------------
+    if args.src == "bigdl":
+        from bigdl_tpu.utils.serializer import load_module
+
+        model, params, state = load_module(args.input)
+    elif args.src == "caffe":
+        from bigdl_tpu.interop.caffe import load_caffe
+
+        proto, weights = args.input.split(",")
+        model, params, state = load_caffe(proto, weights)
+    elif args.src == "tensorflow":
+        from bigdl_tpu.interop.tf import load_tf_graph
+
+        path, io = args.input.split(",")
+        inp, out = io.split(":")
+        model, params, state = load_tf_graph(path, [inp], [out])
+    else:  # onnx
+        from bigdl_tpu.interop.onnx import load_onnx
+
+        model, params, state = load_onnx(args.input)
+
+    # -- save ------------------------------------------------------------
+    if args.dst == "bigdl":
+        from bigdl_tpu.utils.serializer import save_module
+
+        save_module(args.output, model, params, state)
+    elif args.dst == "caffe":
+        from bigdl_tpu.interop.caffe import save_caffe
+
+        proto, weights = args.output.split(",")
+        save_caffe(model, params, state, proto, weights, input_shape=shape)
+    elif args.dst == "tensorflow":
+        from bigdl_tpu.interop.tf import save_tf_graph
+
+        save_tf_graph(model, params, state, args.output, input_shape=shape)
+    else:
+        from bigdl_tpu.interop.onnx import save_onnx
+
+        save_onnx(model, params, state, args.output, input_shape=shape)
+    print(f"converted {args.src} -> {args.dst}: {args.output}")
+
+
+if __name__ == "__main__":
+    main()

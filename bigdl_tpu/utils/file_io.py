@@ -1,0 +1,76 @@
+"""Scheme-dispatching file I/O: local, hdfs://, s3://.
+
+Reference: ``DL/utils/File.scala:26`` — ``save``/``load`` of serialized
+objects to local paths, HDFS (:68-176) and S3, used by checkpointing.
+
+TPU-native: local paths use plain file handles; ``hdfs://`` and ``s3://``
+dispatch to ``fsspec``/``pyarrow``/``boto3`` WHEN INSTALLED and raise an
+actionable error otherwise (this image has no cluster filesystems — the
+interface keeps checkpoint code cloud-portable without hard deps).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, BinaryIO
+
+
+def _open(path: str, mode: str) -> BinaryIO:
+    if path.startswith("hdfs://"):
+        try:
+            import fsspec
+
+            return fsspec.open(path, mode).open()
+        except Exception:
+            pass
+        try:
+            import pyarrow.fs as pafs
+
+            fs, p = pafs.FileSystem.from_uri(path)
+            return (fs.open_input_stream(p) if "r" in mode
+                    else fs.open_output_stream(p))
+        except Exception as e:  # missing driver/JVM/libs all land here
+            raise ImportError(
+                "hdfs:// paths need a working `fsspec` hdfs driver or "
+                f"`pyarrow` HDFS (libjvm) setup: {e}"
+            ) from None
+    if path.startswith("s3://"):
+        try:
+            import fsspec
+
+            return fsspec.open(path, mode).open()
+        except Exception as e:
+            raise ImportError(
+                "s3:// paths need `fsspec` with the s3fs driver installed: "
+                f"{e}"
+            ) from None
+    if "w" in mode:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+    return open(path, mode)
+
+
+def save_bytes(data: bytes, path: str, overwrite: bool = True) -> None:
+    """Reference ``File.saveBytes``."""
+    if not overwrite and not path.startswith(("hdfs://", "s3://")) \
+            and os.path.exists(path):
+        raise FileExistsError(f"{path} exists and overwrite=False")
+    with _open(path, "wb") as f:
+        f.write(data)
+
+
+def load_bytes(path: str) -> bytes:
+    """Reference ``File.readBytes``."""
+    with _open(path, "rb") as f:
+        return f.read()
+
+
+def save(obj: Any, path: str, overwrite: bool = True) -> None:
+    """Pickle-serialize to any supported scheme (reference ``File.save``)."""
+    save_bytes(pickle.dumps(obj), path, overwrite)
+
+
+def load(path: str) -> Any:
+    """Reference ``File.load``."""
+    return pickle.loads(load_bytes(path))

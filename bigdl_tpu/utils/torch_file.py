@@ -1,0 +1,140 @@
+"""Torch7 ``.t7`` file reader.
+
+Reference: ``DL/utils/TorchFile.scala`` — reads legacy Torch serialization
+(the binary format of ``torch.save`` from Lua Torch7) so reference models
+and test fixtures stored as .t7 can be consumed. Read-only here (the
+write path has no consumers in a TPU-native stack); covers numbers,
+strings, booleans, tables, and the dense Float/Double/Long/Int/Byte
+tensor + storage classes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+TYPE_FUNCTION = 6
+TYPE_RECUR_FUNCTION = 8
+LEGACY_TYPE_RECUR_FUNCTION = 7
+
+_STORAGE_DTYPES = {
+    "torch.DoubleStorage": (np.float64, 8),
+    "torch.FloatStorage": (np.float32, 4),
+    "torch.LongStorage": (np.int64, 8),
+    "torch.IntStorage": (np.int32, 4),
+    "torch.ShortStorage": (np.int16, 2),
+    "torch.ByteStorage": (np.uint8, 1),
+    "torch.CharStorage": (np.int8, 1),
+}
+_TENSOR_CLASSES = {
+    "torch.DoubleTensor": "torch.DoubleStorage",
+    "torch.FloatTensor": "torch.FloatStorage",
+    "torch.LongTensor": "torch.LongStorage",
+    "torch.IntTensor": "torch.IntStorage",
+    "torch.ShortTensor": "torch.ShortStorage",
+    "torch.ByteTensor": "torch.ByteStorage",
+    "torch.CharTensor": "torch.CharStorage",
+}
+
+
+class _Reader:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, Any] = {}
+
+    def _read(self, fmt: str):
+        size = struct.calcsize(fmt)
+        return struct.unpack(fmt, self.f.read(size))[0]
+
+    def read_int(self) -> int:
+        return self._read("<i")
+
+    def read_long(self) -> int:
+        return self._read("<q")
+
+    def read_double(self) -> float:
+        return self._read("<d")
+
+    def read_string(self) -> str:
+        n = self.read_int()
+        return self.f.read(n).decode("latin-1")
+
+    def read_object(self) -> Any:
+        t = self.read_int()
+        if t == TYPE_NIL:
+            return None
+        if t == TYPE_NUMBER:
+            v = self.read_double()
+            return int(v) if v.is_integer() else v
+        if t == TYPE_STRING:
+            return self.read_string()
+        if t == TYPE_BOOLEAN:
+            return bool(self.read_int())
+        if t == TYPE_TABLE:
+            idx = self.read_int()
+            if idx in self.memo:
+                return self.memo[idx]
+            table: Dict[Any, Any] = {}
+            self.memo[idx] = table
+            n = self.read_int()
+            for _ in range(n):
+                k = self.read_object()
+                table[k] = self.read_object()
+            return table
+        if t == TYPE_TORCH:
+            idx = self.read_int()
+            if idx in self.memo:
+                return self.memo[idx]
+            version = self.read_string()
+            if version.startswith("V "):
+                class_name = self.read_string()
+            else:  # pre-versioning files: the string IS the class name
+                class_name = version
+            obj = self._read_torch_class(class_name, idx)
+            return obj
+        raise ValueError(f"unsupported t7 type tag {t}")
+
+    def _read_torch_class(self, class_name: str, idx: int) -> Any:
+        if class_name in _STORAGE_DTYPES:
+            dtype, width = _STORAGE_DTYPES[class_name]
+            n = self.read_long()
+            data = np.frombuffer(self.f.read(n * width), dtype=dtype).copy()
+            self.memo[idx] = data
+            return data
+        if class_name in _TENSOR_CLASSES:
+            ndim = self.read_int()
+            size = [self.read_long() for _ in range(ndim)]
+            stride = [self.read_long() for _ in range(ndim)]
+            offset = self.read_long() - 1  # 1-based
+            self.memo[idx] = None  # placeholder for cycles
+            storage = self.read_object()
+            if storage is None or ndim == 0:
+                arr = np.zeros(size, _STORAGE_DTYPES[_TENSOR_CLASSES[class_name]][0])
+            else:
+                arr = np.lib.stride_tricks.as_strided(
+                    storage[offset:],
+                    shape=size,
+                    strides=[s * storage.itemsize for s in stride],
+                ).copy()
+            self.memo[idx] = arr
+            return arr
+        # unknown torch class: read as a table payload (module objects)
+        obj = {"__torch_class__": class_name, "fields": self.read_object()}
+        self.memo[idx] = obj
+        return obj
+
+
+def load_t7(path: str) -> Any:
+    """Read one serialized object from a .t7 file (reference
+    ``TorchFile.load``): tensors as numpy arrays, tables as dicts,
+    unknown torch classes as {'__torch_class__', 'fields'} wrappers."""
+    with open(path, "rb") as f:
+        return _Reader(f).read_object()
