@@ -1,0 +1,86 @@
+"""Per-module timing (profiling hook).
+
+Reference: ``DL/nn/abstractnn/AbstractModule.scala:255-289`` — wall-time
+counters accumulated inside every forward/backward, read by
+``getTimes()``/``resetTimes()`` (summed for graphs at
+``IRGraph.scala:137-143``).
+
+TPU-native deviation: under ``jit`` the whole step fuses into one XLA
+program, so per-module wall times cannot be observed from inside it.
+``module_times`` therefore drives each TOP-LEVEL child as its own jitted
+program (compile excluded, block_until_ready timed) — the same
+layer-attribution information the reference counters give, produced by
+measurement runs instead of per-call instrumentation. For kernel-level
+timelines use ``jax.profiler.trace`` (TensorBoard), the analogue the
+reference lacks (SURVEY notes "no sampled profiler, no chrome-trace").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _timed(fn, *args, reps: int = 3) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)  # warmup/compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def module_times(model, params, state, x, reps: int = 3,
+                 backward: bool = True) -> List[Tuple[str, float, Optional[float]]]:
+    """[(child_name, forward_seconds, backward_seconds)] for each direct
+    child of a Sequential-style model (reference ``getTimes()`` rows).
+
+    ``backward`` adds the grad-of-sum time per child (None for
+    parameter-less children).
+    """
+    import jax.numpy as jnp
+
+    out: List[Tuple[str, float, Optional[float]]] = []
+    h = x
+    state = state or {}
+    for name, child in model._modules.items():
+        p = (params or {}).get(name, {})
+        s = state.get(name, {})
+
+        def fwd(p, h):
+            y, _ = child.apply(p, h, state=s, training=False)
+            return y
+
+        fwd_jit = jax.jit(fwd)
+        t_fwd = _timed(fwd_jit, p, h, reps=reps)
+
+        t_bwd = None
+        if backward and jax.tree_util.tree_leaves(p):
+            def loss(p, h):
+                return jnp.sum(jnp.square(jnp.float32(fwd(p, h))))
+
+            g_jit = jax.jit(jax.grad(loss))
+            # grad re-runs the forward; report backward-only like the
+            # reference counters (clamped: fusion can make the combined
+            # program faster than the naive sum)
+            t_bwd = max(0.0, _timed(g_jit, p, h, reps=reps) - t_fwd)
+        h = fwd_jit(p, h)
+        out.append((name, t_fwd, t_bwd))
+    return out
+
+
+def format_times(rows: List[Tuple[str, float, Optional[float]]]) -> str:
+    """Pretty table like the reference's getTimes log dump."""
+    lines = [f"{'module':<28} {'forward(ms)':>12} {'backward(ms)':>13}"]
+    for name, f, b in rows:
+        bs = f"{b * 1e3:13.3f}" if b is not None else f"{'-':>13}"
+        lines.append(f"{name:<28} {f * 1e3:12.3f} {bs}")
+    total_f = sum(f for _, f, _ in rows)
+    total_b = sum(b for _, _, b in rows if b is not None)
+    lines.append(f"{'TOTAL':<28} {total_f * 1e3:12.3f} {total_b * 1e3:13.3f}")
+    return "\n".join(lines)

@@ -1,0 +1,80 @@
+"""dlframes (DataFrame ML pipeline) + per-module profiling tests
+(reference: ``DL/dlframes/DLEstimator.scala``, ``DLClassifier.scala``;
+``AbstractModule.getTimes``)."""
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import (
+    DLClassifier, DLClassifierModel, DLEstimator, DLImageTransformer,
+)
+
+
+@pytest.fixture
+def frame():
+    pd = pytest.importorskip("pandas")
+    rs = np.random.RandomState(0)
+    x = rs.rand(96, 4).astype("float32")
+    y = (x @ np.asarray([1.0, -1.0, 2.0, -2.0]) > 0).astype(int)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+def test_dl_classifier_fit_transform(frame):
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    est = (DLClassifier(model, nn.ClassNLLCriterion(), feature_size=[4])
+           .set_batch_size(32).set_max_epoch(40).set_learning_rate(0.5))
+    fitted = est.fit(frame)
+    assert isinstance(fitted, DLClassifierModel)
+    out = fitted.transform(frame)
+    acc = float((out["prediction"].to_numpy() == frame["label"].to_numpy()).mean())
+    assert acc > 0.9, acc
+
+
+def test_dl_estimator_regression(frame):
+    pd = pytest.importorskip("pandas")
+    rs = np.random.RandomState(1)
+    x = rs.rand(64, 3).astype("float32")
+    y = x.sum(axis=1, keepdims=True)
+    df = pd.DataFrame({"features": list(x), "label": list(y)})
+    model = nn.Sequential(nn.Linear(3, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), feature_size=[3])
+           .set_batch_size(16).set_max_epoch(60).set_learning_rate(0.2))
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    pred = np.stack(out["prediction"].tolist()).reshape(-1)
+    np.testing.assert_allclose(pred, y.reshape(-1), atol=0.15)
+
+
+def test_dl_image_transformer():
+    pd = pytest.importorskip("pandas")
+    from bigdl_tpu.vision import ChannelNormalize, MatToTensor, Resize
+
+    rs = np.random.RandomState(2)
+    df = pd.DataFrame({"image": [rs.rand(8, 10, 3).astype("float32") * 255
+                                 for _ in range(3)]})
+    chain = Resize(4, 4) >> ChannelNormalize((127.5,) * 3, (127.5,) * 3) >> MatToTensor()
+    out = DLImageTransformer(chain).transform(df)
+    assert out["transformed"][0].shape == (3, 4, 4)
+    assert "image" in out.columns  # original column preserved
+
+
+def test_module_times_reports_children():
+    from bigdl_tpu.utils.profiling import format_times, module_times
+
+    model = nn.Sequential(
+        nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8), nn.LogSoftMax())
+    params, state = model.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(8, 16).astype("float32")
+    rows = module_times(model, params, state, x, reps=1)
+    assert len(rows) == 4
+    names = [r[0] for r in rows]
+    assert names == list(model._modules.keys())
+    for name, f, b in rows:
+        assert f > 0
+    # parameterized layers get a backward time, activations don't
+    assert rows[0][2] is not None and rows[1][2] is None
+    table = format_times(rows)
+    assert "TOTAL" in table and "forward(ms)" in table
