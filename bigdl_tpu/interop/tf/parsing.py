@@ -1,0 +1,120 @@
+"""TFRecord Example parsing (the reference's ParsingOps).
+
+Reference: ``DL/nn/tf/ParsingOps.scala`` (ParseExample over
+``tf.train.Example`` records) fed by the TFRecord reader
+(``DL/utils/tf/TFRecordIterator``).
+
+Host-side decode into numpy batches — on TPU, record parsing belongs in
+the input pipeline (it feeds ``SampleToMiniBatch``/device prefetch), not
+in the compiled graph like TF's in-graph parsing ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bigdl_tpu.interop.tf import example_pb2 as pb
+
+
+class FixedLenFeature:
+    """Dense feature spec (reference/TF ``FixedLenFeature``): fixed
+    ``shape``, ``dtype`` in {float32, int64, bytes}, optional default."""
+
+    def __init__(self, shape: Sequence[int], dtype, default=None):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype) if dtype is not bytes else bytes
+        self.default = default
+
+
+class VarLenFeature:
+    """Ragged feature spec: values come back as a plain list per record."""
+
+    def __init__(self, dtype):
+        self.dtype = np.dtype(dtype) if dtype is not bytes else bytes
+
+
+def _feature_values(feature: "pb.Feature"):
+    kind = feature.WhichOneof("kind")
+    if kind == "bytes_list":
+        return list(feature.bytes_list.value)
+    if kind == "float_list":
+        return list(feature.float_list.value)
+    if kind == "int64_list":
+        return list(feature.int64_list.value)
+    return []
+
+
+def parse_single_example(serialized: bytes, features: Dict[str, object]) -> Dict[str, object]:
+    """One serialized Example -> {name: array | list} per the spec
+    (reference ``ParseExample`` single-record path)."""
+    ex = pb.Example.FromString(serialized)
+    fmap = ex.features.feature
+    out: Dict[str, object] = {}
+    for name, spec in features.items():
+        vals = _feature_values(fmap[name]) if name in fmap else None
+        if isinstance(spec, VarLenFeature):
+            if vals is None:
+                out[name] = []
+            elif spec.dtype is bytes:
+                out[name] = vals
+            else:
+                out[name] = np.asarray(vals, spec.dtype)
+            continue
+        if vals is None or len(vals) == 0:
+            if spec.default is None:
+                raise ValueError(f"example is missing feature {name!r} "
+                                 "and the spec has no default")
+            vals = np.broadcast_to(
+                np.asarray(spec.default), spec.shape).reshape(-1).tolist() \
+                if spec.dtype is not bytes else [spec.default]
+        if spec.dtype is bytes:
+            out[name] = vals[0] if spec.shape == () else list(vals)
+            continue
+        arr = np.asarray(vals, spec.dtype)
+        want = int(np.prod(spec.shape)) if spec.shape else 1
+        if arr.size != want:
+            raise ValueError(
+                f"feature {name!r}: got {arr.size} values, spec shape "
+                f"{spec.shape} wants {want}")
+        out[name] = arr.reshape(spec.shape)
+    return out
+
+
+def parse_example(serialized_batch: Iterable[bytes],
+                  features: Dict[str, object]) -> Dict[str, object]:
+    """Batch parse (reference ``ParseExample``): dense specs stack into
+    (N, *shape) arrays; VarLen and bytes specs return per-record lists."""
+    rows = [parse_single_example(s, features) for s in serialized_batch]
+    out: Dict[str, object] = {}
+    for name, spec in features.items():
+        col = [r[name] for r in rows]
+        if isinstance(spec, FixedLenFeature) and spec.dtype is not bytes:
+            out[name] = np.stack(col) if col else np.zeros((0,) + spec.shape)
+        else:
+            out[name] = col
+    return out
+
+
+def build_example(feature_dict: Dict[str, object]) -> bytes:
+    """Serialize {name: value} into a tf.train.Example (the writer side,
+    pairing with ``dataset/tfrecord.py``'s TFRecordWriter)."""
+    ex = pb.Example()
+    for name, value in feature_dict.items():
+        feat = ex.features.feature[name]
+        if isinstance(value, (bytes, bytearray)):
+            feat.bytes_list.value.append(bytes(value))
+        elif isinstance(value, str):
+            feat.bytes_list.value.append(value.encode())
+        elif isinstance(value, (list, tuple, np.ndarray)):
+            arr = np.asarray(value)
+            if arr.dtype.kind in "iu":
+                feat.int64_list.value.extend(int(v) for v in arr.reshape(-1))
+            else:
+                feat.float_list.value.extend(float(v) for v in arr.reshape(-1))
+        elif isinstance(value, (int, np.integer)):
+            feat.int64_list.value.append(int(value))
+        else:
+            feat.float_list.value.append(float(value))
+    return ex.SerializeToString()

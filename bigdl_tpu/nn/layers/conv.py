@@ -264,3 +264,11 @@ class TemporalConvolution(Module):
         )
         y = y.swapaxes(1, 2)
         return y + ctx.param("bias").astype(x.dtype)
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Reference: ``SpatialShareConvolution.scala`` — identical math to
+    SpatialConvolution; the reference variant exists to share im2col
+    buffers across JVM threads, which has no analogue under XLA (buffers
+    are compiler-managed), so this is a documented alias kept for API
+    parity and model-zoo compatibility."""

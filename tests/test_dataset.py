@@ -167,10 +167,13 @@ def test_optimizer_uses_fast_path_for_tensor_dataset():
     y = (x.sum(axis=1) > 2).astype(np.int32)
     model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2), nn.LogSoftMax())
     # pass the RAW TensorDataSet (not pre-batched): optimizer takes the
-    # sliced fast path and still trains
-    opt = optim.LocalOptimizer(model, DataSet.tensors(x, y), nn.ClassNLLCriterion(),
-                               batch_size=16)
+    # sliced fast path and still trains. Explicit rng: the global default
+    # generator's state depends on test order.
+    from bigdl_tpu.core.rng import RandomGenerator
+
+    opt = optim.LocalOptimizer(model, DataSet.tensors(x, y, rng=RandomGenerator(5)),
+                               nn.ClassNLLCriterion(), batch_size=16)
     opt.set_optim_method(optim.SGD(learning_rate=0.5))
-    opt.set_end_when(optim.Trigger.max_iteration(30))
+    opt.set_end_when(optim.Trigger.max_iteration(60))
     params, _ = opt.optimize()
     assert opt.state.loss < 0.5
