@@ -87,7 +87,7 @@ class CaffePersister:
                                   top=[name])
             return name, layer
 
-        if type(m) is nn.SpatialConvolution:
+        if type(m) in (nn.SpatialConvolution, nn.SpatialShareConvolution):
             name, layer = add("Convolution", "conv")
             cp = layer.convolution_param
             cp.num_output = m.n_output_plane

@@ -88,7 +88,7 @@ class ONNXExporter:
                 inputs.append(self._init(p["bias"], "bias"))
             return self._node("Gemm", inputs, "gemm", transB=1)
 
-        if type(m) is nn.SpatialConvolution:
+        if type(m) in (nn.SpatialConvolution, nn.SpatialShareConvolution):
             w = self._init(p["weight"], "weight")  # OIHW — onnx native
             inputs = [x, w]
             if m.with_bias:

@@ -62,18 +62,24 @@ def parse_single_example(serialized: bytes, features: Dict[str, object]) -> Dict
             else:
                 out[name] = np.asarray(vals, spec.dtype)
             continue
+        want = int(np.prod(spec.shape)) if spec.shape else 1
         if vals is None or len(vals) == 0:
             if spec.default is None:
                 raise ValueError(f"example is missing feature {name!r} "
                                  "and the spec has no default")
-            vals = np.broadcast_to(
-                np.asarray(spec.default), spec.shape).reshape(-1).tolist() \
-                if spec.dtype is not bytes else [spec.default]
+            if spec.dtype is bytes:
+                vals = [spec.default] * want
+            else:
+                vals = np.broadcast_to(
+                    np.asarray(spec.default), spec.shape).reshape(-1).tolist()
         if spec.dtype is bytes:
+            if len(vals) != want:
+                raise ValueError(
+                    f"feature {name!r}: got {len(vals)} bytes values, spec "
+                    f"shape {spec.shape} wants {want}")
             out[name] = vals[0] if spec.shape == () else list(vals)
             continue
         arr = np.asarray(vals, spec.dtype)
-        want = int(np.prod(spec.shape)) if spec.shape else 1
         if arr.size != want:
             raise ValueError(
                 f"feature {name!r}: got {arr.size} values, spec shape "
@@ -91,7 +97,8 @@ def parse_example(serialized_batch: Iterable[bytes],
     for name, spec in features.items():
         col = [r[name] for r in rows]
         if isinstance(spec, FixedLenFeature) and spec.dtype is not bytes:
-            out[name] = np.stack(col) if col else np.zeros((0,) + spec.shape)
+            out[name] = (np.stack(col) if col
+                         else np.zeros((0,) + spec.shape, spec.dtype))
         else:
             out[name] = col
     return out
@@ -109,7 +116,13 @@ def build_example(feature_dict: Dict[str, object]) -> bytes:
             feat.bytes_list.value.append(value.encode())
         elif isinstance(value, (list, tuple, np.ndarray)):
             arr = np.asarray(value)
-            if arr.dtype.kind in "iu":
+            if arr.dtype.kind in "SU" or (
+                    arr.dtype == object and len(arr) and
+                    isinstance(arr.reshape(-1)[0], (bytes, str))):
+                for v in arr.reshape(-1):
+                    feat.bytes_list.value.append(
+                        v if isinstance(v, bytes) else str(v).encode())
+            elif arr.dtype.kind in "iu":
                 feat.int64_list.value.extend(int(v) for v in arr.reshape(-1))
             else:
                 feat.float_list.value.extend(float(v) for v in arr.reshape(-1))

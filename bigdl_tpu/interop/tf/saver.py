@@ -105,7 +105,7 @@ class TensorflowSaver:
                                T=pb.DT_FLOAT)
             return y
 
-        if type(m) is nn.SpatialConvolution:
+        if type(m) in (nn.SpatialConvolution, nn.SpatialShareConvolution):
             if m.n_group != 1:
                 raise ValueError("tf export: grouped conv unsupported")
             ph, pw = m.pad
