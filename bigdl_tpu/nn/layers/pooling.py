@@ -12,6 +12,7 @@ Argument order keeps the reference's W-before-H convention.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -63,9 +64,80 @@ class _Pool2D(Module):
         return tuple(dims), tuple(strides), tuple(pads)
 
 
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _maxpool2d(x, window, strides, pads):
+    """Max-pool with a hand-written backward.
+
+    XLA lowers the gradient of ``reduce_window_max`` to SelectAndScatter,
+    which is ~4x slower than the arithmetic around it on TPU. The custom
+    backward instead scatter-adds ``g * (x_window == y)`` over the
+    ``kh*kw`` window offsets — strided elementwise ops that XLA fuses.
+
+    Tie semantics deviation (documented): positions EQUAL to the window
+    max all receive the gradient (SelectAndScatter picks one). Ties are
+    measure-zero for continuous activations; for post-ReLU zeros the
+    upstream ReLU gradient mask kills the extra contributions.
+    """
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, neg_inf, lax.max, window, strides, pads)
+
+
+def _maxpool2d_fwd(x, window, strides, pads):
+    y = _maxpool2d(x, window, strides, pads)
+    return y, (x, y)
+
+
+def _maxpool2d_bwd(window, strides, pads, res, g):
+    x, y = res
+    # spatial dims are the trailing two of the 4-tuples
+    kh, kw = window[2], window[3]
+    sh, sw = strides[2], strides[3]
+    (plo_h, phi_h), (plo_w, phi_w) = pads[2], pads[3]
+    oh, ow = y.shape[2], y.shape[3]
+    # pad x out to the full strided extent the windows touch
+    need_h = plo_h + (oh - 1) * sh + kh
+    need_w = plo_w + (ow - 1) * sw + kw
+    xp = jnp.pad(
+        x,
+        ((0, 0), (0, 0), (plo_h, max(0, need_h - x.shape[2] - plo_h)),
+         (plo_w, max(0, need_w - x.shape[3] - plo_w))),
+        constant_values=-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else 0,
+    )
+    dxp = jnp.zeros(xp.shape, g.dtype)
+    for di in range(kh):
+        for dj in range(kw):
+            xs = lax.slice(
+                xp,
+                (0, 0, di, dj),
+                (xp.shape[0], xp.shape[1], di + (oh - 1) * sh + 1, dj + (ow - 1) * sw + 1),
+                (1, 1, sh, sw),
+            )
+            contrib = g * (xs == y).astype(g.dtype)
+            dxp = dxp.at[:, :, di:di + (oh - 1) * sh + 1:sh,
+                         dj:dj + (ow - 1) * sw + 1:sw].add(contrib)
+    dx = dxp[:, :, plo_h:plo_h + x.shape[2], plo_w:plo_w + x.shape[3]]
+    return (dx.astype(x.dtype),)
+
+
+_maxpool2d.defvjp(_maxpool2d_fwd, _maxpool2d_bwd)
+
+
 class SpatialMaxPooling(_Pool2D):
+    #: opt-in alternative gradient. In isolation the equality-mask backward
+    #: is ~4x faster than SelectAndScatter on TPU (8.0 -> 2.1 ms on the
+    #: ResNet stem pool), but inside the full ResNet-50 step it measured
+    #: NET SLOWER (94.8 -> 103.3 ms/step): XLA overlaps SelectAndScatter
+    #: with neighboring conv work while the 9-offset scatter chain
+    #: serializes. Default off; flip on for pool-dominated models.
+    fused_backward = False
+
     def forward(self, ctx: Context, x):
         dims, strides, pads = self._window(x)
+        if self.fused_backward and x.ndim == 4 and self.data_format == "NCHW":
+            return _maxpool2d(x, dims, strides, pads)
         # scalar init (not an array) so lax picks the reduce_window_max
         # primitive, which has a reverse-mode autodiff rule
         neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min

@@ -258,3 +258,24 @@ def test_time_distributed_criterion_size_average(rng):
             for t in range(3)
         )
         np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=f"inner_avg={inner_avg}")
+
+
+def test_maxpool_fused_backward_matches_select_and_scatter():
+    """The opt-in equality-mask maxpool gradient must equal XLA's
+    SelectAndScatter gradient on tie-free input."""
+    import jax
+    import jax.numpy as jnp
+
+    m = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+    params, _ = m.init(jax.random.key(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 9, 9), jnp.float32)
+
+    def loss(x):
+        y, _ = m.apply(params, x)
+        return jnp.sum(y * jnp.arange(y.size).reshape(y.shape))
+
+    m.fused_backward = True
+    g_custom = jax.grad(loss)(x)
+    m.fused_backward = False
+    g_std = jax.grad(loss)(x)
+    np.testing.assert_allclose(np.asarray(g_custom), np.asarray(g_std), rtol=1e-6)
