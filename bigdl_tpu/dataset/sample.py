@@ -73,13 +73,25 @@ class MiniBatch:
         feature_padding: Optional[PaddingParam] = None,
         label_padding: Optional[PaddingParam] = None,
     ) -> "MiniBatch":
-        feats = [np.asarray(s.feature) for s in samples]
-        feats = _stack_padded(feats, feature_padding)
+        feats = _stack_component([s.feature for s in samples], feature_padding)
         labels = None
         if samples[0].label is not None:
-            labs = [np.asarray(s.label) for s in samples]
-            labels = _stack_padded(labs, label_padding)
+            labels = _stack_component([s.label for s in samples], label_padding)
         return MiniBatch(feats, labels)
+
+
+def _stack_component(values, padding: Optional[PaddingParam]):
+    """Stack one feature/label slot; multi-tensor samples (reference
+    ``TensorSample`` with several feature tensors, ``Sample.scala:446``)
+    arrive as TUPLES and stack per component (plain lists are raw array
+    data, e.g. ``Sample([1.0, 2.0])``, and stack as one tensor)."""
+    if isinstance(values[0], tuple):
+        n = len(values[0])
+        return tuple(
+            _stack_padded([np.asarray(v[i]) for v in values], padding)
+            for i in range(n)
+        )
+    return _stack_padded([np.asarray(v) for v in values], padding)
 
 
 def _stack_padded(arrays, padding: Optional[PaddingParam]):

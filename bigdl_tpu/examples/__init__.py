@@ -1,0 +1,24 @@
+"""End-to-end example applications.
+
+Reference: ``DL/example/*`` (~15 Spark apps, ~3,000 LoC of scopt CLI
+mains). Each module here is the TPU-native counterpart of one reference
+app — an ``argparse`` main over the same framework surface (models, data
+pipeline, dlframes, interop, quantization), runnable standalone with a
+synthetic-data fallback when the real dataset directory is absent (the
+reference's unit strategy: tiny fixtures, no network downloads).
+
+| module                      | reference app                                   |
+|-----------------------------|-------------------------------------------------|
+| ``text_classification``     | ``example/textclassification/TextClassifier``   |
+| ``udf_predictor``           | ``example/udfpredictor/DataframePredictor``     |
+| ``tree_lstm_sentiment``     | ``example/treeLSTMSentiment/Train``             |
+| ``load_model``              | ``example/loadmodel/ModelValidator``            |
+| ``image_classification``    | ``example/imageclassification/ImagePredictor``  |
+| ``lenet_local``             | ``example/lenetLocal/{Train,Test,Predict}``     |
+| ``ml_pipeline``             | ``example/MLPipeline/DLClassifierLeNet`` etc.   |
+| ``int8_inference``          | ``example/mkldnn/int8/{GenerateInt8Scales,ImageNetInference}`` |
+| ``tf_transfer_learning``    | ``example/tensorflow/{transferlearning,loadandsave}`` |
+| ``dlframes_image``          | ``example/dlframes/{imageInference,imageTransferLearning}`` |
+| ``keras_train``             | ``example/keras/Train``                         |
+| ``language_model``          | ``example/languagemodel/PTBWordLM``             |
+"""

@@ -1,0 +1,110 @@
+"""dlframes image apps: inference and transfer learning over DataFrames.
+
+Reference: ``DL/example/dlframes/imageInference/ImageInference.scala``
+(DLImageReader -> DLImageTransformer -> DLModel.transform appends
+predictions) and ``imageTransferLearning/ImageTransferLearning.scala``
+(pretrained conv features -> DLClassifier fit on a small labeled frame).
+
+TPU-native: same two apps over pandas frames via ``bigdl_tpu.dlframes``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import (
+    DLClassifier, DLImageReader, DLImageTransformer, DLModel,
+)
+
+
+def _transform_chain(size: int = 224):
+    from bigdl_tpu.vision import (
+        AspectScale, CenterCrop, ChannelNormalize, MatToTensor,
+    )
+
+    return (AspectScale(256) >> CenterCrop(size, size)
+            >> ChannelNormalize((123.0, 117.0, 104.0)) >> MatToTensor())
+
+
+def _frame(args):
+    import pandas as pd
+
+    if args.folder:
+        return DLImageReader.read_images(args.folder)
+    rng = np.random.RandomState(0)
+    return pd.DataFrame({
+        "uri": [f"synthetic_{i}" for i in range(args.nSamples)],
+        "image": [rng.rand(256, 256, 3).astype(np.float32) * 255
+                  for _ in range(args.nSamples)],
+    })
+
+
+def inference(args):
+    """ImageInference: model.transform appends a prediction column."""
+    from bigdl_tpu.models import resnet
+
+    model = resnet.build_imagenet(18, args.classNum)
+    params, state = model.init(jax.random.key(0))
+    df = DLImageTransformer(_transform_chain()).transform(_frame(args))
+    dl = DLModel(model, params, state, features_col="transformed",
+                 batch_size=args.batchSize, feature_size=(3, 224, 224))
+    out = dl.transform(df)
+    print(out[["uri"]].assign(
+        top1=[int(np.argmax(p)) for p in out["prediction"]]).to_string(index=False))
+    return out
+
+
+def transfer_learning(args):
+    """ImageTransferLearning: frozen conv features + trained classifier."""
+    from bigdl_tpu.optim.predictor import Predictor
+
+    # feature extractor = small conv stack (stands in for a pretrained
+    # model's convolutional body, which --modelPath would load)
+    extractor = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 7, 7, 4, 4, 3, 3), nn.ReLU(),
+        nn.SpatialMaxPooling(4, 4, 4, 4), nn.GlobalAveragePooling2D(),
+    )
+    eparams, estate = extractor.init(jax.random.key(0))
+
+    df = DLImageTransformer(_transform_chain()).transform(_frame(args))
+    x = np.stack(df["transformed"].to_list())
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 2, (len(x),))
+    x += labels[:, None, None, None] * 0.8  # make classes separable
+
+    feats = Predictor(extractor, eparams, estate,
+                      batch_size=args.batchSize).predict(x)
+    feats = np.stack([np.asarray(f, np.float32) for f in feats])
+    import pandas as pd
+
+    train = pd.DataFrame({"features": list(feats), "label": labels})
+    clf = DLClassifier(
+        nn.Sequential(nn.Linear(feats.shape[-1], 2), nn.LogSoftMax()),
+        nn.ClassNLLCriterion(), feature_size=[feats.shape[-1]]).set_batch_size(args.batchSize).set_max_epoch(args.maxEpoch).set_learning_rate(0.5)
+    model = clf.fit(train)
+    out = model.transform(train)
+    acc = float((out["prediction"].to_numpy() == labels).mean())
+    print(f"transfer-learning accuracy: {acc:.3f}")
+    return acc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("dlframes-image")
+    ap.add_argument("--app", choices=["inference", "transfer"],
+                    default="inference")
+    ap.add_argument("-f", "--folder", default=None,
+                    help="image dir (synthetic if absent)")
+    ap.add_argument("-b", "--batchSize", type=int, default=8)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=5)
+    ap.add_argument("--classNum", type=int, default=1000)
+    ap.add_argument("--nSamples", type=int, default=8)
+    args = ap.parse_args(argv)
+    return inference(args) if args.app == "inference" else transfer_learning(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Image classification over an image folder / DataFrame.
+
+Reference: ``DL/example/imageclassification/ImagePredictor.scala`` (+
+``MlUtils``, ``RowToByteRecords``) — load a trained model, read images
+into a DataFrame, transform, batch-predict, show predictions; and
+``imageFrame/InceptionValidation.scala`` (ImageFrame-based Top-1/Top-5
+validation of Inception-v1).
+
+TPU-native: ``DLImageReader`` -> vision transformer chain ->
+``Predictor.predict_class``; ``--validate`` switches to the
+ImageFrame-validation app using labeled subfolders.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import jax
+
+
+def _load_model(model_path, class_num):
+    if model_path:
+        from bigdl_tpu.utils.serializer import load_module
+
+        return load_module(model_path)
+    from bigdl_tpu.models import inception
+
+    model = inception.build(class_num)
+    params, state = model.init(jax.random.key(0))
+    return model, params, state
+
+
+def _chain(size: int = 224):
+    from bigdl_tpu.vision import (
+        AspectScale, CenterCrop, ChannelNormalize, MatToTensor,
+    )
+
+    return (AspectScale(256) >> CenterCrop(size, size)
+            >> ChannelNormalize((123.0, 117.0, 104.0)) >> MatToTensor())
+
+
+def _synthetic_df(n: int = 8):
+    import pandas as pd
+
+    rng = np.random.RandomState(0)
+    return pd.DataFrame({
+        "uri": [f"synthetic_{i}" for i in range(n)],
+        "image": [rng.rand(256, 256, 3).astype(np.float32) * 255
+                  for i in range(n)],
+    })
+
+
+def predict(args):
+    """ImagePredictor: DataFrame of images -> prediction column."""
+    from bigdl_tpu.dlframes import DLImageReader, DLImageTransformer
+    from bigdl_tpu.optim.predictor import Predictor
+
+    model, params, state = _load_model(args.modelPath, args.classNum)
+    df = (DLImageReader.read_images(args.folder) if args.folder
+          else _synthetic_df())
+    df = DLImageTransformer(_chain()).transform(df)
+    x = np.stack(df["transformed"].to_list())
+    classes = Predictor(model, params, state,
+                        batch_size=args.batchSize).predict_class(x)
+    out = df[["uri"]].assign(prediction=classes)
+    print(out.to_string(index=False))
+    return out
+
+
+def validate(args):
+    """InceptionValidation: labeled ImageFrame -> Top-1/Top-5."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+    from bigdl_tpu.optim.predictor import Evaluator
+    from bigdl_tpu.vision import ImageFrame
+
+    model, params, state = _load_model(args.modelPath, args.classNum)
+    if args.folder:
+        frame = ImageFrame.read(args.folder, with_label=True).transform(_chain())
+        x = np.stack([f["tensor"] for f in frame])
+        y = np.asarray([f["label"] for f in frame], np.int32)
+    else:
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 3, 224, 224).astype(np.float32)
+        y = rng.randint(0, args.classNum, (16,)).astype(np.int32)
+    res = Evaluator(model, params, state, batch_size=args.batchSize).test(
+        DataSet.tensors(x, y), [Top1Accuracy(), Top5Accuracy()])
+    print(f"Top1: {res[0]}  Top5: {res[1]}")
+    return res
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("image-classification")
+    ap.add_argument("-f", "--folder", default=None,
+                    help="image dir (synthetic if absent)")
+    ap.add_argument("--modelPath", default=None,
+                    help=".bigdl model (random-weight Inception if absent)")
+    ap.add_argument("-b", "--batchSize", type=int, default=8)
+    ap.add_argument("--classNum", type=int, default=1000)
+    ap.add_argument("--validate", action="store_true",
+                    help="labeled-folder Top-1/Top-5 validation instead of predict")
+    args = ap.parse_args(argv)
+    return validate(args) if args.validate else predict(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Keras-API training example.
+
+Reference: ``DL/example/keras/Train.scala`` (compile/fit a Keras-style
+Sequential on MNIST with the BigDL Keras tier).
+
+TPU-native: the ``bigdl_tpu.keras`` tier — shape-inferring layers,
+``compile``/``fit``/``evaluate``/``predict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    from bigdl_tpu import keras
+    from bigdl_tpu.dataset.datasets import (
+        MNIST_TRAIN_MEAN, MNIST_TRAIN_STD, load_mnist,
+    )
+
+    ap = argparse.ArgumentParser("keras-train")
+    ap.add_argument("-f", "--folder", default=None,
+                    help="mnist dir (synthetic if absent)")
+    ap.add_argument("-b", "--batchSize", type=int, default=128)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--nSamples", type=int, default=0,
+                    help="cap training samples (0 = all)")
+    args = ap.parse_args(argv)
+
+    x, y = load_mnist(args.folder, train=True)
+    x = ((x - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD)[:, None].astype(np.float32)
+    if args.nSamples:
+        x, y = x[:args.nSamples], y[:args.nSamples]
+    vx, vy = load_mnist(args.folder, train=False)
+    vx = ((vx - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD)[:, None].astype(np.float32)
+
+    model = keras.Sequential()
+    model.add(keras.Convolution2D(32, 3, 3, activation="relu",
+                                  input_shape=(1, 28, 28)))
+    model.add(keras.MaxPooling2D())
+    model.add(keras.Convolution2D(64, 3, 3, activation="relu"))
+    model.add(keras.MaxPooling2D())
+    model.add(keras.Flatten())
+    model.add(keras.Dense(128, activation="relu"))
+    model.add(keras.Dropout(0.25))
+    model.add(keras.Dense(10, activation="softmax"))
+
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=args.batchSize, nb_epoch=args.maxEpoch)
+    scores = model.evaluate(vx, vy, batch_size=args.batchSize)
+    print(f"evaluate: {scores}")
+    return scores
+
+
+if __name__ == "__main__":
+    main()

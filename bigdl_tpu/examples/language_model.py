@@ -1,0 +1,68 @@
+"""PTB word language model.
+
+Reference: ``DL/example/languagemodel/{PTBModel,PTBWordLM}.scala`` —
+LSTM LM over PTB with the Dictionary/tokenizer pipeline.
+
+TPU-native: delegates the model + train loop to
+``bigdl_tpu.models.rnn`` (the reference's ``models/rnn`` and
+``example/languagemodel`` share the same recipe); this wrapper adds the
+corpus plumbing: raw text file -> SentenceTokenizer -> Dictionary ->
+next-word windows, matching the example's data path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def corpus_to_ids(path: Optional[str], vocab_size: int) -> np.ndarray:
+    """Raw text -> flat int32 id stream via the text pipeline (reference
+    ``SentenceTokenizer``/``Dictionary``); synthetic ids when absent."""
+    from bigdl_tpu.dataset.text import Dictionary, tokenize
+
+    if path and os.path.exists(path):
+        with open(path, errors="ignore") as f:
+            sentences = [tokenize(line) for line in f if line.strip()]
+        d = Dictionary(sentences, vocab_size=vocab_size)
+        return np.concatenate([d.indices(s) for s in sentences])
+    rng = np.random.RandomState(0)
+    return rng.randint(0, vocab_size, (20000,)).astype(np.int32)
+
+
+def main(argv=None):
+    from bigdl_tpu.models import rnn
+
+    ap = argparse.ArgumentParser("ptb-word-lm")
+    ap.add_argument("-f", "--dataFile", default=None,
+                    help="raw text corpus (synthetic if absent)")
+    ap.add_argument("--vocabSize", type=int, default=10000)
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("--seqLength", type=int, default=35)
+    ap.add_argument("--hiddenSize", type=int, default=256)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--maxIteration", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    forwarded = [
+        "-b", str(args.batchSize), "-e", str(args.maxEpoch),
+        "--seqLength", str(args.seqLength),
+        "--hiddenSize", str(args.hiddenSize),
+        "--vocabSize", str(args.vocabSize),
+    ]
+    if args.maxIteration:
+        forwarded += ["--maxIteration", str(args.maxIteration)]
+    if args.dataFile:
+        # hand the tokenized stream to the model main via a temp npy file
+        ids = corpus_to_ids(args.dataFile, args.vocabSize)
+        tmp = "/tmp/bigdl_tpu_ptb_ids.npy"
+        np.save(tmp, ids)
+        forwarded += ["--idsFile", tmp]
+    return rnn.main(forwarded)
+
+
+if __name__ == "__main__":
+    main()

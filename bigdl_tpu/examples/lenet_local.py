@@ -1,0 +1,93 @@
+"""Local LeNet train / test / predict trio.
+
+Reference: ``DL/example/lenetLocal/{Train,Test,Predict}.scala`` — the
+single-node workflow: train LeNet on MNIST and checkpoint, evaluate a
+saved model, predict classes for a few samples.
+
+TPU-native: one CLI with ``--mode train|test|predict``; the model is
+persisted through ``utils/serializer`` and evaluated with
+``Evaluator``/``Predictor`` on the single chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def _model_file(folder: str) -> str:
+    return os.path.join(folder, "lenet.bigdl")
+
+
+def train(args) -> str:
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.utils.serializer import save_module
+
+    params, state = lenet.main([
+        "-b", str(args.batchSize), "-e", str(args.maxEpoch),
+        "--learningRate", str(args.learningRate),
+    ] + (["--maxIteration", str(args.maxIteration)] if args.maxIteration else [])
+      + (["-f", args.folder] if args.folder else []))
+    os.makedirs(args.modelDir, exist_ok=True)
+    path = save_module(_model_file(args.modelDir), lenet.build(), params, state)
+    print(f"saved model to {path}")
+    return path
+
+
+def _load(args):
+    from bigdl_tpu.utils.serializer import load_module
+
+    return load_module(_model_file(args.modelDir))
+
+
+def test(args):
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.optim.predictor import Evaluator
+
+    model, params, state = _load(args)
+    ds = lenet.mnist_train_pipeline(args.folder, train=False)
+    res = Evaluator(model, params, state, batch_size=args.batchSize).test(
+        ds, [Top1Accuracy()])
+    print(f"Top1Accuracy: {res[0]}")
+    return res
+
+
+def predict(args):
+    from bigdl_tpu.dataset.datasets import load_mnist
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.optim.predictor import Predictor
+    from bigdl_tpu.dataset.datasets import MNIST_TRAIN_MEAN, MNIST_TRAIN_STD
+
+    model, params, state = _load(args)
+    x, _ = load_mnist(args.folder, train=False)
+    x = ((x - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD)[:args.nPredict, None]
+    classes = Predictor(model, params, state).predict_class(
+        x.astype(np.float32))
+    print(f"predicted classes: {classes.tolist()}")
+    return classes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("lenet-local")
+    ap.add_argument("--mode", choices=["train", "test", "predict"],
+                    default="train")
+    ap.add_argument("-f", "--folder", default=None,
+                    help="mnist dir (synthetic if absent)")
+    ap.add_argument("--modelDir", default="/tmp/bigdl_tpu_lenet")
+    ap.add_argument("-b", "--batchSize", type=int, default=128)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--maxIteration", type=int, default=0)
+    ap.add_argument("--learningRate", type=float, default=0.05)
+    ap.add_argument("--nPredict", type=int, default=8)
+    args = ap.parse_args(argv)
+    return {"train": train, "test": test, "predict": predict}[args.mode](args)
+
+
+if __name__ == "__main__":
+    main()

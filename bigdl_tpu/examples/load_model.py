@@ -1,0 +1,97 @@
+"""Model validator: load a saved model in any supported format and
+evaluate it.
+
+Reference: ``DL/example/loadmodel/ModelValidator.scala`` — one CLI that
+loads a BigDL / Caffe / Torch model (``-t bigdl|caffe|torch``) and runs
+Top-1/Top-5 validation over an image folder.
+
+TPU-native: formats map to ``utils/serializer.load_module`` (repo
+format), ``interop.caffe.load_caffe`` (prototxt + caffemodel) and
+``utils/torch_file.load_t7``; the image folder is read through the
+vision ImageFrame pipeline; synthetic data stands in when no folder is
+given.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def load_any(model_type: str, model_path: str,
+             caffe_def_path: Optional[str] = None):
+    """Returns (module, params, state) for ``bigdl``/``caffe`` models.
+    ``torch`` returns the raw .t7 object tree (the reference likewise
+    hands torch loads to a dedicated converter)."""
+    if model_type == "bigdl":
+        from bigdl_tpu.utils.serializer import load_module
+
+        return load_module(model_path)
+    if model_type == "caffe":
+        from bigdl_tpu.interop.caffe import load_caffe
+
+        if not caffe_def_path:
+            raise ValueError("caffe models need --caffeDefPath (prototxt)")
+        return load_caffe(caffe_def_path, model_path)
+    if model_type == "torch":
+        from bigdl_tpu.utils.torch_file import load_t7
+
+        raise SystemExit(
+            "loaded .t7 object tree:\n"
+            f"{load_t7(model_path)!r}\n"
+            "use bigdl_tpu.utils.convert_model to map it to a module"
+        )
+    raise ValueError("modelType must be bigdl, caffe or torch")
+
+
+def load_images(folder: Optional[str], batch: int,
+                n_synth: int = 64) -> Tuple[np.ndarray, np.ndarray]:
+    """ImageFolder layout (subdir per class) -> normalized NCHW batch
+    arrays; synthetic when absent (reference reads the ImageNet val
+    set)."""
+    if folder:
+        from bigdl_tpu.vision import (
+            AspectScale, CenterCrop, ChannelNormalize, ImageFrame, MatToTensor,
+        )
+
+        frame = ImageFrame.read(folder, with_label=True)
+        chain = (AspectScale(256) >> CenterCrop(224, 224)
+                 >> ChannelNormalize((123.0, 117.0, 104.0)) >> MatToTensor())
+        frame = frame.transform(chain)
+        x = np.stack([f["tensor"] for f in frame])
+        y = np.asarray([f["label"] for f in frame], np.int32)
+        return x, y
+    rng = np.random.RandomState(0)
+    x = rng.rand(n_synth, 3, 224, 224).astype(np.float32)
+    return x, rng.randint(0, 1000, (n_synth,)).astype(np.int32)
+
+
+def main(argv=None):
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+    from bigdl_tpu.optim.predictor import Evaluator
+
+    ap = argparse.ArgumentParser("load-model-validator")
+    ap.add_argument("-t", "--modelType", required=True,
+                    choices=["bigdl", "caffe", "torch"])
+    ap.add_argument("--modelPath", required=True)
+    ap.add_argument("--caffeDefPath", default=None)
+    ap.add_argument("-f", "--folder", default=None,
+                    help="ImageFolder-layout validation images (synthetic if absent)")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    model, params, state = load_any(args.modelType, args.modelPath,
+                                    args.caffeDefPath)
+    x, y = load_images(args.folder, args.batchSize)
+    results = Evaluator(model, params, state, batch_size=args.batchSize).test(
+        DataSet.tensors(x, y), [Top1Accuracy(), Top5Accuracy()])
+    for method, res in zip(("Top1Accuracy", "Top5Accuracy"), results):
+        print(f"{method}: {res}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""ML-pipeline (dlframes) examples: LeNet classifier, logistic
+regression, multi-label linear regression.
+
+Reference: ``DL/example/MLPipeline/{DLClassifierLeNet,
+DLClassifierLogisticRegression, DLEstimatorMultiLabelLR}.scala`` — the
+Spark-ML estimator/transformer workflow over DataFrames.
+
+TPU-native: same workflow over pandas frames through
+``bigdl_tpu.dlframes`` (see that module's docstring for why the frame
+engine is pandas here).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import DLClassifier, DLEstimator
+
+
+def lenet_classifier(args):
+    """DLClassifierLeNet: fit LeNet on MNIST rows, report accuracy."""
+    import pandas as pd
+
+    from bigdl_tpu.dataset.datasets import (
+        MNIST_TRAIN_MEAN, MNIST_TRAIN_STD, load_mnist,
+    )
+    from bigdl_tpu.models import lenet
+
+    x, y = load_mnist(args.folder, train=True)
+    x = ((x - MNIST_TRAIN_MEAN) / MNIST_TRAIN_STD).reshape(len(x), -1)
+    n = min(len(x), args.nSamples)
+    df = pd.DataFrame({"features": list(x[:n].astype(np.float32)),
+                       "label": y[:n].astype(np.int64)})
+
+    clf = DLClassifier(
+        lenet.build(),  # starts with Reshape([1, 28, 28]) over the 784 rows
+        nn.ClassNLLCriterion(), feature_size=[784],
+    ).set_batch_size(args.batchSize).set_max_epoch(args.maxEpoch).set_learning_rate(0.05)
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = float((out["prediction"].to_numpy() == out["label"].to_numpy()).mean())
+    print(f"LeNet pipeline train accuracy: {acc:.3f}")
+    return acc
+
+
+def logistic_regression(args):
+    """DLClassifierLogisticRegression: 2-feature binary LR."""
+    import pandas as pd
+
+    rng = np.random.RandomState(0)
+    n = args.nSamples
+    x = rng.randn(n, 2).astype(np.float32)
+    y = (x[:, 0] + 2 * x[:, 1] > 0).astype(np.int64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    clf = DLClassifier(
+        nn.Sequential(nn.Linear(2, 2), nn.LogSoftMax()),
+        nn.ClassNLLCriterion(), feature_size=[2]).set_batch_size(args.batchSize).set_max_epoch(args.maxEpoch).set_learning_rate(1.0)
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = float((out["prediction"].to_numpy() == y).mean())
+    print(f"logistic-regression pipeline accuracy: {acc:.3f}")
+    return acc
+
+
+def multilabel_lr(args):
+    """DLEstimatorMultiLabelLR: 2-in 2-out linear regression with MSE."""
+    import pandas as pd
+
+    rng = np.random.RandomState(1)
+    n = args.nSamples
+    x = rng.randn(n, 2).astype(np.float32)
+    w = np.asarray([[2.0, -1.0], [0.5, 3.0]], np.float32)
+    t = x @ w
+    df = pd.DataFrame({"features": list(x), "label": list(t)})
+
+    est = DLEstimator(nn.Linear(2, 2), nn.MSECriterion(),
+                      feature_size=[2], label_size=[2]).set_batch_size(args.batchSize).set_max_epoch(args.maxEpoch).set_learning_rate(0.1)
+    model = est.fit(df)
+    out = model.transform(df)
+    pred = np.stack(out["prediction"].to_list())
+    mse = float(np.mean((pred - t) ** 2))
+    print(f"multi-label LR pipeline MSE: {mse:.4f}")
+    return mse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("ml-pipeline")
+    ap.add_argument("--app", choices=["lenet", "lr", "multilabel"],
+                    default="lr")
+    ap.add_argument("-f", "--folder", default=None)
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=5)
+    ap.add_argument("--nSamples", type=int, default=256)
+    args = ap.parse_args(argv)
+    return {"lenet": lenet_classifier, "lr": logistic_regression,
+            "multilabel": multilabel_lr}[args.app](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Text classification with a word-embedding CNN.
+
+Reference: ``DL/example/textclassification/TextClassifier.scala`` +
+``DL/example/utils/TextClassifier.scala`` (news20 corpus + GloVe
+embeddings -> token windows -> temporal conv/pooling stack -> 20-way
+softmax, trained with an Optimizer).
+
+TPU-native: the tokenizer/Dictionary pipeline feeds fixed-length int32
+token ids; the embedding table is a ``LookupTable`` initialized from
+GloVe vectors when ``--embeddingFile`` is given (random otherwise), and
+the conv stack is ``TemporalConvolution``/``TemporalMaxPooling`` — one
+statically-shaped program, no per-sentence shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.text import Dictionary, tokenize
+
+
+def build(class_num: int, vocab_size: int, embed_dim: int = 50,
+          seq_len: int = 500) -> nn.Sequential:
+    """Embedding -> [conv5/relu/pool5] x2 -> conv5/relu -> global max pool
+    -> Linear(128) -> Linear(class_num) (reference
+    ``TextClassifier.buildModel``)."""
+    model = nn.Sequential()
+    # +1: the Dictionary maps unknown words past the vocab
+    model.add(nn.LookupTable(vocab_size + 1, embed_dim))
+    model.add(nn.TemporalConvolution(embed_dim, 128, 5))
+    model.add(nn.ReLU())
+    model.add(nn.TemporalMaxPooling(5, 5))
+    model.add(nn.TemporalConvolution(128, 128, 5))
+    model.add(nn.ReLU())
+    model.add(nn.TemporalMaxPooling(5, 5))
+    model.add(nn.TemporalConvolution(128, 128, 5))
+    model.add(nn.ReLU())
+    # global max over the remaining time axis
+    remaining = ((seq_len - 4) // 5 - 4) // 5 - 4
+    if remaining < 1:
+        raise ValueError(
+            f"maxSequenceLength={seq_len} too short for the conv stack "
+            "(needs >= 149)")
+    model.add(nn.TemporalMaxPooling(remaining, remaining))
+    model.add(nn.Squeeze(1))
+    model.add(nn.Linear(128, 100))
+    model.add(nn.ReLU())
+    model.add(nn.Linear(100, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def load_corpus(base_dir: Optional[str], n_classes: int = 4,
+                n_per_class: int = 64) -> Tuple[List[List[str]], List[int]]:
+    """news20 layout: one subdirectory per category, one file per post.
+    Synthetic class-separable token streams when ``base_dir`` is absent."""
+    if base_dir and os.path.isdir(base_dir):
+        texts, labels = [], []
+        cats = sorted(d for d in os.listdir(base_dir)
+                      if os.path.isdir(os.path.join(base_dir, d)))
+        for li, cat in enumerate(cats):
+            for path in sorted(glob.glob(os.path.join(base_dir, cat, "*"))):
+                with open(path, errors="ignore") as f:
+                    texts.append(tokenize(f.read()))
+                labels.append(li)
+        return texts, labels
+    rng = np.random.RandomState(0)
+    vocab = [f"w{i}" for i in range(200)]
+    texts, labels = [], []
+    for li in range(n_classes):
+        marker = [f"class{li}marker{j}" for j in range(8)]
+        for _ in range(n_per_class):
+            length = int(rng.randint(20, 60))
+            toks = [vocab[rng.randint(200)] for _ in range(length)]
+            for m in marker:  # class-identifying tokens
+                toks.insert(int(rng.randint(len(toks))), m)
+            texts.append(toks)
+            labels.append(li)
+    return texts, labels
+
+
+def load_glove(path: str, dictionary: Dictionary,
+               embed_dim: int) -> np.ndarray:
+    """GloVe text format -> (vocab+1, dim) table; missing words stay at
+    their random init (reference ``buildWord2VecMap``)."""
+    table = np.random.RandomState(1).uniform(
+        -0.05, 0.05, (dictionary.vocab_size + 1, embed_dim)).astype(np.float32)
+    with open(path, errors="ignore") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            idx = dictionary.word2index.get(parts[0])
+            if idx is not None and len(parts) == embed_dim + 1:
+                table[idx] = np.asarray(parts[1:], np.float32)
+    return table
+
+
+def to_arrays(texts: Sequence[List[str]], labels: Sequence[int],
+              dictionary: Dictionary, seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+    x = np.full((len(texts), seq_len), dictionary.unk_index(), np.int32)
+    for i, toks in enumerate(texts):
+        idx = dictionary.indices(toks[:seq_len])
+        x[i, :len(idx)] = idx
+    return x, np.asarray(labels, np.int32)
+
+
+def main(argv=None):
+    import jax
+
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.models.cli import fit
+    from bigdl_tpu.optim import Adagrad, Top1Accuracy, Trigger, optimizer
+
+    ap = argparse.ArgumentParser("text-classifier")
+    ap.add_argument("-b", "--baseDir", default=None,
+                    help="news20-layout corpus dir (synthetic if absent)")
+    ap.add_argument("--embeddingFile", default=None,
+                    help="GloVe .txt vectors (random init if absent)")
+    ap.add_argument("-s", "--maxSequenceLength", type=int, default=500)
+    ap.add_argument("-w", "--maxWordsNum", type=int, default=5000)
+    ap.add_argument("-l", "--trainingSplit", type=float, default=0.8)
+    ap.add_argument("-z", "--batchSize", type=int, default=32)
+    ap.add_argument("--learningRate", type=float, default=0.01)
+    ap.add_argument("--embedDim", type=int, default=50)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--maxIteration", type=int, default=0)
+    ap.add_argument("--checkpoint", default=None)
+    args = ap.parse_args(argv)
+
+    texts, labels = load_corpus(args.baseDir)
+    dictionary = Dictionary(texts, vocab_size=args.maxWordsNum)
+    x, y = to_arrays(texts, labels, dictionary, args.maxSequenceLength)
+    perm = np.random.RandomState(42).permutation(len(x))
+    x, y = x[perm], y[perm]
+    split = int(len(x) * args.trainingSplit)
+    class_num = int(y.max()) + 1
+
+    model = build(class_num, dictionary.vocab_size,
+                  args.embedDim, args.maxSequenceLength)
+    params, state = model.init(jax.random.key(0))
+    if args.embeddingFile:
+        table = load_glove(args.embeddingFile, dictionary, args.embedDim)
+        params = dict(params)
+        lookup_key = next(iter(params))
+        params[lookup_key] = dict(params[lookup_key], weight=table)
+
+    train = DataSet.tensors(x[:split], y[:split]) >> SampleToMiniBatch(args.batchSize)
+    val = DataSet.tensors(x[split:], y[split:])
+
+    opt = optimizer(model, train, nn.ClassNLLCriterion(),
+                    batch_size=args.batchSize)
+    opt.set_model_and_state(params, state)
+    opt.set_optim_method(Adagrad(learning_rate=args.learningRate))
+    opt.set_validation(Trigger.every_epoch(), val, [Top1Accuracy()],
+                       args.batchSize)
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""TensorFlow interop: load/save a frozen graph; transfer learning on a
+loaded TF feature extractor.
+
+Reference: ``DL/example/tensorflow/loadandsave/{Load,Save}.scala`` (load
+a frozen TF model, run it; export a BigDL model as a TF graph) and
+``DL/example/tensorflow/transferlearning/TransferLearning.scala``
+(run an Inception feature extractor loaded from TF, train a fresh
+classifier head on the extracted features).
+
+TPU-native: the frozen GraphDef imports as one pure ``TFGraphModule``
+(one XLA program); transfer learning = extract features once on-device,
+then fit a small head with the ordinary optimizer — no Session/queue
+machinery needed (the reference's queue runners exist to feed Spark
+partitions; here the host pipeline feeds the chip directly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import jax
+
+import bigdl_tpu.nn as nn
+
+
+def demo_feature_graph(path: str, in_ch: int = 4, feat: int = 16) -> str:
+    """Build a small conv feature extractor, export it as a frozen TF
+    GraphDef (stand-in for a downloaded slim checkpoint)."""
+    from bigdl_tpu.interop.tf import save_tf_graph
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(in_ch, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([8 * 4 * 4]),
+        nn.Linear(8 * 4 * 4, feat),
+        nn.Tanh(),
+    )
+    params, state = model.init(jax.random.key(0))
+    save_tf_graph(model, params, state, path, input_shape=(-1, in_ch, 8, 8))
+    return path
+
+
+def main(argv=None):
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.interop.tf import load_tf_graph
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger, optimizer
+    from bigdl_tpu.optim.predictor import Predictor
+
+    ap = argparse.ArgumentParser("tf-transfer-learning")
+    ap.add_argument("--graph", default=None,
+                    help="frozen GraphDef .pb (a demo extractor is built if absent)")
+    ap.add_argument("--inputs", default=None,
+                    help="comma-separated input node names (demo default)")
+    ap.add_argument("--outputs", default=None,
+                    help="comma-separated output node names (demo default)")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=3)
+    ap.add_argument("--nSamples", type=int, default=256)
+    ap.add_argument("--classNum", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    graph_path = args.graph or demo_feature_graph("/tmp/bigdl_tpu_tf_feat.pb")
+    inputs = args.inputs.split(",") if args.inputs else ["input"]
+    outputs = args.outputs.split(",") if args.outputs else ["output"]
+    extractor, ext_params, ext_state = load_tf_graph(graph_path, inputs, outputs)
+
+    # synthetic labeled data in the extractor's input shape
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, args.classNum, (args.nSamples,)).astype(np.int32)
+    x = rng.rand(args.nSamples, 4, 8, 8).astype(np.float32)
+    x += y[:, None, None, None] * 0.5  # class-separable
+
+    # 1) run the TF graph on-device to extract features (Load.scala)
+    feats = Predictor(extractor, ext_params, ext_state,
+                      batch_size=args.batchSize).predict(x)
+    feats = np.stack([np.asarray(f, np.float32) for f in feats])
+
+    # 2) train a fresh head on the frozen features (TransferLearning.scala)
+    head = nn.Sequential(nn.Linear(feats.shape[-1], args.classNum),
+                         nn.LogSoftMax())
+    ds = DataSet.tensors(feats, y) >> SampleToMiniBatch(args.batchSize)
+    opt = optimizer(head, ds, nn.ClassNLLCriterion(), batch_size=args.batchSize)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
+    opt.set_validation(Trigger.every_epoch(), DataSet.tensors(feats, y),
+                       [Top1Accuracy()], args.batchSize)
+    params, state = opt.optimize()
+    print("transfer-learning head trained")
+    return params, state
+
+
+if __name__ == "__main__":
+    main()

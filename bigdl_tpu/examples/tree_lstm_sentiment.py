@@ -1,0 +1,161 @@
+"""Tree-LSTM sentiment classification.
+
+Reference: ``DL/example/treeLSTMSentiment/{Train,TreeSentiment,Utils}.scala``
+— Stanford Sentiment Treebank constituency trees + GloVe embeddings ->
+``BinaryTreeLSTM`` -> per-node sentiment softmax, validated with
+``TreeNNAccuracy`` (root-node accuracy).
+
+TPU-native: trees are encoded as static-shape int32 ``[left, right,
+leaf_index]`` node arrays in topological order (see
+``nn/layers/tree_lstm.py``); an SST-format s-expression parser produces
+them, and a synthetic corpus stands in when no dataset directory is
+given. The whole batch is one ``lax.scan``-over-nodes program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def parse_sst(line: str) -> Tuple[List[str], List[Tuple[int, int, int]], int]:
+    """Parse one SST s-expression ``(3 (2 word) (2 word))`` into
+    (tokens, nodes, root_label). Nodes are ``[left, right, leaf_index]``
+    rows in topological (children-first) order, ids 1-based, 0 = none."""
+    pos = 0
+
+    def parse() -> Tuple[int, int]:  # returns (node_id, label)
+        nonlocal pos
+        assert line[pos] == "(", f"expected '(' at {pos}"
+        pos += 1
+        label_end = line.index(" ", pos)
+        label = int(line[pos:label_end])
+        pos = label_end + 1
+        if line[pos] == "(":  # internal: two children
+            left, _ = parse()
+            assert line[pos] == " ", f"expected ' ' at {pos}"
+            pos += 1
+            right, _ = parse()
+            assert line[pos] == ")", f"expected ')' at {pos}"
+            pos += 1
+            nodes.append((left, right, 0))
+            return len(nodes), label
+        end = line.index(")", pos)  # leaf: a token
+        tokens.append(line[pos:end])
+        pos = end + 1
+        nodes.append((0, 0, len(tokens)))
+        return len(nodes), label
+
+    tokens: List[str] = []
+    nodes: List[Tuple[int, int, int]] = []
+    _, root_label = parse()
+    return tokens, nodes, root_label
+
+
+def synthetic_corpus(n: int = 128, n_classes: int = 3,
+                     seed: int = 0) -> List[str]:
+    """Class-separable synthetic SST lines: sentiment decided by which
+    marker words appear."""
+    rng = np.random.RandomState(seed)
+    lines = []
+    for _ in range(n):
+        label = int(rng.randint(n_classes))
+        words = [f"c{label}w{rng.randint(4)}" for _ in range(4)]
+        lines.append(
+            f"({label} ({label} ({label} {words[0]}) ({label} {words[1]}))"
+            f" ({label} ({label} {words[2]}) ({label} {words[3]})))")
+    return lines
+
+
+def load_trees(folder: Optional[str], split: str) -> List[str]:
+    if folder:
+        path = os.path.join(folder, f"{split}.txt")
+        if os.path.exists(path):
+            with open(path) as f:
+                return [ln.strip() for ln in f if ln.strip()]
+    return synthetic_corpus(seed=0 if split == "train" else 1)
+
+
+def build(vocab_size: int, embed_dim: int, hidden: int,
+          class_num: int) -> nn.Graph:
+    """tokens+tree -> embeddings -> BinaryTreeLSTM -> root hidden ->
+    class log-probs (reference ``TreeSentiment.scala``)."""
+    tokens = nn.Input()
+    tree = nn.Input()
+    emb = nn.LookupTable(vocab_size + 1, embed_dim)(tokens)
+    hiddens = nn.BinaryTreeLSTM(embed_dim, hidden)(emb, tree)
+    root = nn.Select(1, -1)(hiddens)  # topological order: root is last
+    out = nn.LogSoftMax()(nn.Linear(hidden, class_num)(root))
+    return nn.Graph([tokens, tree], out)
+
+
+def encode(lines: List[str], word2index, n_tokens: int,
+           n_nodes: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    toks = np.zeros((len(lines), n_tokens), np.int32)
+    trees = np.zeros((len(lines), n_nodes, 3), np.int32)
+    labels = np.zeros((len(lines),), np.int32)
+    for i, line in enumerate(lines):
+        tk, nd, root = parse_sst(line)
+        tk, nd = tk[:n_tokens], nd[:n_nodes]
+        toks[i, :len(tk)] = [word2index.get(w, 0) for w in tk]
+        trees[i, :len(nd)] = nd
+        # shift the root to the LAST row so Select(1, -1) reads it
+        if len(nd) < n_nodes:
+            trees[i, -1] = trees[i, len(nd) - 1]
+            trees[i, len(nd) - 1] = 0
+        labels[i] = root
+    return toks, trees, labels
+
+
+def main(argv=None):
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.cli import fit
+    from bigdl_tpu.optim import Adagrad, Top1Accuracy, Trigger, optimizer
+
+    ap = argparse.ArgumentParser("tree-lstm-sentiment")
+    ap.add_argument("-f", "--folder", default=None,
+                    help="dir with train.txt/dev.txt SST trees (synthetic if absent)")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("--hiddenSize", type=int, default=64)
+    ap.add_argument("--embedDim", type=int, default=32)
+    ap.add_argument("--learningRate", type=float, default=0.05)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--maxIteration", type=int, default=0)
+    ap.add_argument("--checkpoint", default=None)
+    args = ap.parse_args(argv)
+
+    train_lines = load_trees(args.folder, "train")
+    dev_lines = load_trees(args.folder, "dev")
+    vocab = {}
+    max_tok = max_node = 0
+    for line in train_lines + dev_lines:
+        tk, nd, _ = parse_sst(line)
+        for w in tk:
+            vocab.setdefault(w, len(vocab) + 1)  # 0 is the pad id
+        max_tok, max_node = max(max_tok, len(tk)), max(max_node, len(nd))
+
+    xt, xr, y = encode(train_lines, vocab, max_tok, max_node)
+    vt, vr, vy = encode(dev_lines, vocab, max_tok, max_node)
+    class_num = int(max(y.max(), vy.max())) + 1
+
+    model = build(len(vocab), args.embedDim, args.hiddenSize, class_num)
+    train = (DataSet.array([Sample((a, b), c) for a, b, c in zip(xt, xr, y)])
+             >> SampleToMiniBatch(args.batchSize))
+    val = DataSet.array([Sample((a, b), c) for a, b, c in zip(vt, vr, vy)])
+
+    opt = optimizer(model, train, nn.ClassNLLCriterion(),
+                    batch_size=args.batchSize)
+    opt.set_optim_method(Adagrad(learning_rate=args.learningRate))
+    opt.set_validation(Trigger.every_epoch(), val, [Top1Accuracy()],
+                       args.batchSize)
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Serve a text classifier as a DataFrame UDF.
+
+Reference: ``DL/example/udfpredictor/DataframePredictor.scala`` — train
+(or load) the text classifier, register it as a Spark SQL UDF, and query
+a DataFrame of documents with ``df.withColumn("class", udf(col))`` /
+SQL ``SELECT``.
+
+TPU-native: the "UDF" is a plain Python callable closed over a jitted
+``Predictor`` — applied to a pandas column. The query surface is
+``DataFrame.assign`` (and ``DataFrame.query`` for the SQL-filter step),
+the direct pandas equivalents of the reference's withColumn + WHERE.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Callable, List
+
+import numpy as np
+
+from bigdl_tpu.dataset.text import Dictionary, tokenize
+
+
+def make_udf(model, params, state, dictionary: Dictionary,
+             seq_len: int, batch_size: int = 32) -> Callable[[List[str]], np.ndarray]:
+    """Vectorized UDF: list of raw documents -> predicted class ids."""
+    from bigdl_tpu.examples.text_classification import to_arrays
+    from bigdl_tpu.optim.predictor import Predictor
+
+    predictor = Predictor(model, params, state, batch_size=batch_size)
+
+    def udf(texts: List[str]) -> np.ndarray:
+        toks = [tokenize(t) for t in texts]
+        x, _ = to_arrays(toks, [0] * len(toks), dictionary, seq_len)
+        return predictor.predict_class(x)
+
+    return udf
+
+
+def main(argv=None):
+    import pandas as pd
+
+    from bigdl_tpu.examples.text_classification import (
+        build, load_corpus, to_arrays,
+    )
+
+    ap = argparse.ArgumentParser("udf-predictor")
+    ap.add_argument("-b", "--baseDir", default=None,
+                    help="news20-layout corpus (synthetic if absent)")
+    ap.add_argument("-s", "--maxSequenceLength", type=int, default=500)
+    ap.add_argument("-z", "--batchSize", type=int, default=32)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=1)
+    ap.add_argument("--filterClass", type=int, default=0,
+                    help="the WHERE-clause class of the reference's SQL query")
+    args = ap.parse_args(argv)
+
+    # train the classifier (reference: loads or trains via TextClassifier)
+    import jax
+
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.optim import Adagrad, Trigger, optimizer
+    import bigdl_tpu.nn as nn
+
+    texts, labels = load_corpus(args.baseDir)
+    dictionary = Dictionary(texts, vocab_size=5000)
+    x, y = to_arrays(texts, labels, dictionary, args.maxSequenceLength)
+    class_num = int(y.max()) + 1
+    model = build(class_num, dictionary.vocab_size,
+                  seq_len=args.maxSequenceLength)
+    ds = DataSet.tensors(x, y) >> SampleToMiniBatch(args.batchSize)
+    opt = optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=args.batchSize)
+    opt.set_optim_method(Adagrad(learning_rate=0.01))
+    opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
+    params, state = opt.optimize()
+
+    # register + query (reference: df.withColumn then SQL WHERE)
+    udf = make_udf(model, params, state, dictionary, args.maxSequenceLength,
+                   args.batchSize)
+    docs = pd.DataFrame({"text": [" ".join(t) for t in texts[:16]]})
+    docs = docs.assign(predicted=udf(docs["text"].tolist()))
+    hits = docs.query(f"predicted == {args.filterClass}")
+    print(f"{len(hits)}/{len(docs)} documents predicted class {args.filterClass}")
+    return docs
+
+
+if __name__ == "__main__":
+    main()

@@ -21,70 +21,75 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.init import MsraFiller, Zeros
 
 
-def _conv(cin, cout, k, stride=1, pad=0):
+def _conv(cin, cout, k, stride=1, pad=0, data_format="NCHW"):
     return nn.SpatialConvolution(
         cin, cout, k, k, stride, stride, pad, pad,
-        with_bias=False, weight_init=MsraFiller(),
+        with_bias=False, weight_init=MsraFiller(), data_format=data_format,
     )
 
 
-def _bn(n, zero_init=False):
+def _bn(n, zero_init=False, data_format="NCHW"):
     # reference zero-inits the last BN gamma of each block when
     # optnet/warm-up recipes are on (ResNet.scala getShortcut/iChannels)
-    return (
-        nn.SpatialBatchNormalization(n, weight_init=Zeros())
-        if zero_init
-        else nn.SpatialBatchNormalization(n)
-    )
+    return nn.SpatialBatchNormalization(
+        n, weight_init=Zeros() if zero_init else None, data_format=data_format)
 
 
-def shortcut(cin: int, cout: int, stride: int, shortcut_type: str = "B") -> nn.Module:
+def shortcut(cin: int, cout: int, stride: int, shortcut_type: str = "B",
+             data_format: str = "NCHW") -> nn.Module:
     """Shortcut types (reference ``ResNet.scala`` ``shortcut``):
     A = identity/zero-pad (CIFAR), B = 1x1 conv when shape changes,
     C = always 1x1 conv."""
     use_conv = shortcut_type == "C" or (shortcut_type == "B" and (cin != cout or stride != 1))
     if use_conv:
-        return nn.Sequential(_conv(cin, cout, 1, stride), _bn(cout))
+        return nn.Sequential(_conv(cin, cout, 1, stride, data_format=data_format),
+                             _bn(cout, data_format=data_format))
     if cin != cout:
         # type A: stride then zero-pad channels (Pad on channel dim)
+        ch_dim = 1 if data_format == "NCHW" else 3
         return nn.Sequential(
-            nn.SpatialAveragePooling(1, 1, stride, stride),
-            nn.Padding(1, cout - cin),
+            nn.SpatialAveragePooling(1, 1, stride, stride,
+                                     data_format=data_format),
+            nn.Padding(ch_dim, cout - cin),
         )
     return nn.Identity()
 
 
 def basic_block(cin: int, cout: int, stride: int, shortcut_type: str = "B",
-                zero_init_residual: bool = False) -> nn.Module:
+                zero_init_residual: bool = False,
+                data_format: str = "NCHW") -> nn.Module:
+    df = data_format
     block = nn.Sequential(
-        _conv(cin, cout, 3, stride, 1),
-        _bn(cout),
+        _conv(cin, cout, 3, stride, 1, data_format=df),
+        _bn(cout, data_format=df),
         nn.ReLU(),
-        _conv(cout, cout, 3, 1, 1),
-        _bn(cout, zero_init=zero_init_residual),
+        _conv(cout, cout, 3, 1, 1, data_format=df),
+        _bn(cout, zero_init=zero_init_residual, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type)),
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df)),
         nn.CAddTable(),
         nn.ReLU(),
     )
 
 
 def bottleneck(cin: int, planes: int, stride: int, shortcut_type: str = "B",
-               zero_init_residual: bool = False) -> nn.Module:
+               zero_init_residual: bool = False,
+               data_format: str = "NCHW") -> nn.Module:
+    df = data_format
     cout = planes * 4
     block = nn.Sequential(
-        _conv(cin, planes, 1),
-        _bn(planes),
+        _conv(cin, planes, 1, data_format=df),
+        _bn(planes, data_format=df),
         nn.ReLU(),
-        _conv(planes, planes, 3, stride, 1),
-        _bn(planes),
+        _conv(planes, planes, 3, stride, 1, data_format=df),
+        _bn(planes, data_format=df),
         nn.ReLU(),
-        _conv(planes, cout, 1),
-        _bn(cout, zero_init=zero_init_residual),
+        _conv(planes, cout, 1, data_format=df),
+        _bn(cout, zero_init=zero_init_residual, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type)),
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df)),
         nn.CAddTable(),
         nn.ReLU(),
     )
@@ -100,30 +105,37 @@ IMAGENET_CFG = {
 
 
 def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = "B",
-                   zero_init_residual: bool = True) -> nn.Sequential:
-    """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch)."""
+                   zero_init_residual: bool = True,
+                   data_format: str = "NCHW") -> nn.Sequential:
+    """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch).
+
+    ``data_format="NHWC"`` builds the TPU-preferred channels-last variant
+    (input (B, H, W, C)); channels map onto the 128-wide lane dimension
+    without a layout pass.
+    """
     if depth not in IMAGENET_CFG:
         raise ValueError(f"unsupported imagenet resnet depth {depth}")
     kind, counts = IMAGENET_CFG[depth]
     block = basic_block if kind == "basic" else bottleneck
     expansion = 1 if kind == "basic" else 4
+    df = data_format
 
     model = nn.Sequential(
-        _conv(3, 64, 7, 2, 3).set_name("conv1"),
-        _bn(64),
+        _conv(3, 64, 7, 2, 3, data_format=df).set_name("conv1"),
+        _bn(64, data_format=df),
         nn.ReLU(),
-        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, data_format=df),
     )
     cin = 64
     for stage, (planes, n_blocks) in enumerate(zip([64, 128, 256, 512], counts)):
         for i in range(n_blocks):
             stride = 2 if (stage > 0 and i == 0) else 1
             model.add(
-                block(cin, planes, stride, shortcut_type, zero_init_residual),
+                block(cin, planes, stride, shortcut_type, zero_init_residual, df),
                 name=f"layer{stage + 1}_{i}",
             )
             cin = planes * expansion
-    model.add(nn.GlobalAveragePooling2D())
+    model.add(nn.GlobalAveragePooling2D(data_format=df))
     model.add(nn.Linear(cin, class_num, weight_init=MsraFiller()).set_name("fc"))
     return model
 

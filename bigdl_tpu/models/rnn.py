@@ -8,6 +8,8 @@ stack -> TimeDistributed Linear -> LogSoftMax over time),
 
 from __future__ import annotations
 
+import numpy as np
+
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.layers.recurrent import LSTMCell, MultiRNNCell, Recurrent, RnnCell, TimeDistributed
 
@@ -67,9 +69,15 @@ def main(argv=None):
     parser.add_argument("--vocabSize", type=int, default=1000)
     parser.add_argument("--hiddenSize", type=int, default=64)
     parser.add_argument("--numLayers", type=int, default=1)
+    parser.add_argument("--idsFile", default=None,
+                        help=".npy int32 token-id stream (overrides --folder; "
+                             "used by examples/language_model.py)")
     args = parser.parse_args(argv)
 
-    stream = load_ptb(args.folder, "train", vocab_size=args.vocabSize)
+    if args.idsFile:
+        stream = np.load(args.idsFile).astype(np.int32)
+    else:
+        stream = load_ptb(args.folder, "train", vocab_size=args.vocabSize)
     vocab = int(stream.max()) + 1
     x, y = ptb_windows(stream, args.seqLength)
     ds = DataSet.tensors(x, y)

@@ -110,6 +110,7 @@ class BatchNormalization(Module):
         affine: bool = True,
         weight_init: Optional[InitializationMethod] = None,
         bias_init: Optional[InitializationMethod] = None,
+        data_format: str = "NCHW",
     ):
         super().__init__()
         self.n_output = n_output
@@ -118,6 +119,9 @@ class BatchNormalization(Module):
         self.affine = affine
         self.weight_init = weight_init or Ones()
         self.bias_init = bias_init or Zeros()
+        # channel axis: 1 for NCHW (reference convention); last for NHWC
+        # (the TPU-preferred layout — lanes map to channels)
+        self.ch_axis = 1 if data_format == "NCHW" else -1
 
     def build_params(self, rng):
         if not self.affine:
@@ -134,13 +138,9 @@ class BatchNormalization(Module):
             "running_var": jnp.ones((self.n_output,), jnp.float32),
         }
 
-    def _broadcast(self, v, ndim):
-        shape = [1] * ndim
-        shape[1] = self.n_output
-        return v.reshape(shape)
-
     def forward(self, ctx: Context, x):
-        axes = tuple(i for i in range(x.ndim) if i != 1)
+        ch = self.ch_axis % x.ndim
+        axes = tuple(i for i in range(x.ndim) if i != ch)
         if self.affine:
             gamma = ctx.param("weight").astype(jnp.float32)
             beta = ctx.param("bias").astype(jnp.float32)
@@ -159,7 +159,7 @@ class BatchNormalization(Module):
             return y
         mean = ctx.get_state("running_mean")
         var = ctx.get_state("running_var")
-        y, _ = _bn_apply(x, mean, var, gamma, beta, self.eps, 1)
+        y, _ = _bn_apply(x, mean, var, gamma, beta, self.eps, ch)
         return y
 
 

@@ -11,6 +11,8 @@ Argument order keeps the reference's W-before-H convention.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -64,7 +66,6 @@ class _Pool2D(Module):
         return tuple(dims), tuple(strides), tuple(pads)
 
 
-from functools import partial
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))

@@ -1,0 +1,152 @@
+"""Examples tier: each reference ``DL/example/*`` counterpart runs
+end-to-end on tiny synthetic data (reference test strategy: examples are
+exercised by ``pyspark/test/local_integration`` shell runs; here they are
+plain pytest cases since the mains are importable functions)."""
+
+import numpy as np
+import pytest
+
+
+def test_text_classification_runs():
+    from bigdl_tpu.examples import text_classification
+
+    params, _ = text_classification.main(
+        ["-z", "16", "--maxIteration", "3", "-s", "160", "-w", "500"])
+    assert params is not None
+
+
+def test_text_classification_glove(tmp_path):
+    from bigdl_tpu.examples.text_classification import Dictionary, load_glove
+
+    d = Dictionary([["alpha", "beta"]])
+    p = tmp_path / "glove.txt"
+    p.write_text("alpha 1.0 2.0 3.0\nmissing 4.0 5.0 6.0\n")
+    table = load_glove(str(p), d, 3)
+    assert table.shape == (3, 3)
+    np.testing.assert_allclose(table[d.word2index["alpha"]], [1.0, 2.0, 3.0])
+
+
+def test_udf_predictor_runs():
+    from bigdl_tpu.examples import udf_predictor
+
+    docs = udf_predictor.main(["-z", "16", "-e", "1", "-s", "160"])
+    assert "predicted" in docs.columns and len(docs) == 16
+
+
+def test_tree_lstm_sentiment_parse():
+    from bigdl_tpu.examples.tree_lstm_sentiment import parse_sst
+
+    tokens, nodes, root = parse_sst("(3 (2 good) (2 (2 very) (2 movie)))")
+    assert tokens == ["good", "very", "movie"]
+    assert root == 3
+    # children-first: the root row is last and references earlier nodes
+    left, right, leaf = nodes[-1]
+    assert leaf == 0 and left > 0 and right > 0
+
+
+def test_tree_lstm_sentiment_runs():
+    from bigdl_tpu.examples import tree_lstm_sentiment
+
+    params, _ = tree_lstm_sentiment.main(
+        ["-b", "16", "--maxIteration", "3", "--hiddenSize", "8",
+         "--embedDim", "8"])
+    assert params is not None
+
+
+def test_load_model_bigdl(tmp_path):
+    import jax
+
+    from bigdl_tpu.examples import load_model
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.utils.serializer import save_module
+
+    model = lenet.build()
+    params, state = model.init(jax.random.key(0))
+    path = str(tmp_path / "lenet.bigdl")
+    save_module(path, model, params, state)
+    mod, p, s = load_model.load_any("bigdl", path)
+    assert mod is not None and p is not None
+
+
+def test_lenet_local_trio(tmp_path):
+    from bigdl_tpu.examples import lenet_local
+
+    common = ["--modelDir", str(tmp_path), "-b", "32"]
+    lenet_local.main(["--mode", "train", "--maxIteration", "2"] + common)
+    res = lenet_local.main(["--mode", "test"] + common)
+    assert 0.0 <= res[0].result()[0] <= 1.0
+    classes = lenet_local.main(["--mode", "predict", "--nPredict", "4"] + common)
+    assert classes.shape == (4,)
+
+
+def test_ml_pipeline_lr():
+    from bigdl_tpu.examples import ml_pipeline
+
+    acc = ml_pipeline.main(["--app", "lr", "-e", "10", "--nSamples", "128"])
+    assert acc > 0.8
+
+
+def test_ml_pipeline_multilabel():
+    from bigdl_tpu.examples import ml_pipeline
+
+    mse = ml_pipeline.main(["--app", "multilabel", "-e", "20",
+                            "--nSamples", "128"])
+    assert mse < 1.0
+
+
+def test_int8_inference_runs(capsys):
+    from bigdl_tpu.examples import int8_inference
+
+    fp, q = int8_inference.main(["--arch", "resnet50", "-b", "8",
+                                 "--classNum", "10"])
+    out = capsys.readouterr().out
+    assert "scales" in out and len(fp) == 2 and len(q) == 2
+
+
+def test_tf_transfer_learning_runs():
+    from bigdl_tpu.examples import tf_transfer_learning
+
+    params, _ = tf_transfer_learning.main(
+        ["-b", "16", "-e", "2", "--nSamples", "64"])
+    assert params is not None
+
+
+def test_image_classification_predict():
+    from bigdl_tpu.examples import image_classification
+
+    out = image_classification.main(["-b", "4", "--classNum", "10"])
+    assert "prediction" in out.columns and len(out) == 8
+
+
+def test_dlframes_image_inference():
+    from bigdl_tpu.examples import dlframes_image
+
+    out = dlframes_image.main(["--app", "inference", "-b", "4",
+                               "--classNum", "10", "--nSamples", "4"])
+    assert "prediction" in out.columns
+
+
+def test_dlframes_image_transfer():
+    from bigdl_tpu.examples import dlframes_image
+
+    acc = dlframes_image.main(["--app", "transfer", "-b", "8", "-e", "5",
+                               "--nSamples", "16"])
+    assert acc >= 0.5
+
+
+def test_keras_train_runs():
+    from bigdl_tpu.examples import keras_train
+
+    scores = keras_train.main(["-b", "64", "-e", "1", "--nSamples", "256"])
+    assert scores
+
+
+def test_language_model_runs(tmp_path):
+    from bigdl_tpu.examples import language_model
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog .\n" * 200)
+    params, _ = language_model.main(
+        ["-f", str(corpus), "-b", "8", "--maxIteration", "2",
+         "--seqLength", "8", "--hiddenSize", "8", "--vocabSize", "50"])
+    assert params is not None

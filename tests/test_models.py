@@ -92,3 +92,22 @@ def test_graft_entry_contract():
     spec.loader.exec_module(mod)
     # multichip dry run on the virtual CPU mesh
     mod.dryrun_multichip(4)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """The TPU-preferred channels-last ResNet computes the same function
+    as the NCHW build given transposed input and identical params (the
+    param trees share shapes: conv weights stay OIHW in both layouts)."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models import resnet
+
+    m_nchw = resnet.build_imagenet(18, 7)
+    m_nhwc = resnet.build_imagenet(18, 7, data_format="NHWC")
+    params, state = m_nchw.init(jax.random.key(3))
+    x = np.random.RandomState(0).rand(2, 3, 64, 64).astype(np.float32)
+    out_c, _ = m_nchw.apply(params, x, state=state, training=True)
+    out_l, _ = m_nhwc.apply(params, x.transpose(0, 2, 3, 1), state=state, training=True)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_l),
+                               rtol=2e-4, atol=2e-4)
