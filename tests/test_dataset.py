@@ -177,3 +177,46 @@ def test_optimizer_uses_fast_path_for_tensor_dataset():
     opt.set_end_when(optim.Trigger.max_iteration(60))
     params, _ = opt.optimize()
     assert opt.state.loss < 0.5
+
+
+# ------------------------------------------------------ RowTransformer
+def test_row_transformer_numeric_all():
+    from bigdl_tpu.dataset.datamining import RowTransformer
+
+    rows = [{"a": 1.0, "b": [2.0, 3.0], "c": 4.0}]
+    out = list(RowTransformer.numeric()(rows))
+    np.testing.assert_allclose(out[0]["all"], [1.0, 2.0, 3.0, 4.0])
+
+
+def test_row_transformer_numeric_groups():
+    from bigdl_tpu.dataset.datamining import RowTransformer
+
+    rows = [{"a": 1.0, "b": 2.0, "c": 3.0}] * 2
+    t = RowTransformer.numeric({"x": ["a", "c"], "y": ["b"]})
+    out = list(t(rows))
+    assert len(out) == 2
+    np.testing.assert_allclose(out[0]["x"], [1.0, 3.0])
+    np.testing.assert_allclose(out[0]["y"], [2.0])
+
+
+def test_row_transformer_atomic_and_mixed():
+    from bigdl_tpu.dataset.datamining import RowTransformer
+
+    rows = [{"name": "alpha", "f1": 1.5, "f2": 2.5}]
+    t = RowTransformer.atomic_with_numeric(["name"], {"feats": ["f1", "f2"]})
+    out = list(t(rows))[0]
+    assert out["name"].item() == "alpha"
+    np.testing.assert_allclose(out["feats"], [1.5, 2.5])
+    # positional selection over plain sequences
+    t2 = RowTransformer.atomic([0, 2], row_size=3)
+    out2 = list(t2([(10, 20, 30)]))[0]
+    assert out2["0"].item() == 10 and out2["2"].item() == 30
+
+
+def test_row_transformer_duplicate_key_and_bounds():
+    from bigdl_tpu.dataset.datamining import ColsToNumeric, RowTransformer
+
+    with pytest.raises(ValueError):
+        RowTransformer([ColsToNumeric("k"), ColsToNumeric("k")])
+    with pytest.raises(ValueError):
+        RowTransformer.atomic([5], row_size=3)
