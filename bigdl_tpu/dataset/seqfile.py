@@ -1,0 +1,293 @@
+"""Hadoop SequenceFile I/O and the ImageNet seq-file pipeline.
+
+Reference: the reference distributes ImageNet as Hadoop SequenceFiles —
+written by ``DL/dataset/image/BGRImgToLocalSeqFile.scala`` (key =
+``Text("name\\nlabel")`` or ``Text("label")``, value = ``Text(4-byte BE
+width + 4-byte BE height + raw BGR bytes)``), read back by
+``LocalSeqFileToBytes.scala`` and ``DataSet.SeqFileFolder``
+(``DataSet.scala:487``: ``readLabel``/``readName`` split the key on
+``\\n``).
+
+TPU-native: a dependency-free SequenceFile codec (uncompressed,
+version-6 ``SEQ`` files, Hadoop ``Text``/``BytesWritable`` value
+serialization, vint lengths, sync markers) — no Hadoop/Java needed on a
+TPU-VM host. The decoded stream feeds the ordinary
+``Transformer``-chain/host-prefetch path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import struct
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+
+_MAGIC = b"SEQ"
+_VERSION = 6
+TEXT_CLASS = "org.apache.hadoop.io.Text"
+BYTES_CLASS = "org.apache.hadoop.io.BytesWritable"
+
+
+# -- Hadoop WritableUtils vint ------------------------------------------------
+
+def write_vint(n: int) -> bytes:
+    """Hadoop WritableUtils.writeVInt/VLong."""
+    if -112 <= n <= 127:
+        return bytes([n & 0xFF])
+    length = -112
+    if n < 0:
+        n = ~n
+        length = -120
+    tmp = n
+    while tmp:
+        tmp >>= 8
+        length -= 1
+    out = [length & 0xFF]
+    n_bytes = -(length + 112) if length >= -120 and length < -112 else -(length + 120)
+    for i in range(n_bytes - 1, -1, -1):
+        out.append((n >> (8 * i)) & 0xFF)
+    return bytes(out)
+
+
+def read_vint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Returns (value, new_pos)."""
+    first = struct.unpack_from("b", buf, pos)[0]
+    pos += 1
+    if first >= -112:
+        return first, pos
+    negative = first <= -121
+    n_bytes = (-(first + 120)) if negative else (-(first + 112))
+    val = 0
+    for _ in range(n_bytes):
+        val = (val << 8) | buf[pos]
+        pos += 1
+    return (~val if negative else val), pos
+
+
+def _text(payload: bytes) -> bytes:
+    """Hadoop Text serialization: vint length + bytes."""
+    return write_vint(len(payload)) + payload
+
+
+# -- writer -------------------------------------------------------------------
+
+class SeqFileWriter:
+    """Uncompressed SequenceFile writer (key/value class = Text by
+    default, matching ``BGRImgToLocalSeqFile``)."""
+
+    SYNC_INTERVAL = 2000  # bytes between sync markers (Hadoop default ~2k)
+
+    def __init__(self, path: str, key_class: str = TEXT_CLASS,
+                 value_class: str = TEXT_CLASS):
+        self._f = open(path, "wb")
+        self.key_class = key_class
+        self.value_class = value_class
+        self._sync = np.random.RandomState(
+            abs(hash(path)) % (2 ** 31)).bytes(16)
+        self._since_sync = 0
+        self._write_header()
+
+    def _write_header(self) -> None:
+        f = self._f
+        f.write(_MAGIC + bytes([_VERSION]))
+        f.write(_text(self.key_class.encode()))
+        f.write(_text(self.value_class.encode()))
+        f.write(b"\x00")  # no value compression
+        f.write(b"\x00")  # no block compression
+        f.write(struct.pack(">i", 0))  # empty metadata
+        f.write(self._sync)
+
+    def _serialize(self, payload: bytes, cls: str) -> bytes:
+        if cls == TEXT_CLASS:
+            return _text(payload)
+        if cls == BYTES_CLASS:
+            return struct.pack(">i", len(payload)) + payload
+        raise ValueError(f"unsupported writable class {cls}")
+
+    def append(self, key: bytes, value: bytes) -> None:
+        k = self._serialize(key, self.key_class)
+        v = self._serialize(value, self.value_class)
+        if self._since_sync >= self.SYNC_INTERVAL:
+            self._f.write(struct.pack(">i", -1) + self._sync)
+            self._since_sync = 0
+        rec = struct.pack(">ii", len(k) + len(v), len(k)) + k + v
+        self._f.write(rec)
+        self._since_sync += len(rec)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- reader -------------------------------------------------------------------
+
+class SeqFileReader:
+    """Reads (key_bytes, value_bytes) records from an uncompressed
+    SequenceFile (versions 4-6; Text and BytesWritable payloads are
+    unwrapped to their raw bytes)."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            self._buf = f.read()
+        buf = self._buf
+        if buf[:3] != _MAGIC:
+            raise ValueError(f"{path}: not a SequenceFile (bad magic)")
+        version = buf[3]
+        if version < 4:
+            raise ValueError(f"{path}: SequenceFile version {version} < 4 unsupported")
+        pos = 4
+        klen, pos = read_vint(buf, pos)
+        self.key_class = buf[pos:pos + klen].decode()
+        pos += klen
+        vlen, pos = read_vint(buf, pos)
+        self.value_class = buf[pos:pos + vlen].decode()
+        pos += vlen
+        compressed = buf[pos]; pos += 1
+        block_compressed = buf[pos]; pos += 1
+        if compressed or block_compressed:
+            raise ValueError(f"{path}: compressed SequenceFiles unsupported "
+                             "(the reference writes uncompressed)")
+        n_meta = struct.unpack_from(">i", buf, pos)[0]; pos += 4
+        self.metadata = {}
+        for _ in range(n_meta):
+            kl, pos = read_vint(buf, pos)
+            mk = buf[pos:pos + kl].decode(); pos += kl
+            vl, pos = read_vint(buf, pos)
+            self.metadata[mk] = buf[pos:pos + vl].decode(); pos += vl
+        self._sync = buf[pos:pos + 16]
+        self._pos = pos + 16
+
+    def _unwrap(self, payload: bytes, cls: str) -> bytes:
+        if cls == TEXT_CLASS:
+            n, p = read_vint(payload, 0)
+            return payload[p:p + n]
+        if cls == BYTES_CLASS:
+            n = struct.unpack_from(">i", payload, 0)[0]
+            return payload[4:4 + n]
+        return payload
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes]]:
+        buf, pos = self._buf, self._pos
+        while pos < len(buf):
+            rec_len = struct.unpack_from(">i", buf, pos)[0]
+            pos += 4
+            if rec_len == -1:  # sync escape
+                if buf[pos:pos + 16] != self._sync:
+                    raise ValueError("corrupt seq file: bad sync marker")
+                pos += 16
+                continue
+            key_len = struct.unpack_from(">i", buf, pos)[0]
+            pos += 4
+            key = buf[pos:pos + key_len]
+            value = buf[pos + key_len:pos + rec_len]
+            pos += rec_len
+            yield (self._unwrap(key, self.key_class),
+                   self._unwrap(value, self.value_class))
+
+
+# -- the ImageNet seq-file pipeline ------------------------------------------
+
+@dataclasses.dataclass
+class ByteRecord:
+    """Raw bytes + float label (reference ``ByteRecord``)."""
+
+    data: bytes
+    label: float
+
+
+def read_label(key: bytes) -> str:
+    """Key text -> label (reference ``SeqFileFolder.readLabel``)."""
+    parts = key.decode().split("\n")
+    return parts[0] if len(parts) == 1 else parts[1]
+
+
+def read_name(key: bytes) -> str:
+    parts = key.decode().split("\n")
+    if len(parts) < 2:
+        raise ValueError("key in seq file only contains label, no name")
+    return parts[0]
+
+
+class BGRImgToLocalSeqFile(Transformer):
+    """(label, name, HxWx3 uint8 BGR array) stream -> seq files of
+    ``block_size`` records each; yields the written paths (reference
+    ``BGRImgToLocalSeqFile.scala``: value = 4-byte BE width + height +
+    raw bytes; key = "name\\nlabel" when ``has_name``)."""
+
+    def __init__(self, block_size: int, base_file_name: str,
+                 has_name: bool = False):
+        self.block_size = block_size
+        self.base = base_file_name
+        self.has_name = has_name
+        self._index = 0
+
+    def apply(self, it):
+        it = iter(it)
+        while True:
+            try:
+                first = next(it)
+            except StopIteration:
+                return
+            path = f"{self.base}_{self._index}.seq"
+            with SeqFileWriter(path) as w:
+                wrote = 0
+                record = first
+                while True:
+                    label, name, img = record
+                    img = np.ascontiguousarray(img, np.uint8)
+                    h, w_ = img.shape[:2]
+                    value = struct.pack(">ii", w_, h) + img.tobytes()
+                    key = (f"{name}\n{int(label)}" if self.has_name
+                           else f"{int(label)}")
+                    w.append(key.encode(), value)
+                    wrote += 1
+                    if wrote >= self.block_size:
+                        break
+                    try:
+                        record = next(it)
+                    except StopIteration:
+                        break
+            self._index += 1
+            yield path
+
+
+class LocalSeqFileToBytes(Transformer):
+    """seq-file paths -> ByteRecord stream (reference
+    ``LocalSeqFileToBytes.scala``)."""
+
+    def apply(self, it):
+        for path in it:
+            for key, value in SeqFileReader(path):
+                yield ByteRecord(value, float(read_label(key)))
+
+
+def decode_bgr_record(rec: ByteRecord) -> Tuple[np.ndarray, float]:
+    """ByteRecord -> (HxWx3 uint8 BGR image, label) using the 8-byte
+    width/height prefix the writer emits."""
+    w, h = struct.unpack_from(">ii", rec.data, 0)
+    img = np.frombuffer(rec.data, np.uint8, count=h * w * 3, offset=8)
+    return img.reshape(h, w, 3), rec.label
+
+
+def find_seq_files(folder: str) -> List[str]:
+    paths = sorted(glob.glob(os.path.join(folder, "*.seq")))
+    if not paths:
+        raise FileNotFoundError(f"no .seq files under {folder}")
+    return paths
+
+
+def load_imagenet_seqfiles(folder: str):
+    """All records decoded: yields (HxWx3 uint8 BGR, float label) —
+    the ``DataSet.SeqFileFolder.files`` equivalent for a TPU-VM host."""
+    for rec in LocalSeqFileToBytes()(find_seq_files(folder)):
+        yield decode_bgr_record(rec)

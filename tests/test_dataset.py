@@ -220,3 +220,47 @@ def test_row_transformer_duplicate_key_and_bounds():
         RowTransformer([ColsToNumeric("k"), ColsToNumeric("k")])
     with pytest.raises(ValueError):
         RowTransformer.atomic([5], row_size=3)
+
+
+# ------------------------------------------------------ SequenceFile
+def test_seqfile_roundtrip(tmp_path):
+    from bigdl_tpu.dataset.seqfile import SeqFileReader, SeqFileWriter
+
+    p = str(tmp_path / "a.seq")
+    with SeqFileWriter(p) as w:
+        for i in range(300):  # enough bytes to cross sync intervals
+            w.append(f"key{i}".encode(), bytes([i % 251]) * (50 + i))
+    got = list(SeqFileReader(p))
+    assert len(got) == 300
+    assert got[0][0] == b"key0" and got[299][0] == b"key299"
+    assert got[7][1] == bytes([7]) * 57
+
+
+def test_seqfile_vint_edge_cases():
+    from bigdl_tpu.dataset.seqfile import read_vint, write_vint
+
+    for n in (0, 1, 127, -112, 128, 255, 256, 70000, 2**31 - 1, -113, -70000):
+        buf = write_vint(n)
+        val, pos = read_vint(buf, 0)
+        assert val == n and pos == len(buf), n
+
+
+def test_imagenet_seqfile_pipeline(tmp_path):
+    from bigdl_tpu.dataset.seqfile import (
+        BGRImgToLocalSeqFile, load_imagenet_seqfiles, read_label, read_name,
+    )
+
+    rng = np.random.RandomState(0)
+    records = [(i % 5 + 1, f"img_{i}.jpg", rng.randint(0, 255, (8, 6, 3), np.uint8))
+               for i in range(23)]
+    writer = BGRImgToLocalSeqFile(10, str(tmp_path / "imagenet"), has_name=True)
+    paths = list(writer(records))
+    assert len(paths) == 3  # 10 + 10 + 3
+
+    decoded = list(load_imagenet_seqfiles(str(tmp_path)))
+    assert len(decoded) == 23
+    img, label = decoded[0]
+    np.testing.assert_array_equal(img, records[0][2])
+    assert label == float(records[0][0])
+    assert read_label("name\n7".encode()) == "7"
+    assert read_name("name\n7".encode()) == "name"
