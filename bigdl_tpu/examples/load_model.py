@@ -29,6 +29,10 @@ def load_any(model_type: str, model_path: str,
         from bigdl_tpu.utils.serializer import load_module
 
         return load_module(model_path)
+    if model_type == "bigdl-proto":
+        from bigdl_tpu.interop.bigdl import load_bigdl
+
+        return load_bigdl(model_path)
     if model_type == "caffe":
         from bigdl_tpu.interop.caffe import load_caffe
 
@@ -43,7 +47,7 @@ def load_any(model_type: str, model_path: str,
             f"{load_t7(model_path)!r}\n"
             "use bigdl_tpu.utils.convert_model to map it to a module"
         )
-    raise ValueError("modelType must be bigdl, caffe or torch")
+    raise ValueError("modelType must be bigdl, bigdl-proto, caffe or torch")
 
 
 def load_images(folder: Optional[str], batch: int,
@@ -75,7 +79,7 @@ def main(argv=None):
 
     ap = argparse.ArgumentParser("load-model-validator")
     ap.add_argument("-t", "--modelType", required=True,
-                    choices=["bigdl", "caffe", "torch"])
+                    choices=["bigdl", "bigdl-proto", "caffe", "torch"])
     ap.add_argument("--modelPath", required=True)
     ap.add_argument("--caffeDefPath", default=None)
     ap.add_argument("-f", "--folder", default=None,

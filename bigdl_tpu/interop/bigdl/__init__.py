@@ -1,0 +1,623 @@
+"""Reference-format (protobuf) BigDL model serialization.
+
+Reference: ``DL/utils/serializer/`` — models persist as one protobuf
+``BigDLModule`` tree (``ModuleLoader.loadFromFile`` parses the raw
+bytes, ``ModuleSerializable.doSerializeModule`` stores constructor args
+in the ``attr`` map keyed by the Scala parameter names, and
+``copyFromBigDL`` appends ``parameters`` = [weight, bias] tensors with
+id-shared ``TensorStorage``). Schema: ``bigdl_model.proto`` here, wire-
+compatible with ``spark/dl/src/main/resources/serialization/bigdl.proto``.
+
+This module maps that format onto the TPU-native module zoo both ways:
+
+- ``load_bigdl(path)`` -> ``(module, params, state)`` — reads a model
+  saved by the reference (``Module.saveModule``) covering the
+  Sequential/Graph container tier and the common layer set
+  (conv/linear/BN/pool/activations/LRN/dropout/reshape/table ops/
+  embedding/temporal conv).
+- ``save_bigdl(path, module, params, state)`` — writes a file the
+  reference can read back (ctor attrs under Scala names + module_tags/
+  module_numerics markers + version).
+
+Known reference quirk kept: BN running statistics do not travel through
+the proto path (``parameters`` carries only weight/bias — the reference
+loses them the same way); they re-initialize on load.
+
+Weight layout conversions (Scala <-> here):
+- SpatialConvolution: (nGroup, out/g, in/g, kH, kW) <-> (out, in/g, kH, kW)
+- TemporalConvolution: (out, kW*in) <-> (out, in, kW)
+- Linear/LookupTable/BN: identical shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.bigdl import bigdl_pb2 as pb
+
+SCALA_NN = "com.intel.analytics.bigdl.nn."
+_VERSION = "0.10.0"
+
+
+# -- attr helpers -------------------------------------------------------------
+
+def _attr_int(v: int) -> pb.AttrValue:
+    return pb.AttrValue(dataType=pb.INT32, int32Value=int(v))
+
+
+def _attr_double(v: float) -> pb.AttrValue:
+    return pb.AttrValue(dataType=pb.DOUBLE, doubleValue=float(v))
+
+
+def _attr_bool(v: bool) -> pb.AttrValue:
+    return pb.AttrValue(dataType=pb.BOOL, boolValue=bool(v))
+
+
+def _attr_str(v: str) -> pb.AttrValue:
+    return pb.AttrValue(dataType=pb.STRING, stringValue=v)
+
+
+def _attr_null(dtype) -> pb.AttrValue:
+    """A null-valued attr (regularizer/tensor ctor args the zoo leaves
+    unset — the reference writes the dataType with no value)."""
+    return pb.AttrValue(dataType=dtype)
+
+
+def _attr_int_array(vals: Sequence[int]) -> pb.AttrValue:
+    a = pb.AttrValue(dataType=pb.ARRAY_VALUE)
+    a.arrayValue.size = len(vals)
+    a.arrayValue.datatype = pb.INT32
+    a.arrayValue.i32.extend(int(v) for v in vals)
+    return a
+
+
+def _attr_str_array(vals: Sequence[str]) -> pb.AttrValue:
+    a = pb.AttrValue(dataType=pb.ARRAY_VALUE)
+    a.arrayValue.size = len(vals)
+    a.arrayValue.datatype = pb.STRING
+    a.arrayValue.str.extend(vals)
+    return a
+
+
+def _attr_data_format(fmt: str) -> pb.AttrValue:
+    return pb.AttrValue(dataType=pb.DATA_FORMAT,
+                        dataFormatValue=pb.NCHW if fmt == "NCHW" else pb.NHWC)
+
+
+def _get(attrs, key: str, default=None):
+    """Read one attr by its wire dataType."""
+    if key not in attrs:
+        return default
+    a = attrs[key]
+    field = a.WhichOneof("value")
+    if field is None:
+        return default
+    v = getattr(a, field)
+    if field == "arrayValue":
+        dt = v.datatype
+        if dt == pb.INT32:
+            return list(v.i32)
+        if dt == pb.STRING:
+            return list(v.str)
+        if dt == pb.FLOAT:
+            return list(v.flt)
+        if dt == pb.DOUBLE:
+            return list(v.dbl)
+        if dt == pb.BOOL:
+            return list(v.boolean)
+        return v
+    if field == "dataFormatValue":
+        return "NCHW" if v == pb.NCHW else "NHWC"
+    return v
+
+
+# -- tensor <-> proto ---------------------------------------------------------
+
+class _StorageBook:
+    """Shared-storage bookkeeping (reference ``TensorStorageManager``):
+    tensors referencing the same storage id resolve to one array."""
+
+    def __init__(self):
+        self.by_id: Dict[int, np.ndarray] = {}
+        self._next = 1
+
+    def collect(self, module: pb.BigDLModule) -> None:
+        for t in list(module.parameters) + [module.weight, module.bias]:
+            if t.HasField("storage") and len(t.storage.float_data):
+                self.by_id[t.storage.id] = np.asarray(
+                    t.storage.float_data, np.float32)
+            elif t.HasField("storage") and len(t.storage.double_data):
+                self.by_id[t.storage.id] = np.asarray(
+                    t.storage.double_data, np.float64).astype(np.float32)
+        for sub in module.subModules:
+            self.collect(sub)
+
+    def tensor_to_np(self, t: pb.BigDLTensor) -> Optional[np.ndarray]:
+        if t.dimension == 0 and not t.isScalar:
+            return None
+        data = self.by_id.get(t.storage.id if t.HasField("storage") else t.id)
+        if data is None and t.HasField("storage"):
+            data = (np.asarray(t.storage.float_data, np.float32)
+                    if len(t.storage.float_data) else None)
+        if data is None:
+            return None
+        off = max(0, t.offset - 1)  # Torch storageOffset is 1-based
+        flat = data[off:off + t.nElements]
+        return flat.reshape(tuple(t.size))
+
+    def np_to_tensor(self, arr: np.ndarray) -> pb.BigDLTensor:
+        arr = np.ascontiguousarray(np.asarray(arr, np.float32))
+        sid = self._next
+        self._next += 1
+        strides = []
+        acc = 1
+        for d in reversed(arr.shape):
+            strides.insert(0, acc)
+            acc *= d
+        t = pb.BigDLTensor(
+            datatype=pb.FLOAT, size=list(arr.shape), stride=strides,
+            offset=1, dimension=arr.ndim, nElements=arr.size,
+            isScalar=(arr.ndim == 0), id=sid,
+        )
+        t.storage.datatype = pb.FLOAT
+        t.storage.id = sid
+        t.storage.float_data.extend(arr.reshape(-1).tolist())
+        return t
+
+
+# -- layer converters ---------------------------------------------------------
+# each entry: scala short name -> (to_module(attrs), from_module(module))
+# where to_module returns our Module and from_module returns (attr_dict).
+
+def _conv_to(attrs):
+    return nn.SpatialConvolution(
+        _get(attrs, "nInputPlane"), _get(attrs, "nOutputPlane"),
+        _get(attrs, "kernelW"), _get(attrs, "kernelH"),
+        _get(attrs, "strideW", 1), _get(attrs, "strideH", 1),
+        _get(attrs, "padW", 0), _get(attrs, "padH", 0),
+        n_group=_get(attrs, "nGroup", 1),
+        with_bias=_get(attrs, "withBias", True),
+        data_format=_get(attrs, "format", "NCHW"),
+    )
+
+
+def _conv_from(m):
+    return {
+        "nInputPlane": _attr_int(m.n_input_plane),
+        "nOutputPlane": _attr_int(m.n_output_plane),
+        "kernelW": _attr_int(m.kernel[1]), "kernelH": _attr_int(m.kernel[0]),
+        "strideW": _attr_int(m.stride[1]), "strideH": _attr_int(m.stride[0]),
+        "padW": _attr_int(m.pad[1]), "padH": _attr_int(m.pad[0]),
+        "nGroup": _attr_int(m.n_group), "propagateBack": _attr_bool(True),
+        "wRegularizer": _attr_null(pb.REGULARIZER),
+        "bRegularizer": _attr_null(pb.REGULARIZER),
+        "initWeight": _attr_null(pb.TENSOR), "initBias": _attr_null(pb.TENSOR),
+        "initGradWeight": _attr_null(pb.TENSOR),
+        "initGradBias": _attr_null(pb.TENSOR),
+        "withBias": _attr_bool(m.with_bias),
+        "format": _attr_data_format(m.data_format),
+    }
+
+
+def _linear_to(attrs):
+    return nn.Linear(_get(attrs, "inputSize"), _get(attrs, "outputSize"),
+                     with_bias=_get(attrs, "withBias", True))
+
+
+def _linear_from(m):
+    return {
+        "inputSize": _attr_int(m.input_size),
+        "outputSize": _attr_int(m.output_size),
+        "withBias": _attr_bool(m.with_bias),
+        "wRegularizer": _attr_null(pb.REGULARIZER),
+        "bRegularizer": _attr_null(pb.REGULARIZER),
+        "initWeight": _attr_null(pb.TENSOR), "initBias": _attr_null(pb.TENSOR),
+        "initGradWeight": _attr_null(pb.TENSOR),
+        "initGradBias": _attr_null(pb.TENSOR),
+    }
+
+
+def _bn_to(attrs, spatial):
+    cls = nn.SpatialBatchNormalization if spatial else nn.BatchNormalization
+    kw = {}
+    if spatial:
+        kw["data_format"] = _get(attrs, "dataFormat", "NCHW")
+    return cls(_get(attrs, "nOutput"), eps=_get(attrs, "eps", 1e-5),
+               momentum=_get(attrs, "momentum", 0.1),
+               affine=_get(attrs, "affine", True), **kw)
+
+
+def _bn_from(m, spatial):
+    d = {
+        "nOutput": _attr_int(m.n_output), "eps": _attr_double(m.eps),
+        "momentum": _attr_double(m.momentum), "affine": _attr_bool(m.affine),
+        "initWeight": _attr_null(pb.TENSOR), "initBias": _attr_null(pb.TENSOR),
+        "initGradWeight": _attr_null(pb.TENSOR),
+        "initGradBias": _attr_null(pb.TENSOR),
+    }
+    if spatial:
+        d["dataFormat"] = _attr_data_format(
+            "NCHW" if m.ch_axis == 1 else "NHWC")
+    return d
+
+
+def _maxpool_to(attrs):
+    m = nn.SpatialMaxPooling(
+        _get(attrs, "kW"), _get(attrs, "kH"),
+        _get(attrs, "dW", None) or _get(attrs, "kW"),
+        _get(attrs, "dH", None) or _get(attrs, "kH"),
+        _get(attrs, "padW", 0), _get(attrs, "padH", 0),
+        data_format=_get(attrs, "format", "NCHW"),
+    )
+    if _get(attrs, "ceilMode", False):
+        m.ceil_mode = True
+    return m
+
+
+def _pool_from(m):
+    (kh, kw), (dh, dw), (ph, pw) = m.kernel, m.stride, m.pad
+    return {
+        "kW": _attr_int(kw), "kH": _attr_int(kh),
+        "dW": _attr_int(dw), "dH": _attr_int(dh),
+        "padW": _attr_int(pw), "padH": _attr_int(ph),
+        "format": _attr_data_format(m.data_format),
+        "ceilMode": _attr_bool(getattr(m, "ceil_mode", False)),
+    }
+
+
+def _avgpool_to(attrs):
+    m = nn.SpatialAveragePooling(
+        _get(attrs, "kW"), _get(attrs, "kH"),
+        _get(attrs, "dW", None) or _get(attrs, "kW"),
+        _get(attrs, "dH", None) or _get(attrs, "kH"),
+        _get(attrs, "padW", 0), _get(attrs, "padH", 0),
+        count_include_pad=_get(attrs, "countIncludePad", True),
+        data_format=_get(attrs, "format", "NCHW"),
+    )
+    if _get(attrs, "ceilMode", False):
+        m.ceil_mode = True
+    return m
+
+
+def _avgpool_from(m):
+    d = _pool_from(m)
+    d["countIncludePad"] = _attr_bool(m.count_include_pad)
+    d["globalPooling"] = _attr_bool(False)
+    d["divide"] = _attr_bool(True)
+    return d
+
+
+_SIMPLE = {
+    "ReLU": (lambda attrs: nn.ReLU(), lambda m: {"ip": _attr_bool(False)}),
+    "Tanh": (lambda attrs: nn.Tanh(), lambda m: {}),
+    "Sigmoid": (lambda attrs: nn.Sigmoid(), lambda m: {}),
+    "LogSoftMax": (lambda attrs: nn.LogSoftMax(), lambda m: {}),
+    "SoftMax": (lambda attrs: nn.SoftMax(), lambda m: {}),
+    "Identity": (lambda attrs: nn.Identity(), lambda m: {}),
+    "CAddTable": (lambda attrs: nn.CAddTable(),
+                  lambda m: {"inplace": _attr_bool(False)}),
+    "Input": (lambda attrs: nn.Identity(), lambda m: {}),
+}
+
+
+def _registry():
+    reg: Dict[str, Tuple[Callable, type, Callable]] = {}
+
+    def add(name, to_fn, cls, from_fn):
+        reg[name] = (to_fn, cls, from_fn)
+
+    add("SpatialConvolution", _conv_to, nn.SpatialConvolution, _conv_from)
+    add("Linear", _linear_to, nn.Linear, _linear_from)
+    add("SpatialBatchNormalization", lambda a: _bn_to(a, True),
+        nn.SpatialBatchNormalization, lambda m: _bn_from(m, True))
+    add("BatchNormalization", lambda a: _bn_to(a, False),
+        nn.BatchNormalization, lambda m: _bn_from(m, False))
+    add("SpatialMaxPooling", _maxpool_to, nn.SpatialMaxPooling, _pool_from)
+    add("SpatialAveragePooling", _avgpool_to, nn.SpatialAveragePooling,
+        _avgpool_from)
+    add("Dropout", lambda a: nn.Dropout(_get(a, "initP", 0.5)),
+        nn.Dropout, lambda m: {"initP": _attr_double(m.p),
+                               "inplace": _attr_bool(False),
+                               "scale": _attr_bool(True)})
+    add("Reshape", lambda a: nn.Reshape(list(_get(a, "size"))),
+        nn.Reshape, lambda m: {"size": _attr_int_array(m.size),
+                               "batchMode": _attr_null(pb.BOOL)})
+    add("View", lambda a: nn.View(*_get(a, "sizes")),
+        nn.View, lambda m: {"sizes": _attr_int_array(m.sizes),
+                            "num_input_dims": _attr_int(0)})
+    add("SpatialCrossMapLRN",
+        lambda a: nn.SpatialCrossMapLRN(_get(a, "size", 5),
+                                        _get(a, "alpha", 1.0),
+                                        _get(a, "beta", 0.75),
+                                        _get(a, "k", 1.0)),
+        nn.SpatialCrossMapLRN,
+        lambda m: {"size": _attr_int(m.size), "alpha": _attr_double(m.alpha),
+                   "beta": _attr_double(m.beta), "k": _attr_double(m.k)})
+    add("JoinTable",
+        lambda a: nn.JoinTable(_get(a, "dimension") - 1,
+                               _get(a, "nInputDims", -1)),
+        nn.JoinTable,
+        lambda m: {"dimension": _attr_int(m.dimension + 1),
+                   "nInputDims": _attr_int(m.n_input_dims)})
+    add("LookupTable",
+        lambda a: nn.LookupTable(_get(a, "nIndex"), _get(a, "nOutput"),
+                                 padding_value=int(_get(a, "paddingValue", 0)) or None),
+        nn.LookupTable,
+        lambda m: {"nIndex": _attr_int(m.n_index),
+                   "nOutput": _attr_int(m.n_output),
+                   "paddingValue": _attr_double(m.padding_value or 0),
+                   "maxNorm": _attr_double(1e20),
+                   "normType": _attr_double(2.0),
+                   "shouldScaleGradByFreq": _attr_bool(False),
+                   "wRegularizer": _attr_null(pb.REGULARIZER)})
+    add("TemporalConvolution",
+        lambda a: nn.TemporalConvolution(_get(a, "inputFrameSize"),
+                                         _get(a, "outputFrameSize"),
+                                         _get(a, "kernelW"),
+                                         _get(a, "strideW", 1)),
+        nn.TemporalConvolution,
+        lambda m: {"inputFrameSize": _attr_int(m.input_frame_size),
+                   "outputFrameSize": _attr_int(m.output_frame_size),
+                   "kernelW": _attr_int(m.kernel_w),
+                   "strideW": _attr_int(m.stride_w),
+                   "propagateBack": _attr_bool(True),
+                   "wRegularizer": _attr_null(pb.REGULARIZER),
+                   "bRegularizer": _attr_null(pb.REGULARIZER),
+                   "initWeight": _attr_null(pb.TENSOR),
+                   "initBias": _attr_null(pb.TENSOR),
+                   "initGradWeight": _attr_null(pb.TENSOR),
+                   "initGradBias": _attr_null(pb.TENSOR)})
+    for name, (to_fn, from_fn) in _SIMPLE.items():
+        cls = type(to_fn({}))
+        add(name, to_fn, cls, from_fn)
+    return reg
+
+
+_REG = _registry()
+
+
+# -- weight layout conversions -----------------------------------------------
+
+def _weights_to_ours(module, tensors: List[np.ndarray]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if not tensors:
+        return out
+    if isinstance(module, nn.SpatialConvolution):
+        w = tensors[0]
+        if w.ndim == 5:  # (g, o/g, i/g, kh, kw) -> (o, i/g, kh, kw)
+            w = w.reshape((-1,) + w.shape[2:])
+        out["weight"] = w
+    elif isinstance(module, nn.TemporalConvolution):
+        w = tensors[0]
+        if w.ndim == 2:  # (out, kw*in) frame-major -> (out, in, kw)
+            w = w.reshape(w.shape[0], module.kernel_w,
+                          module.input_frame_size).transpose(0, 2, 1)
+        out["weight"] = w
+    else:
+        out["weight"] = tensors[0]
+    if len(tensors) > 1:
+        out["bias"] = tensors[1]
+    return out
+
+
+def _weights_from_ours(module, params: Dict[str, Any]) -> List[np.ndarray]:
+    if not isinstance(params, dict) or "weight" not in params:
+        return []
+    w = np.asarray(params["weight"], np.float32)
+    if isinstance(module, nn.SpatialConvolution):
+        o, ig, kh, kw = w.shape
+        g = module.n_group
+        w = w.reshape(g, o // g, ig, kh, kw)
+    elif isinstance(module, nn.TemporalConvolution):
+        w = w.transpose(0, 2, 1).reshape(w.shape[0], -1)
+    tensors = [w]
+    if "bias" in params:
+        tensors.append(np.asarray(params["bias"], np.float32))
+    return tensors
+
+
+# -- load ---------------------------------------------------------------------
+
+def _module_from_proto(mod: pb.BigDLModule, book: _StorageBook,
+                       params_out: Dict[str, Any]) -> nn.Module:
+    short = mod.moduleType.rsplit(".", 1)[-1]
+    if short == "Sequential":
+        seq = nn.Sequential()
+        for i, sub in enumerate(mod.subModules):
+            child_params: Dict[str, Any] = {}
+            child = _module_from_proto(sub, book, child_params)
+            name = sub.name or str(i)
+            seq.add(child, name)
+            if child_params:
+                params_out[name] = child_params
+        if mod.name:
+            seq.set_name(mod.name)
+        return seq
+    if short in ("ConcatTable", "Concat"):
+        children = []
+        for i, sub in enumerate(mod.subModules):
+            child_params: Dict[str, Any] = {}
+            child = _module_from_proto(sub, book, child_params)
+            children.append((sub.name or str(i), child, child_params))
+        if short == "Concat":
+            cont = nn.Concat(int(_get(mod.attr, "dimension", 2)) - 1)
+        else:
+            cont = nn.ConcatTable()
+        for name, child, child_params in children:
+            cont.add(child, name)
+            if child_params:
+                params_out[name] = child_params
+        if mod.name:
+            cont.set_name(mod.name)
+        return cont
+    if short in ("StaticGraph", "Graph", "DynamicGraph"):
+        return _graph_from_proto(mod, book, params_out)
+
+    if short not in _REG:
+        raise ValueError(
+            f"no converter for reference module type {mod.moduleType!r}")
+    to_fn = _REG[short][0]
+    module = to_fn(mod.attr)
+    if mod.name:
+        module.set_name(mod.name)
+    tensors = [book.tensor_to_np(t) for t in mod.parameters]
+    tensors = [t for t in tensors if t is not None]
+    params_out.update(_weights_to_ours(module, tensors))
+    return module
+
+
+def _graph_from_proto(mod: pb.BigDLModule, book: _StorageBook,
+                      params_out: Dict[str, Any]) -> nn.Module:
+    """Rebuild a StaticGraph: subModules are forward-execution nodes with
+    preModules linkage; inputNames/outputNames attrs name the endpoints
+    (reference ``Graph.doSerializeModule``)."""
+    input_names = list(_get(mod.attr, "inputNames", []))
+    output_names = list(_get(mod.attr, "outputNames", []))
+    nodes: Dict[str, Any] = {}
+    order: List[Tuple[str, pb.BigDLModule]] = []
+    for sub in mod.subModules:
+        order.append((sub.name, sub))
+
+    graph_inputs = []
+    for name, sub in order:
+        short = sub.moduleType.rsplit(".", 1)[-1]
+        pre = [p for p in sub.preModules]
+        if short == "Input" or (not pre and name in input_names):
+            node = nn.Input()
+            nodes[name] = node
+            graph_inputs.append(node)
+            continue
+        child_params: Dict[str, Any] = {}
+        child = _module_from_proto(sub, book, child_params)
+        parents = [nodes[p] for p in pre]
+        node = child(*parents)
+        nodes[name] = node
+        if child_params:
+            params_out[name] = child_params
+    outs = [nodes[n] for n in output_names]
+    graph = nn.Graph(graph_inputs, outs)
+    if mod.name:
+        graph.set_name(mod.name)
+    return graph
+
+
+def load_bigdl(path: str):
+    """Load a reference-format protobuf model file. Returns
+    (module, params, state)."""
+    mod = pb.BigDLModule()
+    with open(path, "rb") as f:
+        mod.ParseFromString(f.read())
+    book = _StorageBook()
+    book.collect(mod)
+    loaded_params: Dict[str, Any] = {}
+    module = _module_from_proto(mod, book, loaded_params)
+
+    import jax
+
+    params, state = module.init(jax.random.key(0))
+    merged = _merge(params, loaded_params)
+    return module, merged, state
+
+
+def _merge(inited, loaded):
+    """Overlay loaded leaf arrays onto the init()-shaped tree (missing
+    entries keep their init — e.g. BN running stats live in state)."""
+    if not isinstance(inited, dict):
+        return loaded if loaded is not None else inited
+    out = {}
+    for k, v in inited.items():
+        if isinstance(loaded, dict) and k in loaded:
+            lv = loaded[k]
+            if isinstance(v, dict):
+                out[k] = _merge(v, lv)
+            else:
+                arr = np.asarray(lv, np.float32)
+                if tuple(arr.shape) != tuple(np.shape(v)):
+                    raise ValueError(
+                        f"shape mismatch for {k}: file {arr.shape} vs "
+                        f"module {np.shape(v)}")
+                out[k] = arr
+        else:
+            out[k] = v
+    return out
+
+
+# -- save ---------------------------------------------------------------------
+
+def _module_to_proto(module: nn.Module, params, book: _StorageBook,
+                     name: str) -> pb.BigDLModule:
+    mod = pb.BigDLModule(version=_VERSION, train=False)
+    mod.name = module.get_name() or name
+    mod.attr["module_tags"].CopyFrom(_attr_str_array(["Float"]))
+    mod.attr["module_numerics"].CopyFrom(_attr_str_array(["Float"]))
+
+    if isinstance(module, nn.Graph):
+        return _graph_to_proto(module, params, book, mod)
+
+    if isinstance(module, (nn.Sequential, nn.ConcatTable, nn.Concat)):
+        short = type(module).__name__
+        mod.moduleType = SCALA_NN + short
+        if isinstance(module, nn.Concat):
+            mod.attr["dimension"].CopyFrom(_attr_int(module.dimension + 1))
+        for child_name, child in module._modules.items():
+            child_params = params.get(child_name, {}) if isinstance(params, dict) else {}
+            mod.subModules.append(
+                _module_to_proto(child, child_params, book, child_name))
+        return mod
+
+    cls = type(module)
+    short = next((k for k, (_, c, _) in _REG.items() if c is cls), None)
+    if short is None:
+        raise ValueError(f"no reference-format serializer for {cls.__name__} "
+                         "(extend bigdl_tpu.interop.bigdl._registry)")
+    mod.moduleType = SCALA_NN + short
+    for k, v in _REG[short][2](module).items():
+        mod.attr[k].CopyFrom(v)
+    tensors = _weights_from_ours(module, params)
+    if tensors:
+        mod.hasParameters = True
+        for t in tensors:
+            mod.parameters.append(book.np_to_tensor(t))
+    return mod
+
+
+def _graph_to_proto(graph: nn.Graph, params, book: _StorageBook,
+                    mod: pb.BigDLModule) -> pb.BigDLModule:
+    mod.moduleType = SCALA_NN + "StaticGraph"
+    input_names, output_names = [], []
+    names = dict(graph._names)
+    for node in graph._topo:
+        name = names.get(id(node))
+        if node.element is None:  # Input node
+            name = name or f"input{len(input_names) + 1}"
+            names[id(node)] = name
+            sub = pb.BigDLModule(version=_VERSION, name=name,
+                                 moduleType=SCALA_NN + "Input")
+            sub.attr["module_tags"].CopyFrom(_attr_str_array(["Float"]))
+            sub.attr["module_numerics"].CopyFrom(_attr_str_array(["Float"]))
+            mod.subModules.append(sub)
+            input_names.append(name)
+            continue
+        child_params = params.get(name, {}) if isinstance(params, dict) else {}
+        sub = _module_to_proto(node.element, child_params, book, name)
+        sub.name = name
+        for p in node.prev:
+            sub.preModules.append(names[id(p)])
+        mod.subModules.append(sub)
+    for out in graph.outputs:
+        output_names.append(names[id(out)])
+    mod.attr["inputNames"].CopyFrom(_attr_str_array(input_names))
+    mod.attr["outputNames"].CopyFrom(_attr_str_array(output_names))
+    return mod
+
+
+def save_bigdl(path: str, module: nn.Module, params, state=None) -> str:
+    """Write a reference-format protobuf model file."""
+    book = _StorageBook()
+    proto = _module_to_proto(module, params or {}, book, "model")
+    with open(path, "wb") as f:
+        f.write(proto.SerializeToString())
+    return path

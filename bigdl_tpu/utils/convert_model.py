@@ -18,9 +18,9 @@ import argparse
 def main(argv=None):
     parser = argparse.ArgumentParser("convert-model")
     parser.add_argument("--from", dest="src", required=True,
-                        choices=["bigdl", "caffe", "tensorflow", "onnx"])
+                        choices=["bigdl", "bigdl-proto", "caffe", "tensorflow", "onnx"])
     parser.add_argument("--to", dest="dst", required=True,
-                        choices=["bigdl", "caffe", "tensorflow", "onnx"])
+                        choices=["bigdl", "bigdl-proto", "caffe", "tensorflow", "onnx"])
     parser.add_argument("--input", required=True,
                         help="source path; caffe takes 'prototxt,caffemodel', "
                              "tensorflow takes 'graph.pb,input:output'")
@@ -40,6 +40,11 @@ def main(argv=None):
         from bigdl_tpu.utils.serializer import load_module
 
         model, params, state = load_module(args.input)
+    elif args.src == "bigdl-proto":
+        # reference wire format (Bigdl.proto, Module.saveModule files)
+        from bigdl_tpu.interop.bigdl import load_bigdl
+
+        model, params, state = load_bigdl(args.input)
     elif args.src == "caffe":
         from bigdl_tpu.interop.caffe import load_caffe
 
@@ -61,6 +66,10 @@ def main(argv=None):
         from bigdl_tpu.utils.serializer import save_module
 
         save_module(args.output, model, params, state)
+    elif args.dst == "bigdl-proto":
+        from bigdl_tpu.interop.bigdl import save_bigdl
+
+        save_bigdl(args.output, model, params, state)
     elif args.dst == "caffe":
         from bigdl_tpu.interop.caffe import save_caffe
 
