@@ -391,17 +391,30 @@ class TFGraphModule(Module):
         self.nodes: Dict[str, "pb.NodeDef"] = {n.name: n for n in graph_def.node}
         self._consts: Dict[str, np.ndarray] = {}
         self._param_names: List[str] = []
+        self._var_init: Dict[str, np.ndarray] = {}
         for n in graph_def.node:
             if n.op == "Const":
                 arr = tensor_to_numpy(n.attr["value"].tensor)
                 if arr.size >= _PARAM_THRESHOLD and np.issubdtype(arr.dtype, np.floating):
                     self._param_names.append(n.name)
                 self._consts[n.name] = arr
-            elif n.op in ("Variable", "VariableV2"):
-                raise ValueError(
-                    f"graph is not frozen: variable node {n.name!r}; freeze "
-                    "it (convert variables to consts) before import"
-                )
+        # Variable nodes become trainable params (reference Session.scala
+        # trains the loaded graph; frozen graphs simply have none). The
+        # initial value comes from the variable's Assign(var, Const)
+        # initializer when present, else zeros of the shape attr.
+        for n in graph_def.node:
+            if n.op in ("Variable", "VariableV2"):
+                init = None
+                for m in graph_def.node:
+                    if m.op == "Assign" and m.input and _ref(m.input[0])[0] == n.name:
+                        src = _ref(m.input[1])[0]
+                        if src in self._consts:
+                            init = self._consts[src]
+                        break
+                if init is None:
+                    shape = [d.size for d in n.attr["shape"].shape.dim]
+                    init = np.zeros(shape, np.float32)
+                self._var_init[n.name] = np.asarray(init)
         # needed set: nodes reachable from outputs
         self._order = self._topo()
 
@@ -434,8 +447,11 @@ class TFGraphModule(Module):
         return order
 
     def build_params(self, rng):
-        return {name.replace("/", "__"): jnp.asarray(self._consts[name])
-                for name in self._param_names}
+        p = {name.replace("/", "__"): jnp.asarray(self._consts[name])
+             for name in self._param_names}
+        for name, init in self._var_init.items():
+            p[name.replace("/", "__")] = jnp.asarray(init)
+        return p
 
     def forward(self, ctx: Context, x):
         xs = (x,) if len(self.input_names) == 1 else tuple(x)
@@ -455,6 +471,9 @@ class TFGraphModule(Module):
                     values[name] = ctx.param(name.replace("/", "__"))
                 else:
                     values[name] = self._consts[name]
+                continue
+            if node.op in ("Variable", "VariableV2"):
+                values[name] = ctx.param(name.replace("/", "__"))
                 continue
             if node.op in ("Placeholder", "PlaceholderWithDefault") and not node.input:
                 raise ValueError(
@@ -524,3 +543,54 @@ class TFSession:
         fn, params = self._cache[key]
         out = fn(params, *[jnp.asarray(v) for v in feed_dict.values()])
         return [np.asarray(o) for o in (out if isinstance(out, tuple) else (out,))]
+
+    def train(self, inputs: Sequence[str], loss_node: str, data,
+              optim_method=None, n_steps: int = 100, batch_size: int = 32):
+        """Train the graph's Variable nodes (reference
+        ``BigDLSessionImpl.train``, ``Session.scala:111-132`` — which
+        emulates the graph's queue runners to feed it; here the host
+        arrays/iterator feed the jitted step directly, the TPU-native
+        input path).
+
+        ``inputs``: placeholder names, ``loss_node``: scalar loss output,
+        ``data``: tuple of arrays (batched round-robin) or an iterator of
+        per-step feed tuples. Returns (module, trained_params).
+        """
+        from bigdl_tpu.optim.optim_method import SGD
+
+        method = optim_method or SGD(learning_rate=0.01)
+        module = TFGraphModule(self.graph_def, list(inputs), [loss_node])
+        if not module._var_init:
+            raise ValueError("graph has no Variable nodes to train "
+                             "(frozen graph? use run() for inference)")
+        params, _ = module.init(jax.random.key(0))
+        ostate = method.init_state(params)
+
+        @jax.jit
+        def step(params, ostate, *feeds):
+            def loss_fn(p):
+                out, _ = module.apply(p, feeds if len(feeds) > 1 else feeds[0])
+                return jnp.asarray(out, jnp.float32).sum()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_p, new_os = method.update(grads, params, ostate, jnp.int32(1))
+            return new_p, new_os, loss
+
+        if isinstance(data, (tuple, list)):
+            arrays = [np.asarray(a) for a in data]
+            n = arrays[0].shape[0]
+
+            def batches():
+                i = 0
+                while True:
+                    idx = [(i + k) % n for k in range(batch_size)]
+                    yield tuple(a[idx] for a in arrays)
+                    i = (i + batch_size) % n
+            it = batches()
+        else:
+            it = iter(data)
+        loss = None
+        for _ in range(n_steps):
+            feeds = next(it)
+            params, ostate, loss = step(params, ostate, *map(jnp.asarray, feeds))
+        return module, params, (None if loss is None else float(loss))

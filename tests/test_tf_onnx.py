@@ -361,3 +361,60 @@ def test_tf_example_parsing_roundtrip(tmp_path):
     })
     np.testing.assert_allclose(got["img"].numpy(), [1.5, 2.5, 3.5])
     assert int(got["label"]) == 7
+
+
+def test_tf_session_trains_variable_graph(tmp_path):
+    """Session.train analogue: a GraphDef with Variable nodes (not
+    frozen) trains its variables to fit y = x @ W + b (reference
+    ``BigDLSessionImpl.train``, ``Session.scala:111-132``)."""
+    import numpy as np
+
+    from bigdl_tpu.interop.tf import TFSession
+    from bigdl_tpu.interop.tf import loader as tf_loader
+
+    pb = tf_loader.pb
+    g = pb.GraphDef()
+
+    def node(op, name, inputs=(), **attrs):
+        n = g.node.add(name=name, op=op, input=list(inputs))
+        for k, v in attrs.items():
+            if isinstance(v, pb.TensorProto):
+                n.attr[k].tensor.CopyFrom(v)
+            elif k == "dtype" or k == "T":
+                n.attr[k].type = v
+        return n
+
+    w0 = np.zeros((3, 2), np.float32)
+    b0 = np.zeros((2,), np.float32)
+    node("Placeholder", "x", dtype=pb.DT_FLOAT)
+    node("Placeholder", "y", dtype=pb.DT_FLOAT)
+    node("Const", "w_init", value=tf_loader.numpy_to_tensor(w0))
+    node("Const", "b_init", value=tf_loader.numpy_to_tensor(b0))
+    v = g.node.add(name="w", op="VariableV2")
+    for d in (3, 2):
+        v.attr["shape"].shape.dim.add(size=d)
+    v2 = g.node.add(name="b", op="VariableV2")
+    v2.attr["shape"].shape.dim.add(size=2)
+    node("Assign", "w/assign", ["w", "w_init"])
+    node("Assign", "b/assign", ["b", "b_init"])
+    node("MatMul", "mm", ["x", "w"], T=pb.DT_FLOAT)
+    node("Add", "pred", ["mm", "b"], T=pb.DT_FLOAT)
+    node("Sub", "err", ["pred", "y"], T=pb.DT_FLOAT)
+    node("Square", "sq", ["err"], T=pb.DT_FLOAT)
+    node("Const", "axes", value=tf_loader.numpy_to_tensor(
+        np.asarray([0, 1], np.int32)))
+    node("Mean", "loss", ["sq", "axes"], T=pb.DT_FLOAT)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 3).astype(np.float32)
+    true_w = np.asarray([[1.0, -2.0], [0.5, 3.0], [2.0, 0.0]], np.float32)
+    y = x @ true_w + np.asarray([0.3, -0.7], np.float32)
+
+    from bigdl_tpu.optim.optim_method import SGD
+
+    sess = TFSession(g)
+    module, params, final_loss = sess.train(
+        ["x", "y"], "loss", (x, y),
+        optim_method=SGD(learning_rate=0.3), n_steps=200, batch_size=32)
+    assert final_loss < 1e-3, final_loss
+    np.testing.assert_allclose(np.asarray(params["w"]), true_w, atol=0.05)
