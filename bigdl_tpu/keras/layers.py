@@ -441,6 +441,50 @@ class Deconvolution2D(KerasLayer):
         return (self.nb_filter, oh, ow)
 
 
+class AtrousConvolution1D(KerasLayer):
+    """Dilated 1-D conv (reference ``AtrousConvolution1D``: maps onto a
+    width-1 dilated 2-D conv over (steps, 1, dim))."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, activation: Optional[str] = None,
+                 subsample_length: int = 1, bias: bool = True, **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.atrous_rate = atrous_rate
+        self.activation = activation
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def build(self, input_shape):
+        steps, dim = input_shape
+        conv = L.SpatialDilatedConvolution(
+            dim, self.nb_filter, 1, self.filter_length,
+            1, self.subsample_length, 0, 0, 1, self.atrous_rate,
+            with_bias=self.bias,
+        )
+        # (B, steps, dim) -> NCHW (B, dim, steps, 1) -> conv -> back
+        to4 = LambdaLayer(lambda x: jnp.transpose(x, (0, 2, 1))[:, :, :, None])
+        to3 = LambdaLayer(lambda x: jnp.transpose(x[:, :, :, 0], (0, 2, 1)))
+        return _seq(to4, conv, to3, get_activation(self.activation))
+
+    def compute_output_shape(self, input_shape):
+        steps, _ = input_shape
+        eff = self.filter_length + (self.filter_length - 1) * (self.atrous_rate - 1)
+        out = conv_output_length(steps, eff, "valid", self.subsample_length)
+        return (out, self.nb_filter)
+
+
+class SoftMax(KerasLayer):
+    """Standalone softmax activation layer (reference keras ``SoftMax``)."""
+
+    def build(self, input_shape):
+        return get_activation("softmax")
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
 class Convolution1D(KerasLayer):
     """1-D conv over (steps, dim) inputs (reference ``Convolution1D``)."""
 

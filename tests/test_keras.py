@@ -238,3 +238,19 @@ def test_predict_caches_compiled_forward():
     fwd1 = m._jit_fwd
     m.predict(x)
     assert m._jit_fwd is fwd1 and fwd1 is not None
+
+
+def test_atrous_convolution1d_and_softmax():
+    import numpy as np
+
+    from bigdl_tpu import keras
+
+    model = keras.Sequential()
+    model.add(keras.AtrousConvolution1D(4, 3, atrous_rate=2,
+                                        input_shape=(12, 6)))
+    model.add(keras.SoftMax())
+    x = np.random.RandomState(0).rand(2, 12, 6).astype(np.float32)
+    out = model.predict(x)
+    # effective kernel = 3 + 2*(2-1) = 5 -> 12 - 5 + 1 = 8 steps
+    assert out.shape == (2, 8, 4)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-4)
