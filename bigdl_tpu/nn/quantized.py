@@ -49,10 +49,15 @@ def _quantize_weight(w: jax.Array, channel_axis: int = 0):
     return wq, scale.astype(jnp.float32)
 
 
-def _quantize_activation(x: jax.Array):
-    """Dynamic symmetric per-tensor int8."""
-    absmax = jnp.max(jnp.abs(x))
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
+def _quantize_activation(x: jax.Array, static_scale=None):
+    """Symmetric per-tensor int8. With a calibrated ``static_scale`` > 0
+    the dynamic absmax pass is skipped (reference ``GenerateInt8Scales``
+    computes static activation scales offline; dynamic is the fallback)."""
+    dyn = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127.0
+    if static_scale is None:
+        scale = dyn
+    else:
+        scale = jnp.where(static_scale > 0, static_scale, dyn)
     xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return xq, scale
 
@@ -71,17 +76,24 @@ class QuantizedLinear(Module):
     def convert_params(float_params: Dict[str, Any]) -> Dict[str, Any]:
         w = jnp.asarray(float_params["weight"])  # (out, in) layout (x @ w.T)
         wq, scale = _quantize_weight(w, channel_axis=0)
-        p = {"weight_q": wq, "scale": scale.reshape(1, -1)}
+        p = {"weight_q": wq, "scale": scale.reshape(1, -1),
+             "act_scale": jnp.zeros((), jnp.float32)}  # 0 = dynamic
         if "bias" in float_params:
             p["bias"] = jnp.asarray(float_params["bias"], jnp.float32)
         return p
+
+    def build_state(self):
+        return {"act_absmax": jnp.zeros((), jnp.float32)}
 
     def forward(self, ctx: Context, x):
         wq = ctx.param("weight_q")  # (out, in)
         scale_w = ctx.param("scale")  # (1, out)
         orig_shape = x.shape
         x2 = x.reshape(-1, orig_shape[-1]).astype(jnp.float32)
-        xq, scale_x = _quantize_activation(x2)
+        if ctx.training:  # calibration pass: record the running absmax
+            ctx.put_state("act_absmax", jnp.maximum(
+                ctx.get_state("act_absmax"), jnp.max(jnp.abs(x2))))
+        xq, scale_x = _quantize_activation(x2, ctx.param("act_scale"))
         acc = lax.dot_general(
             xq, wq, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32,
@@ -110,10 +122,14 @@ class QuantizedSpatialConvolution(Module):
     def convert_params(float_params: Dict[str, Any]) -> Dict[str, Any]:
         w = jnp.asarray(float_params["weight"])  # (O, I, kh, kw)
         wq, scale = _quantize_weight(w, channel_axis=0)
-        p = {"weight_q": wq, "scale": scale.reshape(-1)}
+        p = {"weight_q": wq, "scale": scale.reshape(-1),
+             "act_scale": jnp.zeros((), jnp.float32)}  # 0 = dynamic
         if "bias" in float_params:
             p["bias"] = jnp.asarray(float_params["bias"], jnp.float32)
         return p
+
+    def build_state(self):
+        return {"act_absmax": jnp.zeros((), jnp.float32)}
 
     def forward(self, ctx: Context, x):
         from bigdl_tpu.nn.layers.conv import _dimension_numbers, _padding
@@ -121,7 +137,10 @@ class QuantizedSpatialConvolution(Module):
         wq = ctx.param("weight_q").astype(jnp.float32)
         scale_w = ctx.param("scale")
         xf = x.astype(jnp.float32)
-        xq, scale_x = _quantize_activation(xf)
+        if ctx.training:  # calibration pass: record the running absmax
+            ctx.put_state("act_absmax", jnp.maximum(
+                ctx.get_state("act_absmax"), jnp.max(jnp.abs(xf))))
+        xq, scale_x = _quantize_activation(xf, ctx.param("act_scale"))
         y = lax.conv_general_dilated(
             xq.astype(jnp.float32), wq,
             window_strides=self.stride,
@@ -204,3 +223,36 @@ def quantize(module: Module, params) -> Tuple[Module, Any]:
         if new_sub:
             new_params[name] = new_sub
     return clone, new_params
+
+
+def calibrate(qmodule: Module, qparams, batches, state=None):
+    """Static activation-scale calibration (reference
+    ``GenerateInt8Scales.scala``: run sample data through the model and
+    record per-layer activation ranges, then persist the scales).
+
+    Runs ``batches`` through the quantized model in training mode — each
+    quantized layer records its running input absmax in module state —
+    then bakes ``act_scale = absmax / 127`` into the params so inference
+    skips the dynamic absmax pass. Returns (calibrated_params, state).
+    """
+    import jax
+
+    if state is None:
+        _, state = qmodule.init(jax.random.key(0))
+    for x in batches:
+        _, state = qmodule.apply(qparams, x, state=state, training=True)
+
+    def bake(params, st):
+        if not isinstance(params, dict):
+            return params
+        out = {}
+        for k, v in params.items():
+            if k == "act_scale" and isinstance(st, dict) and "act_absmax" in st:
+                out[k] = jnp.maximum(jnp.asarray(st["act_absmax"]), 1e-8) / 127.0
+            elif isinstance(v, dict):
+                out[k] = bake(v, st.get(k, {}) if isinstance(st, dict) else {})
+            else:
+                out[k] = v
+        return out
+
+    return bake(qparams, state), state

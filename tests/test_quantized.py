@@ -93,3 +93,35 @@ def test_quantized_model_is_jittable(rng):
     f = jax.jit(lambda qp, x: qm.apply(qp, x)[0])
     out = f(qp, jnp.ones((2, 16)))
     assert out.shape == (2, 4)
+
+
+def test_calibrated_static_scales_match_dynamic():
+    """GenerateInt8Scales analogue: after calibration on representative
+    data, static-scale inference matches dynamic-scale inference (same
+    data range) and the act_scale params are populated."""
+    import jax
+
+    from bigdl_tpu.nn.quantized import calibrate, quantize
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.ReLU(),
+        nn.Reshape([8 * 4 * 4]),
+        nn.Linear(8 * 4 * 4, 5),
+    )
+    params, _ = model.init(jax.random.key(0))
+    qmodel, qparams = quantize(model, params)
+
+    rng = np.random.RandomState(0)
+    calib = [rng.rand(4, 3, 4, 4).astype(np.float32) for _ in range(3)]
+    cparams, state = calibrate(qmodel, qparams, calib)
+
+    scales = [leaf for path, leaf in qmodel.parameters(cparams)
+              if path.endswith("act_scale")]
+    assert scales and all(float(s) > 0 for s in scales)
+
+    x = calib[0]
+    out_dyn, _ = qmodel.apply(qparams, x, training=False)
+    out_static, _ = qmodel.apply(cparams, x, training=False)
+    np.testing.assert_allclose(np.asarray(out_dyn), np.asarray(out_static),
+                               atol=2e-2)
