@@ -1,0 +1,117 @@
+"""Serialization sweep: save/load round-trips across the layer zoo.
+
+Reference test strategy (SURVEY §4): ``SerializerSpec`` runs save/load
+round-trips over ALL registered modules. Here: construct a broad sample
+of the zoo, round-trip through the repo serializer
+(``utils/serializer``), and assert identical outputs on fixed inputs.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.serializer import load_module, save_module
+
+rs = np.random.RandomState(42)
+
+
+def t4(c=3, h=8, w=8, b=2):
+    return rs.rand(b, c, h, w).astype(np.float32)
+
+
+def t3(steps=10, d=6, b=2):
+    return rs.rand(b, steps, d).astype(np.float32)
+
+
+def t2(d=6, b=3):
+    return rs.rand(b, d).astype(np.float32)
+
+
+# (constructor thunk, example input) — one per zoo family member
+SWEEP = [
+    (lambda: nn.Linear(6, 4), t2()),
+    (lambda: nn.Linear(6, 4, with_bias=False), t2()),
+    (lambda: nn.SpatialConvolution(3, 5, 3, 3, pad_w=1, pad_h=1), t4()),
+    (lambda: nn.SpatialConvolution(4, 6, 3, 3, n_group=2), t4(4)),
+    (lambda: nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 0, 0, 2, 2), t4()),
+    (lambda: nn.SpatialFullConvolution(3, 4, 3, 3), t4()),
+    (lambda: nn.TemporalConvolution(6, 4, 3), t3()),
+    (lambda: nn.SpatialMaxPooling(2, 2, 2, 2), t4()),
+    (lambda: nn.SpatialAveragePooling(2, 2, 2, 2), t4()),
+    (lambda: nn.TemporalMaxPooling(2, 2), t3()),
+    (lambda: nn.SpatialBatchNormalization(3), t4()),
+    (lambda: nn.BatchNormalization(6), t2()),
+    (lambda: nn.LayerNormalization(6), t2()),
+    (lambda: nn.SpatialCrossMapLRN(3), t4()),
+    (lambda: nn.ReLU(), t2()),
+    (lambda: nn.ReLU6(), t2()),
+    (lambda: nn.Tanh(), t2()),
+    (lambda: nn.Sigmoid(), t2()),
+    (lambda: nn.ELU(), t2()),
+    (lambda: nn.LeakyReLU(), t2()),
+    (lambda: nn.PReLU(), t2()),
+    (lambda: nn.GELU(), t2()),
+    (lambda: nn.HardTanh(), t2()),
+    (lambda: nn.HardShrink(0.3), t2()),
+    (lambda: nn.SoftShrink(0.3), t2()),
+    (lambda: nn.TanhShrink(), t2()),
+    (lambda: nn.LogSigmoid(), t2()),
+    (lambda: nn.SoftMin(), t2()),
+    (lambda: nn.SoftMax(), t2()),
+    (lambda: nn.LogSoftMax(), t2()),
+    (lambda: nn.SoftPlus(), t2()),
+    (lambda: nn.SoftSign(), t2()),
+    (lambda: nn.BinaryThreshold(0.5), t2()),
+    (lambda: nn.Reshape([2, 3]), t2()),
+    (lambda: nn.View(-1), t4()),
+    (lambda: nn.InferReshape([-1, 3]), t2()),
+    (lambda: nn.Squeeze(), rs.rand(3, 1, 4).astype(np.float32)),
+    (lambda: nn.Unsqueeze(1), t2()),
+    (lambda: nn.Transpose((1, 2)), t3()),
+    (lambda: nn.Select(1, 0), t3()),
+    (lambda: nn.Narrow(1, 0, 3), t3()),
+    (lambda: nn.Tile(1, 2), t2()),
+    (lambda: nn.Reverse(1), t2()),
+    (lambda: nn.Padding(1, 2), t2()),
+    (lambda: nn.Dropout(0.5), t2()),
+    (lambda: nn.GaussianNoise(0.1), t2()),
+    (lambda: nn.GaussianDropout(0.1), t2()),
+    (lambda: nn.CMul([1, 6]), t2()),
+    (lambda: nn.CAdd([1, 6]), t2()),
+    (lambda: nn.Mul(), t2()),
+    (lambda: nn.Add(6), t2()),
+    (lambda: nn.Scale([1, 6]), t2()),
+    (lambda: nn.LookupTable(10, 4),
+     rs.randint(0, 10, (2, 5)).astype(np.int32)),
+    (lambda: nn.Highway(6), t2()),
+    (lambda: nn.NormalizeScale(2.0, 20.0, (1, 3, 1, 1)), t4()),
+    (lambda: nn.Normalize(2.0), t2()),
+    (lambda: nn.Maxout(6, 4, 2), t2()),
+    (lambda: nn.Euclidean(6, 4), t2()),
+    (lambda: nn.Cosine(6, 4), t2()),
+    (lambda: nn.Masking(0.0), t3()),
+    (lambda: nn.GradientReversal(), t2()),
+    (lambda: nn.SpatialZeroPadding(1, 1, 1, 1), t4()),
+    (lambda: nn.Cropping2D((1, 1), (1, 1)), t4()),
+    (lambda: nn.UpSampling2D((2, 2)), t4()),
+    (lambda: nn.ResizeBilinear(12, 12), t4()),
+    (lambda: nn.SpatialSubtractiveNormalization(3, size=5), t4()),
+    (lambda: nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4)), t2()),
+    (lambda: nn.ConcatTable(nn.Linear(6, 4), nn.Linear(6, 4)), t2()),
+    (lambda: nn.Concat(1, nn.Linear(6, 4), nn.Linear(6, 3)), t2()),
+]
+
+
+@pytest.mark.parametrize("i", range(len(SWEEP)))
+def test_roundtrip(i, tmp_path):
+    make, x = SWEEP[i]
+    module = make()
+    params, state = module.init(jax.random.key(i))
+    out1, _ = module.apply(params, x, state=state, training=False)
+    path = str(tmp_path / "m.bigdl")
+    save_module(path, module, params, state)
+    m2, p2, s2 = load_module(path)
+    out2, _ = m2.apply(p2, x, state=s2, training=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6,
+                               err_msg=type(module).__name__)
