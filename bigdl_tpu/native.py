@@ -75,6 +75,11 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.bigdl_crop_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p] + \
             [ctypes.c_int64] * 7
+        lib.bigdl_batch_hwc_to_nchw_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_float, ctypes.c_int,
+        ]
         _lib = lib
     return _lib
 
@@ -233,3 +238,26 @@ def crop_u8(image: np.ndarray, y0: int, x0: int, ch: int, cw: int) -> np.ndarray
         lib.bigdl_crop_u8(image.ctypes.data, out.ctypes.data, c, h, w, y0, x0, ch, cw)
         return out
     return image[:, y0:y0 + ch, x0:x0 + cw].copy()
+
+
+def batch_hwc_to_nchw(images: np.ndarray, mean, std, scale: float = 1.0,
+                      n_threads: int = 4) -> np.ndarray:
+    """(N, H, W, C) uint8 decoded images -> (N, C, H, W) float32
+    normalized batch in ONE pass (transpose + normalize fused; the
+    reference's ``MTLabeledBGRImgToBatch`` hot loop). Numpy fallback when
+    the native library is unavailable."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, h, w, c = images.shape
+    mean = np.ascontiguousarray(np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    std = np.ascontiguousarray(np.broadcast_to(np.asarray(std, np.float32), (c,)))
+    lib = _load()
+    if lib is None:
+        x = images.astype(np.float32) / scale
+        x = (x - mean) / std
+        return np.ascontiguousarray(x.transpose(0, 3, 1, 2))
+    out = np.empty((n, c, h, w), np.float32)
+    lib.bigdl_batch_hwc_to_nchw_f32(
+        images.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+        n, h, w, c, mean.ctypes.data_as(ctypes.c_void_p),
+        std.ctypes.data_as(ctypes.c_void_p), ctypes.c_float(scale), n_threads)
+    return out

@@ -203,4 +203,27 @@ void bigdl_crop_u8(const uint8_t* src, uint8_t* dst, int64_t c, int64_t h,
       memcpy(dst + (pc * ch + y) * cw, src + (pc * h + (y0 + y)) * w + x0, cw);
 }
 
+// One-pass batch assembly: decoded (N, H, W, C) u8 images ->
+// (N, C, H, W) f32 normalized batch, threaded over images. This is the
+// reference's MTLabeledBGRImgToBatch hot loop (transpose + normalize
+// fused so each byte is touched once).
+void bigdl_batch_hwc_to_nchw_f32(const uint8_t* src, float* dst, int64_t n,
+                                 int64_t h, int64_t w, int64_t c,
+                                 const float* mean, const float* stdv,
+                                 float scale, int n_threads) {
+  int64_t hw = h * w;
+  parallel_for(n, n_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      const uint8_t* s = src + i * hw * c;
+      float* d = dst + i * hw * c;
+      for (int64_t ch = 0; ch < c; ch++) {
+        float m = mean[ch], inv = 1.0f / stdv[ch];
+        float* dc = d + ch * hw;
+        const uint8_t* sc = s + ch;
+        for (int64_t k = 0; k < hw; k++) dc[k] = (sc[k * c] / scale - m) * inv;
+      }
+    }
+  });
+}
+
 }  // extern "C"

@@ -199,3 +199,17 @@ def test_tfrecord_prefetcher(tmp_path):
         paths.append(p)
     got = list(TFRecordPrefetcher(paths, capacity=8, n_threads=2))
     assert sorted(got) == sorted(expected)
+
+
+def test_batch_hwc_to_nchw_matches_numpy():
+    from bigdl_tpu import native
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (5, 7, 9, 3), np.uint8)
+    mean, std = [0.4, 0.5, 0.6], [0.2, 0.3, 0.4]
+    out = native.batch_hwc_to_nchw(imgs, mean, std, scale=255.0)
+    ref = (imgs.astype(np.float32) / 255.0 - np.asarray(mean, np.float32)) \
+        / np.asarray(std, np.float32)
+    ref = ref.transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
