@@ -136,3 +136,20 @@ def test_retry_gives_up_after_budget(tmp_path):
     opt.set_end_when(optim.Trigger.max_iteration(4))
     with pytest.raises(RuntimeError, match="permanently broken"):
         opt.optimize()
+
+
+def test_perf_cli_runs(capsys):
+    """Perf harness (DistriOptimizerPerf/Perf.scala analogue) runs and
+    emits a JSON record for both modes."""
+    import json
+
+    from bigdl_tpu.models import perf
+
+    perf.main(["--model", "lenet", "-b", "8", "--mode", "train",
+               "--classNum", "10", "--iters", "1", "2"])
+    perf.main(["--model", "lenet", "-b", "8", "--mode", "fwd",
+               "--classNum", "10", "--iters", "1", "2"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["model"] == "lenet" and "records_per_sec" in rec
