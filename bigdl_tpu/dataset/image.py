@@ -119,3 +119,37 @@ class BGRImgToSample(Transformer):
     def apply(self, it):
         for img, label in it:
             yield Sample(np.ascontiguousarray(img, np.float32), np.asarray(label, np.int32))
+
+
+class MTImageToBatch(Transformer):
+    """(HWC uint8 image, label) stream -> normalized NCHW fp32
+    MiniBatches via the native fused batcher (reference
+    ``MTLabeledBGRImgToBatch.scala``: the multi-threaded batch assembly
+    hot loop; transpose + normalize touch each byte once in C++,
+    threaded over the batch). Python fallback built in (see
+    ``native.batch_hwc_to_nchw``)."""
+
+    def __init__(self, batch_size: int, means, stds, scale: float = 1.0,
+                 n_threads: int = 4, partial_batch: bool = False):
+        self.batch_size = batch_size
+        self.means, self.stds, self.scale = means, stds, scale
+        self.n_threads = n_threads
+        self.partial_batch = partial_batch
+
+    def apply(self, it):
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.native import batch_hwc_to_nchw
+
+        images, labels = [], []
+        for img, label in it:
+            images.append(np.asarray(img, np.uint8))
+            labels.append(label)
+            if len(images) == self.batch_size:
+                x = batch_hwc_to_nchw(np.stack(images), self.means, self.stds,
+                                      self.scale, self.n_threads)
+                yield MiniBatch(x, np.asarray(labels, np.int32))
+                images, labels = [], []
+        if images and self.partial_batch:
+            x = batch_hwc_to_nchw(np.stack(images), self.means, self.stds,
+                                  self.scale, self.n_threads)
+            yield MiniBatch(x, np.asarray(labels, np.int32))

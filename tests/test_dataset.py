@@ -264,3 +264,25 @@ def test_imagenet_seqfile_pipeline(tmp_path):
     assert label == float(records[0][0])
     assert read_label("name\n7".encode()) == "7"
     assert read_name("name\n7".encode()) == "name"
+
+
+def test_mt_image_to_batch_with_seqfiles(tmp_path):
+    """seq files -> decode -> native batch assembly, the reference's
+    ImageNet hot path end-to-end."""
+    from bigdl_tpu.dataset.image import MTImageToBatch
+    from bigdl_tpu.dataset.seqfile import (
+        BGRImgToLocalSeqFile, load_imagenet_seqfiles,
+    )
+
+    rng = np.random.RandomState(3)
+    records = [(i % 3 + 1, f"i{i}", rng.randint(0, 255, (6, 6, 3), np.uint8))
+               for i in range(10)]
+    list(BGRImgToLocalSeqFile(10, str(tmp_path / "part"), has_name=True)(records))
+
+    batcher = MTImageToBatch(4, means=(110.0,) * 3, stds=(60.0,) * 3)
+    batches = list(batcher(load_imagenet_seqfiles(str(tmp_path))))
+    assert len(batches) == 2  # 10 images, batch 4, partial dropped
+    x = batches[0].get_input()
+    assert x.shape == (4, 3, 6, 6) and x.dtype == np.float32
+    expect = (records[0][2].astype(np.float32) - 110.0) / 60.0
+    np.testing.assert_allclose(x[0], expect.transpose(2, 0, 1), atol=1e-5)
