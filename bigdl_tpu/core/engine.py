@@ -69,6 +69,34 @@ class Engine:
         with cls._lock:
             cls._instance = None
 
+    @classmethod
+    def init_multihost(cls, coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None,
+                       config: Optional[EngineConfig] = None) -> "Engine":
+        """Multi-host initialization (the reference's cluster entry:
+        ``Engine.init(nodeNumber, coreNumber, onSpark=true)``,
+        ``Engine.scala:106``).
+
+        Wraps ``jax.distributed.initialize`` — each host process calls
+        this before any other JAX use; afterwards ``jax.devices()`` spans
+        the whole slice and every mesh built by this Engine covers all
+        hosts, with XLA routing collectives over ICI within a slice and
+        DCN across slices. On Cloud TPU the three arguments are
+        auto-detected from the metadata server; pass them explicitly for
+        manual clusters (coordinator ``host:port``, world size, rank).
+        """
+        if jax.process_count() == 1 and (num_processes or 1) > 1:
+            kwargs = {}
+            if coordinator_address is not None:
+                kwargs["coordinator_address"] = coordinator_address
+            if num_processes is not None:
+                kwargs["num_processes"] = num_processes
+            if process_id is not None:
+                kwargs["process_id"] = process_id
+            jax.distributed.initialize(**kwargs)
+        return cls.init(config)
+
     # ---- mesh ----
     def mesh(self, mesh_shape: Optional[Sequence[Tuple[str, int]]] = None) -> Mesh:
         """Build (and cache) the device mesh.

@@ -154,3 +154,15 @@ def test_parameters_flat_paths(rng):
     paths = [p for p, _ in model.parameters(params)]
     assert "0/weight" in paths and "2/bias" in paths
     assert model.n_parameters(params) == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_engine_init_multihost_single_process_noop():
+    """On a single process, init_multihost is an ordinary Engine.init
+    (jax.distributed is only entered for real multi-process worlds)."""
+    from bigdl_tpu.core.engine import Engine
+
+    Engine.reset()
+    eng = Engine.init_multihost()
+    assert Engine.node_number() == 1
+    assert eng.mesh() is not None
+    Engine.reset()
