@@ -1,0 +1,71 @@
+"""Determinism / NaN / transfer sanitizers.
+
+Reference: SURVEY §5 "race detection / sanitizers" — the reference has
+none (closest: ``Engine.checkSingleton``); the TPU build is told to
+"lean on JAX determinism + donation/aliasing checks" instead. This
+module is that tier:
+
+- ``check_deterministic``: run a jitted fn twice, assert bitwise-equal
+  results (catches nondeterministic reductions/rng misuse — the SPMD
+  analogue of a race detector).
+- ``nan_guard``: wrap a step fn; raises with the offending leaf path on
+  the first non-finite output (cheaper and jit-compatible vs global
+  ``jax_debug_nans``).
+- ``no_transfers``: context manager asserting no implicit host<->device
+  transfers happen inside (wraps ``jax.transfer_guard``) — catches the
+  classic "numpy op inside the hot loop silently pulls the array back"
+  throughput bug.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def check_deterministic(fn: Callable, *args, runs: int = 2) -> Any:
+    """Call ``fn(*args)`` ``runs`` times; raise if any pair of results
+    differs bitwise. Returns the (verified) first result."""
+    results = [fn(*args) for _ in range(runs)]
+    first = jax.tree_util.tree_leaves(results[0])
+    for r, result in enumerate(results[1:], start=2):
+        leaves = jax.tree_util.tree_leaves(result)
+        for i, (a, b) in enumerate(zip(first, leaves)):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.tobytes() != b.tobytes():
+                diff = np.max(np.abs(a.astype(np.float64) - b.astype(np.float64)))
+                raise AssertionError(
+                    f"non-deterministic result: leaf {i} differs between run 1 "
+                    f"and run {r} (max abs diff {diff:.3e})")
+    return results[0]
+
+
+def nan_guard(fn: Callable, name: str = "step") -> Callable:
+    """Wrap ``fn``: after each call, check every floating leaf of the
+    result is finite; raise naming the leaf path otherwise."""
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        flat, _ = jax.tree_util.tree_flatten_with_path(out)
+        for path, leaf in flat:
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                if not bool(jnp.all(jnp.isfinite(leaf))):
+                    keys = "/".join(getattr(k, "key", str(k)) for k in path)
+                    raise FloatingPointError(
+                        f"{name}: non-finite values in output leaf '{keys}'")
+        return out
+
+    return wrapped
+
+
+@contextlib.contextmanager
+def no_transfers(level: str = "disallow"):
+    """Assert no implicit host<->device transfers inside the block
+    (explicit ``jax.device_put``/``np.asarray`` fetches still allowed at
+    level 'log'; 'disallow' raises on any implicit transfer)."""
+    with jax.transfer_guard(level):
+        yield

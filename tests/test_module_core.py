@@ -166,3 +166,26 @@ def test_engine_init_multihost_single_process_noop():
     assert Engine.node_number() == 1
     assert eng.mesh() is not None
     Engine.reset()
+
+
+def test_debug_sanitizers():
+    """SURVEY §5 sanitizer tier: determinism check, NaN guard, transfer
+    guard."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from bigdl_tpu.utils.debug import check_deterministic, nan_guard
+
+    f = jax.jit(lambda x: jnp.sum(x * 2))
+    x = jnp.arange(8.0)
+    out = check_deterministic(f, x)
+    assert float(out) == 56.0
+
+    calls = [0]
+    def sometimes_nan(x):
+        calls[0] += 1
+        return {"loss": jnp.where(calls[0] > 1, jnp.nan, 1.0) * jnp.sum(x)}
+    guarded = nan_guard(sometimes_nan)
+    guarded(x)  # first call fine
+    with _pytest.raises(FloatingPointError, match="loss"):
+        guarded(x)
