@@ -116,3 +116,44 @@ def load_ptb(
         stream[i] = t
         t = int(next_tok[t, rng.integers(0, 4)])
     return stream
+
+
+def load_movielens(
+    folder: Optional[str] = None, synthetic_users: int = 200,
+    synthetic_items: int = 100, synthetic_ratings: int = 4000,
+) -> np.ndarray:
+    """Return (N, 3) int32 [user_id, item_id, rating] rows, ids 1-based
+    (reference: ``PY/dataset/movielens.py`` reads ml-1m ``ratings.dat``
+    ``user::item::rating::ts`` lines). Synthetic fallback generates a
+    low-rank preference structure so recommenders have signal."""
+    if folder:
+        for name in ("ratings.dat", os.path.join("ml-1m", "ratings.dat")):
+            path = os.path.join(folder, name)
+            if os.path.exists(path):
+                rows = []
+                with open(path, errors="ignore") as f:
+                    for line in f:
+                        parts = line.strip().split("::")
+                        if len(parts) >= 3:
+                            rows.append([int(parts[0]), int(parts[1]),
+                                         int(float(parts[2]))])
+                return np.asarray(rows, np.int32)
+    rng = np.random.RandomState(11)
+    u_f = rng.randn(synthetic_users, 4)
+    i_f = rng.randn(synthetic_items, 4)
+    users = rng.randint(0, synthetic_users, synthetic_ratings)
+    items = rng.randint(0, synthetic_items, synthetic_ratings)
+    score = (u_f[users] * i_f[items]).sum(1)
+    rating = np.clip(np.round(3 + score), 1, 5).astype(np.int32)
+    return np.stack([users + 1, items + 1, rating], 1).astype(np.int32)
+
+
+def load_news20(folder: Optional[str] = None, n_classes: int = 4,
+                n_per_class: int = 64):
+    """Return (list of token lists, list of int labels) — the news20
+    corpus layout (category subdirs) or a class-separable synthetic
+    corpus (reference: ``PY/dataset/news20.py``). Thin alias over the
+    text-classification example's loader so both share one format."""
+    from bigdl_tpu.examples.text_classification import load_corpus
+
+    return load_corpus(folder, n_classes=n_classes, n_per_class=n_per_class)

@@ -286,3 +286,13 @@ def test_mt_image_to_batch_with_seqfiles(tmp_path):
     assert x.shape == (4, 3, 6, 6) and x.dtype == np.float32
     expect = (records[0][2].astype(np.float32) - 110.0) / 60.0
     np.testing.assert_allclose(x[0], expect.transpose(2, 0, 1), atol=1e-5)
+
+
+def test_load_movielens_synthetic_and_file(tmp_path):
+    from bigdl_tpu.dataset.datasets import load_movielens
+
+    rows = load_movielens()
+    assert rows.shape[1] == 3 and rows[:, 2].min() >= 1 and rows[:, 2].max() <= 5
+    (tmp_path / "ratings.dat").write_text("1::10::4::978300760\n2::20::5::978300761\n")
+    rows = load_movielens(str(tmp_path))
+    np.testing.assert_array_equal(rows, [[1, 10, 4], [2, 20, 5]])
