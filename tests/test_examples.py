@@ -150,3 +150,11 @@ def test_language_model_runs(tmp_path):
         ["-f", str(corpus), "-b", "8", "--maxIteration", "2",
          "--seqLength", "8", "--hiddenSize", "8", "--vocabSize", "50"])
     assert params is not None
+
+
+def test_recommendation_ncf():
+    from bigdl_tpu.examples import recommendation
+
+    hr = recommendation.main(["-b", "128", "--maxIteration", "20",
+                              "--embedDim", "8", "--evalNeg", "20"])
+    assert 0.0 <= hr <= 1.0
