@@ -119,6 +119,9 @@ class SocketFeedDataSet(AbstractDataSet):
         self._epoch_size = epoch_size
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._open_producers = 0
+        self._connected = 0  # total accepted so far (end-of-stream fires
+        # only after ALL n_producers have connected AND finished — a fast
+        # first producer must not end the stream early)
         self._lock = threading.Lock()
         fam = socket.AF_UNIX if isinstance(address, str) else socket.AF_INET
         self._server = socket.socket(fam, socket.SOCK_STREAM)
@@ -140,6 +143,7 @@ class SocketFeedDataSet(AbstractDataSet):
             conn, _ = self._server.accept()
             with self._lock:
                 self._open_producers += 1
+                self._connected += 1
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
 
@@ -165,7 +169,8 @@ class SocketFeedDataSet(AbstractDataSet):
             conn.close()
             with self._lock:
                 self._open_producers -= 1
-                done = self._open_producers == 0
+                done = (self._open_producers == 0
+                        and self._connected == self.n_producers)
             if done:
                 self._queue.put(None)  # end-of-stream sentinel
 
