@@ -146,7 +146,9 @@ def main():
 
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
-    batch = args.batch or (256 if on_tpu else 8)
+    # batch sweep on the bench chip (PERF_NOTES.md): 64:2530, 96:2544,
+    # 128:2762, 192:2407, 256:2691, 512:2142 img/s — 128 is the knee
+    batch = args.batch or (128 if on_tpu else 8)
     class_num = 1000
     compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
