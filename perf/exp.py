@@ -1,0 +1,106 @@
+"""Perf diagnosis: where do the 95 ms/step go? Differential timing.
+
+Usage: python perf_exp.py <variant>  (fwd | step | step512 | nhwc | nhwc512)
+"""
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+
+from bigdl_tpu.models import resnet
+from bigdl_tpu.nn import CrossEntropyCriterion
+from bigdl_tpu.optim.optim_method import SGD
+
+
+def timed_scan(make_body, carry, n1=4, n2=12, reps=4):
+    def runner(n):
+        @jax.jit
+        def multi(carry):
+            out, losses = jax.lax.scan(lambda c, _: make_body(c), carry, None, length=n)
+            return losses
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def report(name, dt, batch, mult=3):
+    flops = mult * 4.089e9 * batch
+    print(f"{name}: {dt*1e3:.2f} ms  {batch/dt:.0f} img/s  "
+          f"mfu={flops/dt/197e12:.3f}", flush=True)
+
+
+def make(batch, data_format="NCHW"):
+    model = resnet.build_imagenet(50, 1000, data_format=data_format)
+    crit = CrossEntropyCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    params, mstate = model.init(jax.random.key(0))
+    ostate = method.init_state(params)
+    shape = (batch, 3, 224, 224) if data_format == "NCHW" else (batch, 224, 224, 3)
+    x = jnp.asarray(np.random.rand(*shape), jnp.bfloat16)
+    y = jnp.asarray(np.random.randint(0, 1000, (batch,)), jnp.int32)
+    return model, crit, method, params, mstate, ostate, x, y
+
+
+def step_fn(model, crit, method):
+    def step(c):
+        p, ms, os_, xx, yy = c
+        def loss_fn(pp):
+            out, nms = model.apply(pp, xx, state=ms, training=True)
+            return crit.forward(out.astype(jnp.float32), yy), nms
+        (loss, nms), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, nos = method.update(g, p, os_, jnp.int32(1))
+        return (np_, nms, nos, xx, yy), loss
+    return step
+
+
+def main():
+    variant = sys.argv[1]
+    if variant == "fwd":
+        model, crit, method, params, mstate, ostate, x, y = make(256)
+        def fwd(c):
+            p, xx = c
+            out, _ = model.apply(p, xx, state=mstate, training=True)
+            l = out.astype(jnp.float32).mean()
+            # chain iterations so the loop body can't be hoisted
+            return (p, xx + (l * 1e-30).astype(xx.dtype)), l
+        dt = timed_scan(fwd, (params, x))
+        report("fwd-train b256", dt, 256, mult=1)
+    elif variant == "step":
+        model, crit, method, params, mstate, ostate, x, y = make(256)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y))
+        report("full-step b256", dt, 256)
+    elif variant == "step64":
+        model, crit, method, params, mstate, ostate, x, y = make(64)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=8, n2=24)
+        report("full-step b64", dt, 64)
+    elif variant == "step96":
+        model, crit, method, params, mstate, ostate, x, y = make(96)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=8, n2=24)
+        report("full-step b96", dt, 96)
+    elif variant == "step128":
+        model, crit, method, params, mstate, ostate, x, y = make(128)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=6, n2=18)
+        report("full-step b128", dt, 128)
+    elif variant == "step192":
+        model, crit, method, params, mstate, ostate, x, y = make(192)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=5, n2=15)
+        report("full-step b192", dt, 192)
+    elif variant == "step512":
+        model, crit, method, params, mstate, ostate, x, y = make(512)
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=2, n2=8)
+        report("full-step b512", dt, 512)
+    elif variant == "nhwc":
+        model, crit, method, params, mstate, ostate, x, y = make(256, "NHWC")
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y))
+        report("full-step-nhwc b256", dt, 256)
+    elif variant == "nhwc512":
+        model, crit, method, params, mstate, ostate, x, y = make(512, "NHWC")
+        dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=2, n2=8)
+        report("full-step-nhwc b512", dt, 512)
+
+
+if __name__ == "__main__":
+    main()

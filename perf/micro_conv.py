@@ -1,0 +1,100 @@
+"""Conv ceilings + conv/BN/ReLU composite by layout, longer chains."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+
+def timed(fn, carry, n1=16, n2=96, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def main():
+    B = 256
+    # conv3x3 64ch 56x56, NCHW/OIHW
+    w = jnp.asarray(np.random.rand(64, 64, 3, 3) * 0.01, jnp.bfloat16)
+    a = jnp.asarray(np.random.rand(B, 64, 56, 56), jnp.bfloat16)
+    fl = 2 * B * 56 * 56 * 64 * 64 * 9
+
+    def conv_nchw(c):
+        x, _ = c
+        y = lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                     dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return (y, jnp.float32(0)), y.ravel()[0].astype(jnp.float32)
+    dt = timed(conv_nchw, (a, jnp.float32(0)))
+    print(f"conv3x3 64ch NCHW: {dt*1e3:.3f} ms  {fl/dt/1e12:.0f} TFLOP/s mfu={fl/dt/193e12:.2f}", flush=True)
+
+    # same conv, NHWC/HWIO
+    wh = jnp.asarray(np.transpose(np.asarray(w, np.float32), (2, 3, 1, 0)), jnp.bfloat16)
+    ah = jnp.asarray(np.random.rand(B, 56, 56, 64), jnp.bfloat16)
+
+    def conv_nhwc(c):
+        x, _ = c
+        y = lax.conv_general_dilated(x, wh, (1, 1), "SAME",
+                                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return (y, jnp.float32(0)), y.ravel()[0].astype(jnp.float32)
+    dt = timed(conv_nhwc, (ah, jnp.float32(0)))
+    print(f"conv3x3 64ch NHWC/HWIO: {dt*1e3:.3f} ms  {fl/dt/1e12:.0f} TFLOP/s mfu={fl/dt/193e12:.2f}", flush=True)
+
+    # composite: conv + train-BN stats + normalize + relu, both layouts
+    g = jnp.ones((64,), jnp.float32); b = jnp.zeros((64,), jnp.float32)
+
+    def blk_nchw(c):
+        x, _ = c
+        y = lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                     dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        m = jnp.mean(y, axis=(0, 2, 3), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=(0, 2, 3))
+        inv = lax.rsqrt(jnp.maximum(m2 - m * m, 0.0) + 1e-5)
+        sc = (inv * g).astype(y.dtype).reshape(1, -1, 1, 1)
+        sh = (b - m * inv * g).astype(y.dtype).reshape(1, -1, 1, 1)
+        z = jnp.maximum(y * sc + sh, 0)
+        return (z, jnp.float32(0)), z.ravel()[0].astype(jnp.float32)
+    dt = timed(blk_nchw, (a, jnp.float32(0)))
+    print(f"conv+bn+relu NCHW: {dt*1e3:.3f} ms", flush=True)
+
+    def blk_nhwc(c):
+        x, _ = c
+        y = lax.conv_general_dilated(x, wh, (1, 1), "SAME",
+                                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        m = jnp.mean(y, axis=(0, 1, 2), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=(0, 1, 2))
+        inv = lax.rsqrt(jnp.maximum(m2 - m * m, 0.0) + 1e-5)
+        sc = (inv * g).astype(y.dtype)
+        sh = (b - m * inv * g).astype(y.dtype)
+        z = jnp.maximum(y * sc + sh, 0)
+        return (z, jnp.float32(0)), z.ravel()[0].astype(jnp.float32)
+    dt = timed(blk_nhwc, (ah, jnp.float32(0)))
+    print(f"conv+bn+relu NHWC: {dt*1e3:.3f} ms", flush=True)
+
+    # bottleneck-style 1x1 256->1024 @14x14 NHWC vs NCHW
+    B2 = 256
+    w1 = jnp.asarray(np.random.rand(1, 1, 256, 1024) * 0.01, jnp.bfloat16)
+    w1b = jnp.asarray(np.random.rand(1, 1, 1024, 256) * 0.01, jnp.bfloat16)
+    a2 = jnp.asarray(np.random.rand(B2, 14, 14, 256), jnp.bfloat16)
+    fl2 = 2 * 2 * B2 * 14 * 14 * 256 * 1024
+
+    def mm_nhwc(c):
+        x, _ = c
+        y = lax.conv_general_dilated(x, w1, (1, 1), "SAME",
+                                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        z = lax.conv_general_dilated(y, w1b, (1, 1), "SAME",
+                                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return (z, jnp.float32(0)), z.ravel()[0].astype(jnp.float32)
+    dt = timed(mm_nhwc, (a2, jnp.float32(0)))
+    print(f"conv1x1 256<->1024 NHWC: {dt*1e3:.3f} ms  {fl2/dt/1e12:.0f} TFLOP/s mfu={fl2/dt/193e12:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""BN stats reduction strategies over NCHW activations."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+
+def timed(fn, carry, n1=16, n2=96, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def main():
+    N, C, H, W = 256, 64, 112, 112
+    x = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+    nbytes = x.size * 2
+    chain = lambda x, m: x + (m * 1e-30).astype(x.dtype)
+
+    def base(c):
+        x, _ = c
+        m = jnp.mean(x, axis=(0, 2, 3), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 2, 3))
+        return (chain(x, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(base, (x, jnp.float32(0)))
+    print(f"baseline mean+meansq (0,2,3): {dt*1e3:.3f} ms  eff {2*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    def twostage(c):
+        x, _ = c
+        # stage 1: reduce the minor H*W dims per (n, c) row; stage 2: reduce n
+        xr = x.reshape(N, C, H * W)
+        s1 = jnp.sum(xr, axis=2, dtype=jnp.float32)          # (N, C)
+        s2 = jnp.sum(jnp.square(xr.astype(jnp.float32)), axis=2)
+        m = s1.sum(0) / (N * H * W)
+        m2 = s2.sum(0) / (N * H * W)
+        return (chain(x, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(twostage, (x, jnp.float32(0)))
+    print(f"two-stage (HW then N): {dt*1e3:.3f} ms  eff {2*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    def ones_mm(c):
+        x, _ = c
+        xr = x.reshape(N, C, H * W)
+        ones = jnp.ones((N, H * W), jnp.bfloat16)
+        # s[c] = sum_n sum_hw x[n,c,hw]: contract over n and hw on the MXU
+        s = lax.dot_general(xr, ones, (((0, 2), (0, 1)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        s2 = lax.dot_general(xr, xr, (((0, 2), (0, 2)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (C, C); diag = sum x^2
+        m = s / (N * H * W)
+        m2 = jnp.diagonal(s2) / (N * H * W)
+        return (chain(x, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(ones_mm, (x, jnp.float32(0)))
+    print(f"ones-matmul (diag trick): {dt*1e3:.3f} ms", flush=True)
+
+    def transpose_first(c):
+        x, _ = c
+        xt = x.transpose(0, 2, 3, 1)
+        m = jnp.mean(xt, axis=(0, 1, 2), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(xt.astype(jnp.float32)), axis=(0, 1, 2))
+        return (chain(x, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(transpose_first, (x, jnp.float32(0)))
+    print(f"transpose->NHWC reduce: {dt*1e3:.3f} ms  eff {2*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    # bwd-style: sum_g and sum_g_xhat (reads two tensors)
+    g2 = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+
+    def bwd_base(c):
+        x, _ = c
+        sg = jnp.sum(g2, axis=(0, 2, 3), dtype=jnp.float32)
+        sgx = jnp.sum(g2 * x, axis=(0, 2, 3), dtype=jnp.float32)
+        return (chain(x, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(bwd_base, (x, jnp.float32(0)))
+    print(f"bwd sums baseline: {dt*1e3:.3f} ms  eff {3*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    def bwd_twostage(c):
+        x, _ = c
+        gr = g2.reshape(N, C, H * W)
+        xr = x.reshape(N, C, H * W)
+        sg = jnp.sum(gr, axis=2, dtype=jnp.float32).sum(0)
+        sgx = jnp.sum((gr * xr), axis=2, dtype=jnp.float32).sum(0)
+        return (chain(x, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(bwd_twostage, (x, jnp.float32(0)))
+    print(f"bwd sums two-stage: {dt*1e3:.3f} ms  eff {3*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Profile one ResNet-50 train step; aggregate device time per op."""
+import glob, gzip, json, sys
+import jax, jax.numpy as jnp, numpy as np
+
+from perf_exp import make, step_fn
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    model, crit, method, params, mstate, ostate, x, y = make(batch)
+    body = step_fn(model, crit, method)
+
+    @jax.jit
+    def one(c):
+        c2, loss = body(c)
+        return c2, loss
+
+    c = (params, mstate, ostate, x, y)
+    c, loss = one(c); float(loss)  # compile
+    jax.profiler.start_trace("/tmp/jaxtrace_rn")
+    for _ in range(3):
+        c, loss = one(c)
+    float(loss)
+    jax.profiler.stop_trace()
+
+    path = sorted(glob.glob("/tmp/jaxtrace_rn/**/*.trace.json.gz", recursive=True))[-1]
+    with gzip.open(path) as f:
+        trace = json.load(f)
+    events = [e for e in trace["traceEvents"]
+              if e.get("ph") == "X" and "dur" in e]
+    # device lanes: pick pids whose thread names mention TensorFlow ops/XLA
+    by_cat = {}
+    total = 0
+    for e in events:
+        name = e.get("name", "")
+        args = e.get("args", {}) or {}
+        lane = str(args.get("device_id", "")) + str(e.get("pid", ""))
+        hlo_cat = args.get("tf_op", "") or name
+        key = name.split(".")[0].split("_")[0]
+        if any(k in name for k in ("fusion", "convolution", "copy", "transpose",
+                                    "reduce", "custom", "all-reduce", "dot",
+                                    "scatter", "select", "bitcast", "dynamic")):
+            by_cat.setdefault(key, [0, 0])
+            by_cat[key][0] += e["dur"]
+            by_cat[key][1] += 1
+            total += e["dur"]
+    for k, (dur, n) in sorted(by_cat.items(), key=lambda kv: -kv[1][0])[:15]:
+        print(f"{k:30s} {dur/1e3/3:9.2f} ms/step  x{n//3}")
+    print(f"total categorized: {total/1e3/3:.2f} ms/step")
+
+    # top 20 individual ops
+    agg = {}
+    for e in events:
+        n = e.get("name", "")
+        if any(k in n for k in ("fusion", "convolution", "copy", "transpose", "reduce", "dot", "custom")):
+            a = agg.setdefault(n, [0, 0])
+            a[0] += e["dur"]; a[1] += 1
+    print("\ntop ops:")
+    for n, (dur, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:25]:
+        print(f"  {dur/1e3/3:8.2f} ms/step x{cnt//3}  {n[:90]}")
+
+
+if __name__ == "__main__":
+    main()
