@@ -269,6 +269,9 @@ def _pool_from(m):
 
 
 def _avgpool_to(attrs):
+    if _get(attrs, "globalPooling", False):
+        return nn.GlobalAveragePooling2D(
+            data_format=_get(attrs, "format", "NCHW"))
     m = nn.SpatialAveragePooling(
         _get(attrs, "kW"), _get(attrs, "kH"),
         _get(attrs, "dW", None) or _get(attrs, "kW"),
@@ -370,6 +373,13 @@ def _registry():
                    "initBias": _attr_null(pb.TENSOR),
                    "initGradWeight": _attr_null(pb.TENSOR),
                    "initGradBias": _attr_null(pb.TENSOR)})
+    add("Padding",
+        lambda a: nn.Padding(_get(a, "dim") - 1, _get(a, "pad"),
+                             _get(a, "value", 0.0)),
+        nn.Padding,
+        lambda m: {"dim": _attr_int(m.dim + 1), "pad": _attr_int(m.pad),
+                   "nInputDim": _attr_int(0),
+                   "value": _attr_double(m.value), "nIndex": _attr_int(1)})
     for name, (to_fn, from_fn) in _SIMPLE.items():
         cls = type(to_fn({}))
         add(name, to_fn, cls, from_fn)
@@ -566,6 +576,21 @@ def _module_to_proto(module: nn.Module, params, book: _StorageBook,
             child_params = params.get(child_name, {}) if isinstance(params, dict) else {}
             mod.subModules.append(
                 _module_to_proto(child, child_params, book, child_name))
+        return mod
+
+    if isinstance(module, nn.GlobalAveragePooling2D):
+        # reference encoding: SpatialAveragePooling with globalPooling=true
+        mod.moduleType = SCALA_NN + "SpatialAveragePooling"
+        fmt = "NCHW" if module.axes == (2, 3) else "NHWC"
+        for k, v in {"kW": _attr_int(1), "kH": _attr_int(1),
+                     "dW": _attr_int(1), "dH": _attr_int(1),
+                     "padW": _attr_int(0), "padH": _attr_int(0),
+                     "globalPooling": _attr_bool(True),
+                     "ceilMode": _attr_bool(False),
+                     "countIncludePad": _attr_bool(True),
+                     "divide": _attr_bool(True),
+                     "format": _attr_data_format(fmt)}.items():
+            mod.attr[k].CopyFrom(v)
         return mod
 
     cls = type(module)

@@ -102,3 +102,23 @@ def test_unknown_module_type_raises(tmp_path):
     p.write_bytes(mod.SerializeToString())
     with pytest.raises(ValueError, match="no converter"):
         load_bigdl(str(p))
+
+
+def test_resnet18_roundtrip(tmp_path):
+    """The full ResNet graph (ConcatTable/CAddTable residuals, BN,
+    global average pooling, type-A Padding shortcuts for CIFAR)
+    round-trips through the reference wire format."""
+    from bigdl_tpu.models import resnet
+
+    x = np.random.RandomState(7).rand(2, 3, 32, 32).astype(np.float32)
+    _roundtrip(resnet.build_cifar(depth=8, class_num=10, shortcut_type="A"),
+               x, tmp_path, atol=1e-4)
+    x224 = np.random.RandomState(8).rand(1, 3, 64, 64).astype(np.float32)
+    _roundtrip(resnet.build_imagenet(18, 10), x224, tmp_path, atol=1e-4)
+
+
+def test_inception_roundtrip(tmp_path):
+    from bigdl_tpu.models import inception
+
+    x = np.random.RandomState(9).rand(1, 3, 64, 64).astype(np.float32)
+    _roundtrip(inception.build(10, has_dropout=False), x, tmp_path, atol=1e-4)
