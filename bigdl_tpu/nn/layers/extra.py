@@ -500,3 +500,75 @@ class SpatialContrastiveNormalization(Module):
 
     def forward(self, ctx: Context, x):
         return self.div.forward(ctx, self.sub.forward(ctx, x))
+
+
+class SpatialConvolutionMap(Module):
+    """Torch-legacy connection-table conv (reference
+    ``SpatialConvolutionMap.scala``): each output plane connects to a
+    subset of input planes given by ``conn_table`` rows ``(in, out)``
+    (0-based here; the reference/Torch tables are 1-based).
+
+    TPU-native: the per-connection (kH, kW) kernels scatter into a dense
+    (O, I, kH, kW) weight at trace time (the table is static), and the
+    whole layer runs as ONE full convolution on the MXU — the sparsity
+    becomes structural zeros instead of the reference's per-connection
+    accumulation loops.
+
+    Tables: ``full_table(i, o)``, ``one_to_one_table(n)``,
+    ``random_table(i, o, fanin)`` mirror the reference's builders.
+    """
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.conn_table = np.asarray(conn_table, np.int32).reshape(-1, 2)
+        self.n_input_plane = int(self.conn_table[:, 0].max()) + 1
+        self.n_output_plane = int(self.conn_table[:, 1].max()) + 1
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.weight_init = weight_init or Xavier()
+
+    @staticmethod
+    def full_table(n_in: int, n_out: int) -> np.ndarray:
+        return np.asarray([(i, o) for o in range(n_out) for i in range(n_in)],
+                          np.int32)
+
+    @staticmethod
+    def one_to_one_table(n: int) -> np.ndarray:
+        return np.asarray([(i, i) for i in range(n)], np.int32)
+
+    @staticmethod
+    def random_table(n_in: int, n_out: int, fanin: int,
+                     seed: int = 0) -> np.ndarray:
+        rng = np.random.RandomState(seed)
+        rows = []
+        for o in range(n_out):
+            for i in rng.choice(n_in, size=min(fanin, n_in), replace=False):
+                rows.append((int(i), o))
+        return np.asarray(rows, np.int32)
+
+    def build_params(self, rng):
+        kh, kw = self.kernel
+        n_conn = len(self.conn_table)
+        fanin = max(1, n_conn // max(1, self.n_output_plane))
+        return {
+            "weight": self.weight_init(
+                fold_in_str(rng, "weight"), (n_conn, kh, kw),
+                fanin * kh * kw, fanin * kh * kw),
+            "bias": jnp.zeros((self.n_output_plane,), jnp.float32),
+        }
+
+    def forward(self, ctx: Context, x):
+        kh, kw = self.kernel
+        w = ctx.param("weight").astype(x.dtype)  # (n_conn, kh, kw)
+        dense = jnp.zeros(
+            (self.n_output_plane, self.n_input_plane, kh, kw), x.dtype)
+        dense = dense.at[self.conn_table[:, 1], self.conn_table[:, 0]].set(w)
+        y = lax.conv_general_dilated(
+            x, dense, self.stride,
+            [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return y + ctx.param("bias").astype(x.dtype)[:, None, None]

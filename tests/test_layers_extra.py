@@ -174,3 +174,27 @@ def test_spatial_normalization_family():
     assert np.isfinite(np.asarray(out)).all()
     out, _, _ = run(nn.SpatialContrastiveNormalization(3, size=5), x)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_spatial_convolution_map():
+    """Connection-table conv: a full table must equal a plain conv with
+    the same kernels; a partial table only mixes connected planes."""
+    x = rs.rand(2, 3, 6, 6).astype(np.float32)
+
+    table = nn.SpatialConvolutionMap.full_table(3, 4)
+    m = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    out, params, _ = run(m, x)
+    dense = np.zeros((4, 3, 3, 3), np.float32)
+    dense[table[:, 1], table[:, 0]] = np.asarray(params["weight"])
+    ref = nn.SpatialConvolution(3, 4, 3, 3, pad_w=1, pad_h=1)
+    rp, _ = ref.init(__import__("jax").random.key(0))
+    rp = dict(rp, weight=dense, bias=np.asarray(params["bias"]))
+    ref_out, _ = ref.apply(rp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=1e-5)
+
+    one = nn.SpatialConvolutionMap(nn.SpatialConvolutionMap.one_to_one_table(3),
+                                   3, 3, pad_w=1, pad_h=1)
+    out2, p2, _ = run(one, x)
+    assert np.asarray(out2).shape == (2, 3, 6, 6)
+    rnd = nn.SpatialConvolutionMap.random_table(4, 6, 2)
+    assert rnd.shape == (12, 2) and rnd[:, 1].max() == 5
