@@ -190,11 +190,17 @@ def main(argv=None):
     parser.add_argument("--dataset", default="cifar10", choices=["cifar10", "imagenet"])
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weightDecay", type=float, default=1e-4)
+    parser.add_argument("--dataFormat", default="NCHW",
+                        choices=["NCHW", "NHWC"],
+                        help="imagenet variant only; NHWC = channels-last")
     args = parser.parse_args(argv)
 
     if args.dataset == "imagenet":
-        model = build_imagenet(args.depth if args.depth in IMAGENET_CFG else 50, 1000)
+        model = build_imagenet(args.depth if args.depth in IMAGENET_CFG else 50,
+                               1000, data_format=args.dataFormat)
         x, y = _synthetic_images(64, (3, 224, 224), 1000, seed=1)
+        if args.dataFormat == "NHWC":
+            x = np.ascontiguousarray(np.transpose(x, (0, 2, 3, 1)))
     else:
         model = build_cifar(args.depth, 10)
         x, y = load_cifar10(args.folder, train=True)
