@@ -21,4 +21,5 @@ reference's unit strategy: tiny fixtures, no network downloads).
 | ``dlframes_image``          | ``example/dlframes/{imageInference,imageTransferLearning}`` |
 | ``keras_train``             | ``example/keras/Train``                         |
 | ``language_model``          | ``example/languagemodel/PTBWordLM``             |
+| ``recommendation``          | NCF over movielens (LookupTable + HitRatio/NDCG) |
 """
