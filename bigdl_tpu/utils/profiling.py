@@ -84,3 +84,26 @@ def format_times(rows: List[Tuple[str, float, Optional[float]]]) -> str:
     total_b = sum(b for _, _, b in rows if b is not None)
     lines.append(f"{'TOTAL':<28} {total_f * 1e3:12.3f} {total_b * 1e3:13.3f}")
     return "\n".join(lines)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Kernel-level timeline capture (chrome-trace / TensorBoard xplane;
+    the analogue SURVEY §5 notes the reference LACKS — "no sampled
+    profiler, no chrome-trace export"). Wraps ``jax.profiler``:
+
+        with profiling.trace("/tmp/tb"):
+            train_step(...)
+
+    produces ``plugins/profile/<ts>/*.trace.json.gz`` viewable in
+    chrome://tracing or TensorBoard."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

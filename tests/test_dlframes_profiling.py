@@ -78,3 +78,16 @@ def test_module_times_reports_children():
     assert rows[0][2] is not None and rows[1][2] is None
     table = format_times(rows)
     assert "TOTAL" in table and "forward(ms)" in table
+
+
+def test_trace_contextmanager(tmp_path):
+    import glob
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils import profiling
+
+    with profiling.trace(str(tmp_path)):
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    assert glob.glob(str(tmp_path / "plugins" / "profile" / "*" / "*")), \
+        "no trace files written"
