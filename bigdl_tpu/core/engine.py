@@ -86,7 +86,23 @@ class Engine:
         auto-detected from the metadata server; pass them explicitly for
         manual clusters (coordinator ``host:port``, world size, rank).
         """
-        if jax.process_count() == 1 and (num_processes or 1) > 1:
+        import os
+
+        # IMPORTANT: decide whether to initialize WITHOUT touching any
+        # jax backend API — jax.distributed.initialize must run before
+        # the backend is created. Distributed init engages when the
+        # caller passed explicit topology args OR a cluster environment
+        # is detectable; a plain single-process call is an ordinary init.
+        explicit = any(a is not None
+                       for a in (coordinator_address, num_processes, process_id))
+        # TPU_WORKER_HOSTNAMES is set even on single-host TPU-VMs: only a
+        # multi-entry list means a real multi-host slice
+        cluster_env = (
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")
+            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+            or "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
+        if explicit or cluster_env:
             kwargs = {}
             if coordinator_address is not None:
                 kwargs["coordinator_address"] = coordinator_address

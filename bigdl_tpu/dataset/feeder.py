@@ -39,6 +39,14 @@ from bigdl_tpu.dataset.sample import MiniBatch
 _MAGIC = b"BDLFEED1"
 
 
+class _StreamError:
+    """Queue marker: a producer failed; consumers must not mistake the
+    truncated stream for a clean end."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 def _send_all(sock: socket.socket, data: bytes) -> None:
     view = memoryview(data)
     while view:
@@ -148,6 +156,7 @@ class SocketFeedDataSet(AbstractDataSet):
                              daemon=True).start()
 
     def _reader(self, conn: socket.socket) -> None:
+        error: Optional[BaseException] = None
         try:
             magic = _recv_exact(conn, len(_MAGIC))
             if magic != _MAGIC:
@@ -155,23 +164,37 @@ class SocketFeedDataSet(AbstractDataSet):
             while True:
                 hdr = _recv_exact(conn, 4)
                 if hdr is None:
+                    # EOF between frames = producer closed without the
+                    # explicit end frame; tolerated (complete batches only)
                     break
                 n_arrays = struct.unpack(">I", hdr)[0]
                 if n_arrays == 0:
                     break
                 arrays = []
                 for _ in range(n_arrays):
-                    ln = struct.unpack(">Q", _recv_exact(conn, 8))[0]
-                    arrays.append(np.load(io.BytesIO(_recv_exact(conn, ln)),
+                    raw = _recv_exact(conn, 8)
+                    if raw is None:
+                        raise IOError("producer died mid-frame (truncated "
+                                      "array header)")
+                    ln = struct.unpack(">Q", raw)[0]
+                    payload = _recv_exact(conn, ln)
+                    if payload is None:
+                        raise IOError("producer died mid-frame (truncated "
+                                      "array payload)")
+                    arrays.append(np.load(io.BytesIO(payload),
                                           allow_pickle=False))
                 self._queue.put(tuple(arrays))
+        except BaseException as e:  # surface to the consumer, not stderr
+            error = e
         finally:
             conn.close()
             with self._lock:
                 self._open_producers -= 1
                 done = (self._open_producers == 0
                         and self._connected == self.n_producers)
-            if done:
+            if error is not None:
+                self._queue.put(_StreamError(error))
+            elif done:
                 self._queue.put(None)  # end-of-stream sentinel
 
     # -- AbstractDataSet ---------------------------------------------------
@@ -192,11 +215,13 @@ class SocketFeedDataSet(AbstractDataSet):
         while True:
             item = self._queue.get()
             if item is None:
-                if train:
-                    # training epochs iterate forever in the reference;
-                    # once producers finish, the stream simply ends
-                    return
+                # producers all finished cleanly: the stream ends (one
+                # shot — re-feed for another epoch from the producers)
                 return
+            if isinstance(item, _StreamError):
+                raise IOError(
+                    "batch producer failed mid-stream; refusing to treat "
+                    "truncated data as end-of-stream") from item.error
             arrays = item
             if len(arrays) == 1:
                 yield MiniBatch(arrays[0], None)

@@ -402,18 +402,36 @@ class TFGraphModule(Module):
         # trains the loaded graph; frozen graphs simply have none). The
         # initial value comes from the variable's Assign(var, Const)
         # initializer when present, else zeros of the shape attr.
+        by_name = {n.name: n for n in graph_def.node}
+
+        def resolve_const(name: str, depth: int = 0):
+            """Follow Identity/read chains to a Const (the standard
+            tf.Variable export shape is Assign(var, Identity(Const)))."""
+            if depth > 8:
+                return None
+            if name in self._consts:
+                return self._consts[name]
+            node = by_name.get(name)
+            if node is not None and node.op in ("Identity", "Snapshot") and node.input:
+                return resolve_const(_ref(node.input[0])[0], depth + 1)
+            return None
+
         for n in graph_def.node:
             if n.op in ("Variable", "VariableV2"):
                 init = None
                 for m in graph_def.node:
                     if m.op == "Assign" and m.input and _ref(m.input[0])[0] == n.name:
-                        src = _ref(m.input[1])[0]
-                        if src in self._consts:
-                            init = self._consts[src]
+                        init = resolve_const(_ref(m.input[1])[0])
                         break
                 if init is None:
                     shape = [d.size for d in n.attr["shape"].shape.dim]
                     init = np.zeros(shape, np.float32)
+                    import logging
+
+                    logging.getLogger("bigdl_tpu.interop.tf").warning(
+                        "variable %r has no Const-resolvable initializer; "
+                        "starting from zeros (random initializer ops are "
+                        "not evaluated at import)", n.name)
                 self._var_init[n.name] = np.asarray(init)
         # needed set: nodes reachable from outputs
         self._order = self._topo()
@@ -545,7 +563,8 @@ class TFSession:
         return [np.asarray(o) for o in (out if isinstance(out, tuple) else (out,))]
 
     def train(self, inputs: Sequence[str], loss_node: str, data,
-              optim_method=None, n_steps: int = 100, batch_size: int = 32):
+              optim_method=None, n_steps: int = 100, batch_size: int = 32,
+              steps_per_epoch: Optional[int] = None):
         """Train the graph's Variable nodes (reference
         ``BigDLSessionImpl.train``, ``Session.scala:111-132`` — which
         emulates the graph's queue runners to feed it; here the host
@@ -567,13 +586,13 @@ class TFSession:
         ostate = method.init_state(params)
 
         @jax.jit
-        def step(params, ostate, *feeds):
+        def step(params, ostate, epoch, *feeds):
             def loss_fn(p):
                 out, _ = module.apply(p, feeds if len(feeds) > 1 else feeds[0])
                 return jnp.asarray(out, jnp.float32).sum()
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            new_p, new_os = method.update(grads, params, ostate, jnp.int32(1))
+            new_p, new_os = method.update(grads, params, ostate, epoch)
             return new_p, new_os, loss
 
         if isinstance(data, (tuple, list)):
@@ -590,7 +609,9 @@ class TFSession:
         else:
             it = iter(data)
         loss = None
-        for _ in range(n_steps):
+        for i in range(n_steps):
             feeds = next(it)
-            params, ostate, loss = step(params, ostate, *map(jnp.asarray, feeds))
+            epoch = jnp.int32(i // steps_per_epoch + 1 if steps_per_epoch else 1)
+            params, ostate, loss = step(params, ostate, epoch,
+                                        *map(jnp.asarray, feeds))
         return module, params, (None if loss is None else float(loss))

@@ -161,8 +161,10 @@ class Highway(Module):
     def forward(self, ctx: Context, x):
         t = jax.nn.sigmoid(
             self._modules["gate"].forward(ctx.child("gate"), x))
+        # 'activation' matches the auto-registered child key so a
+        # parameterized activation (e.g. PReLU) finds its params
         h = self.activation.forward(
-            ctx.child("act"),
+            ctx.child("activation"),
             self._modules["transform"].forward(ctx.child("transform"), x))
         return t * h + (1 - t) * x
 

@@ -52,12 +52,18 @@ def _quantize_weight(w: jax.Array, channel_axis: int = 0):
 def _quantize_activation(x: jax.Array, static_scale=None):
     """Symmetric per-tensor int8. With a calibrated ``static_scale`` > 0
     the dynamic absmax pass is skipped (reference ``GenerateInt8Scales``
-    computes static activation scales offline; dynamic is the fallback)."""
-    dyn = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127.0
+    computes static activation scales offline; dynamic is the fallback).
+    ``lax.cond`` (not ``where``) so the full-tensor absmax reduction is
+    genuinely NOT executed on the calibrated path."""
+
+    def dyn(_):
+        return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127.0
+
     if static_scale is None:
-        scale = dyn
+        scale = dyn(None)
     else:
-        scale = jnp.where(static_scale > 0, static_scale, dyn)
+        scale = lax.cond(static_scale > 0,
+                         lambda _: static_scale.astype(jnp.float32), dyn, None)
     xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return xq, scale
 
