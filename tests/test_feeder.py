@@ -83,3 +83,30 @@ def test_socket_feed_trains_local_optimizer():
     ds.close()
     w = np.asarray(params["weight"]).T  # Linear stores (out, in)
     np.testing.assert_allclose(w, w_true, atol=0.1)
+
+
+def test_producer_death_mid_frame_raises():
+    """A producer dying mid-frame must raise at the consumer — truncated
+    data must NOT look like a clean end-of-stream."""
+    import socket
+    import struct
+
+    from bigdl_tpu.dataset.feeder import _MAGIC
+
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=1)
+    addr = ds.bound_address
+
+    def bad_producer():
+        s = socket.socket()
+        s.connect(addr)
+        s.sendall(_MAGIC)
+        s.sendall(struct.pack(">I", 2))  # promises 2 arrays...
+        s.sendall(struct.pack(">Q", 100))  # ...header for the first...
+        s.close()  # ...then dies
+
+    t = threading.Thread(target=bad_producer, daemon=True)
+    t.start()
+    with pytest.raises(IOError, match="producer failed"):
+        list(ds.batches(0, train=False))
+    t.join()
+    ds.close()
