@@ -265,3 +265,19 @@ def test_plateau_min_lr_floors_lr():
         f = plateau.update(1.0, base_lr=0.1)
     # factor floored at min_lr/base_lr = 0.1 so lr = 0.1*0.1 = 0.01
     np.testing.assert_allclose(f * 0.1, 0.01)
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    import numpy as np
+
+    from bigdl_tpu.utils.checkpoint import (
+        load_checkpoint_orbax, save_checkpoint_orbax,
+    )
+
+    params = {"layer": {"weight": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    ostate = {"momentum": np.ones((2,), np.float32)}
+    p = save_checkpoint_orbax(str(tmp_path), "epoch3", params,
+                              optim_state=ostate, meta={"epoch": 3})
+    lp, lms, los, meta = load_checkpoint_orbax(p)
+    np.testing.assert_array_equal(lp["layer"]["weight"],
+                                  params["layer"]["weight"])
+    np.testing.assert_array_equal(los["momentum"], ostate["momentum"])
+    assert meta["epoch"] == 3
