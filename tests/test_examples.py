@@ -158,3 +158,16 @@ def test_recommendation_ncf():
     hr = recommendation.main(["-b", "128", "--maxIteration", "20",
                               "--embedDim", "8", "--evalNeg", "20"])
     assert 0.0 <= hr <= 1.0
+
+
+def test_maskrcnn_cli_predict_and_evaluate():
+    from bigdl_tpu.models import maskrcnn
+
+    out = maskrcnn.main(["--mode", "predict", "--numClasses", "5",
+                         "--depth", "18", "--minSize", "96",
+                         "--maxSize", "128"])
+    assert "masks" in out
+    ap = maskrcnn.main(["--mode", "evaluate", "--numClasses", "5",
+                        "--depth", "18", "--minSize", "96",
+                        "--maxSize", "128", "--nImages", "2"])
+    assert 0.0 <= ap <= 1.0
