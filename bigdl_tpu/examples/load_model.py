@@ -22,9 +22,8 @@ import numpy as np
 
 def load_any(model_type: str, model_path: str,
              caffe_def_path: Optional[str] = None):
-    """Returns (module, params, state) for ``bigdl``/``caffe`` models.
-    ``torch`` returns the raw .t7 object tree (the reference likewise
-    hands torch loads to a dedicated converter)."""
+    """Returns (module, params, state) for any supported format
+    (``torch`` converts the legacy Sequential zoo via ``t7_to_module``)."""
     if model_type == "bigdl":
         from bigdl_tpu.utils.serializer import load_module
 
@@ -40,13 +39,9 @@ def load_any(model_type: str, model_path: str,
             raise ValueError("caffe models need --caffeDefPath (prototxt)")
         return load_caffe(caffe_def_path, model_path)
     if model_type == "torch":
-        from bigdl_tpu.utils.torch_file import load_t7
+        from bigdl_tpu.utils.torch_file import load_t7, t7_to_module
 
-        raise SystemExit(
-            "loaded .t7 object tree:\n"
-            f"{load_t7(model_path)!r}\n"
-            "use bigdl_tpu.utils.convert_model to map it to a module"
-        )
+        return t7_to_module(load_t7(model_path))
     raise ValueError("modelType must be bigdl, bigdl-proto, caffe or torch")
 
 

@@ -18,7 +18,7 @@ import argparse
 def main(argv=None):
     parser = argparse.ArgumentParser("convert-model")
     parser.add_argument("--from", dest="src", required=True,
-                        choices=["bigdl", "bigdl-proto", "caffe", "tensorflow", "onnx"])
+                        choices=["bigdl", "bigdl-proto", "caffe", "tensorflow", "onnx", "torch"])
     parser.add_argument("--to", dest="dst", required=True,
                         choices=["bigdl", "bigdl-proto", "caffe", "tensorflow", "onnx"])
     parser.add_argument("--input", required=True,
@@ -56,6 +56,10 @@ def main(argv=None):
         path, io = args.input.split(",")
         inp, out = io.split(":")
         model, params, state = load_tf_graph(path, [inp], [out])
+    elif args.src == "torch":
+        from bigdl_tpu.utils.torch_file import load_t7, t7_to_module
+
+        model, params, state = t7_to_module(load_t7(args.input))
     else:  # onnx
         from bigdl_tpu.interop.onnx import load_onnx
 

@@ -138,3 +138,232 @@ def load_t7(path: str) -> Any:
     unknown torch classes as {'__torch_class__', 'fields'} wrappers."""
     with open(path, "rb") as f:
         return _Reader(f).read_object()
+
+
+# -- writer (reference ``TorchFile.scala`` write path) ------------------------
+
+class _Writer:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self._next_idx = 1
+
+    def _write(self, fmt: str, v) -> None:
+        self.f.write(struct.pack(fmt, v))
+
+    def write_object(self, obj: Any) -> None:
+        if obj is None:
+            self._write("<i", TYPE_NIL)
+        elif isinstance(obj, bool):
+            self._write("<i", TYPE_BOOLEAN)
+            self._write("<i", int(obj))
+        elif isinstance(obj, (int, float)):
+            self._write("<i", TYPE_NUMBER)
+            self._write("<d", float(obj))
+        elif isinstance(obj, str):
+            self._write("<i", TYPE_STRING)
+            data = obj.encode("latin-1")
+            self._write("<i", len(data))
+            self.f.write(data)
+        elif isinstance(obj, np.ndarray):
+            self._write("<i", TYPE_TORCH)
+            self._write("<i", self._idx())
+            cls = {"float32": "torch.FloatTensor", "float64": "torch.DoubleTensor",
+                   "int64": "torch.LongTensor", "int32": "torch.IntTensor",
+                   "uint8": "torch.ByteTensor"}[str(obj.dtype)]
+            self._write_versioned(cls)
+            arr = np.ascontiguousarray(obj)
+            self._write("<i", arr.ndim)
+            for d in arr.shape:
+                self._write("<q", d)
+            stride = [int(s // arr.itemsize) for s in arr.strides]
+            for s in stride:
+                self._write("<q", s)
+            self._write("<q", 1)  # 1-based storage offset
+            # inline storage object
+            self._write("<i", TYPE_TORCH)
+            self._write("<i", self._idx())
+            self._write_versioned(_TENSOR_CLASSES[cls])
+            self._write("<q", arr.size)
+            self.f.write(arr.tobytes())
+        elif isinstance(obj, dict) and "__torch_class__" in obj:
+            self._write("<i", TYPE_TORCH)
+            self._write("<i", self._idx())
+            self._write_versioned(obj["__torch_class__"])
+            self.write_object(obj.get("fields", {}))
+        elif isinstance(obj, dict):
+            self._write("<i", TYPE_TABLE)
+            self._write("<i", self._idx())
+            self._write("<i", len(obj))
+            for k, v in obj.items():
+                self.write_object(k)
+                self.write_object(v)
+        elif isinstance(obj, (list, tuple)):
+            self.write_object({i + 1: v for i, v in enumerate(obj)})
+        else:
+            raise TypeError(f"cannot serialize {type(obj).__name__} to t7")
+
+    def _idx(self) -> int:
+        i = self._next_idx
+        self._next_idx += 1
+        return i
+
+    def _write_versioned(self, class_name: str) -> None:
+        for s in ("V 1", class_name):
+            data = s.encode("latin-1")
+            self._write("<i", len(data))
+            self.f.write(data)
+
+
+def save_t7(path: str, obj: Any) -> str:
+    """Write a Torch7 file readable by :func:`load_t7` (and Lua Torch).
+    Shared references are not deduplicated (each occurrence serializes
+    its own copy) — fine for module trees."""
+    with open(path, "wb") as f:
+        _Writer(f).write_object(obj)
+    return path
+
+
+# -- legacy torch module tree -> bigdl_tpu module -----------------------------
+
+def _lua_list(table) -> list:
+    """Lua array table {1: a, 2: b, ...} -> [a, b, ...]."""
+    if table is None:
+        return []
+    if isinstance(table, (list, tuple)):
+        return list(table)
+    return [table[k] for k in sorted(k for k in table if isinstance(k, (int, float)))]
+
+
+def t7_to_module(obj):
+    """Convert a loaded legacy-Torch module tree (``load_t7`` output) to
+    ``(module, params, state)`` (reference: the ``loadmodel`` example's
+    Torch path + ``TorchFile.scala``). Covers the legacy Sequential zoo:
+    conv/linear/pooling/BN/LRN/activations/dropout/reshape/view/concat."""
+    import jax
+
+    import bigdl_tpu.nn as nn
+
+    loaded_params: Dict[str, Any] = {}
+
+    def conv(module_obj, path):
+        f = module_obj["fields"]
+        m = nn.SpatialConvolution(
+            int(f["nInputPlane"]), int(f["nOutputPlane"]),
+            int(f["kW"]), int(f["kH"]), int(f.get("dW", 1)), int(f.get("dH", 1)),
+            int(f.get("padW", 0)), int(f.get("padH", 0)))
+        w = np.asarray(f["weight"], np.float32)
+        if w.ndim == 2:  # MM variant stores (nOut, nIn*kH*kW)
+            w = w.reshape(int(f["nOutputPlane"]), int(f["nInputPlane"]),
+                          int(f["kH"]), int(f["kW"]))
+        entry = {"weight": w}
+        if f.get("bias") is not None:
+            entry["bias"] = np.asarray(f["bias"], np.float32)
+        loaded_params[path] = entry
+        return m
+
+    def linear(module_obj, path):
+        f = module_obj["fields"]
+        w = np.asarray(f["weight"], np.float32)
+        m = nn.Linear(w.shape[1], w.shape[0],
+                      with_bias=f.get("bias") is not None)
+        entry = {"weight": w}
+        if f.get("bias") is not None:
+            entry["bias"] = np.asarray(f["bias"], np.float32)
+        loaded_params[path] = entry
+        return m
+
+    def bn(module_obj, path, spatial):
+        f = module_obj["fields"]
+        n = int(np.asarray(f["running_mean"]).shape[0])
+        cls = nn.SpatialBatchNormalization if spatial else nn.BatchNormalization
+        m = cls(n, eps=float(f.get("eps", 1e-5)),
+                momentum=float(f.get("momentum", 0.1)),
+                affine=f.get("weight") is not None)
+        entry = {}
+        if f.get("weight") is not None:
+            entry["weight"] = np.asarray(f["weight"], np.float32)
+            entry["bias"] = np.asarray(f["bias"], np.float32)
+        if entry:
+            loaded_params[path] = entry
+        return m
+
+    def pool(module_obj, path, kind):
+        f = module_obj["fields"]
+        cls = nn.SpatialMaxPooling if kind == "max" else nn.SpatialAveragePooling
+        m = cls(int(f["kW"]), int(f["kH"]), int(f.get("dW", 1)),
+                int(f.get("dH", 1)), int(f.get("padW", 0)), int(f.get("padH", 0)))
+        if f.get("ceil_mode"):
+            m.ceil()
+        return m
+
+    SIMPLE = {
+        "nn.ReLU": lambda o, p: nn.ReLU(),
+        "nn.Tanh": lambda o, p: nn.Tanh(),
+        "nn.Sigmoid": lambda o, p: nn.Sigmoid(),
+        "nn.SoftMax": lambda o, p: nn.SoftMax(),
+        "nn.LogSoftMax": lambda o, p: nn.LogSoftMax(),
+        "nn.Identity": lambda o, p: nn.Identity(),
+        "nn.Dropout": lambda o, p: nn.Dropout(float(o["fields"].get("p", 0.5))),
+        "nn.Reshape": lambda o, p: nn.Reshape(
+            [int(d) for d in np.asarray(o["fields"]["size"]).reshape(-1)]),
+        "nn.View": lambda o, p: nn.View(
+            *[int(d) for d in np.asarray(o["fields"]["size"]).reshape(-1)]),
+        "nn.SpatialZeroPadding": lambda o, p: nn.SpatialZeroPadding(
+            int(o["fields"]["pad_l"]), int(o["fields"]["pad_r"]),
+            int(o["fields"]["pad_t"]), int(o["fields"]["pad_b"])),
+        "nn.SpatialCrossMapLRN": lambda o, p: nn.SpatialCrossMapLRN(
+            int(o["fields"].get("size", 5)),
+            float(o["fields"].get("alpha", 1.0)),
+            float(o["fields"].get("beta", 0.75)),
+            float(o["fields"].get("k", 1.0))),
+        "nn.SpatialConvolution": conv,
+        "nn.SpatialConvolutionMM": conv,
+        "nn.Linear": linear,
+        "nn.SpatialBatchNormalization": lambda o, p: bn(o, p, True),
+        "nn.BatchNormalization": lambda o, p: bn(o, p, False),
+        "nn.SpatialMaxPooling": lambda o, p: pool(o, p, "max"),
+        "nn.SpatialAveragePooling": lambda o, p: pool(o, p, "avg"),
+    }
+
+    def convert(module_obj, path_parts):
+        cls = module_obj.get("__torch_class__", "")
+        if cls in ("nn.Sequential", "nn.Concat", "nn.ConcatTable"):
+            children = _lua_list(module_obj["fields"].get("modules"))
+            if cls == "nn.Concat":
+                cont = nn.Concat(int(module_obj["fields"].get("dimension", 2)) - 1)
+            elif cls == "nn.ConcatTable":
+                cont = nn.ConcatTable()
+            else:
+                cont = nn.Sequential()
+            for i, child in enumerate(children):
+                name = str(i)
+                cont.add(convert(child, path_parts + [name]), name)
+            return cont
+        if cls not in SIMPLE:
+            raise ValueError(f"no torch-legacy converter for {cls!r}")
+        return SIMPLE[cls](module_obj, "/".join(path_parts))
+
+    module = convert(obj, [])
+    params, state = module.init(jax.random.key(0))
+
+    def overlay(tree, parts):
+        if not isinstance(tree, dict):
+            return tree
+        repl = loaded_params.get("/".join(parts))
+        out = {}
+        for k, v in tree.items():
+            if repl is not None and k in repl and not isinstance(v, dict):
+                arr = np.asarray(repl[k], np.float32)
+                if tuple(arr.shape) != tuple(np.shape(v)):
+                    raise ValueError(
+                        f"t7 weight shape mismatch at {'/'.join(parts)}/{k}: "
+                        f"{arr.shape} vs {np.shape(v)}")
+                out[k] = arr
+            elif isinstance(v, dict):
+                out[k] = overlay(v, parts + [k])
+            else:
+                out[k] = v
+        return out
+
+    merged = overlay(params, [])
+    return module, merged, state

@@ -211,3 +211,63 @@ def test_post_ctor_additions_inside_ctor_child_survive(tmp_path, rng):
     inner = outer.modules["0"]
     inner.add(nn.ReLU())          # added AFTER outer's construction
     roundtrip(tmp_path, outer, _x(2, 4), rng)
+
+
+# ------------------------------------------------------ torch .t7 interop
+def test_t7_write_read_roundtrip(tmp_path):
+    import numpy as np
+
+    from bigdl_tpu.utils.torch_file import load_t7, save_t7
+
+    obj = {"a": 1.5, "b": "hello", "t": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "nested": {1: True, 2: None}}
+    p = str(tmp_path / "x.t7")
+    save_t7(p, obj)
+    back = load_t7(p)
+    assert back["a"] == 1.5 and back["b"] == "hello"
+    np.testing.assert_array_equal(back["t"], obj["t"])
+    assert back["nested"][1] is True
+
+
+def test_t7_legacy_model_converts_and_predicts(tmp_path):
+    """A legacy-Torch Sequential (conv/bn/pool/linear) written as .t7
+    loads into an equivalent module with its weights (the reference
+    loadmodel example's Torch path)."""
+    import numpy as np
+    import jax
+
+    from bigdl_tpu.utils.torch_file import load_t7, save_t7, t7_to_module
+
+    rng = np.random.RandomState(0)
+    w_conv = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+    b_conv = rng.randn(4).astype(np.float32) * 0.1
+    w_fc = rng.randn(5, 4 * 3 * 3).astype(np.float32) * 0.1
+    b_fc = rng.randn(5).astype(np.float32) * 0.1
+
+    def t(cls, fields):
+        return {"__torch_class__": cls, "fields": fields}
+
+    model_obj = t("nn.Sequential", {"modules": {
+        1: t("nn.SpatialConvolution", {
+            "nInputPlane": 3, "nOutputPlane": 4, "kW": 3, "kH": 3,
+            "dW": 1, "dH": 1, "padW": 1, "padH": 1,
+            "weight": w_conv, "bias": b_conv}),
+        2: t("nn.ReLU", {}),
+        3: t("nn.SpatialMaxPooling", {"kW": 2, "kH": 2, "dW": 2, "dH": 2,
+                                      "padW": 0, "padH": 0}),
+        4: t("nn.Reshape", {"size": np.asarray([4 * 3 * 3], np.int64)}),
+        5: t("nn.Linear", {"weight": w_fc, "bias": b_fc}),
+        6: t("nn.LogSoftMax", {}),
+    }})
+    p = str(tmp_path / "legacy.t7")
+    save_t7(p, model_obj)
+
+    module, params, state = t7_to_module(load_t7(p))
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    out, _ = module.apply(params, x, state=state, training=False)
+    assert np.asarray(out).shape == (2, 5)
+    # weights actually landed (not random init)
+    np.testing.assert_array_equal(np.asarray(params["0"]["weight"]), w_conv)
+    np.testing.assert_array_equal(np.asarray(params["4"]["weight"]), w_fc)
+    # log-probs sum to 1
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-4)
