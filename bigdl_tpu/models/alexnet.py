@@ -1,0 +1,108 @@
+"""AlexNet (OWT variant + the original grouped/LRN variant).
+
+Reference: ``DL/example/loadmodel/AlexNet.scala`` — ``AlexNet_OWT``
+("one weird trick" single-tower layout used by the loadmodel example)
+and ``AlexNet`` (the original Caffe layout with LRN and grouped convs).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build_owt(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """AlexNet-OWT (reference ``AlexNet_OWT.apply``); input 3x224x224."""
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 11, 11, 4, 4, 2, 2).set_name("conv1"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"),
+        nn.SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2).set_name("conv2"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"),
+        nn.SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1).set_name("conv3"),
+        nn.ReLU(),
+        nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1).set_name("conv4"),
+        nn.ReLU(),
+        nn.SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1).set_name("conv5"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"),
+        nn.View(256 * 6 * 6),
+        nn.Linear(256 * 6 * 6, 4096).set_name("fc6"),
+        nn.ReLU(),
+    )
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096).set_name("fc7"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num).set_name("fc8"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """Original AlexNet (reference ``AlexNet.apply``): LRN after the
+    first two stages, grouped conv2/4/5; input 3x227x227."""
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 96, 11, 11, 4, 4).set_name("conv1"),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm1"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"),
+        nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2).set_name("conv2"),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm2"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"),
+        nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1).set_name("conv3"),
+        nn.ReLU(),
+        nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2).set_name("conv4"),
+        nn.ReLU(),
+        nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2).set_name("conv5"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"),
+        nn.View(256 * 6 * 6),
+        nn.Linear(256 * 6 * 6, 4096).set_name("fc6"),
+        nn.ReLU(),
+    )
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096).set_name("fc7"))
+    model.add(nn.ReLU())
+    if has_dropout:
+        model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num).set_name("fc8"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def main(argv=None):
+    """Train CLI on synthetic ImageNet-shaped data (reference: the
+    loadmodel example consumes AlexNet for validation; a Train main is
+    provided for recipe parity with the other zoo models)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.models.cli import fit, make_parser
+    from bigdl_tpu.optim import SGD, optimizer
+
+    parser = make_parser("alexnet-train", batch_size=64, max_epoch=2,
+                         learning_rate=0.01,
+                         folder_help="unused (synthetic data)")
+    parser.add_argument("--variant", choices=["owt", "original"], default="owt")
+    parser.add_argument("--classNum", type=int, default=1000)
+    args = parser.parse_args(argv)
+
+    size = 224 if args.variant == "owt" else 227
+    model = (build_owt if args.variant == "owt" else build)(args.classNum)
+    rng = np.random.RandomState(0)
+    x = rng.rand(4 * args.batchSize, 3, size, size).astype("float32")
+    y = rng.randint(0, args.classNum, (4 * args.batchSize,)).astype("int32")
+    ds = DataSet.tensors(x, y)
+
+    opt = optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=args.batchSize)
+    opt.set_optim_method(SGD(learning_rate=args.learningRate, momentum=0.9))
+    return fit(opt, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,21 @@ def test_resnet_nhwc_matches_nchw():
     out_l, _ = m_nhwc.apply(params, x.transpose(0, 2, 3, 1), state=state, training=True)
     np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_l),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_alexnet_variants_forward():
+    """Both AlexNet layouts (reference example/loadmodel/AlexNet.scala)
+    produce class log-probs at their canonical input sizes."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models import alexnet
+
+    for build_fn, size in ((alexnet.build_owt, 224), (alexnet.build, 227)):
+        m = build_fn(class_num=10, has_dropout=False)
+        params, state = m.init(jax.random.key(0))
+        x = np.random.RandomState(0).rand(2, 3, size, size).astype(np.float32)
+        out, _ = m.apply(params, x, state=state, training=False)
+        assert np.asarray(out).shape == (2, 10)
+        np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0,
+                                   rtol=1e-4)
