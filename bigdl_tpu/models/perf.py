@@ -42,6 +42,10 @@ def build_model(name: str, class_num: int):
         return vgg.build_vgg16(class_num=class_num), (3, 224, 224)
     if name == "vgg19":
         return vgg.build_vgg19(class_num=class_num), (3, 224, 224)
+    if name == "alexnet":
+        from bigdl_tpu.models import alexnet
+
+        return alexnet.build_owt(class_num), (3, 224, 224)
     raise ValueError(f"unknown model {name}")
 
 
@@ -69,7 +73,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser("perf")
     ap.add_argument("--model", default="resnet50",
                     choices=["lenet", "resnet18", "resnet50", "inception-v1",
-                             "vgg16", "vgg19"])
+                             "vgg16", "vgg19", "alexnet"])
     ap.add_argument("-b", "--batchSize", type=int, default=32)
     ap.add_argument("--mode", choices=["train", "fwd"], default="train")
     ap.add_argument("--int8", action="store_true",
