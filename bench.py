@@ -135,9 +135,11 @@ def main():
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--short", type=int, default=4)
     ap.add_argument("--long", type=int, default=20)
-    ap.add_argument("--host-pipeline", action="store_true",
-                    help="also measure data->device throughput fed from the "
-                         "host input pipeline (extra JSON field)")
+    ap.add_argument("--no-host-pipeline", dest="host_pipeline",
+                    action="store_false", default=True,
+                    help="skip the data->device fed-throughput measurement "
+                         "(on by default — the reference's canonical metric "
+                         "is pipeline-fed, DistriOptimizer.scala:410-417)")
     args = ap.parse_args()
 
     from bigdl_tpu.models import resnet
@@ -222,20 +224,28 @@ def main():
 
     host_rate = xfer_bw = None
     if args.host_pipeline:
-        host_rate = run_host_pipeline(
-            model, criterion, method, batch, n2 * 2, compute_dtype)
-        # measured host->device bandwidth: on this tunneled runner it is
-        # ~40-70 MB/s (the wall for any host-fed mode); a real TPU-VM PCIe
-        # link does GB/s and closes the gap to the resident-batch number
-        probe = (np.random.rand(batch, 3, 224, 224) * 255).astype(np.uint8)
-        fetch = jax.jit(lambda a: jnp.float32(a).sum())
-        float(fetch(jax.device_put(probe)))  # warmup: compiles cast+sum too
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(fetch(jax.device_put(probe)))
-            best = min(best, time.perf_counter() - t0)
-        xfer_bw = probe.nbytes / best
+        # the fed number is supplementary; never let a pipeline hiccup kill
+        # the headline measurement
+        try:
+            host_rate = run_host_pipeline(
+                model, criterion, method, batch, n2 * 2, compute_dtype)
+            # measured host->device bandwidth: on this tunneled runner it is
+            # ~40-70 MB/s (the wall for any host-fed mode); a real TPU-VM PCIe
+            # link does GB/s and closes the gap to the resident-batch number
+            probe = (np.random.rand(batch, 3, 224, 224) * 255).astype(np.uint8)
+            fetch = jax.jit(lambda a: jnp.float32(a).sum())
+            float(fetch(jax.device_put(probe)))  # warmup: compiles cast+sum
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(fetch(jax.device_put(probe)))
+                best = min(best, time.perf_counter() - t0)
+            xfer_bw = probe.nbytes / best
+        except Exception as e:  # pragma: no cover - defensive
+            import sys
+
+            print(f"host-pipeline measurement failed: {e}", file=sys.stderr)
+            host_rate = xfer_bw = None
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",

@@ -19,9 +19,10 @@ This module maps that format onto the TPU-native module zoo both ways:
   reference can read back (ctor attrs under Scala names + module_tags/
   module_numerics markers + version).
 
-Known reference quirk kept: BN running statistics do not travel through
-the proto path (``parameters`` carries only weight/bias — the reference
-loses them the same way); they re-initialize on load.
+BN running statistics travel as TENSOR attrs exactly like the reference
+(``BatchNormalization.doSerializeModule`` persists ``runningMean`` /
+``runningVar`` / ``saveMean`` / ``saveStd``, ``BatchNormalization.scala:396-433``);
+they load into module *state* here and are emitted from state on save.
 
 Weight layout conversions (Scala <-> here):
 - SpatialConvolution: (nGroup, out/g, in/g, kH, kW) <-> (out, in/g, kH, kW)
@@ -125,7 +126,9 @@ class _StorageBook:
         self._next = 1
 
     def collect(self, module: pb.BigDLModule) -> None:
-        for t in list(module.parameters) + [module.weight, module.bias]:
+        attr_tensors = [a.tensorValue for a in module.attr.values()
+                        if a.WhichOneof("value") == "tensorValue"]
+        for t in list(module.parameters) + [module.weight, module.bias] + attr_tensors:
             if t.HasField("storage") and len(t.storage.float_data):
                 self.by_id[t.storage.id] = np.asarray(
                     t.storage.float_data, np.float32)
@@ -431,18 +434,31 @@ def _weights_from_ours(module, params: Dict[str, Any]) -> List[np.ndarray]:
 
 # -- load ---------------------------------------------------------------------
 
+def _attr_tensor(attrs, key: str, book: _StorageBook) -> Optional[np.ndarray]:
+    if key not in attrs:
+        return None
+    a = attrs[key]
+    if a.WhichOneof("value") != "tensorValue":
+        return None
+    return book.tensor_to_np(a.tensorValue)
+
+
 def _module_from_proto(mod: pb.BigDLModule, book: _StorageBook,
-                       params_out: Dict[str, Any]) -> nn.Module:
+                       params_out: Dict[str, Any],
+                       state_out: Dict[str, Any]) -> nn.Module:
     short = mod.moduleType.rsplit(".", 1)[-1]
     if short == "Sequential":
         seq = nn.Sequential()
         for i, sub in enumerate(mod.subModules):
             child_params: Dict[str, Any] = {}
-            child = _module_from_proto(sub, book, child_params)
+            child_state: Dict[str, Any] = {}
+            child = _module_from_proto(sub, book, child_params, child_state)
             name = sub.name or str(i)
             seq.add(child, name)
             if child_params:
                 params_out[name] = child_params
+            if child_state:
+                state_out[name] = child_state
         if mod.name:
             seq.set_name(mod.name)
         return seq
@@ -450,21 +466,24 @@ def _module_from_proto(mod: pb.BigDLModule, book: _StorageBook,
         children = []
         for i, sub in enumerate(mod.subModules):
             child_params: Dict[str, Any] = {}
-            child = _module_from_proto(sub, book, child_params)
-            children.append((sub.name or str(i), child, child_params))
+            child_state: Dict[str, Any] = {}
+            child = _module_from_proto(sub, book, child_params, child_state)
+            children.append((sub.name or str(i), child, child_params, child_state))
         if short == "Concat":
             cont = nn.Concat(int(_get(mod.attr, "dimension", 2)) - 1)
         else:
             cont = nn.ConcatTable()
-        for name, child, child_params in children:
+        for name, child, child_params, child_state in children:
             cont.add(child, name)
             if child_params:
                 params_out[name] = child_params
+            if child_state:
+                state_out[name] = child_state
         if mod.name:
             cont.set_name(mod.name)
         return cont
     if short in ("StaticGraph", "Graph", "DynamicGraph"):
-        return _graph_from_proto(mod, book, params_out)
+        return _graph_from_proto(mod, book, params_out, state_out)
 
     if short not in _REG:
         raise ValueError(
@@ -476,11 +495,19 @@ def _module_from_proto(mod: pb.BigDLModule, book: _StorageBook,
     tensors = [book.tensor_to_np(t) for t in mod.parameters]
     tensors = [t for t in tensors if t is not None]
     params_out.update(_weights_to_ours(module, tensors))
+    if isinstance(module, nn.BatchNormalization):
+        rm = _attr_tensor(mod.attr, "runningMean", book)
+        rv = _attr_tensor(mod.attr, "runningVar", book)
+        if rm is not None and rm.size:
+            state_out["running_mean"] = rm.reshape(-1)
+        if rv is not None and rv.size:
+            state_out["running_var"] = rv.reshape(-1)
     return module
 
 
 def _graph_from_proto(mod: pb.BigDLModule, book: _StorageBook,
-                      params_out: Dict[str, Any]) -> nn.Module:
+                      params_out: Dict[str, Any],
+                      state_out: Dict[str, Any]) -> nn.Module:
     """Rebuild a StaticGraph: subModules are forward-execution nodes with
     preModules linkage; inputNames/outputNames attrs name the endpoints
     (reference ``Graph.doSerializeModule``)."""
@@ -501,12 +528,15 @@ def _graph_from_proto(mod: pb.BigDLModule, book: _StorageBook,
             graph_inputs.append(node)
             continue
         child_params: Dict[str, Any] = {}
-        child = _module_from_proto(sub, book, child_params)
+        child_state: Dict[str, Any] = {}
+        child = _module_from_proto(sub, book, child_params, child_state)
         parents = [nodes[p] for p in pre]
         node = child(*parents)
         nodes[name] = node
         if child_params:
             params_out[name] = child_params
+        if child_state:
+            state_out[name] = child_state
     outs = [nodes[n] for n in output_names]
     graph = nn.Graph(graph_inputs, outs)
     if mod.name:
@@ -523,13 +553,15 @@ def load_bigdl(path: str):
     book = _StorageBook()
     book.collect(mod)
     loaded_params: Dict[str, Any] = {}
-    module = _module_from_proto(mod, book, loaded_params)
+    loaded_state: Dict[str, Any] = {}
+    module = _module_from_proto(mod, book, loaded_params, loaded_state)
 
     import jax
 
     params, state = module.init(jax.random.key(0))
     merged = _merge(params, loaded_params)
-    return module, merged, state
+    merged_state = _merge(state, loaded_state)
+    return module, merged, merged_state
 
 
 def _merge(inited, loaded):
@@ -558,14 +590,14 @@ def _merge(inited, loaded):
 # -- save ---------------------------------------------------------------------
 
 def _module_to_proto(module: nn.Module, params, book: _StorageBook,
-                     name: str) -> pb.BigDLModule:
+                     name: str, state=None) -> pb.BigDLModule:
     mod = pb.BigDLModule(version=_VERSION, train=False)
     mod.name = module.get_name() or name
     mod.attr["module_tags"].CopyFrom(_attr_str_array(["Float"]))
     mod.attr["module_numerics"].CopyFrom(_attr_str_array(["Float"]))
 
     if isinstance(module, nn.Graph):
-        return _graph_to_proto(module, params, book, mod)
+        return _graph_to_proto(module, params, book, mod, state)
 
     if isinstance(module, (nn.Sequential, nn.ConcatTable, nn.Concat)):
         short = type(module).__name__
@@ -574,8 +606,10 @@ def _module_to_proto(module: nn.Module, params, book: _StorageBook,
             mod.attr["dimension"].CopyFrom(_attr_int(module.dimension + 1))
         for child_name, child in module._modules.items():
             child_params = params.get(child_name, {}) if isinstance(params, dict) else {}
+            child_state = state.get(child_name, {}) if isinstance(state, dict) else {}
             mod.subModules.append(
-                _module_to_proto(child, child_params, book, child_name))
+                _module_to_proto(child, child_params, book, child_name,
+                                 child_state))
         return mod
 
     if isinstance(module, nn.GlobalAveragePooling2D):
@@ -601,6 +635,21 @@ def _module_to_proto(module: nn.Module, params, book: _StorageBook,
     mod.moduleType = SCALA_NN + short
     for k, v in _REG[short][2](module).items():
         mod.attr[k].CopyFrom(v)
+    if isinstance(module, nn.BatchNormalization):
+        # the reference loader reads all four stat attrs unconditionally
+        # (BatchNormalization.scala doLoadModule); saveMean/saveStd are the
+        # last-forward transients, re-derived here from the running stats
+        st = state if isinstance(state, dict) else {}
+        rm = np.asarray(st.get("running_mean",
+                               np.zeros(module.n_output)), np.float32)
+        rv = np.asarray(st.get("running_var",
+                               np.ones(module.n_output)), np.float32)
+        for key, arr in (("runningMean", rm), ("runningVar", rv),
+                         ("saveMean", rm),
+                         ("saveStd", 1.0 / np.sqrt(rv + module.eps))):
+            a = pb.AttrValue(dataType=pb.TENSOR)
+            a.tensorValue.CopyFrom(book.np_to_tensor(arr))
+            mod.attr[key].CopyFrom(a)
     tensors = _weights_from_ours(module, params)
     if tensors:
         mod.hasParameters = True
@@ -610,7 +659,7 @@ def _module_to_proto(module: nn.Module, params, book: _StorageBook,
 
 
 def _graph_to_proto(graph: nn.Graph, params, book: _StorageBook,
-                    mod: pb.BigDLModule) -> pb.BigDLModule:
+                    mod: pb.BigDLModule, state=None) -> pb.BigDLModule:
     mod.moduleType = SCALA_NN + "StaticGraph"
     input_names, output_names = [], []
     names = dict(graph._names)
@@ -627,7 +676,9 @@ def _graph_to_proto(graph: nn.Graph, params, book: _StorageBook,
             input_names.append(name)
             continue
         child_params = params.get(name, {}) if isinstance(params, dict) else {}
-        sub = _module_to_proto(node.element, child_params, book, name)
+        child_state = state.get(name, {}) if isinstance(state, dict) else {}
+        sub = _module_to_proto(node.element, child_params, book, name,
+                               child_state)
         sub.name = name
         for p in node.prev:
             sub.preModules.append(names[id(p)])
@@ -642,7 +693,7 @@ def _graph_to_proto(graph: nn.Graph, params, book: _StorageBook,
 def save_bigdl(path: str, module: nn.Module, params, state=None) -> str:
     """Write a reference-format protobuf model file."""
     book = _StorageBook()
-    proto = _module_to_proto(module, params or {}, book, "model")
+    proto = _module_to_proto(module, params or {}, book, "model", state or {})
     with open(path, "wb") as f:
         f.write(proto.SerializeToString())
     return path

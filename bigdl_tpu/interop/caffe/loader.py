@@ -58,7 +58,11 @@ def _blob_array(blob) -> np.ndarray:
     if blob.HasField("shape") and len(blob.shape.dim):
         return data.reshape([int(d) for d in blob.shape.dim])
     dims = [d for d in (blob.num, blob.channels, blob.height, blob.width) if d]
-    return data.reshape(dims) if dims else data
+    if dims and int(np.prod(dims)) == data.size:
+        return data.reshape(dims)
+    # legacy writers (e.g. the reference CaffePersister) set only some of
+    # num/channels/height/width — leave flat; layer geometry reshapes it
+    return data
 
 
 def _conv_geometry(p):
@@ -222,6 +226,13 @@ class CaffeLoader:
                 for t in layer.tops:
                     shapes[t] = out_shape
             parents = [tops[b] for b in layer.bottoms if b in tops]
+            if not parents and not layer.bottoms:
+                # a compute layer with no bottom consumes the net input
+                # (reference CaffePersister emits such prototxts — the data
+                # input declaration is dropped on persist)
+                implicit = Input()
+                inputs.append(implicit)
+                parents = [implicit]
             node = Node(module, parents)
             for t in layer.tops:
                 tops[t] = node
@@ -335,6 +346,10 @@ class CaffeLoader:
             bias = bool(p.bias_term)
             w = blobs[0] if blobs else None
             if w is not None:
+                if w.ndim != 4:  # legacy blob with partial dims: use geometry
+                    w = w.reshape(n_out if t == "Convolution" else -1,
+                                  -1 if t == "Convolution" else n_out // group,
+                                  kh, kw)
                 n_in = w.shape[1] * group
             elif in_shape:
                 n_in = in_shape[0]

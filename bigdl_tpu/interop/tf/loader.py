@@ -47,6 +47,16 @@ _NP_DTYPES = {
 
 def tensor_to_numpy(t: "pb.TensorProto") -> np.ndarray:
     shape = [int(d.size) for d in t.tensor_shape.dim]
+    if t.dtype == pb.DT_STRING:
+        # string consts appear in training graphs (Assert messages, reader
+        # patterns); keep them as object arrays so import doesn't choke
+        vals = list(t.string_val)
+        n = int(np.prod(shape)) if shape else 1
+        if len(vals) < n:  # trailing-repeat compression (TF MakeNdarray)
+            vals = vals + [vals[-1] if vals else b""] * (n - len(vals))
+        arr = np.empty(len(vals), dtype=object)
+        arr[:] = vals
+        return arr.reshape(shape) if shape else arr.reshape(())
     if t.dtype == pb.DT_BFLOAT16:
         import ml_dtypes
 
@@ -438,9 +448,13 @@ class TFGraphModule(Module):
 
     def _topo(self) -> List[str]:
         # iterative DFS: real frozen graphs (ResNets, unrolled RNNs) have
-        # input chains far deeper than Python's recursion limit
+        # input chains far deeper than Python's recursion limit. Fed nodes
+        # (inputs) are leaves — their ancestors are pruned, so feeding an
+        # interior node (e.g. a queue-dequeue in a training graph) cuts the
+        # unsupported producer subgraph away entirely.
         order: List[str] = []
         state: Dict[str, int] = {}  # 0 visiting, 1 done
+        fed = set(self.input_names)
         for root, _ in self.output_refs:
             stack: List[Tuple[str, bool]] = [(root, False)]
             while stack:
@@ -458,6 +472,8 @@ class TFGraphModule(Module):
                         "supported in frozen-graph import)")
                 state[name] = 0
                 stack.append((name, True))
+                if name in fed:
+                    continue
                 for ref in self.nodes[name].input:
                     base, idx = _ref(ref)
                     if idx >= 0 and state.get(base) != 1:  # skip control deps

@@ -52,15 +52,26 @@ class CAveTable(Module):
 
 
 class JoinTable(Module):
-    """Concatenate table elements along ``dimension`` (0-indexed over the
-    batched shape; reference: ``JoinTable.scala``)."""
+    """Concatenate table elements along ``dimension`` (0-indexed;
+    reference: ``JoinTable.scala``).
+
+    ``n_input_dims`` mirrors the reference's ``nInputDims``: when > 0,
+    ``dimension`` refers to an *unbatched* sample of that rank, and an
+    input of rank ``n_input_dims + 1`` is treated as batched — the join
+    axis shifts right by one at forward time (reference
+    ``getPositiveDimension``)."""
 
     def __init__(self, dimension: int, n_input_dims: int = -1):
         super().__init__()
         self.dimension = dimension
+        self.n_input_dims = n_input_dims
 
     def forward(self, ctx: Context, x):
-        return jnp.concatenate(list(x), axis=self.dimension)
+        axis = self.dimension
+        if (self.n_input_dims > 0 and axis >= 0
+                and x[0].ndim == self.n_input_dims + 1):
+            axis += 1
+        return jnp.concatenate(list(x), axis=axis)
 
 
 class SelectTable(Module):

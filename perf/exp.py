@@ -1,6 +1,6 @@
 """Perf diagnosis: where do the 95 ms/step go? Differential timing.
 
-Usage: python perf_exp.py <variant>  (fwd | step | step512 | nhwc | nhwc512)
+Usage: python perf/exp.py <variant>  (fwd | step | step512 | nhwc | nhwc512)
 """
 import sys, time
 import jax, jax.numpy as jnp, numpy as np

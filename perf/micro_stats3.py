@@ -1,0 +1,188 @@
+"""Round-3 Pallas BN-stats kernel: single-pass streaming multi-reduce.
+
+The round-2 attempt (micro_pallas.py) did a cross-lane reduce of a
+(C, HW) block on EVERY grid step — the same slow lowering XLA hits.
+This version accumulates blocks ELEMENTWISE into a (c_blk, HW) fp32
+VMEM scratch (pure VPU adds at streaming bandwidth) and defers the
+cross-lane reduce to once per channel tile; sum and sum-of-squares come
+out of ONE pass over x (XLA needs two sweeps).
+
+Grid: (C/c_blk, N), N fastest (TPU grids iterate row-major, so the
+scratch accumulates over the whole batch before the c-tile advances).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=16, n2=96, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def _pick_cblk(C, HW, budget_bytes=2 * 1024 * 1024):
+    if C * HW * 4 <= budget_bytes:
+        return C
+    for cb in range(C, 7, -1):
+        if C % cb == 0 and cb % 8 == 0 and cb * HW * 4 <= budget_bytes:
+            return cb
+    return 8
+
+
+def _fwd_kernel(x_ref, s_ref, s2_ref, acc_s, acc_s2):
+    n = pl.program_id(1)
+    blk = x_ref[0].astype(jnp.float32)
+    sq = blk * blk
+
+    @pl.when(n == 0)
+    def _():
+        acc_s[...] = blk
+        acc_s2[...] = sq
+
+    @pl.when(n > 0)
+    def _():
+        acc_s[...] += blk
+        acc_s2[...] += sq
+
+    @pl.when(n == pl.num_programs(1) - 1)
+    def _():
+        s_ref[...] = jnp.sum(acc_s[...], axis=1, keepdims=True)
+        s2_ref[...] = jnp.sum(acc_s2[...], axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def pallas_stats(x, c_blk):
+    N, C, HW = x.shape
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(C // c_blk, N),
+        in_specs=[pl.BlockSpec((1, c_blk, HW), lambda c, n: (n, c, 0))],
+        out_specs=[pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0)),
+                   pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+        out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((c_blk, HW), jnp.float32),
+                        pltpu.VMEM((c_blk, HW), jnp.float32)],
+    )(x)
+
+
+def _bwd_kernel(g_ref, x_ref, mean_ref, sg_ref, sgx_ref, acc_g, acc_gx):
+    n = pl.program_id(1)
+    g = g_ref[0].astype(jnp.float32)
+    xc = x_ref[0].astype(jnp.float32) - mean_ref[...]
+    gx = g * xc
+
+    @pl.when(n == 0)
+    def _():
+        acc_g[...] = g
+        acc_gx[...] = gx
+
+    @pl.when(n > 0)
+    def _():
+        acc_g[...] += g
+        acc_gx[...] += gx
+
+    @pl.when(n == pl.num_programs(1) - 1)
+    def _():
+        sg_ref[...] = jnp.sum(acc_g[...], axis=1, keepdims=True)
+        sgx_ref[...] = jnp.sum(acc_gx[...], axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def pallas_bwd_stats(g, x, mean, c_blk):
+    N, C, HW = x.shape
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=(C // c_blk, N),
+        in_specs=[pl.BlockSpec((1, c_blk, HW), lambda c, n: (n, c, 0)),
+                  pl.BlockSpec((1, c_blk, HW), lambda c, n: (n, c, 0)),
+                  pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+        out_specs=[pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0)),
+                   pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+        out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((c_blk, HW), jnp.float32),
+                        pltpu.VMEM((c_blk, HW), jnp.float32)],
+    )(g, x, mean.reshape(C, 1))
+
+
+def bench_shape(N, C, H, W):
+    HW = H * W
+    x4 = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+    g4 = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+    nbytes = x4.size * 2
+    chain = lambda x, m: x + (m * 1e-30).astype(x.dtype)
+    c_blk = _pick_cblk(C, HW)
+    print(f"--- shape ({N},{C},{H},{W})  c_blk={c_blk}", flush=True)
+
+    # numerics check
+    s, s2 = pallas_stats(x4.reshape(N, C, HW), c_blk)
+    ref_s = np.asarray(jnp.sum(x4.astype(jnp.float32), axis=(0, 2, 3)))
+    ref_s2 = np.asarray(jnp.sum(jnp.square(x4.astype(jnp.float32)), axis=(0, 2, 3)))
+    np.testing.assert_allclose(np.asarray(s)[:, 0], ref_s, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(s2)[:, 0], ref_s2, rtol=2e-3)
+    mean = jnp.asarray(ref_s / (N * HW), jnp.float32)
+    sg, sgx = pallas_bwd_stats(g4.reshape(N, C, HW), x4.reshape(N, C, HW), mean, c_blk)
+    ref_sg = np.asarray(jnp.sum(g4.astype(jnp.float32), axis=(0, 2, 3)))
+    ref_sgx = np.asarray(jnp.sum(
+        g4.astype(jnp.float32) * (x4.astype(jnp.float32) - mean.reshape(1, C, 1, 1)),
+        axis=(0, 2, 3)))
+    np.testing.assert_allclose(np.asarray(sg)[:, 0], ref_sg, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(sgx)[:, 0], ref_sgx, rtol=2e-3, atol=ref_s2.max() * 2e-4)
+    print("numerics OK", flush=True)
+
+    def xla_fwd(c):
+        x, _ = c
+        m = jnp.mean(x, axis=(0, 2, 3), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 2, 3))
+        return (chain(x, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(xla_fwd, (x4, jnp.float32(0)))
+    print(f"XLA  fwd pair : {dt*1e3:.3f} ms  eff {2*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    def pl_fwd(c):
+        x, _ = c
+        s, s2 = pallas_stats(x.reshape(N, C, HW), c_blk)
+        return (chain(x, s.sum() + s2.sum()), jnp.float32(0)), s.sum()
+    dt = timed(pl_fwd, (x4, jnp.float32(0)))
+    print(f"PAL  fwd pair : {dt*1e3:.3f} ms  eff {nbytes/dt/1e9:.0f} GB/s (1 read)", flush=True)
+
+    def xla_bwd(c):
+        x, _ = c
+        sg = jnp.sum(g4, axis=(0, 2, 3), dtype=jnp.float32)
+        sgx = jnp.sum(g4 * x, axis=(0, 2, 3), dtype=jnp.float32)
+        return (chain(x, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(xla_bwd, (x4, jnp.float32(0)))
+    print(f"XLA  bwd pair : {dt*1e3:.3f} ms  eff {3*nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+    def pl_bwd(c):
+        x, _ = c
+        sg, sgx = pallas_bwd_stats(g4.reshape(N, C, HW), x.reshape(N, C, HW), mean, c_blk)
+        return (chain(x, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(pl_bwd, (x4, jnp.float32(0)))
+    print(f"PAL  bwd pair : {dt*1e3:.3f} ms  eff {2*nbytes/dt/1e9:.0f} GB/s (2 reads)", flush=True)
+
+
+def main():
+    bench_shape(128, 64, 112, 112)   # conv1 output @ bench batch
+    # bench_shape(128, 256, 56, 56)    # layer1 bottleneck out
+    # bench_shape(128, 512, 28, 28)    # layer2
+    # bench_shape(128, 2048, 7, 7)     # layer4 (tiny HW: lane-padded case)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Block-shape sweep for the streaming BN-stats kernel (fwd only).
+
+micro_stats3 at (c_blk=32, n_blk=1) ran at 134 GB/s = ~6us per 802KB
+grid step -> per-step DMA cost dominates. Hypotheses: strided c-slice
+DMA, too-small blocks, missing pipelining. Sweep (c_blk, n_blk).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=16, n2=96, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def make_stats(N, C, HW, c_blk, n_blk):
+    def kernel(x_ref, s_ref, s2_ref, acc_s, acc_s2):
+        n = pl.program_id(1)
+        blk = x_ref[...].astype(jnp.float32)          # (n_blk, c_blk, HW)
+        part = jnp.sum(blk, axis=0)                   # (c_blk, HW)
+        part2 = jnp.sum(blk * blk, axis=0)
+
+        @pl.when(n == 0)
+        def _():
+            acc_s[...] = part
+            acc_s2[...] = part2
+
+        @pl.when(n > 0)
+        def _():
+            acc_s[...] += part
+            acc_s2[...] += part2
+
+        @pl.when(n == pl.num_programs(1) - 1)
+        def _():
+            s_ref[...] = jnp.sum(acc_s[...], axis=1, keepdims=True)
+            s2_ref[...] = jnp.sum(acc_s2[...], axis=1, keepdims=True)
+
+    @jax.jit
+    def stats(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(C // c_blk, N // n_blk),
+            in_specs=[pl.BlockSpec((n_blk, c_blk, HW), lambda c, n: (n, c, 0))],
+            out_specs=[pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0)),
+                       pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+            out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.float32)] * 2,
+            scratch_shapes=[pltpu.VMEM((c_blk, HW), jnp.float32),
+                            pltpu.VMEM((c_blk, HW), jnp.float32)],
+        )(x)
+    return stats
+
+
+def main():
+    N, C, H, W = 128, 64, 112, 112
+    HW = H * W
+    x = jnp.asarray(np.random.rand(N, C, HW), jnp.bfloat16)
+    nbytes = x.size * 2
+    chain = lambda x, m: x + (m * 1e-30).astype(x.dtype)
+
+    first = True
+    for c_blk, n_blk in [(64, 1), (64, 2), (64, 4), (32, 4), (64, 8)]:
+        stats = make_stats(N, C, HW, c_blk, n_blk)
+        if first:
+            s, s2 = stats(x)
+            ref_s = np.asarray(jnp.sum(x.astype(jnp.float32), axis=(0, 2)))
+            np.testing.assert_allclose(np.asarray(s)[:, 0], ref_s, rtol=2e-3)
+            print("numerics OK", flush=True)
+            first = False
+
+        def fn(c, stats=stats):
+            xx, _ = c
+            s, s2 = stats(xx)
+            return (chain(xx, s.sum() + s2.sum()), jnp.float32(0)), s.sum()
+        dt = timed(fn, (x, jnp.float32(0)))
+        blk_mb = n_blk * c_blk * HW * 2 / 1e6
+        print(f"c_blk={c_blk} n_blk={n_blk} ({blk_mb:.1f}MB/blk): "
+              f"{dt*1e3:.3f} ms  eff {nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""BN-stats kernel v3: sublane-reduce per block, tiny (c_blk, W) accs.
+
+v2 (micro_stats3b) was VMEM-bound: accumulating into full (c_blk, HW)
+fp32 scratch costs ~13MB VMEM r/w per 1.6MB HBM block. Here each grid
+step reduces its (c_blk, H, W) block over H — the sublane direction,
+the FAST reduce on TPU — and accumulates only (c_blk, W) fp32. The
+cross-lane reduce over W happens once per channel tile.
+
+Input stays natural NCHW 4D: no reshapes in or out of the kernel.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=16, n2=96, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def _pick_cblk(C, H, W, budget=3 * 1024 * 1024):
+    for cb in [C] + [c for c in (512, 256, 128, 64, 32, 16, 8) if c < C]:
+        if C % cb == 0 and cb * H * W * 2 <= budget:
+            return cb
+    return 8
+
+
+def make_stats(N, C, H, W, c_blk):
+    def kernel(x_ref, s_ref, s2_ref, acc_s, acc_s2):
+        n = pl.program_id(1)
+        blk = x_ref[0].astype(jnp.float32)      # (c_blk, H, W)
+        part = jnp.sum(blk, axis=1)             # sublane reduce -> (c_blk, W)
+        part2 = jnp.sum(blk * blk, axis=1)
+
+        @pl.when(n == 0)
+        def _():
+            acc_s[...] = part
+            acc_s2[...] = part2
+
+        @pl.when(n > 0)
+        def _():
+            acc_s[...] += part
+            acc_s2[...] += part2
+
+        @pl.when(n == pl.num_programs(1) - 1)
+        def _():
+            s_ref[...] = jnp.sum(acc_s[...], axis=1, keepdims=True)
+            s2_ref[...] = jnp.sum(acc_s2[...], axis=1, keepdims=True)
+
+    @jax.jit
+    def stats(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(C // c_blk, N),
+            in_specs=[pl.BlockSpec((1, c_blk, H, W), lambda c, n: (n, c, 0, 0))],
+            out_specs=[pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0)),
+                       pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+            out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.float32)] * 2,
+            scratch_shapes=[pltpu.VMEM((c_blk, W), jnp.float32),
+                            pltpu.VMEM((c_blk, W), jnp.float32)],
+        )(x)
+    return stats
+
+
+def make_bwd(N, C, H, W, c_blk):
+    def kernel(g_ref, x_ref, mean_ref, sg_ref, sgx_ref, acc_g, acc_gx):
+        n = pl.program_id(1)
+        g = g_ref[0].astype(jnp.float32)
+        xc = x_ref[0].astype(jnp.float32) - mean_ref[...]   # (c_blk,1,1) bcast
+        pg = jnp.sum(g, axis=1)
+        pgx = jnp.sum(g * xc, axis=1)
+
+        @pl.when(n == 0)
+        def _():
+            acc_g[...] = pg
+            acc_gx[...] = pgx
+
+        @pl.when(n > 0)
+        def _():
+            acc_g[...] += pg
+            acc_gx[...] += pgx
+
+        @pl.when(n == pl.num_programs(1) - 1)
+        def _():
+            sg_ref[...] = jnp.sum(acc_g[...], axis=1, keepdims=True)
+            sgx_ref[...] = jnp.sum(acc_gx[...], axis=1, keepdims=True)
+
+    @jax.jit
+    def bwd(g, x, mean):
+        return pl.pallas_call(
+            kernel,
+            grid=(C // c_blk, N),
+            in_specs=[pl.BlockSpec((1, c_blk, H, W), lambda c, n: (n, c, 0, 0)),
+                      pl.BlockSpec((1, c_blk, H, W), lambda c, n: (n, c, 0, 0)),
+                      pl.BlockSpec((c_blk, 1, 1), lambda c, n: (c, 0, 0))],
+            out_specs=[pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0)),
+                       pl.BlockSpec((c_blk, 1), lambda c, n: (c, 0))],
+            out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.float32)] * 2,
+            scratch_shapes=[pltpu.VMEM((c_blk, W), jnp.float32),
+                            pltpu.VMEM((c_blk, W), jnp.float32)],
+        )(g, x, mean.reshape(C, 1, 1))
+    return bwd
+
+
+def bench_shape(N, C, H, W):
+    x = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+    g = jnp.asarray(np.random.rand(N, C, H, W), jnp.bfloat16)
+    nbytes = x.size * 2
+    chain = lambda x, m: x + (m * 1e-30).astype(x.dtype)
+    c_blk = _pick_cblk(C, H, W)
+    print(f"--- ({N},{C},{H},{W}) c_blk={c_blk}", flush=True)
+
+    stats = make_stats(N, C, H, W, c_blk)
+    s, s2 = stats(x)
+    ref_s = np.asarray(jnp.sum(x.astype(jnp.float32), axis=(0, 2, 3)))
+    np.testing.assert_allclose(np.asarray(s)[:, 0], ref_s, rtol=2e-3)
+    ref_s2 = np.asarray(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 2, 3)))
+    np.testing.assert_allclose(np.asarray(s2)[:, 0], ref_s2, rtol=2e-3)
+    mean = jnp.asarray(ref_s / (N * H * W), jnp.float32)
+    bwd = make_bwd(N, C, H, W, c_blk)
+    sg, sgx = bwd(g, x, mean)
+    ref_sg = np.asarray(jnp.sum(g.astype(jnp.float32), axis=(0, 2, 3)))
+    ref_sgx = np.asarray(jnp.sum(
+        g.astype(jnp.float32) * (x.astype(jnp.float32) - mean.reshape(1, C, 1, 1)),
+        axis=(0, 2, 3)))
+    np.testing.assert_allclose(np.asarray(sg)[:, 0], ref_sg, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(sgx)[:, 0], ref_sgx, rtol=2e-3,
+                               atol=abs(ref_sgx).max() * 2e-3 + 1e-3)
+    print("numerics OK", flush=True)
+
+    def xla_fwd(c):
+        xx, _ = c
+        m = jnp.mean(xx, axis=(0, 2, 3), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(xx.astype(jnp.float32)), axis=(0, 2, 3))
+        return (chain(xx, m.sum() + m2.sum()), jnp.float32(0)), m.sum()
+    dt = timed(xla_fwd, (x, jnp.float32(0)))
+    print(f"XLA fwd : {dt*1e3:.3f} ms  {2*nbytes/dt/1e9:.0f} GB/s(2rd)", flush=True)
+
+    def pl_fwd(c):
+        xx, _ = c
+        s, s2 = stats(xx)
+        return (chain(xx, s.sum() + s2.sum()), jnp.float32(0)), s.sum()
+    dt = timed(pl_fwd, (x, jnp.float32(0)))
+    print(f"PAL fwd : {dt*1e3:.3f} ms  {nbytes/dt/1e9:.0f} GB/s(1rd)", flush=True)
+
+    def xla_bwd(c):
+        xx, _ = c
+        sg = jnp.sum(g, axis=(0, 2, 3), dtype=jnp.float32)
+        sgx = jnp.sum(g * xx, axis=(0, 2, 3), dtype=jnp.float32)
+        return (chain(xx, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(xla_bwd, (x, jnp.float32(0)))
+    print(f"XLA bwd : {dt*1e3:.3f} ms", flush=True)
+
+    def pl_bwd(c):
+        xx, _ = c
+        sg, sgx = bwd(g, xx, mean)
+        return (chain(xx, sg.sum() + sgx.sum()), jnp.float32(0)), sg.sum()
+    dt = timed(pl_bwd, (x, jnp.float32(0)))
+    print(f"PAL bwd : {dt*1e3:.3f} ms  {2*nbytes/dt/1e9:.0f} GB/s(2rd)", flush=True)
+
+
+def main():
+    import sys
+    which = sys.argv[1] if len(sys.argv) > 1 else "a"
+    if which == "a":
+        bench_shape(128, 64, 112, 112)
+    elif which == "b":
+        bench_shape(128, 256, 56, 56)
+    elif which == "c":
+        bench_shape(128, 1024, 14, 14)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,7 @@
 import glob, gzip, json, sys
 import jax, jax.numpy as jnp, numpy as np
 
-from perf_exp import make, step_fn
+from exp import make, step_fn
 
 
 def main():

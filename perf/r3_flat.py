@@ -1,0 +1,112 @@
+"""Round-3: fused (flat-buffer) optimizer + state carries.
+
+Hypothesis from the b128 profile: copy x208 (5.1ms) + multiply x204
+(7.7ms) + add (4.5ms) are per-leaf overhead on ~540 small carried
+tensors (SGD momentum axpys + scan-carry aliasing copies), not real
+bandwidth. Carrying ONE flat fp32 buffer each for params / momentum /
+BN-state and doing the optimizer as a single fused axpy should collapse
+those buckets.
+
+Usage: python perf/r3_flat.py {base|flatopt|flatall} [batch]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from exp import make, report, step_fn
+
+
+def flatten_spec(tree):
+    leaves = jax.tree.leaves(tree)
+    treedef = jax.tree.structure(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    return treedef, shapes, sizes, offs
+
+
+def to_flat(tree):
+    return jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in jax.tree.leaves(tree)])
+
+
+def from_flat(flat, spec):
+    treedef, shapes, sizes, offs = spec
+    parts = [lax.slice(flat, (offs[i],), (offs[i] + sizes[i],)).reshape(shapes[i])
+             for i in range(len(sizes))]
+    return jax.tree.unflatten(treedef, parts)
+
+
+def timed_scan(make_body, carry, n1=6, n2=18, reps=4):
+    def runner(n):
+        @jax.jit
+        def multi(carry):
+            out, losses = lax.scan(lambda c, _: make_body(c), carry, None, length=n)
+            return losses
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def main():
+    variant = sys.argv[1]
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    model, crit, method, params, mstate, ostate, x, y = make(batch)
+    lr, mu = 0.1, 0.9
+
+    if variant == "base":
+        dt = timed_scan(step_fn(model, crit, method),
+                        (params, mstate, ostate, x, y))
+        report(f"base b{batch}", dt, batch)
+        return
+
+    pspec = flatten_spec(params)
+    w0 = to_flat(params)
+    v0 = jnp.zeros_like(w0)
+
+    if variant == "flatopt":
+        def step(c):
+            w, v, ms, xx, yy = c
+            def loss_fn(wf):
+                p = from_flat(wf, pspec)
+                out, nms = model.apply(p, xx, state=ms, training=True)
+                return crit.forward(out.astype(jnp.float32), yy), nms
+            (loss, nms), gw = jax.value_and_grad(loss_fn, has_aux=True)(w)
+            nv = mu * v + gw
+            nw = w - lr * nv
+            return (nw, nv, nms, xx, yy), loss
+        dt = timed_scan(step, (w0, v0, mstate, x, y))
+        report(f"flatopt b{batch}", dt, batch)
+        return
+
+    if variant == "flatall":
+        sspec = flatten_spec(mstate)
+        s0 = to_flat(mstate)
+
+        def step(c):
+            w, v, s, xx, yy = c
+            ms = from_flat(s, sspec)
+            def loss_fn(wf):
+                p = from_flat(wf, pspec)
+                out, nms = model.apply(p, xx, state=ms, training=True)
+                return crit.forward(out.astype(jnp.float32), yy), nms
+            (loss, nms), gw = jax.value_and_grad(loss_fn, has_aux=True)(w)
+            nv = mu * v + gw
+            nw = w - lr * nv
+            return (nw, nv, to_flat(nms), xx, yy), loss
+        dt = timed_scan(step, (w0, v0, s0, x, y))
+        report(f"flatall b{batch}", dt, batch)
+        return
+
+
+if __name__ == "__main__":
+    main()

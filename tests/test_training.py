@@ -268,6 +268,7 @@ def test_plateau_min_lr_floors_lr():
 def test_orbax_checkpoint_roundtrip(tmp_path):
     import numpy as np
 
+    pytest.importorskip("orbax.checkpoint")
     from bigdl_tpu.utils.checkpoint import (
         load_checkpoint_orbax, save_checkpoint_orbax,
     )
