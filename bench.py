@@ -154,7 +154,11 @@ def main():
     class_num = 1000
     compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
-    model = resnet.build_imagenet(50, class_num)
+    # HWIO kernel storage: bit-identical math, saves the per-step OIHW
+    # layout staging around the fused conv+SGD kernels (~1% step time;
+    # round-3 HLO analysis in PERF_NOTES.md)
+    model = resnet.build_imagenet(50, class_num,
+                                  kernel_format="HWIO" if on_tpu else "OIHW")
     criterion = CrossEntropyCriterion()
     method = SGD(learning_rate=0.1, momentum=0.9)
 

@@ -1,0 +1,68 @@
+/*
+ * Executor-side feed producer for JVM (Scala/Java) Spark tasks.
+ *
+ * Wire protocol (see bigdl_tpu/dataset/feeder.py; byte layout pinned by
+ * tests/test_feeder.py::test_wire_format_conformance):
+ *
+ *   handshake:  8 bytes  "BDLFEED1"
+ *   per batch:  uint32 BE n_arrays, then per array: uint64 BE length +
+ *               that many bytes of a .npy (v1.0) serialization
+ *   end:        uint32 BE 0
+ *
+ * The .npy payloads here are C-order little-endian float32 / int32 with
+ * the standard 10/6-byte magic+header; numpy on the host reads them with
+ * np.load. Call fromPartition() inside rdd.mapPartitions.
+ */
+import java.io.DataOutputStream;
+import java.net.Socket;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
+public final class JvmFeedProducer implements AutoCloseable {
+    private final Socket sock;
+    private final DataOutputStream out;
+
+    public JvmFeedProducer(String host, int port) throws Exception {
+        sock = new Socket(host, port);
+        out = new DataOutputStream(sock.getOutputStream());
+        out.write("BDLFEED1".getBytes(StandardCharsets.US_ASCII));
+    }
+
+    /** One batch = one float32 feature array + one int32 label array. */
+    public void push(float[] features, int[] featShape,
+                     int[] labels) throws Exception {
+        out.writeInt(2);                       // n_arrays, uint32 BE
+        byte[] f = npy(featShape, features, null);
+        out.writeLong(f.length);               // uint64 BE
+        out.write(f);
+        byte[] l = npy(new int[]{labels.length}, null, labels);
+        out.writeLong(l.length);
+        out.write(l);
+    }
+
+    @Override public void close() throws Exception {
+        out.writeInt(0);                       // end-of-stream frame
+        out.flush();
+        sock.close();
+    }
+
+    /** Minimal .npy v1.0 writer (C-order, little-endian). */
+    private static byte[] npy(int[] shape, float[] f, int[] i) {
+        StringBuilder dims = new StringBuilder();
+        for (int d : shape) dims.append(d).append(",");
+        String hdr = "{'descr': '" + (f != null ? "<f4" : "<i4")
+                + "', 'fortran_order': False, 'shape': (" + dims + "), }";
+        int pad = 64 - ((10 + hdr.length() + 1) % 64);
+        hdr = hdr + " ".repeat(pad) + "\n";
+        int n = f != null ? f.length : i.length;
+        ByteBuffer buf = ByteBuffer.allocate(10 + hdr.length() + 4 * n);
+        buf.put((byte) 0x93).put("NUMPY".getBytes(StandardCharsets.US_ASCII));
+        buf.put((byte) 1).put((byte) 0);
+        buf.order(ByteOrder.LITTLE_ENDIAN).putShort((short) hdr.length());
+        buf.put(hdr.getBytes(StandardCharsets.US_ASCII));
+        if (f != null) for (float v : f) buf.putFloat(v);
+        else for (int v : i) buf.putInt(v);
+        return buf.array();
+    }
+}

@@ -402,7 +402,7 @@ def _weights_to_ours(module, tensors: List[np.ndarray]) -> Dict[str, np.ndarray]
         w = tensors[0]
         if w.ndim == 5:  # (g, o/g, i/g, kh, kw) -> (o, i/g, kh, kw)
             w = w.reshape((-1,) + w.shape[2:])
-        out["weight"] = w
+        out["weight"] = module.weight_from_oihw(w)
     elif isinstance(module, nn.TemporalConvolution):
         w = tensors[0]
         if w.ndim == 2:  # (out, kw*in) frame-major -> (out, in, kw)
@@ -421,6 +421,7 @@ def _weights_from_ours(module, params: Dict[str, Any]) -> List[np.ndarray]:
         return []
     w = np.asarray(params["weight"], np.float32)
     if isinstance(module, nn.SpatialConvolution):
+        w = np.asarray(module.weight_as_oihw(w))
         o, ig, kh, kw = w.shape
         g = module.n_group
         w = w.reshape(g, o // g, ig, kh, kw)

@@ -103,7 +103,7 @@ class CaffePersister:
             cp.pad_h, cp.pad_w = ph, pw
             cp.group = m.n_group
             cp.bias_term = m.with_bias
-            _add_blob(layer, _np(p["weight"]))
+            _add_blob(layer, _np(m.weight_as_oihw(p["weight"])))
             if m.with_bias:
                 _add_blob(layer, _np(p["bias"]))
             return name

@@ -89,7 +89,8 @@ class ONNXExporter:
             return self._node("Gemm", inputs, "gemm", transB=1)
 
         if type(m) in (nn.SpatialConvolution, nn.SpatialShareConvolution):
-            w = self._init(p["weight"], "weight")  # OIHW — onnx native
+            # OIHW is onnx-native; HWIO storage transposes on export
+            w = self._init(m.weight_as_oihw(p["weight"]), "weight")
             inputs = [x, w]
             if m.with_bias:
                 inputs.append(self._init(p["bias"], "bias"))

@@ -380,7 +380,63 @@ _OPS: Dict[str, Callable] = {
     "Any": _reduction(jnp.any),
     "ZerosLike": lambda i, n, c: jnp.zeros_like(i[0]),
     "OnesLike": lambda i, n, c: jnp.ones_like(i[0]),
+    # --- long tail (reference DL/utils/tf/loaders coverage, MIGRATION.md) ---
+    "ApproximateEqual": lambda i, n, c: jnp.abs(i[0] - i[1]) < _attr_f(n, "tolerance", 1e-5),
+    "Digamma": lambda i, n, c: jax.scipy.special.digamma(i[0]),
+    "Lgamma": lambda i, n, c: jax.scipy.special.gammaln(i[0]),
+    "Erf": lambda i, n, c: jax.scipy.special.erf(i[0]),
+    "Erfc": lambda i, n, c: jax.scipy.special.erfc(i[0]),
+    "Expm1": lambda i, n, c: jnp.expm1(i[0]),
+    "Inv": lambda i, n, c: 1.0 / i[0],
+    "IsFinite": lambda i, n, c: jnp.isfinite(i[0]),
+    "IsInf": lambda i, n, c: jnp.isinf(i[0]),
+    "IsNan": lambda i, n, c: jnp.isnan(i[0]),
+    "Mod": lambda i, n, c: jnp.mod(i[0], i[1]),
+    "TruncateMod": lambda i, n, c: jnp.fmod(i[0], i[1]),
+    "TruncateDiv": lambda i, n, c: jnp.trunc(i[0] / i[1]).astype(i[0].dtype)
+    if jnp.issubdtype(i[0].dtype, jnp.integer) else jnp.trunc(i[0] / i[1]),
+    "Rint": lambda i, n, c: jnp.round(i[0]),
+    "L2Loss": lambda i, n, c: 0.5 * jnp.sum(jnp.square(i[0])),
+    "TopK": lambda i, n, c: lax.top_k(i[0], int(n.attr["k"].i)),
+    "InTopK": lambda i, n, c: jnp.any(
+        lax.top_k(i[0], int(n.attr["k"].i))[1]
+        == i[1].astype(jnp.int32)[:, None], axis=1),
+    "SegmentSum": lambda i, n, c: jax.ops.segment_sum(
+        i[0], i[1].astype(jnp.int32)),
+    "SoftmaxCrossEntropyWithLogits": lambda i, n, c: (
+        -jnp.sum(i[1] * jax.nn.log_softmax(i[0], axis=-1), axis=-1),
+        i[1] - jax.nn.softmax(i[0], axis=-1),  # (loss, backprop) outputs
+    ),
+    "LRN": lambda i, n, c: _lrn(i, n),
+    "ResizeBilinear": lambda i, n, c: jax.image.resize(
+        i[0], (i[0].shape[0], int(np.asarray(i[1])[0]),
+               int(np.asarray(i[1])[1]), i[0].shape[3]),
+        method="bilinear"),
+    "Conv3D": lambda i, n, c: _conv3d(i, n),
+    "Assert": lambda i, n, c: None,  # graph-mode assert: no-op at import
 }
+
+
+def _lrn(i, n):
+    # TF LRN is NHWC cross-channel: alpha is per-element (not /size)
+    depth_radius = int(n.attr["depth_radius"].i or 5)
+    bias = _attr_f(n, "bias", 1.0)
+    alpha = _attr_f(n, "alpha", 1.0)
+    beta = _attr_f(n, "beta", 0.5)
+    size = 2 * depth_radius + 1
+    sq = jnp.square(i[0])
+    window = lax.reduce_window(
+        sq, 0.0, lax.add, (1, 1, 1, size), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (0, 0), (depth_radius, depth_radius)])
+    return i[0] / (bias + alpha * window) ** beta
+
+
+def _conv3d(i, n):
+    strides = tuple(int(s) for s in n.attr["strides"].list.i)[1:4]
+    pad = n.attr["padding"].s.decode()
+    return lax.conv_general_dilated(
+        i[0], i[1], strides, pad,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
 # weights smaller than this stay inline constants; larger ones are lifted
 # into the params tree
@@ -522,12 +578,12 @@ class TFGraphModule(Module):
                 if idx < 0:
                     continue
                 v = values[base]
-                args.append(v[idx] if isinstance(v, tuple) else v)
+                args.append(v[idx] if isinstance(v, (tuple, list)) else v)
             values[name] = fn(args, node, ctx)
         outs = []
         for base, idx in self.output_refs:
             v = values[base]
-            outs.append(v[idx] if isinstance(v, tuple) else v)
+            outs.append(v[idx] if isinstance(v, (tuple, list)) else v)
         return outs[0] if len(outs) == 1 else tuple(outs)
 
 

@@ -38,12 +38,15 @@ class DefinitionLoader:
             return DefinitionLoader.from_json_str(f.read())
 
     @staticmethod
-    def from_json_str(text: str) -> "keras.Sequential":
+    def from_json_str(text: str):
         spec = json.loads(text)
-        if spec.get("class_name") != "Sequential":
+        cls = spec.get("class_name")
+        if cls in ("Model", "Functional"):
+            return DefinitionLoader._from_functional(spec)
+        if cls != "Sequential":
             raise ValueError(
-                f"only Sequential models are supported, got "
-                f"{spec.get('class_name')!r} (reference converter scope)")
+                f"only Sequential and functional Model graphs are "
+                f"supported, got {cls!r} (reference converter scope)")
         layers_cfg = spec["config"]
         if isinstance(layers_cfg, dict):  # keras 2.x nests under "layers"
             layers_cfg = layers_cfg["layers"]
@@ -53,6 +56,67 @@ class DefinitionLoader:
             if layer is not None:
                 model.add(layer)
         return model
+
+    # keras-2 merge classes -> keras-1 Merge modes
+    _MERGE_MODES = {"Add": "sum", "Multiply": "mul", "Average": "ave",
+                    "Maximum": "max", "Minimum": "min",
+                    "Concatenate": "concat"}
+
+    @staticmethod
+    def _from_functional(spec) -> "keras.Model":
+        """Functional ``Model`` graphs (reference ``DefinitionLoader``
+        handles graph models via inbound_nodes topology): each layer entry
+        wires to its parents by name; InputLayers become
+        :func:`keras.Input` nodes.
+
+        Scope notes: shared layers (multiple inbound node indices) are out
+        of scope like the reference; merge layers map onto
+        :class:`keras.Merge`."""
+        cfg = spec["config"]
+        nodes: Dict[str, object] = {}
+        for lc in cfg["layers"]:
+            name = lc.get("name") or lc["config"].get("name")
+            cls = lc["class_name"]
+            inbound = lc.get("inbound_nodes") or []
+            if cls == "InputLayer" or not inbound:
+                shape = (lc["config"].get("batch_input_shape")
+                         or lc["config"].get("batch_shape"))
+                nodes[name] = keras.Input(
+                    shape=tuple(int(d) for d in shape[1:]), name=name)
+                continue
+            first = inbound[0]
+            if isinstance(first, dict):  # keras-3 {"args": [...]} form
+                raise ValueError(
+                    "keras-3 functional JSON is not supported; re-save the "
+                    "model with tf.keras (legacy h5/json)")
+            if len(inbound) > 1:
+                raise ValueError(
+                    f"layer {name!r} is shared ({len(inbound)} call sites); "
+                    "shared layers are out of scope (reference converter "
+                    "scope)")
+            parents = [nodes[p[0]] for p in first]
+            if cls == "Merge":
+                layer = keras.Merge(
+                    mode=lc["config"].get("mode", "sum"),
+                    concat_axis=lc["config"].get("concat_axis", -1))
+            elif cls in DefinitionLoader._MERGE_MODES:
+                layer = keras.Merge(
+                    mode=DefinitionLoader._MERGE_MODES[cls],
+                    concat_axis=lc["config"].get("axis", -1))
+            else:
+                layer = DefinitionLoader._convert_layer(lc)
+            if name:
+                layer.set_name(name)
+            nodes[name] = layer(parents) if len(parents) > 1 \
+                else layer(parents[0])
+
+        def endpoints(key):
+            return [nodes[entry[0]] for entry in cfg[key]]
+
+        inputs = endpoints("input_layers")
+        outputs = endpoints("output_layers")
+        return keras.Model(inputs[0] if len(inputs) == 1 else inputs,
+                           outputs[0] if len(outputs) == 1 else outputs)
 
     @staticmethod
     def _convert_layer(lc: Dict):
@@ -245,12 +309,13 @@ def load_keras(json_path: Optional[str] = None,
             return False
 
         sub = tree
-        for part in ("seq", name):
-            if isinstance(sub, dict) and part in sub:
-                sub = sub[part]
-            elif part != "seq":
-                return False
-        return merge(sub)
+        for root in ("seq", "graph"):  # Sequential / functional Model
+            if isinstance(sub, dict) and root in sub:
+                sub = sub[root]
+                break
+        if not (isinstance(sub, dict) and name in sub):
+            return False
+        return merge(sub[name])
 
     import jax
 

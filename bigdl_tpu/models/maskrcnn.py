@@ -265,6 +265,10 @@ def main(argv=None):
     ap.add_argument("--minSize", type=int, default=800)
     ap.add_argument("--maxSize", type=int, default=1333)
     ap.add_argument("--nImages", type=int, default=4)
+    ap.add_argument("--cocoMap", action="store_true",
+                    help="evaluate: report COCO-style box+mask mAP@[.5:.95] "
+                         "(reference MeanAveragePrecisionObjectDetection, "
+                         "ValidationMethod.scala:675) instead of AP@0.5")
     args = ap.parse_args(argv)
 
     model = build(args.numClasses, args.depth)
@@ -293,16 +297,44 @@ def main(argv=None):
                       f"mask_px={int(out['masks'][k].sum())}")
         return out
 
-    # evaluate: AP@0.5 of (random-weight) detections vs synthetic truth
+    # evaluate: (random-weight) detections vs synthetic truth
     rng = np.random.RandomState(1)
-    dets, gts = [], []
+    dets, gts, cdets, cgts = [], [], [], []
     for _ in range(args.nImages):
         img = (rng.rand(160, 200, 3) * 255).astype(np.uint8)
         out = predictor.predict(img)
         keep = np.asarray(out["valid"]).astype(bool)
         dets.append((out["boxes"][keep], out["scores"][keep]))
-        gts.append(np.asarray([[10, 10, 60, 60], [80, 40, 150, 120]],
-                              np.float32))
+        gt_boxes = np.asarray([[10, 10, 60, 60], [80, 40, 150, 120]],
+                              np.float32)
+        gts.append(gt_boxes)
+        if args.cocoMap:
+            h, w = img.shape[:2]
+
+            def box_mask(b):
+                m = np.zeros((h, w), bool)
+                m[int(b[1]):int(b[3]), int(b[0]):int(b[2])] = True
+                return m
+
+            cdets.append({
+                "boxes": out["boxes"][keep], "scores": out["scores"][keep],
+                "labels": np.asarray(out["labels"])[keep],
+                "masks": [np.asarray(m) > 0.5
+                          for m, k in zip(out["masks"], keep) if k],
+            })
+            cgts.append({
+                "boxes": gt_boxes, "labels": np.ones(len(gt_boxes), int),
+                "masks": [box_mask(b) for b in gt_boxes],
+            })
+    if args.cocoMap:
+        from bigdl_tpu.optim.validation import coco_detection_map
+
+        box_map = coco_detection_map(cdets, cgts, args.numClasses)
+        mask_map = coco_detection_map(cdets, cgts, args.numClasses,
+                                      masks=True)
+        print(f"box mAP@[.5:.95]: {box_map:.4f}  "
+              f"mask mAP@[.5:.95]: {mask_map:.4f} over {args.nImages} images")
+        return box_map, mask_map
     ap_val = detection_average_precision(dets, gts, iou_threshold=0.5)
     print(f"AP@0.5: {ap_val:.4f} over {args.nImages} images")
     return ap_val

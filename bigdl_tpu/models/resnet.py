@@ -21,10 +21,12 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.init import MsraFiller, Zeros
 
 
-def _conv(cin, cout, k, stride=1, pad=0, data_format="NCHW"):
+def _conv(cin, cout, k, stride=1, pad=0, data_format="NCHW",
+          kernel_format="OIHW"):
     return nn.SpatialConvolution(
         cin, cout, k, k, stride, stride, pad, pad,
         with_bias=False, weight_init=MsraFiller(), data_format=data_format,
+        kernel_format=kernel_format,
     )
 
 
@@ -36,13 +38,14 @@ def _bn(n, zero_init=False, data_format="NCHW"):
 
 
 def shortcut(cin: int, cout: int, stride: int, shortcut_type: str = "B",
-             data_format: str = "NCHW") -> nn.Module:
+             data_format: str = "NCHW", kernel_format: str = "OIHW") -> nn.Module:
     """Shortcut types (reference ``ResNet.scala`` ``shortcut``):
     A = identity/zero-pad (CIFAR), B = 1x1 conv when shape changes,
     C = always 1x1 conv."""
     use_conv = shortcut_type == "C" or (shortcut_type == "B" and (cin != cout or stride != 1))
     if use_conv:
-        return nn.Sequential(_conv(cin, cout, 1, stride, data_format=data_format),
+        return nn.Sequential(_conv(cin, cout, 1, stride, data_format=data_format,
+                                   kernel_format=kernel_format),
                              _bn(cout, data_format=data_format))
     if cin != cout:
         # type A: stride then zero-pad channels (Pad on channel dim)
@@ -57,17 +60,17 @@ def shortcut(cin: int, cout: int, stride: int, shortcut_type: str = "B",
 
 def basic_block(cin: int, cout: int, stride: int, shortcut_type: str = "B",
                 zero_init_residual: bool = False,
-                data_format: str = "NCHW") -> nn.Module:
-    df = data_format
+                data_format: str = "NCHW", kernel_format: str = "OIHW") -> nn.Module:
+    df, kf = data_format, kernel_format
     block = nn.Sequential(
-        _conv(cin, cout, 3, stride, 1, data_format=df),
+        _conv(cin, cout, 3, stride, 1, data_format=df, kernel_format=kf),
         _bn(cout, data_format=df),
         nn.ReLU(),
-        _conv(cout, cout, 3, 1, 1, data_format=df),
+        _conv(cout, cout, 3, 1, 1, data_format=df, kernel_format=kf),
         _bn(cout, zero_init=zero_init_residual, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df)),
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df, kf)),
         nn.CAddTable(),
         nn.ReLU(),
     )
@@ -75,21 +78,21 @@ def basic_block(cin: int, cout: int, stride: int, shortcut_type: str = "B",
 
 def bottleneck(cin: int, planes: int, stride: int, shortcut_type: str = "B",
                zero_init_residual: bool = False,
-               data_format: str = "NCHW") -> nn.Module:
-    df = data_format
+               data_format: str = "NCHW", kernel_format: str = "OIHW") -> nn.Module:
+    df, kf = data_format, kernel_format
     cout = planes * 4
     block = nn.Sequential(
-        _conv(cin, planes, 1, data_format=df),
+        _conv(cin, planes, 1, data_format=df, kernel_format=kf),
         _bn(planes, data_format=df),
         nn.ReLU(),
-        _conv(planes, planes, 3, stride, 1, data_format=df),
+        _conv(planes, planes, 3, stride, 1, data_format=df, kernel_format=kf),
         _bn(planes, data_format=df),
         nn.ReLU(),
-        _conv(planes, cout, 1, data_format=df),
+        _conv(planes, cout, 1, data_format=df, kernel_format=kf),
         _bn(cout, zero_init=zero_init_residual, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df)),
+        nn.ConcatTable(block, shortcut(cin, cout, stride, shortcut_type, df, kf)),
         nn.CAddTable(),
         nn.ReLU(),
     )
@@ -106,7 +109,8 @@ IMAGENET_CFG = {
 
 def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = "B",
                    zero_init_residual: bool = True,
-                   data_format: str = "NCHW") -> nn.Sequential:
+                   data_format: str = "NCHW",
+                   kernel_format: str = "OIHW") -> nn.Sequential:
     """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch).
 
     ``data_format="NHWC"`` builds the TPU-preferred channels-last variant
@@ -118,10 +122,11 @@ def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = 
     kind, counts = IMAGENET_CFG[depth]
     block = basic_block if kind == "basic" else bottleneck
     expansion = 1 if kind == "basic" else 4
-    df = data_format
+    df, kf = data_format, kernel_format
 
     model = nn.Sequential(
-        _conv(3, 64, 7, 2, 3, data_format=df).set_name("conv1"),
+        _conv(3, 64, 7, 2, 3, data_format=df,
+              kernel_format=kf).set_name("conv1"),
         _bn(64, data_format=df),
         nn.ReLU(),
         nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, data_format=df),
@@ -131,7 +136,8 @@ def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = 
         for i in range(n_blocks):
             stride = 2 if (stage > 0 and i == 0) else 1
             model.add(
-                block(cin, planes, stride, shortcut_type, zero_init_residual, df),
+                block(cin, planes, stride, shortcut_type, zero_init_residual,
+                      df, kf),
                 name=f"layer{stage + 1}_{i}",
             )
             cin = planes * expansion

@@ -527,3 +527,156 @@ class DetectionOutputSSD(Module):
         sel_valid = valid.reshape(-1)
         top_scores, order = lax.top_k(sel_scores, self.keep_top_k)
         return (sel_boxes[order], top_scores, sel_labels[order], sel_valid[order])
+
+
+def scale_bbox(boxes: jax.Array, scale_h: float, scale_w: float) -> jax.Array:
+    """Scale (x1, y1, x2, y2) boxes (reference ``BboxUtil.scaleBBox``)."""
+    return jnp.stack([boxes[:, 0] * scale_w, boxes[:, 1] * scale_h,
+                      boxes[:, 2] * scale_w, boxes[:, 3] * scale_h], axis=1)
+
+
+def bbox_vote(kept_boxes: jax.Array, cand_boxes: jax.Array,
+              cand_scores: jax.Array, iou_threshold: float) -> jax.Array:
+    """Box voting (reference ``BboxUtil.bboxVote``): each kept box becomes
+    the score-weighted average of all candidate boxes overlapping it by
+    >= ``iou_threshold``. Vectorized: one (K, N) IoU matrix instead of the
+    reference's per-detection scan."""
+    iou = bbox_iou(kept_boxes, cand_boxes)            # (K, N)
+    w = jnp.where(iou >= iou_threshold, jnp.maximum(cand_scores, 0.0), 0.0)
+    den = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+    return (w @ cand_boxes) / den
+
+
+class Proposal(Module):
+    """Faster-RCNN RPN proposal layer (reference ``Proposal.scala``):
+    decode bbox deltas against (ratios x scales) anchors over the feature
+    grid, clip to the image, drop boxes smaller than ``min_size`` at the
+    original image scale, take the score top-k, NMS at 0.7, keep the
+    post-NMS top-k.
+
+    Input table: ``(cls_scores (1, 2A, H, W), bbox_deltas (1, 4A, H, W),
+    im_info (1, 4) = [height, width, scale_h, scale_w])`` — channel
+    layout matches the reference: scores = [background x A, object x A],
+    deltas = A blocks of (dx, dy, dw, dh).
+
+    TPU deviation (static shapes): returns ``(rois (K, 5), scores (K,),
+    valid (K,))`` with K = the post-NMS top-k for the current mode and
+    rois[:, 0] = batch index 0, instead of a variable-length tensor.
+    """
+
+    def __init__(self, pre_nms_topn_test: int = 6000,
+                 post_nms_topn_test: int = 300,
+                 ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8.0, 16.0, 32.0),
+                 pre_nms_topn_train: int = 12000,
+                 post_nms_topn_train: int = 2000,
+                 min_size: float = 16.0, nms_thresh: float = 0.7,
+                 stride: float = 16.0):
+        super().__init__()
+        self.anchor = Anchor(ratios, scales)
+        self.pre_nms_topn_test = pre_nms_topn_test
+        self.post_nms_topn_test = post_nms_topn_test
+        self.pre_nms_topn_train = pre_nms_topn_train
+        self.post_nms_topn_train = post_nms_topn_train
+        self.min_size = min_size
+        self.nms_thresh = nms_thresh
+        self.stride = stride
+
+    def forward(self, ctx: Context, x):
+        cls_scores, bbox_deltas, im_info = x
+        a = self.anchor.num_anchors
+        _, _, fh, fw = cls_scores.shape
+        # object scores are the second A channels (reference narrows to
+        # [A+1, 2A]); flatten in (h, w, a) order like transposeAndReshape
+        scores = cls_scores[0, a:].transpose(1, 2, 0).reshape(-1)
+        deltas = bbox_deltas[0].reshape(a, 4, fh, fw).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anchors = self.anchor.generate(fh, fw, self.stride)
+        boxes = bbox_decode(anchors, deltas)
+        im_h, im_w = im_info[0, 0], im_info[0, 1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1), jnp.clip(boxes[:, 1], 0, im_h - 1),
+            jnp.clip(boxes[:, 2], 0, im_w - 1), jnp.clip(boxes[:, 3], 0, im_h - 1),
+        ], axis=1)
+        min_h = self.min_size * im_info[0, 2]
+        min_w = self.min_size * im_info[0, 3]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_w) & \
+               ((boxes[:, 3] - boxes[:, 1] + 1) >= min_h)
+        scores = jnp.where(keep, scores, -jnp.inf)
+
+        pre = self.pre_nms_topn_train if ctx.training else self.pre_nms_topn_test
+        post = self.post_nms_topn_train if ctx.training else self.post_nms_topn_test
+        k = min(pre, scores.shape[0])
+        top_scores, top_idx = lax.top_k(scores, k)
+        top_boxes = boxes[top_idx]
+        keep_idx, valid = nms(top_boxes, top_scores, self.nms_thresh, post)
+        rois = jnp.where(valid[:, None], top_boxes[keep_idx], 0.0)
+        roi_scores = jnp.where(valid, top_scores[keep_idx], -jnp.inf)
+        rois5 = jnp.concatenate([jnp.zeros((post, 1), rois.dtype), rois], axis=1)
+        return rois5, roi_scores, valid
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN post-processing (reference ``DetectionOutputFrcnn.scala``):
+    unscale RoIs to raw image space, apply per-class box regression, clip,
+    per-class score threshold + NMS (skipping background class 0),
+    optional box voting, global cap at ``max_per_image`` detections.
+
+    Input table: ``(scores (N, n_classes) softmax probabilities,
+    box_deltas (N, 4*n_classes), rois (N, 5) from Proposal,
+    im_info (1, 4) = [height, width, scale_h, scale_w])``.
+
+    TPU deviation (static shapes): returns ``(boxes (K, 4), scores (K,),
+    labels (K,), valid (K,))`` with K = ``max_per_image``, matching
+    :class:`DetectionOutputSSD`'s convention, instead of the reference's
+    packed variable-length (1, 1 + 6*count) tensor.
+    """
+
+    def __init__(self, nms_thresh: float = 0.3, n_classes: int = 21,
+                 bbox_vote: bool = False, max_per_image: int = 100,
+                 thresh: float = 0.05):
+        super().__init__()
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.bbox_vote = bbox_vote
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+
+    def forward(self, ctx: Context, x):
+        scores, box_deltas, rois, im_info = x
+        n = scores.shape[0]
+        c = self.n_classes
+        raw = scale_bbox(rois[:, 1:5],
+                         1.0 / im_info[0, 2], 1.0 / im_info[0, 3])
+        im_h = im_info[0, 0] / im_info[0, 2]
+        im_w = im_info[0, 1] / im_info[0, 3]
+        # per-class decode: (C, N, 4)
+        deltas = box_deltas.reshape(n, c, 4).transpose(1, 0, 2)
+        all_boxes = jax.vmap(lambda d: bbox_clip(bbox_decode(raw, d),
+                                                 im_h, im_w))(deltas)
+        fg_boxes = all_boxes[1:]                     # drop background
+        fg_scores = scores[:, 1:].T                  # (C-1, N)
+        k = min(self.max_per_image, n)
+        idx, valid = jax.vmap(
+            lambda b, s: nms(b, s, self.nms_thresh, k, self.thresh)
+        )(fg_boxes, fg_scores)
+        sel_boxes = jnp.take_along_axis(
+            fg_boxes, jnp.maximum(idx, 0)[..., None], axis=1)   # (C-1, k, 4)
+        sel_scores = jnp.where(
+            valid, jnp.take_along_axis(fg_scores, jnp.maximum(idx, 0), 1),
+            -jnp.inf)
+        if self.bbox_vote:
+            cand_scores = jnp.where(fg_scores > self.thresh, fg_scores, 0.0)
+            sel_boxes = jax.vmap(bbox_vote, in_axes=(0, 0, 0, None))(
+                sel_boxes, fg_boxes, cand_scores, self.nms_thresh)
+        sel_labels = jnp.broadcast_to(
+            jnp.arange(1, c, dtype=jnp.int32)[:, None], idx.shape)
+        flat_scores = sel_scores.reshape(-1)
+        kk = min(self.max_per_image, flat_scores.shape[0])
+        top_scores, order = lax.top_k(flat_scores, kk)
+        pad = self.max_per_image - kk
+        boxes_out = jnp.pad(sel_boxes.reshape(-1, 4)[order], ((0, pad), (0, 0)))
+        return (boxes_out,
+                jnp.pad(top_scores, (0, pad), constant_values=-jnp.inf),
+                jnp.pad(sel_labels.reshape(-1)[order], (0, pad)),
+                jnp.pad(valid.reshape(-1)[order] & jnp.isfinite(top_scores),
+                        (0, pad)))

@@ -123,6 +123,10 @@ class QuantizedSpatialConvolution(Module):
         self.data_format = src.data_format
         self.dilation = getattr(src, "dilation", (1, 1))
         self.n_output_plane = src.n_output_plane
+        if getattr(src, "kernel_format", "OIHW") != "OIHW":
+            raise ValueError(
+                "quantization expects OIHW-stored conv weights; transpose "
+                "the params (SpatialConvolution.weight_as_oihw) first")
 
     @staticmethod
     def convert_params(float_params: Dict[str, Any]) -> Dict[str, Any]:

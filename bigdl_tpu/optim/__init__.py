@@ -35,6 +35,11 @@ from bigdl_tpu.optim.validation import (
     Loss,
     HitRatio,
     NDCG,
+    MeanAveragePrecision,
+    MeanAveragePrecisionObjectDetection,
+    coco_detection_map,
+    detection_average_precision,
+    mask_iou,
 )
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer, optimizer

@@ -246,6 +246,36 @@ class MeanAveragePrecision(ValidationMethod):
         return mean_ap * n, n
 
 
+def _ap_from_records(records, n_gt, use_voc2007=False):
+    """Average precision from (score, is_tp) match records against n_gt
+    ground truths; None when undefined (no records or no gts). The single
+    shared AP arithmetic for the PASCAL and COCO paths."""
+    import numpy as np
+
+    if not records or n_gt == 0:
+        return None
+    records = sorted(records, key=lambda r: -r[0])
+    tps = np.asarray([r[1] for r in records])
+    tp = np.cumsum(tps)
+    fp = np.cumsum(1 - tps)
+    recall = tp / n_gt
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    if use_voc2007:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11
+        return float(ap)
+    # VOC2010+/COCO-style: area under the monotone precision envelope,
+    # with (0, p) and (1, 0) sentinels so every recall segment counts
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
 def detection_average_precision(detections, groundtruths, iou_threshold=0.5,
                                 use_voc2007=False):
     """AP for one class of detections over a dataset (reference:
@@ -286,25 +316,207 @@ def detection_average_precision(detections, groundtruths, iou_threshold=0.5,
                 records.append((scores[i], 1.0))
             else:
                 records.append((scores[i], 0.0))
-    if not records or total_gt == 0:
-        return 0.0
-    records.sort(key=lambda r: -r[0])
-    tps = np.asarray([r[1] for r in records])
-    tp = np.cumsum(tps)
-    fp = np.cumsum(1 - tps)
-    recall = tp / total_gt
-    precision = tp / np.maximum(tp + fp, 1e-12)
-    if use_voc2007:
-        ap = 0.0
-        for t in np.arange(0.0, 1.1, 0.1):
-            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
-            ap += p / 11
-        return float(ap)
-    # VOC2010+/COCO-style: area under the monotone precision envelope,
-    # with (0, p) and (1, 0) sentinels so every recall segment counts
-    mrec = np.concatenate([[0.0], recall, [1.0]])
-    mpre = np.concatenate([[0.0], precision, [0.0]])
-    for i in range(len(mpre) - 2, -1, -1):
-        mpre[i] = max(mpre[i], mpre[i + 1])
-    idx = np.where(mrec[1:] != mrec[:-1])[0]
-    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+    ap = _ap_from_records(records, total_gt, use_voc2007)
+    return 0.0 if ap is None else ap
+
+
+def mask_iou(masks_a, masks_b):
+    """Pairwise IoU between binary mask stacks (N, H, W) x (M, H, W)
+    (reference ``MaskUtils.scala``; numpy host-side like the box path).
+    Intersections via one (N, P) @ (P, M) matmul — no (N, M, P) temporary,
+    so full-image masks stay cheap."""
+    import numpy as np
+
+    inter, area_a, area_b = _mask_inter_areas(masks_a, masks_b)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / np.maximum(union, 1e-9)
+
+
+def _mask_inter_areas(masks_a, masks_b):
+    """(inter (N, M), area_a (N,), area_b (M,)) for binary mask stacks."""
+    import numpy as np
+
+    a = np.stack([np.asarray(m, bool).reshape(-1) for m in masks_a])
+    b = np.stack([np.asarray(m, bool).reshape(-1) for m in masks_b])
+    inter = a.astype(np.float64) @ b.astype(np.float64).T
+    return inter, a.sum(-1).astype(np.float64), b.sum(-1).astype(np.float64)
+
+
+COCO_IOU_THRESHOLDS = tuple(round(0.5 + 0.05 * i, 2) for i in range(10))
+
+
+def _coco_pair_overlap(det, gt, order, gi, crowd, masks, d_m=None, g_m=None):
+    """(len(order), len(gi)) effective-overlap matrix: standard IoU against
+    normal ground truths, intersection-over-DETECTION-area against iscrowd
+    ones (the COCO crowd rule)."""
+    import numpy as np
+
+    if masks:
+        inter, area_d, area_g = _mask_inter_areas(
+            [d_m[i] for i in order], [g_m[j] for j in gi])
+    else:
+        a = np.asarray(det["boxes"], np.float64).reshape(-1, 4)[order]
+        b = np.asarray(gt["boxes"], np.float64).reshape(-1, 4)[gi]
+        area_d = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+        area_g = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+        lt = np.maximum(a[:, None, :2], b[None, :, :2])
+        rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+    union = np.maximum(area_d[:, None] + area_g[None, :] - inter, 1e-9)
+    iou = inter / union
+    ioa = inter / np.maximum(area_d[:, None], 1e-9)
+    return np.where(crowd[gi][None, :], ioa, iou)
+
+
+def _coco_accumulate(records, total_gt, det, gt, iou_thresholds, masks):
+    """Fold one image's detections into the (class, threshold) record
+    lists. ``records[(c, t)]`` collects (score, is_tp); crowd matches are
+    dropped (neither TP nor FP), crowd gts don't count as missable."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.segmentation import rle_decode
+
+    def to_mask(m):
+        return rle_decode(m) if isinstance(m, dict) else np.asarray(m, bool)
+
+    d_scores = np.asarray(det["scores"], np.float64).reshape(-1)
+    d_labels = np.asarray(det["labels"]).reshape(-1).astype(int)
+    g_labels = np.asarray(gt["labels"]).reshape(-1).astype(int)
+    n_classes = len(total_gt)
+    all_labels = np.concatenate([d_labels, g_labels])
+    if all_labels.size and (all_labels.min() < 0
+                            or all_labels.max() >= n_classes):
+        bad = int(all_labels.min() if all_labels.min() < 0
+                  else all_labels.max())
+        raise ValueError(
+            f"label {bad} outside [0, {n_classes}); labels must be "
+            "contiguous 0-based (COCODataset.cat_to_label remaps sparse "
+            "COCO category ids)")
+    crowd = np.asarray(gt.get("iscrowd", np.zeros(len(g_labels))),
+                       bool).reshape(-1)
+    d_m = [to_mask(m) for m in det["masks"]] if masks else None
+    g_m = [to_mask(m) for m in gt["masks"]] if masks else None
+
+    for c in np.unique(np.concatenate([d_labels, g_labels])):
+        di = np.where(d_labels == c)[0]
+        gi = np.where(g_labels == c)[0]
+        total_gt[int(c)] += int((~crowd[gi]).sum())
+        if len(di) == 0:
+            continue
+        order = di[np.argsort(-d_scores[di])]
+        if len(gi) == 0:
+            for t in iou_thresholds:
+                records[(int(c), t)].extend(
+                    (d_scores[i], 0.0) for i in order)
+            continue
+        ov = _coco_pair_overlap(det, gt, order, gi, crowd, masks, d_m, g_m)
+        g_crowd = crowd[gi]
+        for t in iou_thresholds:
+            taken = np.zeros(len(gi), bool)
+            rec = records[(int(c), t)]
+            for r, i in enumerate(order):
+                # prefer the best still-free non-crowd gt (COCO rule)
+                cand = np.where(~taken & ~g_crowd)[0]
+                j = cand[np.argmax(ov[r, cand])] if len(cand) else -1
+                if j >= 0 and ov[r, j] >= t:
+                    taken[j] = True
+                    rec.append((d_scores[i], 1.0))
+                elif g_crowd.any() and ov[r, g_crowd].max(initial=0.0) >= t:
+                    pass  # overlaps a crowd region: ignored, not a FP
+                else:
+                    rec.append((d_scores[i], 0.0))
+
+
+def _coco_summarize(records, total_gt, num_classes, iou_thresholds):
+    import numpy as np
+
+    aps = []
+    for c in range(num_classes):
+        if total_gt[c] == 0:
+            continue
+        per_t = [_ap_from_records(records[(c, t)], total_gt[c])
+                 for t in iou_thresholds]
+        per_t = [a if a is not None else 0.0 for a in per_t]
+        aps.append(float(np.mean(per_t)))
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def coco_detection_map(detections, groundtruths, num_classes,
+                       iou_thresholds=COCO_IOU_THRESHOLDS, masks=False):
+    """COCO-style mAP@[.5:.95] (reference
+    ``MeanAveragePrecisionObjectDetection``, ``ValidationMethod.scala:675``,
+    COCO branch incl. RLE masks): per-class AP averaged over classes and
+    over the 10 IoU thresholds. Crowd ground truths follow the COCO rule:
+    overlap against them is intersection-over-detection-area, matches are
+    ignored (neither TP nor FP), and they are not missable GTs.
+
+    ``detections``: per image dict with keys ``boxes (N,4)``, ``scores
+    (N,)``, ``labels (N,)`` and (``masks=True``) ``masks`` — list of N
+    binary (H, W) arrays or RLE dicts (``dataset/segmentation.py``).
+    ``groundtruths``: per image dict with ``boxes (M,4)``, ``labels (M,)``,
+    optional ``iscrowd (M,)`` and ``masks``.
+    Returns the scalar mAP.
+    """
+    import numpy as np
+
+    records = {(c, t): [] for c in range(num_classes) for t in iou_thresholds}
+    total_gt = np.zeros((num_classes,), np.int64)
+    for det, gt in zip(detections, groundtruths):
+        _coco_accumulate(records, total_gt, det, gt, iou_thresholds, masks)
+    return _coco_summarize(records, total_gt, num_classes, iou_thresholds)
+
+
+class MeanAveragePrecisionObjectDetection(ValidationMethod):
+    """Detection mAP validation method (reference
+    ``MeanAveragePrecisionObjectDetection``, ``ValidationMethod.scala:675``).
+    ``iou_thresholds=(0.5,)`` gives PASCAL-style AP@0.5; the default COCO
+    range gives mAP@[.5:.95]; ``masks=True`` scores segmentation (mask
+    IoU) instead of boxes.
+
+    Match records pool across ``batch`` calls (the reference merges raw
+    records through ValidationResult ``+``), and each call returns a
+    telescoping partial sum, so the framework's weighted average equals
+    the pooled whole-dataset mAP regardless of batch size."""
+
+    jit_safe = False
+
+    def __init__(self, num_classes: int,
+                 iou_thresholds=COCO_IOU_THRESHOLDS, masks: bool = False,
+                 name: str = None):
+        import numpy as np
+
+        self.num_classes = num_classes
+        self.iou_thresholds = tuple(iou_thresholds)
+        self.masks = masks
+        self.name = name or (
+            "MaskMAP@[.5:.95]" if masks else "MAP@[%.2f:%.2f]" %
+            (self.iou_thresholds[0], self.iou_thresholds[-1]))
+        self._records = {(c, t): [] for c in range(num_classes)
+                         for t in self.iou_thresholds}
+        self._total_gt = np.zeros((num_classes,), np.int64)
+        self._prev_sum = 0.0
+        self._n_seen = 0
+
+    def batch(self, output, target):
+        """Re-summarizing every call makes a full validation epoch
+        O(batches x records log records) host-side; for very large sets
+        prefer one batch() call over the whole prediction list."""
+        import numpy as np
+
+        before = (sum(len(r) for r in self._records.values()),
+                  int(self._total_gt.sum()))
+        for det, gt in zip(output, target):
+            _coco_accumulate(self._records, self._total_gt, det, gt,
+                             self.iou_thresholds, self.masks)
+        self._n_seen += len(output)
+        after = (sum(len(r) for r in self._records.values()),
+                 int(self._total_gt.sum()))
+        if after == before and self._n_seen != len(output):
+            pooled = self._prev_sum / max(self._n_seen - len(output), 1)
+        else:
+            pooled = _coco_summarize(self._records, self._total_gt,
+                                     self.num_classes, self.iou_thresholds)
+        contribution = pooled * self._n_seen - self._prev_sum
+        self._prev_sum = pooled * self._n_seen
+        return contribution, len(output)

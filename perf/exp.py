@@ -32,8 +32,9 @@ def report(name, dt, batch, mult=3):
           f"mfu={flops/dt/197e12:.3f}", flush=True)
 
 
-def make(batch, data_format="NCHW"):
-    model = resnet.build_imagenet(50, 1000, data_format=data_format)
+def make(batch, data_format="NCHW", kernel_format="OIHW"):
+    model = resnet.build_imagenet(50, 1000, data_format=data_format,
+                                  kernel_format=kernel_format)
     crit = CrossEntropyCriterion()
     method = SGD(learning_rate=0.1, momentum=0.9)
     params, mstate = model.init(jax.random.key(0))
@@ -54,6 +55,27 @@ def step_fn(model, crit, method):
         np_, nos = method.update(g, p, os_, jnp.int32(1))
         return (np_, nms, nos, xx, yy), loss
     return step
+
+
+def variant_fwdbwd(batch=128):
+    """fwd+bwd WITHOUT the optimizer update: params are loop-invariant, so
+    XLA hoists the per-step conv-weight layout copies out of the scan.
+    Gap vs full step = optimizer cost + per-step weight layout copies."""
+    model, crit, method, params, mstate, ostate, x, y = make(batch)
+
+    def step(c):
+        p, ms, xx, yy = c
+        def loss_fn(pp):
+            out, nms = model.apply(pp, xx, state=ms, training=True)
+            return crit.forward(out.astype(jnp.float32), yy), nms
+        (loss, nms), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        # chain grads into the carry via x so backward can't be elided,
+        # but do NOT update params (keeps them loop-invariant)
+        gsum = sum(jnp.float32(l).sum() for l in jax.tree.leaves(g))
+        xx = xx + (gsum * 1e-30).astype(xx.dtype)
+        return (p, nms, xx, yy), loss
+    dt = timed_scan(step, (params, mstate, x, y), n1=6, n2=18)
+    report(f"fwdbwd-noupd b{batch}", dt, batch)
 
 
 def main():
@@ -100,7 +122,17 @@ def main():
         model, crit, method, params, mstate, ostate, x, y = make(512, "NHWC")
         dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=2, n2=8)
         report("full-step-nhwc b512", dt, 512)
+    elif variant == "fwdbwd":
+        variant_fwdbwd(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+    elif variant.startswith("hwio"):
+        batch = int(variant[4:] or 128)
+        model, crit, method, params, mstate, ostate, x, y = make(
+            batch, kernel_format="HWIO")
+        dt = timed_scan(step_fn(model, crit, method),
+                        (params, mstate, ostate, x, y), n1=6, n2=18)
+        report(f"full-step-hwio b{batch}", dt, batch)
 
 
 if __name__ == "__main__":
     main()
+

@@ -1,0 +1,72 @@
+"""Dump the optimized HLO of one b128 train step; categorize copies/adds.
+
+The trace profile shows copy x208 (~5ms) and add_add fusions (~2.7ms)
+whose identity is unclear. The compiled HLO text has shapes + op
+provenance metadata — attribute the bytes.
+"""
+import re
+import sys
+from collections import defaultdict
+
+import jax
+import numpy as np
+
+from exp import make, step_fn
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    kf = sys.argv[2] if len(sys.argv) > 2 else "OIHW"
+    model, crit, method, params, mstate, ostate, x, y = make(batch, kernel_format=kf)
+    body = step_fn(model, crit, method)
+
+    @jax.jit
+    def multi(c):
+        c2, loss = jax.lax.scan(lambda cc, _: body(cc), c, None, length=8)
+        return loss
+
+    lowered = multi.lower((params, mstate, ostate, x, y))
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    with open("/tmp/step_hlo.txt", "w") as f:
+        f.write(txt)
+    print(f"HLO text: {len(txt)} bytes -> /tmp/step_hlo.txt", flush=True)
+
+    dt_bytes = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "pred": 1, "f16": 2,
+                "s8": 1, "u8": 1}
+
+    def shape_bytes(shape_str):
+        m = re.match(r"(\w+)\[([\d,]*)\]", shape_str)
+        if not m:
+            return 0
+        dt, dims = m.group(1), m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * dt_bytes.get(dt, 4)
+
+    # categorize copy/transpose/bitcast instructions by shape
+    pat = re.compile(r"%?(\S+?) = (\S+) (copy|transpose|bitcast-convert)\(")
+    copies = defaultdict(lambda: [0, 0])
+    for m in pat.finditer(txt):
+        sh = m.group(2)
+        b = shape_bytes(sh)
+        copies[(m.group(3), sh)][0] += b
+        copies[(m.group(3), sh)][1] += 1
+    top = sorted(copies.items(), key=lambda kv: -kv[1][0])[:25]
+    print("top copy/transpose by bytes (per 8-step scan body):")
+    for (op, sh), (b, n) in top:
+        print(f"  {op:10s} {sh:40s} x{n}  {b/1e6:8.1f} MB total")
+
+    # fusion roots named add_add / copy_subtract: find their shapes
+    for name in ("add_add_fusion", "copy_subtract_fusion", "convert_reduce_fusion"):
+        print(f"\n{name} definitions:")
+        for m in re.finditer(rf"%{name}[\.\d]* \(", txt):
+            start = m.start()
+            line = txt[txt.rfind("\n", 0, start) + 1: txt.find("\n", start)]
+            print("  " + line[:160])
+
+
+if __name__ == "__main__":
+    main()

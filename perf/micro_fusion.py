@@ -1,0 +1,95 @@
+"""Does fusing BN stat reductions into the conv epilogue hurt the conv?
+
+The b128 HLO shows XLA fuses conv + convert + square + both (0,2,3)
+reduces into ONE kernel (fused_computation.11). If the reduce epilogue
+forces a worse conv tiling, splitting them with optimization_barrier
+(conv at full speed + separate streaming stats) could net a win.
+
+Variants per shape: conv-only / conv+stats fused / conv+BARRIER+stats.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timed(fn, carry, n1=8, n2=40, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def run(N, Cin, Cout, H, W, k, stride, pad):
+    x = jnp.asarray(np.random.rand(N, Cin, H, W), jnp.bfloat16)
+    w = jnp.asarray(np.random.randn(Cout, Cin, k, k) * 0.05, jnp.bfloat16)
+    chain = lambda x, m: x + (m * 1e-30).astype(x.dtype)
+
+    def conv(xx, ww):
+        return lax.conv_general_dilated(
+            xx, ww, (stride, stride), [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def stats(y):
+        m = jnp.mean(y, axis=(0, 2, 3), dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=(0, 2, 3))
+        return m, m2
+
+    def conv_only(c):
+        xx, ww = c
+        y = conv(xx, ww)
+        s = jnp.float32(y).sum()  # forces the conv, cheap-ish epilogue
+        return (chain(xx, s), ww), s
+    dt0 = timed(conv_only, (x, w))
+
+    def fused(c):
+        xx, ww = c
+        y = conv(xx, ww)
+        m, m2 = stats(y)
+        s = m.sum() + m2.sum() + jnp.float32(y[0, 0, 0, 0])
+        return (chain(xx, s), ww), s
+    dt1 = timed(fused, (x, w))
+
+    def barrier(c):
+        xx, ww = c
+        y = conv(xx, ww)
+        y = lax.optimization_barrier(y)
+        m, m2 = stats(y)
+        s = m.sum() + m2.sum() + jnp.float32(y[0, 0, 0, 0])
+        return (chain(xx, s), ww), s
+    dt2 = timed(barrier, (x, w))
+
+    ho, wo = (H + 2 * pad - k) // stride + 1, (W + 2 * pad - k) // stride + 1
+    fl = 2 * N * Cout * ho * wo * Cin * k * k
+    print(f"({N},{Cin}->{Cout},{H}x{W},k{k}s{stride}): "
+          f"conv {dt0*1e3:.3f} ms ({fl/dt0/1e12:.0f}TF/s) | "
+          f"fused+stats {dt1*1e3:.3f} | barrier+stats {dt2*1e3:.3f}",
+          flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "a"
+    if which == "a":
+        run(128, 3, 64, 224, 224, 7, 2, 3)     # conv1
+    elif which == "b":
+        run(128, 64, 64, 56, 56, 3, 1, 1)      # layer1 3x3
+    elif which == "c":
+        run(128, 64, 256, 56, 56, 1, 1, 0)     # layer1 1x1 expand
+    elif which == "d":
+        run(128, 128, 128, 28, 28, 3, 1, 1)    # layer2 3x3
+
+
+if __name__ == "__main__":
+    main()

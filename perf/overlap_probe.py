@@ -1,0 +1,111 @@
+"""Evidence for compute/comm overlap of the dp gradient sync.
+
+Reference: the reference overlaps backward with layer-wise gradient sync
+(``DistriParameterSynchronizer.scala:66``, ``ParallelOptimizer.scala:481``).
+Under SPMD the analogue is XLA's async collectives: the TPU backend emits
+``all-reduce-start``/``all-reduce-done`` pairs and its latency-hiding
+scheduler places independent backward compute between them, so gradient
+communication rides under computation with no framework code.
+
+This probe AOT-compiles the DistriOptimizer-shaped dp train step for a
+REAL multi-chip TPU topology (v5e:2x2x1 via ``jax.experimental
+.topologies`` — no chips needed, the same compiler that runs on-device)
+and reports, per async collective pair, how many fusion/convolution
+instructions the final schedule placed BETWEEN start and done — >0 means
+the collective is overlapped with compute.
+
+Writes the summary to PERF_NOTES-overlap evidence; artifact at
+/tmp/overlap_hlo.txt.
+"""
+import re
+import sys
+
+import numpy as np
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "/root/repo")
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+
+    model = resnet.build_imagenet(50, 1000)
+    crit = CrossEntropyCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    params, mstate = model.init(jax.random.key(0))
+    ostate = method.init_state(params)
+
+    def step(params, mstate, ostate, x, y):
+        def loss_fn(p):
+            out, nms = model.apply(p, x, state=mstate, training=True)
+            return crit.forward(out.astype(jnp.float32), y), nms
+        (loss, nms), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        np_, nos = method.update(g, params, ostate, jnp.int32(1))
+        return np_, nms, nos, loss
+
+    return step, params, mstate, ostate
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2x1")
+    devs = topo.devices
+    mesh = Mesh(np.asarray(devs).reshape(len(devs)), ("dp",))
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+
+    step, params, mstate, ostate = build_step()
+    batch = 32 * len(devs)
+
+    def shaped(tree, sh):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype, sharding=sh),
+            tree)
+
+    args = (shaped(params, repl), shaped(mstate, repl), shaped(ostate, repl),
+            jax.ShapeDtypeStruct((batch, 3, 224, 224), jnp.bfloat16,
+                                 sharding=data),
+            jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=data))
+    lowered = jax.jit(step, out_shardings=(repl, repl, repl, repl)).lower(*args)
+    txt = lowered.compile().as_text()
+    with open("/tmp/overlap_hlo.txt", "w") as f:
+        f.write(txt)
+
+    lines = txt.splitlines()
+    starts = {}
+    pairs = []
+    compute_re = re.compile(r"= \S+ (fusion|convolution|dot)\(")
+    for i, ln in enumerate(lines):
+        m = re.search(r"%((all-reduce|reduce-scatter|all-gather)"
+                      r"-start[\w.\-]*) =", ln)
+        if m:
+            starts[m.group(1)] = i
+        m2 = re.search(r"-done[\w.\-]*\(%((?:all-reduce|reduce-scatter|"
+                       r"all-gather)-start[\w.\-]*)", ln)
+        if m2 and m2.group(1) in starts:
+            s = starts[m2.group(1)]
+            between = sum(1 for j in range(s + 1, i)
+                          if compute_re.search(lines[j]))
+            pairs.append((m2.group(1), i - s, between))
+    sync = len(re.findall(r"= \S+ all-reduce\(", txt))
+    overlapped = [p for p in pairs if p[2] > 0]
+    total_between = sum(p[2] for p in pairs)
+    print(f"devices: {len(devs)} (v5e:2x2x1 AOT)")
+    print(f"async collective pairs: {len(pairs)}; sync all-reduce: {sync}")
+    print(f"pairs with compute scheduled between start/done: "
+          f"{len(overlapped)}/{len(pairs)} "
+          f"(total compute ops inside windows: {total_between})")
+    for name, dist, between in sorted(pairs, key=lambda p: -p[2])[:12]:
+        print(f"  {name[:58]:58s} sched-dist={dist:5d} compute-between={between}")
+
+
+if __name__ == "__main__":
+    main()

@@ -214,3 +214,173 @@ def test_tree_nn_accuracy():
     tgt = jnp.asarray([[0, 1, 2], [1, 1, 2]])
     v, n = m.batch(out, tgt)
     assert n == 2 and int(v) == 1
+
+
+def test_proposal_layer_shapes_and_ranking():
+    """Proposal (reference Proposal.scala): the anchor whose objectness is
+    boosted must survive into the top rois; output is fixed-size with a
+    validity mask and batch-index column 0."""
+    import bigdl_tpu.nn as nn
+
+    prop = nn.Proposal(pre_nms_topn_test=50, post_nms_topn_test=8,
+                       ratios=[1.0], scales=[2.0], min_size=0.0,
+                       stride=16.0)
+    a = prop.anchor.num_anchors
+    assert a == 1
+    fh = fw = 4
+    rng = np.random.RandomState(0)
+    scores = rng.uniform(-2, -1, (1, 2 * a, fh, fw)).astype(np.float32)
+    scores[0, a, 2, 1] = 5.0  # strong object score at cell (h=2, w=1)
+    deltas = np.zeros((1, 4 * a, fh, fw), np.float32)
+    im_info = np.asarray([[64.0, 64.0, 1.0, 1.0]], np.float32)
+
+    params, state = prop.init(jax.random.key(0))
+    (rois5, roi_scores, valid), _ = prop.apply(
+        params, (scores, deltas, im_info), state=state, training=False)
+    rois5, roi_scores, valid = map(np.asarray, (rois5, roi_scores, valid))
+    assert rois5.shape == (8, 5) and valid.shape == (8,)
+    assert valid[0]  # at least the best proposal is valid
+    assert rois5[0, 0] == 0.0  # batch index column
+    # best roi is the anchor at cell (2, 1): center ~ ((1+.5)*16, (2+.5)*16)
+    cx = (rois5[0, 1] + rois5[0, 3]) / 2
+    cy = (rois5[0, 2] + rois5[0, 4]) / 2
+    assert abs(cx - 24.0) < 1e-3 and abs(cy - 40.0) < 1e-3
+    assert roi_scores[0] == 5.0
+
+
+def test_detection_output_frcnn():
+    """DetectionOutputFrcnn (reference DetectionOutputFrcnn.scala):
+    per-class NMS skipping background, score threshold, global ranking."""
+    import bigdl_tpu.nn as nn
+
+    det = nn.DetectionOutputFrcnn(nms_thresh=0.5, n_classes=3,
+                                  max_per_image=4, thresh=0.1)
+    # 3 rois (batch col + xyxy), identity deltas
+    rois = np.asarray([
+        [0, 10, 10, 20, 20],
+        [0, 11, 11, 21, 21],   # overlaps roi 0 heavily
+        [0, 40, 40, 60, 60],
+    ], np.float32)
+    n, c = 3, 3
+    deltas = np.zeros((n, 4 * c), np.float32)
+    scores = np.asarray([
+        # bg,  cls1, cls2
+        [0.05, 0.90, 0.05],
+        [0.10, 0.80, 0.10],   # same class, suppressed by NMS vs row 0
+        [0.05, 0.05, 0.90],
+    ], np.float32)
+    im_info = np.asarray([[100.0, 100.0, 1.0, 1.0]], np.float32)
+
+    params, state = det.init(jax.random.key(0))
+    (boxes, out_scores, labels, valid), _ = det.apply(
+        params, (scores, deltas, rois, im_info), state=state, training=False)
+    boxes, out_scores, labels, valid = map(
+        np.asarray, (boxes, out_scores, labels, valid))
+    assert boxes.shape == (4, 4) and labels.shape == (4,)
+    got = [(int(l), round(float(s), 2)) for l, s, v in
+           zip(labels, out_scores, valid) if v]
+    # detections: cls1 @0.9 (roi0), cls2 @0.9 (roi2); roi1 NMS-suppressed
+    assert (1, 0.9) in got and (2, 0.9) in got
+    assert (1, 0.8) not in got
+
+
+def test_coco_map_hand_computed():
+    """mAP@[.5:.95] on a hand-computed fixture: det2's IoU vs its GT is
+    exactly 0.81, so it is a TP at the 7 thresholds <= 0.80 (AP 1.0) and
+    a FP at the 3 above (AP 0.5): mAP = (7*1.0 + 3*0.5) / 10 = 0.85."""
+    from bigdl_tpu.optim.validation import coco_detection_map
+
+    dets = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 29, 29], [40, 40, 50, 50]],
+        "scores": [0.9, 0.8, 0.7],
+        "labels": [1, 1, 1],
+    }]
+    gts = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 30, 30]],
+        "labels": [1, 1],
+    }]
+    v = coco_detection_map(dets, gts, num_classes=2)
+    assert abs(v - 0.85) < 1e-6
+    # PASCAL-style single threshold
+    v50 = coco_detection_map(dets, gts, num_classes=2, iou_thresholds=(0.5,))
+    assert abs(v50 - 1.0) < 1e-6
+
+
+def test_coco_map_masks_and_crowd():
+    """Mask IoU scoring (RLE + binary inputs) and the COCO crowd rule:
+    a detection matching only a crowd region is ignored, not a FP."""
+    from bigdl_tpu.dataset.segmentation import rle_encode
+    from bigdl_tpu.optim.validation import coco_detection_map
+
+    def sq_mask(x1, y1, x2, y2, h=64, w=64):
+        m = np.zeros((h, w), bool)
+        m[y1:y2, x1:x2] = True
+        return m
+
+    dets = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 29, 29]],
+        "scores": [0.9, 0.8],
+        "labels": [1, 1],
+        "masks": [rle_encode(sq_mask(0, 0, 10, 10)), sq_mask(20, 20, 29, 29)],
+    }]
+    gts = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 30, 30]],
+        "labels": [1, 1],
+        "masks": [sq_mask(0, 0, 10, 10), sq_mask(20, 20, 30, 30)],
+    }]
+    v = coco_detection_map(dets, gts, num_classes=2, masks=True)
+    # mask IoU of det2 = 81/100 = 0.81: same 0.85 arithmetic as boxes
+    assert abs(v - 0.85) < 1e-6
+
+    # crowd: second GT is iscrowd -> not counted as a missable GT, and a
+    # detection overlapping only it is dropped rather than scored FP
+    gts_crowd = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 30, 30]],
+        "labels": [1, 1],
+        "iscrowd": [0, 1],
+    }]
+    dets_crowd = [{
+        "boxes": [[0, 0, 10, 10], [20, 20, 30, 30]],
+        "scores": [0.9, 0.8],
+        "labels": [1, 1],
+    }]
+    v = coco_detection_map(dets_crowd, gts_crowd, num_classes=2)
+    assert abs(v - 1.0) < 1e-6
+
+
+def test_coco_crowd_ioa_and_pooled_batches():
+    """COCO crowd rule: overlap vs a crowd GT is intersection-over-
+    DETECTION-area, so a small detection inside a big crowd region is
+    ignored entirely. And MeanAveragePrecisionObjectDetection pools match
+    records across batch() calls (batch-size invariant)."""
+    from bigdl_tpu.optim.validation import (
+        MeanAveragePrecisionObjectDetection, coco_detection_map,
+    )
+
+    # det 2 lies fully inside a 100x100 crowd region: IoU would be 0.0025
+    # (never ignored) but IoA = 1.0 (always ignored)
+    dets = [{
+        "boxes": [[0, 0, 10, 10], [50, 50, 55, 55]],
+        "scores": [0.9, 0.95],
+        "labels": [1, 1],
+    }]
+    gts = [{
+        "boxes": [[0, 0, 10, 10], [30, 30, 130, 130]],
+        "labels": [1, 1],
+        "iscrowd": [0, 1],
+    }]
+    assert abs(coco_detection_map(dets, gts, num_classes=2) - 1.0) < 1e-6
+
+    # pooled across batches == single-shot over the whole set
+    img_a = ({"boxes": [[0, 0, 10, 10], [20, 20, 30, 30]],
+              "scores": [0.9, 0.8], "labels": [1, 1]},
+             {"boxes": [[0, 0, 10, 10], [20, 20, 30, 30]], "labels": [1, 1]})
+    img_b = ({"boxes": [[0, 0, 10, 10], [40, 40, 50, 50]],
+              "scores": [0.95, 0.85], "labels": [1, 1]},
+             {"boxes": [[0, 0, 10, 10]], "labels": [1]})
+    whole = coco_detection_map([img_a[0], img_b[0]], [img_a[1], img_b[1]],
+                               num_classes=2)
+    m = MeanAveragePrecisionObjectDetection(2)
+    s1, n1 = m.batch([img_a[0]], [img_a[1]])
+    s2, n2 = m.batch([img_b[0]], [img_b[1]])
+    assert abs((s1 + s2) / (n1 + n2) - whole) < 1e-9

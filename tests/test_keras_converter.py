@@ -159,3 +159,75 @@ def test_unsupported_layer_raises():
     })
     with pytest.raises(ValueError, match="unsupported Keras layer"):
         DefinitionLoader.from_json_str(spec)
+
+
+def _functional_json():
+    """Two-branch functional graph: input -> (d_a, d_b) -> Merge(sum) -> out."""
+    return json.dumps({
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "inp",
+                 "config": {"name": "inp", "batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "d_a",
+                 "config": {"name": "d_a", "output_dim": 4, "activation": "relu"},
+                 "inbound_nodes": [[["inp", 0, 0]]]},
+                {"class_name": "Dense", "name": "d_b",
+                 "config": {"name": "d_b", "output_dim": 4},
+                 "inbound_nodes": [[["inp", 0, 0]]]},
+                {"class_name": "Merge", "name": "add",
+                 "config": {"name": "add", "mode": "sum"},
+                 "inbound_nodes": [[["d_a", 0, 0], ["d_b", 0, 0]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "output_dim": 2},
+                 "inbound_nodes": [[["add", 0, 0]]]},
+            ],
+            "input_layers": [["inp", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    })
+
+
+def test_functional_model_converts_with_by_name_weights(tmp_path):
+    """VERDICT round-2 item 7: graph Models convert (inbound_nodes
+    topology) and HDF5 weights load by layer name."""
+    rs = np.random.RandomState(3)
+    wa, ba = rs.randn(6, 4).astype("f4"), rs.randn(4).astype("f4")
+    wb, bb = rs.randn(6, 4).astype("f4"), rs.randn(4).astype("f4")
+    wo, bo = rs.randn(4, 2).astype("f4"), rs.randn(2).astype("f4")
+    h5 = str(tmp_path / "func.h5")
+    # h5 order deliberately scrambled: loading is by NAME, not position
+    _write_keras1_h5(h5, [("out", [wo, bo]), ("d_b", [wb, bb]),
+                          ("d_a", [wa, ba])])
+
+    model = load_keras(json_str=_functional_json(), hdf5_path=h5)
+    x = rs.rand(5, 6).astype("f4")
+    got = model.predict(x)
+    want = (np.maximum(x @ wa + ba, 0) + (x @ wb + bb)) @ wo + bo
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_keras2_merge_classes():
+    """keras-2 style: Concatenate with explicit axis instead of Merge."""
+    spec = json.dumps({
+        "class_name": "Functional",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "inp",
+                 "config": {"name": "inp", "batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "d1",
+                 "config": {"name": "d1", "units": 5},
+                 "inbound_nodes": [[["inp", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "name": "cat",
+                 "config": {"name": "cat", "axis": -1},
+                 "inbound_nodes": [[["inp", 0, 0, {}], ["d1", 0, 0, {}]]]},
+            ],
+            "input_layers": [["inp", 0, 0]],
+            "output_layers": [["cat", 0, 0]],
+        },
+    })
+    model = DefinitionLoader.from_json_str(spec)
+    out = model.predict(np.random.RandomState(4).rand(2, 3).astype("f4"))
+    assert out.shape == (2, 8)

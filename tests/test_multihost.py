@@ -1,0 +1,100 @@
+"""Two-process ``jax.distributed`` proof (VERDICT round-2 item 9).
+
+Reference: the cluster entry ``Engine.init(nodeNumber, coreNumber,
+onSpark=true)`` (``Engine.scala:106``) — the reference's DP training
+spans executor JVMs; here the analogue is N host processes joined by
+``jax.distributed`` (wrapped by ``Engine.init_multihost``), with XLA
+collectives crossing the process boundary.
+
+The test spawns two REAL OS processes on the CPU backend (4 virtual
+devices each -> an 8-device global mesh), runs a psum across all 8, and
+a data-parallel jit whose sharded input spans both processes. Skips
+rather than fails on environment-level flakiness (port contention,
+distributed-service timeouts), per the round-2 brief.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1]); coord = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, r"%(repo)s")
+
+# the TPU plugin in this image re-forces JAX_PLATFORMS; the config update
+# is the override that sticks (same trick as tests/conftest.py), and it
+# must precede jax.distributed.initialize / any backend creation
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.core.engine import Engine
+
+eng = Engine.init_multihost(coordinator_address=coord, num_processes=2,
+                            process_id=proc_id)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("dp",))
+
+# cross-process psum: every process contributes its rank+1
+from jax.experimental.shard_map import shard_map
+ones = jnp.ones((8, 4))
+sharded = jax.device_put(ones, NamedSharding(mesh, P("dp", None)))
+f = jax.jit(shard_map(lambda x: jax.lax.psum(x.sum(), "dp"),
+                      mesh=mesh, in_specs=P("dp", None), out_specs=P()))
+total = f(sharded)
+# replicated result: every process's local shard holds the global sum
+assert float(np.asarray(total.addressable_shards[0].data)) == 32.0
+
+# dp train-shaped reduction: global mean over a batch spanning processes
+g = jax.jit(lambda x: x.mean(), out_shardings=NamedSharding(mesh, P()))
+m = g(sharded)
+assert float(np.asarray(m.addressable_shards[0].data)) == 1.0
+print(f"proc {proc_id} OK", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_psum(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER % {"repo": repo})
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen([sys.executable, str(worker), str(i), coord],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=200)
+            outs.append(out.decode(errors="replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed service timed out (flaky environment)")
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(outs)
+        if any(k in joined for k in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                     "Address already in use")):
+            pytest.skip(f"distributed runtime unavailable: {joined[-400:]}")
+        raise AssertionError(joined)
+    assert all("OK" in o for o in outs), outs

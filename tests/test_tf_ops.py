@@ -201,3 +201,84 @@ def test_ops_star_export_surface():
 
     for name in ("AddOp", "Gather", "TopK", "Cond", "While", "BatchMatMul"):
         assert name in O.__all__ and hasattr(O, name)
+
+
+def test_tf_loader_long_tail_ops():
+    """Round-3 loader additions (MIGRATION.md coverage table): math tail,
+    L2Loss/TopK/InTopK/SegmentSum, TF-semantics LRN, numpy oracles."""
+    import numpy as np
+
+    from bigdl_tpu.interop.tf import tensorflow_pb2 as tfpb
+    from bigdl_tpu.interop.tf.loader import TFGraphModule, numpy_to_tensor
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(3, 5).astype(np.float32) + 0.1
+
+    g = tfpb.GraphDef()
+    g.node.add(name="x", op="Placeholder").attr["dtype"].type = tfpb.DT_FLOAT
+
+    def const(name, arr):
+        n = g.node.add(name=name, op="Const")
+        n.attr["value"].tensor.CopyFrom(numpy_to_tensor(arr))
+
+    g.node.add(name="erf", op="Erf", input=["x"])
+    g.node.add(name="expm1", op="Expm1", input=["x"])
+    g.node.add(name="lg", op="Lgamma", input=["x"])
+    g.node.add(name="l2", op="L2Loss", input=["x"])
+    const("den", np.full((3, 5), 0.3, np.float32))
+    g.node.add(name="mod", op="Mod", input=["x", "den"])
+    tk = g.node.add(name="topk", op="TopK", input=["x"])
+    tk.attr["k"].i = 2
+    const("seg", np.asarray([0, 0, 1], np.int64))
+    g.node.add(name="segsum", op="SegmentSum", input=["x", "seg"])
+
+    import jax
+
+    m = TFGraphModule(g, inputs=["x"],
+                      outputs=["erf", "expm1", "lg", "l2", "mod",
+                               "topk:0", "segsum"])
+    params, state = m.init(jax.random.key(0))
+    outs, _ = m.apply(params, x, state=state, training=False)
+    erf, expm1, lg, l2, mod, topv, segsum = [np.asarray(o) for o in outs]
+
+    from scipy import special
+
+    np.testing.assert_allclose(erf, special.erf(x), rtol=1e-5)
+    np.testing.assert_allclose(expm1, np.expm1(x), rtol=1e-5)
+    np.testing.assert_allclose(lg, special.gammaln(x), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(l2, 0.5 * np.sum(x * x), rtol=1e-5)
+    np.testing.assert_allclose(mod, np.mod(x, 0.3), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(topv, -np.sort(-x, axis=-1)[:, :2], rtol=1e-6)
+    want_seg = np.stack([x[0] + x[1], x[2]])
+    np.testing.assert_allclose(segsum[:2], want_seg, rtol=1e-5)
+
+
+def test_tf_loader_lrn_matches_formula():
+    import numpy as np
+
+    from bigdl_tpu.interop.tf import tensorflow_pb2 as tfpb
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(2, 4, 4, 6).astype(np.float32)  # NHWC
+    g = tfpb.GraphDef()
+    g.node.add(name="x", op="Placeholder").attr["dtype"].type = tfpb.DT_FLOAT
+    lrn = g.node.add(name="lrn", op="LRN", input=["x"])
+    lrn.attr["depth_radius"].i = 2
+    lrn.attr["bias"].f = 1.0
+    lrn.attr["alpha"].f = 0.5
+    lrn.attr["beta"].f = 0.75
+
+    import jax
+
+    m = TFGraphModule(g, inputs=["x"], outputs=["lrn"])
+    params, state = m.init(jax.random.key(0))
+    out, _ = m.apply(params, x, state=state, training=False)
+
+    # TF formula: out = x / (bias + alpha * sum_{d-r..d+r} x_d^2)^beta
+    want = np.empty_like(x)
+    for c in range(6):
+        lo, hi = max(0, c - 2), min(6, c + 3)
+        denom = (1.0 + 0.5 * np.sum(x[..., lo:hi] ** 2, axis=-1)) ** 0.75
+        want[..., c] = x[..., c] / denom
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-6)

@@ -282,3 +282,30 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
                                   params["layer"]["weight"])
     np.testing.assert_array_equal(los["momentum"], ostate["momentum"])
     assert meta["epoch"] == 3
+
+
+def test_async_checkpoint(tmp_path):
+    """save_checkpoint_async writes off-thread; result() returns the
+    path and the file round-trips identically to the sync writer."""
+    from bigdl_tpu.utils.checkpoint import (
+        load_checkpoint, save_checkpoint_async,
+    )
+
+    params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    ostate = {"m": np.ones((3,), np.float32)}
+    h = save_checkpoint_async(str(tmp_path), "it42", params,
+                              optim_state=ostate, meta={"iteration": 42})
+    p = h.result(timeout=30)
+    assert h.done()
+    payload, meta = load_checkpoint(p, {
+        "params": {"w": np.zeros((3, 4), np.float32)},
+        "optim_state": {"m": np.zeros((3,), np.float32)},
+    })
+    np.testing.assert_array_equal(payload["params"]["w"], params["w"])
+    np.testing.assert_array_equal(payload["optim_state"]["m"], ostate["m"])
+    assert meta["iteration"] == 42
+
+    # worker errors surface at result(), not silently
+    bad = save_checkpoint_async("/nonexistent-dir-xyz/\0bad", "t", params)
+    with pytest.raises(BaseException):
+        bad.result(timeout=30)
