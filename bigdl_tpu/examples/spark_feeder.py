@@ -76,10 +76,16 @@ def main(argv=None):
     ap.add_argument("--nBatches", type=int, default=4, help="batches/task")
     ap.add_argument("--batchSize", type=int, default=32)
     ap.add_argument("--maxEpoch", type=int, default=1)
+    ap.add_argument("--bindHost", default="127.0.0.1",
+                    help="host interface to listen on (use 0.0.0.0 for "
+                         "remote Spark executors)")
+    ap.add_argument("--feedHost", default=None,
+                    help="address executors connect to (this host's "
+                         "routable name when executors are remote)")
     args = ap.parse_args(argv)
 
     # host side: bind first so producers have a live port to hit
-    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=args.nProducers,
+    ds = SocketFeedDataSet((args.bindHost, 0), n_producers=args.nProducers,
                            epoch_size=args.nProducers * args.nBatches)
     host, port = ds.bound_address
 
@@ -88,8 +94,21 @@ def main(argv=None):
 
         sc = SparkContext.getOrCreate()
         spawn = None
+        # the Spark action must run CONCURRENTLY with the consumer:
+        # producers block in send() once the host queue + TCP buffers
+        # fill (backpressure), so a foreground collect() would deadlock
+        # before optimize() ever starts draining
+        import threading
+
+        spark_thread = threading.Thread(
+            target=run_spark,
+            args=(sc, args.feedHost or host, port, args.nProducers,
+                  args.nBatches, args.batchSize),
+            daemon=True)
+        spark_thread.start()
     except ImportError:
         sc = None
+        spark_thread = None
         # stand-in executors: separate PROCESSES, same closure
         ctx = multiprocessing.get_context("spawn")
         spawn = [
@@ -100,10 +119,6 @@ def main(argv=None):
         ]
         for p in spawn:
             p.start()
-
-    if sc is not None:
-        run_spark(sc, host, port, args.nProducers, args.nBatches,
-                  args.batchSize)
 
     model = nn.Sequential(
         nn.Linear(784, 64), nn.ReLU(), nn.Linear(64, 10), nn.LogSoftMax())
@@ -116,6 +131,8 @@ def main(argv=None):
     if spawn:
         for p in spawn:
             p.join(timeout=30)
+    if sc is not None and spark_thread is not None:
+        spark_thread.join(timeout=60)
 
     # sanity: the model saw real data (loss finite, params moved)
     leaf = np.asarray(params["0"]["weight"])

@@ -408,18 +408,17 @@ _OPS: Dict[str, Callable] = {
         i[1] - jax.nn.softmax(i[0], axis=-1),  # (loss, backprop) outputs
     ),
     "LRN": lambda i, n, c: _lrn(i, n),
-    "ResizeBilinear": lambda i, n, c: jax.image.resize(
-        i[0], (i[0].shape[0], int(np.asarray(i[1])[0]),
-               int(np.asarray(i[1])[1]), i[0].shape[3]),
-        method="bilinear"),
+    "ResizeBilinear": lambda i, n, c: _resize_bilinear(i, n),
     "Conv3D": lambda i, n, c: _conv3d(i, n),
     "Assert": lambda i, n, c: None,  # graph-mode assert: no-op at import
 }
 
 
 def _lrn(i, n):
-    # TF LRN is NHWC cross-channel: alpha is per-element (not /size)
-    depth_radius = int(n.attr["depth_radius"].i or 5)
+    # TF LRN is NHWC cross-channel: alpha is per-element (not /size);
+    # default radius 5 applies only when the attr is ABSENT (0 is valid)
+    depth_radius = (int(n.attr["depth_radius"].i)
+                    if "depth_radius" in n.attr else 5)
     bias = _attr_f(n, "bias", 1.0)
     alpha = _attr_f(n, "alpha", 1.0)
     beta = _attr_f(n, "beta", 0.5)
@@ -429,6 +428,38 @@ def _lrn(i, n):
         sq, 0.0, lax.add, (1, 1, 1, size), (1, 1, 1, 1),
         [(0, 0), (0, 0), (0, 0), (depth_radius, depth_radius)])
     return i[0] / (bias + alpha * window) ** beta
+
+
+def _resize_bilinear(i, n):
+    """TF1 ResizeBilinear semantics: default (align_corners=False) uses
+    the legacy asymmetric mapping src = dst * (src_len/dst_len);
+    align_corners=True uses src = dst * (src_len-1)/(dst_len-1). Neither
+    is jax.image.resize's half-pixel-center convention, so sample
+    explicitly with a separable gather + lerp."""
+    x = i[0]  # NHWC
+    out_h, out_w = (int(v) for v in np.asarray(i[1]).reshape(-1)[:2])
+    align = bool(n.attr["align_corners"].b) if "align_corners" in n.attr \
+        else False
+
+    def src_coords(dst_len, src_len):
+        d = jnp.arange(dst_len, dtype=jnp.float32)
+        if align and dst_len > 1:
+            return d * ((src_len - 1) / (dst_len - 1))
+        return d * (src_len / dst_len)
+
+    def lerp_axis(arr, dst_len, axis):
+        src_len = arr.shape[axis]
+        s = jnp.clip(src_coords(dst_len, src_len), 0, src_len - 1)
+        lo = jnp.floor(s).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, src_len - 1)
+        frac = (s - lo).astype(arr.dtype)
+        shape = [1] * arr.ndim
+        shape[axis] = dst_len
+        frac = frac.reshape(shape)
+        return (jnp.take(arr, lo, axis=axis) * (1 - frac)
+                + jnp.take(arr, hi, axis=axis) * frac)
+
+    return lerp_axis(lerp_axis(x, out_h, 1), out_w, 2)
 
 
 def _conv3d(i, n):

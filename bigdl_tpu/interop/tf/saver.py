@@ -114,8 +114,9 @@ class TensorflowSaver:
                 tf_padding = b"SAME"
             else:
                 x = self._pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
-            # our OIHW -> TF HWIO
-            w = self._const(np.asarray(p["weight"]).transpose(2, 3, 1, 0), "weight")
+            # wire OIHW (via the module's storage-layout export) -> TF HWIO
+            w_oihw = np.asarray(m.weight_as_oihw(p["weight"]))
+            w = self._const(w_oihw.transpose(2, 3, 1, 0), "weight")
             sh, sw = m.stride
             y = self._node("Conv2D", self._name("conv"), [x, w],
                            strides=[1, 1, sh, sw], padding=tf_padding,

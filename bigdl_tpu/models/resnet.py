@@ -113,16 +113,21 @@ def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = 
                    kernel_format: str = "OIHW") -> nn.Sequential:
     """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch).
 
-    ``data_format="NHWC"`` builds the TPU-preferred channels-last variant
-    (input (B, H, W, C)); channels map onto the 128-wide lane dimension
-    without a layout pass.
+    ``data_format="NHWC"`` builds the channels-last variant (input
+    (B, H, W, C)). ``data_format="MIXED"`` is the measured-fastest TPU
+    layout (PERF_NOTES.md round 3): NCHW for the stem + 64-channel
+    layer1 (narrow channels underfill the 128-lane dimension in NHWC,
+    making those convs ~2x slower), one transpose, then NHWC for
+    layers 2-4 where convs are up to 1.8x faster AND the BN statistic
+    reductions become lane-minor accumulations. Input stays NCHW.
     """
     if depth not in IMAGENET_CFG:
         raise ValueError(f"unsupported imagenet resnet depth {depth}")
     kind, counts = IMAGENET_CFG[depth]
     block = basic_block if kind == "basic" else bottleneck
     expansion = 1 if kind == "basic" else 4
-    df, kf = data_format, kernel_format
+    mixed = data_format == "MIXED"
+    df, kf = ("NCHW", kernel_format) if mixed else (data_format, kernel_format)
 
     model = nn.Sequential(
         _conv(3, 64, 7, 2, 3, data_format=df,
@@ -133,6 +138,10 @@ def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = 
     )
     cin = 64
     for stage, (planes, n_blocks) in enumerate(zip([64, 128, 256, 512], counts)):
+        if mixed and stage == 1:
+            # NCHW -> NHWC between layer1 and layer2
+            model.add(nn.Transpose((1, 2), (2, 3)), name="to_nhwc")
+            df = "NHWC"
         for i in range(n_blocks):
             stride = 2 if (stage > 0 and i == 0) else 1
             model.add(

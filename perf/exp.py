@@ -39,7 +39,8 @@ def make(batch, data_format="NCHW", kernel_format="OIHW"):
     method = SGD(learning_rate=0.1, momentum=0.9)
     params, mstate = model.init(jax.random.key(0))
     ostate = method.init_state(params)
-    shape = (batch, 3, 224, 224) if data_format == "NCHW" else (batch, 224, 224, 3)
+    shape = ((batch, 224, 224, 3) if data_format == "NHWC"
+             else (batch, 3, 224, 224))  # MIXED takes NCHW input
     x = jnp.asarray(np.random.rand(*shape), jnp.bfloat16)
     y = jnp.asarray(np.random.randint(0, 1000, (batch,)), jnp.int32)
     return model, crit, method, params, mstate, ostate, x, y
@@ -118,12 +119,24 @@ def main():
         model, crit, method, params, mstate, ostate, x, y = make(256, "NHWC")
         dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y))
         report("full-step-nhwc b256", dt, 256)
+    elif variant == "nhwc128":
+        model, crit, method, params, mstate, ostate, x, y = make(128, "NHWC")
+        dt = timed_scan(step_fn(model, crit, method),
+                        (params, mstate, ostate, x, y), n1=6, n2=18)
+        report("full-step-nhwc b128", dt, 128)
     elif variant == "nhwc512":
         model, crit, method, params, mstate, ostate, x, y = make(512, "NHWC")
         dt = timed_scan(step_fn(model, crit, method), (params, mstate, ostate, x, y), n1=2, n2=8)
         report("full-step-nhwc b512", dt, 512)
     elif variant == "fwdbwd":
         variant_fwdbwd(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+    elif variant.startswith("mixed"):
+        batch = int(variant[5:] or 128)
+        model, crit, method, params, mstate, ostate, x, y = make(
+            batch, "MIXED", kernel_format="HWIO")
+        dt = timed_scan(step_fn(model, crit, method),
+                        (params, mstate, ostate, x, y), n1=6, n2=18)
+        report(f"full-step-mixed b{batch}", dt, batch)
     elif variant.startswith("hwio"):
         batch = int(variant[4:] or 128)
         model, crit, method, params, mstate, ostate, x, y = make(

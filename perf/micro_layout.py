@@ -1,0 +1,83 @@
+"""Per-shape conv+BN-stats cost: NCHW/OIHW vs NHWC/HWIO.
+
+Round-2 rejected full-model NHWC because the ONE shape measured
+(conv3x3 64ch 56x56) had 2x slower convs. Channels < 128 underfill the
+lane dimension in NHWC; the deeper layers (128-2048 ch) may not pay
+that. If NHWC convs are at parity for C >= 128 while NHWC BN stat
+reduces run lane-minor (~5x cheaper VPU), a mixed-layout model wins.
+
+Measures fwd conv + fused stats + BACKWARD (the real training cost) per
+representative ResNet-50 shape in both layouts.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timed(fn, carry, n1=8, n2=32, reps=5):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def run(N, Cin, Cout, HW, k, fmt):
+    if fmt == "NCHW":
+        x = jnp.asarray(np.random.rand(N, Cin, HW, HW), jnp.bfloat16)
+        w = jnp.asarray(np.random.randn(Cout, Cin, k, k) * 0.05, jnp.bfloat16)
+        dn = ("NCHW", "OIHW", "NCHW")
+        axes = (0, 2, 3)
+    else:
+        x = jnp.asarray(np.random.rand(N, HW, HW, Cin), jnp.bfloat16)
+        w = jnp.asarray(np.random.randn(k, k, Cin, Cout) * 0.05, jnp.bfloat16)
+        dn = ("NHWC", "HWIO", "NHWC")
+        axes = (0, 1, 2)
+
+    def convstats_loss(ww, xx):
+        y = lax.conv_general_dilated(xx, ww.astype(xx.dtype), (1, 1), "SAME",
+                                     dimension_numbers=dn)
+        m = jnp.mean(y, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(y.astype(jnp.float32)), axis=axes)
+        # cheap surrogate for the BN-normalized loss path: keeps stats and
+        # y live so fwd stats AND backward-through-conv both run
+        return (m.sum() - m2.sum()) * 1e-3 + jnp.float32(y).mean()
+
+    def step(c):
+        ww, v, xx = c
+        loss, g = jax.value_and_grad(convstats_loss)(ww, xx)
+        v = 0.9 * v + g
+        ww = ww - 0.1 * v
+        return (ww, v, xx), loss
+
+    dt = timed(step, (w.astype(jnp.float32), jnp.zeros(w.shape, jnp.float32), x))
+    fl = 2 * N * HW * HW * Cout * Cin * k * k * 3
+    print(f"{fmt} ({N},{Cin}->{Cout},{HW}^2,k{k}): {dt*1e3:.3f} ms "
+          f"({fl/dt/1e12:.0f} TF/s fwd+bwd)", flush=True)
+
+
+SHAPES = {
+    "l1": (128, 64, 64, 56, 3),
+    "l2": (128, 128, 128, 28, 3),
+    "l3": (128, 256, 256, 14, 3),
+    "l2x": (128, 256, 512, 28, 1),
+    "l3x": (128, 512, 1024, 14, 1),
+}
+
+
+if __name__ == "__main__":
+    which = sys.argv[1]
+    fmt = sys.argv[2]
+    run(*SHAPES[which], fmt)

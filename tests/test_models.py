@@ -129,3 +129,23 @@ def test_alexnet_variants_forward():
         assert np.asarray(out).shape == (2, 10)
         np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0,
                                    rtol=1e-4)
+
+
+def test_resnet_mixed_layout_matches_nchw():
+    """data_format="MIXED" (NCHW stem -> NHWC deep layers, PERF_NOTES
+    round 3) is numerically identical to the NCHW model."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.models import resnet
+
+    m1 = resnet.build_imagenet(18, 10)
+    m2 = resnet.build_imagenet(18, 10, data_format="MIXED",
+                               kernel_format="HWIO")
+    p1, s1 = m1.init(jax.random.key(0))
+    p2, s2 = m2.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(2, 3, 64, 64).astype(np.float32)
+    o1, _ = m1.apply(p1, x, state=s1, training=True)
+    o2, _ = m2.apply(p2, x, state=s2, training=True)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-4, atol=1e-4)

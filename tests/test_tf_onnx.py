@@ -418,3 +418,25 @@ def test_tf_session_trains_variable_graph(tmp_path):
         optim_method=SGD(learning_rate=0.3), n_steps=200, batch_size=32)
     assert final_loss < 1e-3, final_loss
     np.testing.assert_allclose(np.asarray(params["w"]), true_w, atol=0.05)
+
+
+def test_tf_export_hwio_conv_roundtrip(tmp_path):
+    """A kernel_format="HWIO" conv exports identical TF graphs to OIHW
+    (the saver must go through weight_as_oihw, not assume storage)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.interop.tf.loader import load_tf_graph
+    from bigdl_tpu.interop.tf.saver import save_tf_graph
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 8, 8).astype(np.float32)
+    m_h = nn.Sequential(nn.SpatialConvolution(3, 5, 3, 3, pad_w=1, pad_h=1,
+                                              kernel_format="HWIO"))
+    params, state = m_h.init(jax.random.key(3))
+    want, _ = m_h.apply(params, x, state=state, training=False)
+
+    path = str(tmp_path / "hwio.pb")
+    save_tf_graph(m_h, params, state, path, input_shape=(-1, 3, 8, 8))
+    m2, p2, s2 = load_tf_graph(path, inputs=["input"], outputs=["output"])
+    got, _ = m2.apply(p2, x, state=s2, training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
