@@ -100,11 +100,21 @@ def main(argv=None):
         # before optimize() ever starts draining
         import threading
 
-        spark_thread = threading.Thread(
-            target=run_spark,
-            args=(sc, args.feedHost or host, port, args.nProducers,
-                  args.nBatches, args.batchSize),
-            daemon=True)
+        if args.bindHost in ("0.0.0.0", "::") and not args.feedHost:
+            raise SystemExit(
+                "--bindHost is a wildcard: remote executors cannot "
+                "connect to it — pass --feedHost <this host's routable "
+                "address>")
+        spark_err: list = []
+
+        def spark_action():
+            try:
+                run_spark(sc, args.feedHost or host, port, args.nProducers,
+                          args.nBatches, args.batchSize)
+            except BaseException as e:  # surfaced after optimize/join
+                spark_err.append(e)
+
+        spark_thread = threading.Thread(target=spark_action, daemon=True)
         spark_thread.start()
     except ImportError:
         sc = None
@@ -133,6 +143,8 @@ def main(argv=None):
             p.join(timeout=30)
     if sc is not None and spark_thread is not None:
         spark_thread.join(timeout=60)
+        if spark_err:
+            raise RuntimeError("Spark feed job failed") from spark_err[0]
 
     # sanity: the model saw real data (loss finite, params moved)
     leaf = np.asarray(params["0"]["weight"])

@@ -230,6 +230,8 @@ def _pad(inp, node, ctx):
 
 def _strided_slice(inp, node, ctx):
     x, begin, end, strides = inp
+    if any(isinstance(v, jax.core.Tracer) for v in (begin, end, strides)):
+        return _strided_slice_dynamic(inp, node)
     begin, end, strides = (np.asarray(v).tolist() for v in (begin, end, strides))
     bm = int(node.attr["begin_mask"].i)
     em = int(node.attr["end_mask"].i)
@@ -249,6 +251,44 @@ def _strided_slice(inp, node, ctx):
         e = None if em & (1 << ax) else int(end[ax])
         idx.append(slice(b, e, int(strides[ax])))
     return x[tuple(idx)]
+
+
+def _strided_slice_dynamic(inp, node):
+    """StridedSlice with loop-variable indices (the pattern while_v2
+    bodies emit for ``x[:, t]``): lax.dynamic_slice with unit strides.
+    Each sliced axis keeps its static extent unless masked out; a
+    shrink axis takes one element at the dynamic index and squeezes."""
+    x, begin, end, strides = inp
+    bm = int(node.attr["begin_mask"].i)
+    em = int(node.attr["end_mask"].i)
+    sm = int(node.attr["shrink_axis_mask"].i)
+    if int(node.attr["new_axis_mask"].i) or int(node.attr["ellipsis_mask"].i):
+        raise NotImplementedError("dynamic StridedSlice with axis masks")
+    if not isinstance(strides, jax.core.Tracer) and \
+            not all(int(s) == 1 for s in np.asarray(strides).reshape(-1)):
+        raise NotImplementedError("dynamic StridedSlice with strides != 1")
+    n = begin.shape[0] if hasattr(begin, "shape") else len(begin)
+    starts, sizes, squeeze = [], [], []
+    for ax in range(x.ndim):
+        if ax >= n:
+            starts.append(0)
+            sizes.append(x.shape[ax])
+            continue
+        b = begin[ax]
+        if sm & (1 << ax):
+            starts.append(b)
+            sizes.append(1)
+            squeeze.append(ax)
+        elif (bm & (1 << ax)) and (em & (1 << ax)):
+            starts.append(0)
+            sizes.append(x.shape[ax])
+        else:
+            raise NotImplementedError(
+                "dynamic StridedSlice with partial static bounds")
+    starts = [s.astype(jnp.int32) if hasattr(s, "astype") else jnp.int32(s)
+              for s in starts]
+    y = lax.dynamic_slice(x, starts, sizes)
+    return jnp.squeeze(y, axis=tuple(squeeze)) if squeeze else y
 
 
 def _cast(inp, node, ctx):
@@ -438,6 +478,10 @@ def _resize_bilinear(i, n):
     explicitly with a separable gather + lerp."""
     x = i[0]  # NHWC
     out_h, out_w = (int(v) for v in np.asarray(i[1]).reshape(-1)[:2])
+    if "half_pixel_centers" in n.attr and n.attr["half_pixel_centers"].b:
+        # TF2-style resize: jax.image.resize's bilinear IS half-pixel
+        return jax.image.resize(x, (x.shape[0], out_h, out_w, x.shape[3]),
+                                method="bilinear")
     align = bool(n.attr["align_corners"].b) if "align_corners" in n.attr \
         else False
 
@@ -469,6 +513,89 @@ def _conv3d(i, n):
         i[0], i[1], strides, pad,
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
+class _TensorList:
+    """A TF TensorList (while_v2's TensorArray): a fixed-size stack of
+    same-shaped elements. ``buf`` is lazy — materialized as zeros on the
+    first SetItem once the element shape is known (TensorListReserve's
+    element_shape is usually the unknown sentinel -1)."""
+
+    def __init__(self, buf, size: int):
+        self.buf = buf
+        self.size = size
+
+
+def _tl_set_item(i, n, c):
+    tl, idx, item = i[0], i[1], i[2]
+    buf = tl.buf
+    if buf is None:
+        buf = jnp.zeros((tl.size,) + tuple(item.shape), item.dtype)
+    idx = jnp.asarray(idx, jnp.int32)
+    buf = lax.dynamic_update_slice(
+        buf, item[None].astype(buf.dtype),
+        (idx,) + (jnp.int32(0),) * item.ndim)
+    return _TensorList(buf, tl.size)
+
+
+_TL_OPS = {
+    "TensorListReserve": lambda i, n, c: _TensorList(
+        None, int(np.asarray(i[1]))),
+    "TensorListSetItem": _tl_set_item,
+    "TensorListGetItem": lambda i, n, c: lax.dynamic_index_in_dim(
+        i[0].buf, jnp.asarray(i[1], jnp.int32), 0, keepdims=False),
+    "TensorListStack": lambda i, n, c: i[0].buf,
+    "TensorListFromTensor": lambda i, n, c: _TensorList(
+        i[0], i[0].shape[0]),
+    "TensorListLength": lambda i, n, c: jnp.int32(i[0].size),
+}
+_OPS.update(_TL_OPS)
+
+
+def _eval_function(module, fdef, args, ctx):
+    """Evaluate a FunctionDef (while_v2 cond/body) with positional arg
+    values. Function-internal references use the ``node:port:index``
+    form; bare names are signature args."""
+    values: Dict[str, object] = {}
+    for a, v in zip(fdef.signature.input_arg, args):
+        values[a.name] = v
+
+    def resolve(ref):
+        parts = ref.split(":")
+        if len(parts) == 1:
+            return values[parts[0]]
+        v = values[parts[0]]
+        idx = int(parts[-1]) if len(parts) == 3 else 0
+        return v[idx] if isinstance(v, (tuple, list)) else v
+
+    # node_def order is NOT guaranteed topological (same reason the main
+    # graph path runs _topo): order by dependencies first
+    by_name = {nd.name: nd for nd in fdef.node_def}
+    order, state = [], {}
+
+    def visit(name):
+        if state.get(name) == 1 or name not in by_name:
+            return
+        if state.get(name) == 0:
+            raise ValueError(f"cycle in FunctionDef at {name!r}")
+        state[name] = 0
+        for r in by_name[name].input:
+            if not r.startswith("^"):
+                visit(r.split(":")[0])
+        state[name] = 1
+        order.append(name)
+
+    for nd in fdef.node_def:
+        visit(nd.name)
+
+    for name in order:
+        nd = by_name[name]
+        if nd.op == "Const":
+            values[nd.name] = tensor_to_numpy(nd.attr["value"].tensor)
+            continue
+        nd_args = [resolve(r) for r in nd.input if not r.startswith("^")]
+        values[nd.name] = module._eval_op(nd, nd_args, ctx)
+    return [resolve(fdef.ret[a.name]) for a in fdef.signature.output_arg]
+
+
 # weights smaller than this stay inline constants; larger ones are lifted
 # into the params tree
 _PARAM_THRESHOLD = 32
@@ -486,6 +613,9 @@ class TFGraphModule(Module):
         self.input_names = [_ref(i)[0] for i in inputs]
         self.output_refs = [_ref(o) for o in outputs]
         self.nodes: Dict[str, "pb.NodeDef"] = {n.name: n for n in graph_def.node}
+        # while_v2 cond/body FunctionDefs (graph.library)
+        self._functions = {f.signature.name: f
+                           for f in graph_def.library.function}
         self._consts: Dict[str, np.ndarray] = {}
         self._param_names: List[str] = []
         self._var_init: Dict[str, np.ndarray] = {}
@@ -574,6 +704,59 @@ class TFGraphModule(Module):
             p[name.replace("/", "__")] = jnp.asarray(init)
         return p
 
+    def _eval_op(self, node, args, ctx):
+        if node.op in ("While", "StatelessWhile"):
+            return self._eval_while(node, args, ctx)
+        if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+            fdef = self._functions[node.attr["f"].func.name]
+            outs = _eval_function(self, fdef, args, ctx)
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        fn = _OPS.get(node.op)
+        if fn is None:
+            raise NotImplementedError(
+                f"TF op {node.op!r} (node {node.name!r}) is not supported")
+        return fn(args, node, ctx)
+
+    def _eval_while(self, node, args, ctx):
+        """while_v2 (`StatelessWhile`/`While`): loop vars carry through
+        ``lax.while_loop``; cond/body are FunctionDefs. Lazy TensorLists
+        in the carry are materialized by running the body once OUTSIDE
+        the loop purely for shape discovery — its outputs are discarded,
+        so XLA dead-code-eliminates that probe entirely."""
+        body = self._functions[node.attr["body"].func.name]
+        cond = self._functions[node.attr["cond"].func.name]
+        carry = list(args)
+        if any(isinstance(v, _TensorList) and v.buf is None for v in carry):
+            probe = _eval_function(self, body, carry, ctx)
+            for k, v in enumerate(carry):
+                if isinstance(v, _TensorList) and v.buf is None:
+                    pv = probe[k]
+                    if not isinstance(pv, _TensorList) or pv.buf is None:
+                        raise ValueError(
+                            f"cannot infer element shape of TensorList loop "
+                            f"var {k} of {node.name!r}: the loop body never "
+                            "writes it")
+                    carry[k] = _TensorList(
+                        jnp.zeros(pv.buf.shape, pv.buf.dtype), v.size)
+        kinds = [v.size if isinstance(v, _TensorList) else None
+                 for v in carry]
+
+        def pack(c):
+            return tuple(v.buf if isinstance(v, _TensorList)
+                         else jnp.asarray(v) for v in c)
+
+        def unpack(t):
+            return [_TensorList(b, k) if k is not None else b
+                    for b, k in zip(t, kinds)]
+
+        out = lax.while_loop(
+            lambda c: jnp.asarray(
+                _eval_function(self, cond, unpack(list(c)), ctx)[0]
+            ).reshape(()),
+            lambda c: pack(_eval_function(self, body, unpack(list(c)), ctx)),
+            pack(carry))
+        return tuple(unpack(out))
+
     def forward(self, ctx: Context, x):
         xs = (x,) if len(self.input_names) == 1 else tuple(x)
         if len(xs) != len(self.input_names):
@@ -599,10 +782,6 @@ class TFGraphModule(Module):
             if node.op in ("Placeholder", "PlaceholderWithDefault") and not node.input:
                 raise ValueError(
                     f"placeholder {name!r} was not listed in inputs")
-            fn = _OPS.get(node.op)
-            if fn is None:
-                raise NotImplementedError(
-                    f"TF op {node.op!r} (node {name!r}) is not supported")
             args = []
             for ref in node.input:
                 base, idx = _ref(ref)
@@ -610,7 +789,7 @@ class TFGraphModule(Module):
                     continue
                 v = values[base]
                 args.append(v[idx] if isinstance(v, (tuple, list)) else v)
-            values[name] = fn(args, node, ctx)
+            values[name] = self._eval_op(node, args, ctx)
         outs = []
         for base, idx in self.output_refs:
             v = values[base]

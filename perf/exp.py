@@ -10,11 +10,12 @@ from bigdl_tpu.nn import CrossEntropyCriterion
 from bigdl_tpu.optim.optim_method import SGD
 
 
-def timed_scan(make_body, carry, n1=4, n2=12, reps=4):
+def timed_scan(make_body, carry, n1=4, n2=12, reps=4, unroll=1):
     def runner(n):
         @jax.jit
         def multi(carry):
-            out, losses = jax.lax.scan(lambda c, _: make_body(c), carry, None, length=n)
+            out, losses = jax.lax.scan(lambda c, _: make_body(c), carry, None,
+                                       length=n, unroll=unroll)
             return losses
         return multi
     m1, m2 = runner(n1), runner(n2)
@@ -79,6 +80,18 @@ def variant_fwdbwd(batch=128):
     report(f"fwdbwd-noupd b{batch}", dt, batch)
 
 
+
+
+
+def variant_unroll(batch=128, unroll=2):
+    model, crit, method, params, mstate, ostate, x, y = make(
+        batch, kernel_format="HWIO")
+    dt = timed_scan(step_fn(model, crit, method),
+                    (params, mstate, ostate, x, y), n1=6, n2=18,
+                    unroll=unroll)
+    report(f"unroll{unroll}-hwio b{batch}", dt, batch)
+
+
 def main():
     variant = sys.argv[1]
     if variant == "fwd":
@@ -130,6 +143,8 @@ def main():
         report("full-step-nhwc b512", dt, 512)
     elif variant == "fwdbwd":
         variant_fwdbwd(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+    elif variant.startswith("unroll"):
+        variant_unroll(128, int(variant[6:] or 2))
     elif variant.startswith("mixed"):
         batch = int(variant[5:] or 128)
         model, crit, method, params, mstate, ostate, x, y = make(
@@ -148,4 +163,3 @@ def main():
 
 if __name__ == "__main__":
     main()
-

@@ -271,3 +271,26 @@ def test_t7_legacy_model_converts_and_predicts(tmp_path):
     np.testing.assert_array_equal(np.asarray(params["4"]["weight"]), w_fc)
     # log-probs sum to 1
     np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_hwio_conv_module_roundtrip(tmp_path):
+    """kernel_format is a captured ctor arg: an HWIO-stored conv model
+    round-trips through the repo serializer with its layout intact."""
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.serializer import load_module, save_module
+
+    m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, pad_w=1, pad_h=1,
+                                            kernel_format="HWIO"))
+    params, state = m.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(2, 3, 6, 6).astype(np.float32)
+    want, _ = m.apply(params, x, state=state, training=False)
+    path = save_module(str(tmp_path / "m.bigdl"), m, params, state)
+    m2, p2, s2 = load_module(path)
+    assert m2._modules["0"].kernel_format == "HWIO"
+    assert np.asarray(p2["0"]["weight"]).shape == (3, 3, 3, 4)
+    got, _ = m2.apply(p2, x, state=s2, training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

@@ -440,3 +440,141 @@ def test_tf_export_hwio_conv_roundtrip(tmp_path):
     got, _ = m2.apply(p2, x, state=s2, training=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_stock_tf_batchnorm_nchw_and_control_dep_imports():
+    """The reference's batch_norm_nchw + control_dep fixture patterns
+    (its models/*.py generators), authored here with stock TF as the
+    oracle: FusedBatchNorm in NCHW data_format, plus an op consumed
+    through a tf.control_dependencies edge (^name inputs must be skipped
+    without dropping the data path)."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(0)
+    xv = rs.rand(2, 3, 5, 5).astype("float32")
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [2, 3, 5, 5], name="x")
+        gamma = tf.constant(rs.rand(3).astype("float32") + 0.5)
+        beta = tf.constant(rs.rand(3).astype("float32"))
+        mean = tf.constant(rs.rand(3).astype("float32"))
+        var = tf.constant(rs.rand(3).astype("float32") + 0.5)
+        bn, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            x, gamma, beta, mean, var, epsilon=1e-3,
+            data_format="NCHW", is_training=False)
+        marker = tf.identity(bn, name="marker")
+        with tf.control_dependencies([marker]):
+            y = tf.nn.relu(bn, name="out")
+        with tf.compat.v1.Session(graph=g) as sess:
+            want = sess.run("out:0", {"x:0": xv})
+        gd = g.as_graph_def()
+
+    gd2 = tfpb.GraphDef()
+    gd2.ParseFromString(gd.SerializeToString())
+    m = TFGraphModule(gd2, inputs=["x"], outputs=["out"])
+    params, state = m.init(jax.random.key(0))
+    got, _ = m.apply(params, xv, state=state, training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_stock_tf_resize_and_lrn_oracle():
+    """Round-3 loader ops vs the real TF kernels: ResizeBilinear (both
+    align_corners modes) and LRN."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(1)
+    xv = rs.rand(2, 4, 6, 8).astype("float32")  # NHWC
+    for align in (False, True):
+        with tf.Graph().as_default() as g:
+            x = tf.compat.v1.placeholder(tf.float32, [2, 4, 6, 8], name="x")
+            r = tf.compat.v1.image.resize_bilinear(
+                x, [9, 13], align_corners=align, name="rb")
+            lrn = tf.nn.local_response_normalization(
+                x, depth_radius=2, bias=1.0, alpha=0.3, beta=0.6, name="lrn")
+            with tf.compat.v1.Session(graph=g) as sess:
+                want_r, want_l = sess.run(["rb:0", "lrn:0"], {"x:0": xv})
+            gd = g.as_graph_def()
+        gd2 = tfpb.GraphDef()
+        gd2.ParseFromString(gd.SerializeToString())
+        m = TFGraphModule(gd2, inputs=["x"], outputs=["rb", "lrn"])
+        params, state = m.init(jax.random.key(0))
+        (got_r, got_l), _ = m.apply(params, xv, state=state, training=False)
+        np.testing.assert_allclose(np.asarray(got_r), want_r,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_l), want_l,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_stock_tf_while_loop_rnn_imports():
+    """The reference's dynamic_lstm/gru fixture pattern (its
+    tf.while_loop-based RNN generators): a while_v2 graph — StatelessWhile
+    + FunctionDefs + TensorList accumulation + loop-variable
+    StridedSlice — imports onto lax.while_loop with TF as the oracle."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(0)
+    xv = rs.rand(2, 7, 5).astype("float32")
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [2, 7, 5], name="x")
+        W = tf.constant(rs.randn(5, 4).astype("float32") * 0.4)
+        U = tf.constant(rs.randn(4, 4).astype("float32") * 0.4)
+        ta = tf.TensorArray(tf.float32, size=7)
+
+        def cond(t, h, ta):
+            return t < 7
+
+        def body(t, h, ta):
+            h = tf.tanh(tf.matmul(x[:, t], W) + tf.matmul(h, U))
+            return t + 1, h, ta.write(t, h)
+
+        _, hT, ta = tf.while_loop(
+            cond, body, [tf.constant(0), tf.zeros([2, 4]), ta])
+        tf.transpose(ta.stack(), [1, 0, 2], name="seq")
+        tf.identity(hT, name="out")
+        with tf.compat.v1.Session(graph=g) as sess:
+            want_h, want_seq = sess.run(["out:0", "seq:0"], {"x:0": xv})
+        gd = g.as_graph_def()
+
+    gd2 = tfpb.GraphDef()
+    gd2.ParseFromString(gd.SerializeToString())
+    m = TFGraphModule(gd2, inputs=["x"], outputs=["out", "seq"])
+    params, state = m.init(jax.random.key(0))
+    (got_h, got_seq), _ = m.apply(params, xv, state=state, training=False)
+    np.testing.assert_allclose(np.asarray(got_h), want_h, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_seq), want_seq, rtol=1e-5,
+                               atol=1e-6)
+    # and the whole thing must stay jittable (lax.while_loop, no py loop)
+    out2 = jax.jit(lambda p, xx: m.apply(p, xx, state=state,
+                                         training=False)[0])(params, xv)
+    np.testing.assert_allclose(np.asarray(out2[0]), want_h, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_stock_tf2_resize_half_pixel_imports():
+    """TF2 tf.image.resize emits ResizeBilinear with
+    half_pixel_centers=True — different sampling than both TF1 modes."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(0)
+    xv = rs.rand(2, 4, 6, 3).astype("float32")
+    with tf.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [2, 4, 6, 3], name="x")
+        tf.identity(tf.image.resize(x, [9, 13], method="bilinear"),
+                    name="rb")
+        with tf.compat.v1.Session(graph=g) as sess:
+            want = sess.run("rb:0", {"x:0": xv})
+        gd = g.as_graph_def()
+    g2 = tfpb.GraphDef()
+    g2.ParseFromString(gd.SerializeToString())
+    m = TFGraphModule(g2, inputs=["x"], outputs=["rb"])
+    params, state = m.init(jax.random.key(0))
+    got, _ = m.apply(params, xv, state=state, training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
