@@ -106,14 +106,14 @@ def main(argv=None):
     im_info = np.asarray([[h, w, 1.0, 1.0]], np.float32)
     fwd = jax.jit(lambda p, xx: model.apply(p, xx, state=state,
                                             training=False)[0])
-    boxes, scores, labels, valid = fwd(params, (x, im_info))
-    n = int(np.asarray(valid).sum())
+    boxes, scores, labels, valid = map(
+        np.asarray, fwd(params, (x, im_info)))
+    n = int(valid.sum())
     print(f"{n} detections")
-    for k in range(len(np.asarray(valid))):
-        if np.asarray(valid)[k]:
-            b = np.asarray(boxes)[k]
-            print(f"  label={int(np.asarray(labels)[k])} "
-                  f"score={float(np.asarray(scores)[k]):.3f} "
+    for k in range(len(valid)):
+        if valid[k]:
+            b = boxes[k]
+            print(f"  label={int(labels[k])} score={float(scores[k]):.3f} "
                   f"box=({b[0]:.0f},{b[1]:.0f},{b[2]:.0f},{b[3]:.0f})")
     return n
 
