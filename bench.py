@@ -27,6 +27,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 import argparse
 import json
 import math
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import jax
@@ -130,7 +134,7 @@ def run_host_pipeline(model, criterion, method, batch, n_iters, compute_dtype):
     return batch / dt
 
 
-def main():
+def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--short", type=int, default=4)
@@ -140,8 +144,25 @@ def main():
                     help="skip the data->device fed-throughput measurement "
                          "(on by default — the reference's canonical metric "
                          "is pipeline-fed, DistriOptimizer.scala:410-417)")
-    args = ap.parse_args()
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run the measurement directly (no "
+                         "supervisor). The default entry point supervises a "
+                         "--worker subprocess so a dead TPU tunnel cannot "
+                         "kill the run without emitting a JSON line.")
+    ap.add_argument("--max-wait", type=float, default=1200.0,
+                    help="supervisor: total seconds to keep re-probing an "
+                         "unavailable backend before giving up (the axon "
+                         "tunnel dies and comes back; round-3's number was "
+                         "lost to exactly this). Worst-case wall clock is "
+                         "max-wait + worker-timeout: a worker launched just "
+                         "inside the deadline may still use its full budget")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--probe-interval", type=float, default=45.0)
+    ap.add_argument("--worker-timeout", type=float, default=1800.0)
+    return ap.parse_args(argv)
 
+
+def run_bench(args):
     from bigdl_tpu.models import resnet
     from bigdl_tpu.nn import CrossEntropyCriterion
     from bigdl_tpu.optim.optim_method import SGD
@@ -273,6 +294,127 @@ def main():
         "first_step_loss": round(first_loss, 4),
         "timing": "differential (cancels RPC dispatch overhead; host fetch forces sync)",
     }))
+
+
+_DIAG = {"printed": False}
+
+
+def _emit_diagnostic(error, detail, attempts):
+    """Last-resort JSON line: the driver must always have something to parse
+    (round 3 recorded nothing because a dead tunnel killed the process at
+    ``jax.devices()`` before any output — VERDICT r3, Missing #1)."""
+    if _DIAG["printed"]:
+        return
+    _DIAG["printed"] = True
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": error,
+        "attempts": attempts,
+        "detail": detail[-800:] if detail else "",
+    }), flush=True)
+
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "v = float(jnp.ones((8, 8)).sum());"
+    "print(d[0].platform, flush=True)"
+)
+
+
+def supervise(args):
+    """Probe the backend in disposable subprocesses (a hung ``jax.devices()``
+    cannot be interrupted in-process), then run the measurement as a
+    ``--worker`` subprocess. Retries both on a bounded budget and always
+    prints exactly one JSON line."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    deadline = time.time() + args.max_wait
+    attempts = 0
+    last_err = "no attempt made"
+    child = [None]  # active subprocess, killed by the signal handler
+
+    def on_term(signum, frame):
+        if child[0] is not None and child[0].poll() is None:
+            child[0].kill()  # don't orphan a worker holding the TPU
+        _emit_diagnostic("killed_by_signal_%d" % signum, last_err, attempts)
+        sys.exit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_term)
+
+    def run_child(argv, timeout):
+        """subprocess.run with the Popen tracked so on_term can kill it."""
+        p = subprocess.Popen(argv, cwd=here, text=True,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        child[0] = p
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            raise
+        finally:
+            child[0] = None
+        return p.returncode, out or "", err or ""
+
+    passthrough = ["--batch", str(args.batch), "--short", str(args.short),
+                   "--long", str(args.long)]
+    if not args.host_pipeline:
+        passthrough.append("--no-host-pipeline")
+
+    while True:
+        attempts += 1
+        try:
+            rc, out, err = run_child([sys.executable, "-c", _PROBE_SRC],
+                                     args.probe_timeout)
+            probe_ok = rc == 0
+            if not probe_ok:
+                last_err = "backend probe rc=%d: %s" % (rc, err.strip()[-400:])
+            else:
+                print("probe ok: platform=%s (attempt %d)"
+                      % (out.strip(), attempts), file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            probe_ok = False
+            last_err = ("backend probe hung >%.0fs (tunnel down: jax.devices()"
+                        " blocks forever)" % args.probe_timeout)
+
+        if probe_ok:
+            try:
+                rc, out, err = run_child(
+                    [sys.executable, os.path.abspath(__file__), "--worker",
+                     *passthrough], args.worker_timeout)
+                if err:
+                    sys.stderr.write(err)
+                line = next((ln for ln in reversed(out.splitlines())
+                             if ln.startswith("{") and '"metric"' in ln), None)
+                if rc == 0 and line:
+                    _DIAG["printed"] = True
+                    print(line, flush=True)
+                    return 0
+                last_err = "worker rc=%d: %s" % (rc, err.strip()[-600:])
+            except subprocess.TimeoutExpired:
+                last_err = "worker timed out after %.0fs" % args.worker_timeout
+
+        if time.time() + args.probe_interval >= deadline:
+            break
+        print("bench attempt %d failed (%s); retrying in %.0fs"
+              % (attempts, last_err.splitlines()[-1][:200] if last_err else "?",
+                 args.probe_interval), file=sys.stderr)
+        time.sleep(args.probe_interval)
+
+    _emit_diagnostic("tpu_unavailable", last_err, attempts)
+    return 0
+
+
+def main():
+    args = _parse_args()
+    if args.worker:
+        run_bench(args)
+    else:
+        sys.exit(supervise(args))
 
 
 if __name__ == "__main__":
