@@ -517,18 +517,24 @@ class _TensorList:
     """A TF TensorList (while_v2's TensorArray): a fixed-size stack of
     same-shaped elements. ``buf`` is lazy — materialized as zeros on the
     first SetItem once the element shape is known (TensorListReserve's
-    element_shape is usually the unknown sentinel -1)."""
+    element_shape is usually the unknown sentinel -1).
 
-    def __init__(self, buf, size: int):
+    Also used as the *flow* value of a v1 ``TensorArrayV3``; ``ragged``
+    holds variable-length elements (TensorArraySplitV3 only — those never
+    ride a loop carry)."""
+
+    def __init__(self, buf, size: int, ragged=None):
         self.buf = buf
         self.size = size
+        self.ragged = ragged
 
 
-def _tl_set_item(i, n, c):
-    tl, idx, item = i[0], i[1], i[2]
+def _buf_write(tl, idx, item, dtype=None):
+    """One-element write into a (possibly lazy) TensorList/TensorArray
+    buffer; materializes zeros of the element shape on first write."""
     buf = tl.buf
     if buf is None:
-        buf = jnp.zeros((tl.size,) + tuple(item.shape), item.dtype)
+        buf = jnp.zeros((tl.size,) + tuple(item.shape), dtype or item.dtype)
     idx = jnp.asarray(idx, jnp.int32)
     buf = lax.dynamic_update_slice(
         buf, item[None].astype(buf.dtype),
@@ -536,18 +542,114 @@ def _tl_set_item(i, n, c):
     return _TensorList(buf, tl.size)
 
 
+def _tl_set_item(i, n, c):
+    return _buf_write(i[0], i[1], i[2])
+
+
+def _tl_buf(tl, node):
+    """The materialized buffer of a TensorList/TensorArray flow; reads
+    before any write have no element shape to materialize from."""
+    if tl.ragged is not None:
+        raise NotImplementedError(
+            f"ragged TensorArray (from SplitV3) read as dense at node "
+            f"{node.name!r}; only ConcatV3 accepts ragged arrays")
+    if tl.buf is None:
+        raise ValueError(
+            f"TensorList/TensorArray at node {node.name!r} is read before "
+            "any element was written: the element shape is unknown "
+            "(a reserve-then-read-only list cannot be materialized)")
+    return tl.buf
+
+
 _TL_OPS = {
     "TensorListReserve": lambda i, n, c: _TensorList(
         None, int(np.asarray(i[1]))),
     "TensorListSetItem": _tl_set_item,
     "TensorListGetItem": lambda i, n, c: lax.dynamic_index_in_dim(
-        i[0].buf, jnp.asarray(i[1], jnp.int32), 0, keepdims=False),
-    "TensorListStack": lambda i, n, c: i[0].buf,
+        _tl_buf(i[0], n), jnp.asarray(i[1], jnp.int32), 0, keepdims=False),
+    "TensorListStack": lambda i, n, c: _tl_buf(i[0], n),
     "TensorListFromTensor": lambda i, n, c: _TensorList(
         i[0], i[0].shape[0]),
     "TensorListLength": lambda i, n, c: jnp.int32(i[0].size),
 }
 _OPS.update(_TL_OPS)
+
+
+# ------------------------------------------------- v1 TensorArray (V3 ops)
+# Reference: ``DL/nn/tf/DataFlowOps.scala:45-293`` (TensorArrayCreator /
+# Write / Read / Gather / Scatter / Split / Concat / Size). The reference
+# keeps a mutable per-frame array store; here the TensorArray's *flow*
+# output carries the buffer as a :class:`_TensorList` — TF already threads
+# the flow through Enter/Merge/Switch/NextIteration as a loop variable
+# precisely to order reads after writes, so a buffer riding the flow turns
+# in-loop writes into ordinary functional carry updates.
+
+class _TAHandle:
+    """Static metadata of a TensorArrayV3 (the DT_RESOURCE handle output);
+    the data lives on the flow value."""
+
+    def __init__(self, size: int, dtype):
+        self.size = size
+        self.dtype = dtype
+
+
+def _ta_create(i, n, c):
+    size = int(np.asarray(i[0]))
+    dt = n.attr["dtype"].type
+    dtype = jnp.bfloat16 if dt == pb.DT_BFLOAT16 else _NP_DTYPES.get(dt, np.float32)
+    return _TAHandle(size, dtype), _TensorList(None, size)
+
+
+def _ta_write(i, n, c):
+    handle, idx, val, flow = i
+    return _buf_write(flow, idx, val, dtype=handle.dtype)
+
+
+def _ta_scatter(i, n, c):
+    handle, indices, val, flow = i
+    buf = flow.buf
+    if buf is None:
+        buf = jnp.zeros((flow.size,) + tuple(val.shape[1:]), handle.dtype)
+    idx = jnp.asarray(indices, jnp.int32)
+    return _TensorList(buf.at[idx].set(val.astype(buf.dtype)), flow.size)
+
+
+def _ta_split(i, n, c):
+    _handle, val, lengths, flow = i
+    lens = [int(v) for v in np.asarray(lengths).reshape(-1)]
+    elems, off = [], 0
+    for ln in lens:
+        elems.append(val[off:off + ln])
+        off += ln
+    return _TensorList(None, len(elems), ragged=elems)
+
+
+def _ta_concat(i, n, c):
+    _handle, flow = i
+    if flow.ragged is not None:
+        out = jnp.concatenate(flow.ragged, axis=0)
+        lens = np.asarray([e.shape[0] for e in flow.ragged], np.int64)
+    else:
+        buf = _tl_buf(flow, n)
+        out = buf.reshape((-1,) + buf.shape[2:])
+        lens = np.full(buf.shape[0], buf.shape[1], np.int64)
+    return out, jnp.asarray(lens)
+
+
+_TA_OPS = {
+    "TensorArrayV3": _ta_create,
+    "TensorArrayWriteV3": _ta_write,
+    "TensorArrayReadV3": lambda i, n, c: lax.dynamic_index_in_dim(
+        _tl_buf(i[2], n), jnp.asarray(i[1], jnp.int32), 0, keepdims=False),
+    "TensorArrayGatherV3": lambda i, n, c: jnp.take(
+        _tl_buf(i[2], n), jnp.asarray(i[1], jnp.int32), axis=0),
+    "TensorArrayScatterV3": _ta_scatter,
+    "TensorArraySplitV3": _ta_split,
+    "TensorArrayConcatV3": _ta_concat,
+    "TensorArraySizeV3": lambda i, n, c: jnp.int32(i[1].size),
+    "TensorArrayCloseV3": lambda i, n, c: None,
+}
+_OPS.update(_TA_OPS)
 
 
 def _eval_function(module, fdef, args, ctx):
@@ -563,7 +665,11 @@ def _eval_function(module, fdef, args, ctx):
         if len(parts) == 1:
             return values[parts[0]]
         v = values[parts[0]]
-        idx = int(parts[-1]) if len(parts) == 3 else 0
+        # 'node:out:idx' (function-internal) or short-form 'node:1'
+        if len(parts) == 3:
+            idx = int(parts[-1])
+        else:
+            idx = int(parts[1]) if parts[1].isdigit() else 0
         return v[idx] if isinstance(v, (tuple, list)) else v
 
     # node_def order is NOT guaranteed topological (same reason the main
@@ -594,6 +700,32 @@ def _eval_function(module, fdef, args, ctx):
         nd_args = [resolve(r) for r in nd.input if not r.startswith("^")]
         values[nd.name] = module._eval_op(nd, nd_args, ctx)
     return [resolve(fdef.ret[a.name]) for a in fdef.signature.output_arg]
+
+
+class _V1Frame:
+    """One TF-1 while frame: the ``Enter → Merge → Switch → (body) →
+    NextIteration`` cycle closed by ``Exit`` (reference executes these
+    dynamically with ``DL/nn/Scheduler.scala`` + ``FrameManager.scala``
+    interpreting ``DL/nn/tf/ControlOps.scala:65-229``).
+
+    TPU-native redesign: the frame is lowered *structurally*, once, into a
+    single functional loop — ``lax.scan`` when the trip count is statically
+    derivable (the canonical ``i < N; i += 1`` counter pattern), else
+    ``lax.while_loop``. Merges become the loop carry, Switch's true port is
+    the carry inside the body, loop-invariant Enters close over outer
+    values, Exits read the final carry."""
+
+    def __init__(self, name):
+        self.name = name
+        self.members = set()    # node names inside the frame
+        self.merges = []        # loop-var Merge names, graph order
+        self.init_refs = []     # per merge: outer ref feeding its Enter
+        self.body_refs = []     # per merge: in-frame ref of the next value
+        self.switches = {}      # merge name -> Switch name
+        self.invariants = {}    # loop-invariant Enter name -> outer ref
+        self.cond_ref = ""      # LoopCond's input ref
+        self.exits = {}         # Exit node name -> merge index
+        self.external = []      # outer node names the frame depends on
 
 
 # weights smaller than this stay inline constants; larger ones are lifted
@@ -660,8 +792,104 @@ class TFGraphModule(Module):
                         "starting from zeros (random initializer ops are "
                         "not evaluated at import)", n.name)
                 self._var_init[n.name] = np.asarray(init)
+        # TF-1 while frames: collapse each Enter→…→Exit cycle into one
+        # functional loop before the (acyclic) topological walk
+        self._exit_to_frame: Dict[str, _V1Frame] = {}
+        if any(n.op in ("Enter", "RefEnter") for n in graph_def.node):
+            self._build_frames()
         # needed set: nodes reachable from outputs
         self._order = self._topo()
+
+    def _build_frames(self):
+        from collections import defaultdict
+
+        consumers = defaultdict(list)
+        for nd in self.graph_def.node:
+            for ref in nd.input:
+                consumers[_ref(ref)[0]].append(nd.name)
+        enters_by_frame = defaultdict(list)
+        for nd in self.graph_def.node:
+            if nd.op in ("Enter", "RefEnter"):
+                enters_by_frame[nd.attr["frame_name"].s.decode()].append(nd.name)
+
+        for fname, enters in enters_by_frame.items():
+            fr = _V1Frame(fname)
+            enter_set = set(enters)
+            work = list(enters)
+            while work:
+                nm = work.pop()
+                if nm in fr.members:
+                    continue
+                fr.members.add(nm)
+                nd = self.nodes[nm]
+                if nd.op in ("Exit", "RefExit"):
+                    continue  # frame boundary: consumers are outer
+                if nd.op in ("Enter", "RefEnter") and nm not in enter_set:
+                    raise NotImplementedError(
+                        f"nested v1 while frames: {fname!r} contains Enter "
+                        f"node {nm!r} of another frame")
+                work.extend(consumers.get(nm, []))
+
+            members = [nd for nd in self.graph_def.node
+                       if nd.name in fr.members]
+            loopconds = [nd for nd in members if nd.op == "LoopCond"]
+            if len(loopconds) != 1:
+                raise NotImplementedError(
+                    f"frame {fname!r} has {len(loopconds)} LoopCond nodes "
+                    "(expected exactly 1)")
+            fr.cond_ref = loopconds[0].input[0]
+
+            loop_var_enters = set()
+            for nd in members:
+                if nd.op not in ("Merge", "RefMerge"):
+                    continue
+                ins = [_ref(r)[0] for r in nd.input]
+                ei = [k for k, b in enumerate(ins) if b in enter_set]
+                if len(ei) != 1:
+                    raise NotImplementedError(
+                        f"Merge {nd.name!r} in frame {fname!r} does not pair "
+                        "one Enter with one NextIteration (v1 cond-style "
+                        "Switch/Merge outside a loop is not supported)")
+                e, other = ins[ei[0]], ins[1 - ei[0]]
+                if self.nodes[other].op not in ("NextIteration",
+                                                "RefNextIteration"):
+                    raise NotImplementedError(
+                        f"Merge {nd.name!r}: second input {other!r} is "
+                        f"{self.nodes[other].op}, expected NextIteration")
+                loop_var_enters.add(e)
+                fr.merges.append(nd.name)
+                fr.init_refs.append(self.nodes[e].input[0])
+                fr.body_refs.append(self.nodes[other].input[0])
+
+            for nd in members:
+                if nd.op in ("Switch", "RefSwitch"):
+                    data = _ref(nd.input[0])[0]
+                    if data in fr.merges:
+                        fr.switches[data] = nd.name
+
+            for e in enters:
+                if e not in loop_var_enters:
+                    fr.invariants[e] = self.nodes[e].input[0]
+
+            for nd in members:
+                if nd.op in ("Exit", "RefExit"):
+                    sw = _ref(nd.input[0])[0]
+                    midx = next((k for k, m in enumerate(fr.merges)
+                                 if fr.switches.get(m) == sw), None)
+                    if midx is None:
+                        raise NotImplementedError(
+                            f"Exit {nd.name!r} does not read a loop-var "
+                            "Switch")
+                    fr.exits[nd.name] = midx
+                    self._exit_to_frame[nd.name] = fr
+
+            ext = set()
+            for nd in members:
+                for ref in nd.input:
+                    base, idx = _ref(ref)
+                    if idx >= 0 and base not in fr.members:
+                        ext.add(base)
+            fr.external = sorted(ext)
 
     def _topo(self) -> List[str]:
         # iterative DFS: real frozen graphs (ResNets, unrolled RNNs) have
@@ -685,11 +913,19 @@ class TFGraphModule(Module):
                     continue
                 if st == 0:
                     raise ValueError(
-                        f"cycle at node {name!r} (control flow is not "
-                        "supported in frozen-graph import)")
+                        f"cycle at node {name!r} (fetching a node from "
+                        "INSIDE a v1 while frame is not supported — fetch "
+                        "the loop's Exit outputs instead)")
                 state[name] = 0
                 stack.append((name, True))
                 if name in fed:
+                    continue
+                if name in self._exit_to_frame:
+                    # the whole frame evaluates as one unit when its first
+                    # Exit is reached; depend on the frame's outer inputs
+                    for base in self._exit_to_frame[name].external:
+                        if state.get(base) != 1:
+                            stack.append((base, False))
                     continue
                 for ref in self.nodes[name].input:
                     base, idx = _ref(ref)
@@ -717,27 +953,38 @@ class TFGraphModule(Module):
                 f"TF op {node.op!r} (node {node.name!r}) is not supported")
         return fn(args, node, ctx)
 
-    def _eval_while(self, node, args, ctx):
-        """while_v2 (`StatelessWhile`/`While`): loop vars carry through
-        ``lax.while_loop``; cond/body are FunctionDefs. Lazy TensorLists
-        in the carry are materialized by running the body once OUTSIDE
-        the loop purely for shape discovery — its outputs are discarded,
-        so XLA dead-code-eliminates that probe entirely."""
-        body = self._functions[node.attr["body"].func.name]
-        cond = self._functions[node.attr["cond"].func.name]
-        carry = list(args)
+    def _run_loop(self, cond_fn, body_fn, carry, loop_name, trip=None):
+        """Run a TF loop functionally. ``cond_fn``/``body_fn`` take and
+        return *unpacked* lists (arrays and :class:`_TensorList` flows).
+
+        Lazy TensorLists in the carry are materialized by running the body
+        once OUTSIDE the loop purely for shape discovery — its outputs are
+        discarded, so XLA dead-code-eliminates that probe entirely.
+
+        With a static ``trip`` count the loop lowers to ``lax.scan`` —
+        which, unlike ``lax.while_loop``, is reverse-differentiable, so
+        imported v1 RNN graphs can be trained with jax.grad."""
+        carry = list(carry)
         if any(isinstance(v, _TensorList) and v.buf is None for v in carry):
-            probe = _eval_function(self, body, carry, ctx)
+            probe = body_fn(list(carry))
             for k, v in enumerate(carry):
                 if isinstance(v, _TensorList) and v.buf is None:
                     pv = probe[k]
                     if not isinstance(pv, _TensorList) or pv.buf is None:
                         raise ValueError(
                             f"cannot infer element shape of TensorList loop "
-                            f"var {k} of {node.name!r}: the loop body never "
+                            f"var {k} of {loop_name!r}: the loop body never "
                             "writes it")
                     carry[k] = _TensorList(
                         jnp.zeros(pv.buf.shape, pv.buf.dtype), v.size)
+        for k, v in enumerate(carry):
+            if isinstance(v, _TensorList) and v.ragged is not None:
+                raise NotImplementedError(
+                    f"ragged TensorArray as loop var {k} of {loop_name!r}")
+            if isinstance(v, _TAHandle):
+                raise NotImplementedError(
+                    f"TensorArray handle as loop var {k} of {loop_name!r} "
+                    "(handles normally enter frames as loop invariants)")
         kinds = [v.size if isinstance(v, _TensorList) else None
                  for v in carry]
 
@@ -749,13 +996,159 @@ class TFGraphModule(Module):
             return [_TensorList(b, k) if k is not None else b
                     for b, k in zip(t, kinds)]
 
-        out = lax.while_loop(
-            lambda c: jnp.asarray(
-                _eval_function(self, cond, unpack(list(c)), ctx)[0]
-            ).reshape(()),
-            lambda c: pack(_eval_function(self, body, unpack(list(c)), ctx)),
-            pack(carry))
-        return tuple(unpack(out))
+        if trip is not None:
+            out, _ = lax.scan(
+                lambda c, _: (pack(body_fn(unpack(list(c)))), None),
+                pack(carry), None, length=trip)
+        else:
+            out = lax.while_loop(
+                lambda c: jnp.asarray(
+                    cond_fn(unpack(list(c)))).reshape(()),
+                lambda c: pack(body_fn(unpack(list(c)))),
+                pack(carry))
+        return unpack(out)
+
+    def _eval_while(self, node, args, ctx):
+        """while_v2 (`StatelessWhile`/`While`): loop vars carry through
+        the functional loop; cond/body are FunctionDefs."""
+        body = self._functions[node.attr["body"].func.name]
+        cond = self._functions[node.attr["cond"].func.name]
+        out = self._run_loop(
+            lambda c: _eval_function(self, cond, c, ctx)[0],
+            lambda c: _eval_function(self, body, c, ctx),
+            list(args), node.name)
+        return tuple(out)
+
+    def _eval_v1_frame(self, fr: _V1Frame, values, ctx):
+        """Evaluate one v1 while frame; writes every Exit's value into
+        ``values``. See :class:`_V1Frame` for the lowering."""
+
+        def outer(ref):
+            base, idx = _ref(ref)
+            v = values[base]
+            return v[idx] if isinstance(v, (tuple, list)) else v
+
+        inv = {nm: outer(ref) for nm, ref in fr.invariants.items()}
+        init = [outer(r) for r in fr.init_refs]
+
+        def subgraph(carry, refs):
+            """Evaluate in-frame refs with Merges/Switches seeded from the
+            carry (Switch is seeded on both ports: during body execution
+            the predicate is true, and the false port is only read by
+            Exit, which lives outside this evaluation)."""
+            local: Dict[str, object] = dict(inv)
+            for k, m in enumerate(fr.merges):
+                local[m] = carry[k]
+                sw = fr.switches.get(m)
+                if sw is not None:
+                    local[sw] = (carry[k], carry[k])
+
+            def eval_node(base):
+                if base in local:
+                    return
+                if base not in fr.members:
+                    local[base] = values[base]
+                    return
+                nd = self.nodes[base]
+                if nd.op == "Const":
+                    local[base] = tensor_to_numpy(nd.attr["value"].tensor)
+                    return
+                if nd.op in ("Enter", "RefEnter", "Merge", "RefMerge",
+                             "Switch", "RefSwitch", "NextIteration",
+                             "RefNextIteration", "LoopCond"):
+                    raise NotImplementedError(
+                        f"control node {base!r} ({nd.op}) in frame "
+                        f"{fr.name!r} is not part of the canonical while "
+                        "pattern (tf.cond inside a loop body?)")
+                args = []
+                for ref in nd.input:
+                    b, idx = _ref(ref)
+                    if idx < 0:
+                        continue
+                    eval_node(b)
+                    v = local[b]
+                    args.append(v[idx] if isinstance(v, (tuple, list)) else v)
+                local[base] = self._eval_op(nd, args, ctx)
+
+            out = []
+            for ref in refs:
+                b, idx = _ref(ref)
+                eval_node(b)
+                v = local[b]
+                out.append(v[idx] if isinstance(v, (tuple, list)) else v)
+            return out
+
+        final = self._run_loop(
+            lambda c: subgraph(c, [fr.cond_ref])[0],
+            lambda c: subgraph(c, fr.body_refs),
+            init, fr.name, trip=self._static_trip_count(fr, values, init))
+        for exit_name, k in fr.exits.items():
+            values[exit_name] = final[k]
+
+    def _static_trip_count(self, fr: _V1Frame, values, init):
+        """Detect the canonical counted loop — cond ``Less(i, limit)`` with
+        loop-invariant concrete ``limit`` and body ``i + 1`` — so the loop
+        can lower to differentiable ``lax.scan``. Returns None when the
+        pattern doesn't hold (falls back to ``lax.while_loop``)."""
+
+        def follow(base):
+            # v1 lowering wraps Switch:1 in Identity ('while/Identity');
+            # skip such chains when pattern-matching
+            for _ in range(8):
+                nd = self.nodes.get(base)
+                if nd is None or nd.op not in ("Identity", "Snapshot") \
+                        or not nd.input:
+                    break
+                base = _ref(nd.input[0])[0]
+            return base
+
+        def static_value(ref):
+            base = follow(_ref(ref)[0])
+            if base in fr.invariants:
+                v = values.get(_ref(fr.invariants[base])[0])
+            elif base in fr.members:
+                nd = self.nodes[base]
+                if nd.op != "Const":
+                    return None
+                v = tensor_to_numpy(nd.attr["value"].tensor)
+            else:
+                v = values.get(base)
+            if v is None or isinstance(v, (jax.core.Tracer, tuple, list,
+                                           _TensorList, _TAHandle)):
+                return None
+            try:
+                return int(np.asarray(v))
+            except (TypeError, ValueError):
+                return None
+
+        cnd = self.nodes.get(follow(_ref(fr.cond_ref)[0]))
+        if cnd is None or cnd.op != "Less":
+            return None
+        i_merge = follow(_ref(cnd.input[0])[0])
+        if i_merge not in fr.merges:
+            return None
+        k = fr.merges.index(i_merge)
+        if k >= len(init):
+            return None
+        limit = static_value(cnd.input[1])
+        i0 = init[k]
+        if limit is None or isinstance(i0, jax.core.Tracer):
+            return None
+        # body must be i + 1 off the loop var's Switch true port
+        inc = self.nodes.get(follow(_ref(fr.body_refs[k])[0]))
+        sw = fr.switches.get(i_merge)
+        if inc is None or inc.op not in ("Add", "AddV2") or sw is None:
+            return None
+        if not any(follow(_ref(r)[0]) == sw for r in inc.input):
+            return None
+        step = next((static_value(r) for r in inc.input
+                     if follow(_ref(r)[0]) != sw), None)
+        if step != 1:
+            return None
+        try:
+            return max(0, limit - int(np.asarray(i0)))
+        except (TypeError, ValueError):
+            return None
 
     def forward(self, ctx: Context, x):
         xs = (x,) if len(self.input_names) == 1 else tuple(x)
@@ -768,6 +1161,10 @@ class TFGraphModule(Module):
         param_set = set(self._param_names)
         for name in self._order:
             if name in values:
+                continue
+            if name in self._exit_to_frame:
+                # fills values[] for every Exit of the frame at once
+                self._eval_v1_frame(self._exit_to_frame[name], values, ctx)
                 continue
             node = self.nodes[name]
             if node.op == "Const":

@@ -1,0 +1,219 @@
+"""TF-1 control-flow import: Enter/Exit/Merge/Switch/NextIteration/LoopCond
+frames and the standalone TensorArrayV3 op tier, with stock TF as the oracle.
+
+Mirrors the reference's v1-graph fixture family
+(``spark/dl/src/test/resources/tf/models/dynamic_lstm.py`` /
+``dynamic_rnn.py`` / ``tensor_array.py``) whose graphs are interpreted there
+by ``DL/nn/Scheduler.scala`` + ``FrameManager.scala`` over
+``DL/nn/tf/ControlOps.scala:65-229`` and ``DataFlowOps.scala:45-293``.
+Here each frame lowers structurally to ONE functional loop — lax.scan when
+the trip count is static (keeps reverse-mode autodiff working), else
+lax.while_loop — and TensorArray buffers ride the flow value as carries.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+tf = pytest.importorskip("tensorflow")
+v1 = tf.compat.v1
+
+from bigdl_tpu.interop.tf import tensorflow_pb2 as tfpb  # noqa: E402
+from bigdl_tpu.interop.tf.loader import TFGraphModule  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _v1_control_flow():
+    """Generate genuine Enter/Merge/Switch graphs (TF2 defaults to
+    while_v2 even under compat.v1); restore v2 for other test files."""
+    v1.disable_control_flow_v2()
+    yield
+    v1.enable_control_flow_v2()
+
+
+def _import(graph_def, inputs, outputs):
+    g2 = tfpb.GraphDef()
+    g2.ParseFromString(graph_def.SerializeToString())
+    m = TFGraphModule(g2, inputs=inputs, outputs=outputs)
+    params, state = m.init(jax.random.key(0))
+    return m, params, state
+
+
+def test_v1_counter_while_loop_matches_oracle():
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [3], name="x")
+        _, acc = v1.while_loop(
+            lambda i, a: i < 5,
+            lambda i, a: (i + 1, a + tf.cast(i, tf.float32) * x),
+            [tf.constant(0), tf.zeros([3])])
+        tf.identity(acc, name="out")
+        with v1.Session(graph=g) as sess:
+            want = sess.run("out:0", {"x:0": np.array([1., 2., 3.], "f")})
+        gd = g.as_graph_def()
+
+    # the point of this file: the graph really is v1 control flow
+    ops = {n.op for n in gd.node}
+    assert {"Enter", "Exit", "Merge", "Switch", "NextIteration",
+            "LoopCond"} <= ops
+
+    m, params, state = _import(gd, ["x"], ["out"])
+    got, _ = m.apply(params, np.array([1., 2., 3.], "f"), state=state,
+                     training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_v1_data_dependent_loop_falls_back_to_while():
+    """Cond on a running value (not a counter): no static trip count, so
+    the frame must run as lax.while_loop — and still match TF."""
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [], name="x")
+        _, n = v1.while_loop(
+            lambda a, n: a < 100.0,
+            lambda a, n: (a * x, n + 1),
+            [tf.constant(1.0), tf.constant(0)])
+        tf.identity(tf.cast(n, tf.float32), name="out")
+        with v1.Session(graph=g) as sess:
+            want = sess.run("out:0", {"x:0": np.float32(1.7)})
+        gd = g.as_graph_def()
+
+    m, params, state = _import(gd, ["x"], ["out"])
+    fr = next(iter(m._exit_to_frame.values()))
+    assert m._static_trip_count(
+        fr, {"x": np.float32(1.7)},
+        [np.float32(1.0), np.int32(0)]) is None
+    got, _ = m.apply(params, np.float32(1.7), state=state, training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def _lstm_graph(rs, T=7, B=4, I=5, H=6):
+    """The reference dynamic_lstm fixture pattern: a v1 while frame with a
+    time counter, (c, h) state, a read-only input TensorArray (unstacked
+    before the loop) and an output TensorArray written per step — exactly
+    the graph shape tf.compat.v1.nn.dynamic_rnn emits."""
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [B, T, I], name="x")
+        Wk = tf.constant(rs.randn(I + H, 4 * H).astype("f") * 0.3,
+                         name="kernel")
+        bk = tf.constant(rs.randn(4 * H).astype("f") * 0.1, name="bias")
+        xt = tf.transpose(x, [1, 0, 2])
+        in_ta = tf.TensorArray(tf.float32, T).unstack(xt)
+        out_ta = tf.TensorArray(tf.float32, T)
+
+        def body(t, c, h, ta):
+            xx = in_ta.read(t)
+            z = tf.matmul(tf.concat([xx, h], 1), Wk) + bk
+            i_, j_, f_, o_ = tf.split(z, 4, 1)
+            c2 = tf.sigmoid(f_ + 1.0) * c + tf.sigmoid(i_) * tf.tanh(j_)
+            h2 = tf.sigmoid(o_) * tf.tanh(c2)
+            return t + 1, c2, h2, ta.write(t, h2)
+
+        _, cT, hT, out_ta = v1.while_loop(
+            lambda t, c, h, ta: t < T, body,
+            [tf.constant(0), tf.zeros([B, H]), tf.zeros([B, H]), out_ta])
+        tf.transpose(out_ta.stack(), [1, 0, 2], name="outputs")
+        tf.identity(cT, name="state_c")
+        tf.identity(hT, name="state_h")
+        return g
+
+
+def test_v1_dynamic_lstm_matches_oracle_and_is_jittable():
+    rs = np.random.RandomState(0)
+    xv = rs.rand(4, 7, 5).astype("f")
+    g = _lstm_graph(rs)
+    with v1.Session(graph=g) as sess:
+        want_o, want_c, want_h = sess.run(
+            ["outputs:0", "state_c:0", "state_h:0"], {"x:0": xv})
+    gd = g.as_graph_def()
+    assert "TensorArrayWriteV3" in {n.op for n in gd.node}
+
+    m, params, state = _import(gd, ["x"], ["outputs", "state_c", "state_h"])
+    (got_o, got_c, got_h), _ = m.apply(params, xv, state=state,
+                                       training=False)
+    np.testing.assert_allclose(np.asarray(got_o), want_o, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_c), want_c, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_h), want_h, rtol=1e-4,
+                               atol=1e-5)
+
+    out2 = jax.jit(lambda p, xx: m.apply(p, xx, state=state,
+                                         training=False)[0][0])(params, xv)
+    np.testing.assert_allclose(np.asarray(out2), want_o, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_v1_dynamic_lstm_is_reverse_differentiable():
+    """The counted-loop frame lowers to lax.scan, so jax.grad works
+    through the imported graph — the capability the reference implements
+    with its TensorArrayGrad/StackPush backward ops
+    (``DL/nn/tf/DataFlowOps.scala``); here autodiff provides it."""
+    rs = np.random.RandomState(1)
+    xv = rs.rand(4, 7, 5).astype("f")
+    g = _lstm_graph(rs)
+    gd = g.as_graph_def()
+    m, params, state = _import(gd, ["x"], ["outputs", "state_c", "state_h"])
+    assert params, "LSTM kernel Const should be lifted into params"
+
+    def loss(p, xx):
+        (o, c, _h), _ = m.apply(p, xx, state=state, training=False)
+        return (o * o).sum() + c.sum()
+
+    grads = jax.grad(loss)(params, xv)
+    total = sum(float(np.abs(np.asarray(gv)).sum())
+                for gv in jax.tree.leaves(grads))
+    assert np.isfinite(total) and total > 0
+
+
+def test_v1_tensor_array_fixture_mirror():
+    """Per-op mirror of the reference's tensor_array.py fixture:
+    scatter+gather, split+concat (ragged), write+read+size,
+    unstack+stack."""
+    rs = np.random.RandomState(2)
+    iv = rs.rand(20, 3, 4).astype("f")
+    outs = ["scatter_and_gather", "split_and_concat", "size1",
+            "write_and_read", "size2", "unstack_and_stack"]
+    with tf.Graph().as_default() as g:
+        inputs = v1.placeholder(tf.float32, [20, 3, 4], name="input_node")
+        i1, i2, i3, i4 = tf.split(inputs, 4, 0)
+        ta = tf.TensorArray(tf.float32, 128)
+        ta = ta.scatter([1, 2, 5, 4, 3], i1)
+        ta.gather([1, 2, 5, 4, 3], name="scatter_and_gather")
+        # ragged elements: TF2 needs infer_shape=False (TF1 allowed it)
+        ta = tf.TensorArray(tf.float32, 2, infer_shape=False)
+        ta = ta.split(i2, [2, 3])
+        tf.identity(ta.concat(), name="split_and_concat")
+        ta = tf.TensorArray(tf.float32, 5)
+        ta = ta.identity()
+        ta = ta.write(1, i3)
+        tf.cast(ta.size(), tf.float32, name="size1")
+        ta.read(1, name="write_and_read")
+        tf.cast(ta.size(), tf.float32, name="size2")
+        ta = tf.TensorArray(tf.float32, 5)
+        ta = ta.unstack(i4)
+        tf.identity(ta.stack(), name="unstack_and_stack")
+        with v1.Session(graph=g) as sess:
+            wants = sess.run([o + ":0" for o in outs],
+                             {"input_node:0": iv})
+        gd = g.as_graph_def()
+
+    m, params, state = _import(gd, ["input_node"], outs)
+    gots, _ = m.apply(params, iv, state=state, training=False)
+    for name, want, got in zip(outs, wants, gots):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_v1_loop_reading_unwritten_tensor_array_raises():
+    """ADVICE r3: reads of a never-written TensorArray/TensorList must be
+    a diagnosable error naming the node, not a TypeError on None."""
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [3], name="x")
+        ta = tf.TensorArray(tf.float32, 4, infer_shape=False,
+                            element_shape=None)
+        ta.read(0, name="bad_read")
+        gd = g.as_graph_def()
+
+    m, params, state = _import(gd, ["x"], ["bad_read"])
+    with pytest.raises(ValueError, match="read before any"):
+        m.apply(params, np.zeros(3, "f"), state=state, training=False)
