@@ -126,6 +126,7 @@ class SocketFeedDataSet(AbstractDataSet):
         self.depth = depth
         self._epoch_size = epoch_size
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._failed: Optional[BaseException] = None
         self._open_producers = 0
         self._connected = 0  # total accepted so far (end-of-stream fires
         # only after ALL n_producers have connected AND finished — a fast
@@ -213,6 +214,11 @@ class SocketFeedDataSet(AbstractDataSet):
         ignored (the executor side owns batching, as in the reference
         where per-partition batch = global/nodes)."""
         while True:
+            if self._failed is not None:
+                # sticky: a failed feed job must keep failing even if a
+                # retry loop re-enters batches() on a drained queue
+                raise IOError("feed job failed before/while producing "
+                              "batches") from self._failed
             item = self._queue.get()
             if item is None:
                 # producers all finished cleanly: the stream ends (one
@@ -229,6 +235,20 @@ class SocketFeedDataSet(AbstractDataSet):
                 yield MiniBatch(arrays[0], arrays[1])
             else:
                 yield MiniBatch(tuple(arrays[:-1]), arrays[-1])
+
+    def fail(self, error: BaseException) -> None:
+        """Poison the stream: unblocks a consumer waiting in ``batches()``
+        and makes every future epoch fail fast. For feed *drivers* whose
+        producer job dies before any producer ever connects (ADVICE r3:
+        otherwise optimize() blocks forever on the empty queue)."""
+        self._failed = error
+        try:
+            # non-blocking: if the queue is full the consumer is not
+            # stuck in get(), and the sticky _failed check in batches()
+            # fails it on its next iteration anyway
+            self._queue.put_nowait(_StreamError(error))
+        except queue.Full:
+            pass
 
     def close(self) -> None:
         self._server.close()

@@ -50,7 +50,10 @@ def partition_producer(host: str, port: int, seed: int, n_batches: int,
 
 
 def run_spark(sc, host, port, n_partitions, n_batches, batch):
-    """Real Spark path: one feed connection per partition task. A real
+    """Real Spark path: one feed connection per partition task. UNTESTED
+    in this image (no pyspark): only the multiprocessing fallback below
+    and the JVM byte-layout conformance test exercise the wire protocol;
+    this branch's Spark-specific plumbing has never run here. A real
     job would iterate the partition's records inside the closure; the
     synthetic producer only needs the partition index for a distinct
     seed."""
@@ -113,6 +116,9 @@ def main(argv=None):
                           args.nBatches, args.batchSize)
             except BaseException as e:  # surfaced after optimize/join
                 spark_err.append(e)
+                # poison the feed so optimize() unblocks instead of
+                # waiting forever on a stream no producer will ever feed
+                ds.fail(e)
 
         spark_thread = threading.Thread(target=spark_action, daemon=True)
         spark_thread.start()
@@ -136,7 +142,12 @@ def main(argv=None):
                     batch_size=args.batchSize)
     opt.set_optim_method(SGD(learning_rate=0.05))
     opt.set_end_when(Trigger.max_epoch(args.maxEpoch))
-    params, state = opt.optimize()
+    try:
+        params, state = opt.optimize()
+    except Exception:
+        if spark_err:
+            raise RuntimeError("Spark feed job failed") from spark_err[0]
+        raise
 
     if spawn:
         for p in spawn:

@@ -1043,32 +1043,42 @@ class TFGraphModule(Module):
                 if sw is not None:
                     local[sw] = (carry[k], carry[k])
 
-            def eval_node(base):
-                if base in local:
-                    return
-                if base not in fr.members:
-                    local[base] = values[base]
-                    return
-                nd = self.nodes[base]
-                if nd.op == "Const":
-                    local[base] = tensor_to_numpy(nd.attr["value"].tensor)
-                    return
-                if nd.op in ("Enter", "RefEnter", "Merge", "RefMerge",
-                             "Switch", "RefSwitch", "NextIteration",
-                             "RefNextIteration", "LoopCond"):
-                    raise NotImplementedError(
-                        f"control node {base!r} ({nd.op}) in frame "
-                        f"{fr.name!r} is not part of the canonical while "
-                        "pattern (tf.cond inside a loop body?)")
-                args = []
-                for ref in nd.input:
-                    b, idx = _ref(ref)
-                    if idx < 0:
+            def eval_node(root):
+                # iterative DFS: loop bodies can chain arbitrarily many
+                # sequential ops (same rationale as _topo's iterative walk)
+                stack = [(root, False)]
+                while stack:
+                    base, ready = stack.pop()
+                    if base in local:
                         continue
-                    eval_node(b)
-                    v = local[b]
-                    args.append(v[idx] if isinstance(v, (tuple, list)) else v)
-                local[base] = self._eval_op(nd, args, ctx)
+                    if base not in fr.members:
+                        local[base] = values[base]
+                        continue
+                    nd = self.nodes[base]
+                    if nd.op == "Const":
+                        local[base] = tensor_to_numpy(nd.attr["value"].tensor)
+                        continue
+                    if nd.op in ("Enter", "RefEnter", "Merge", "RefMerge",
+                                 "Switch", "RefSwitch", "NextIteration",
+                                 "RefNextIteration", "LoopCond"):
+                        raise NotImplementedError(
+                            f"control node {base!r} ({nd.op}) in frame "
+                            f"{fr.name!r} is not part of the canonical while "
+                            "pattern (tf.cond inside a loop body?)")
+                    deps = [_ref(r) for r in nd.input]
+                    if not ready:
+                        stack.append((base, True))
+                        stack.extend((b, False) for b, idx in deps
+                                     if idx >= 0 and b not in local)
+                        continue
+                    args = []
+                    for b, idx in deps:
+                        if idx < 0:
+                            continue
+                        v = local[b]
+                        args.append(v[idx] if isinstance(v, (tuple, list))
+                                    else v)
+                    local[base] = self._eval_op(nd, args, ctx)
 
             out = []
             for ref in refs:

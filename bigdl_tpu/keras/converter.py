@@ -65,15 +65,20 @@ class DefinitionLoader:
     @staticmethod
     def _from_functional(spec) -> "keras.Model":
         """Functional ``Model`` graphs (reference ``DefinitionLoader``
-        handles graph models via inbound_nodes topology): each layer entry
-        wires to its parents by name; InputLayers become
-        :func:`keras.Input` nodes.
+        handles graph models via inbound_nodes topology,
+        ``PY/keras/converter.py:289,462``): each layer entry wires to its
+        parents by ``[name, node_index, tensor_index]``; InputLayers
+        become :func:`keras.Input` nodes.
 
-        Scope notes: shared layers (multiple inbound node indices) are out
-        of scope like the reference; merge layers map onto
-        :class:`keras.Merge`."""
+        Shared layers (multiple inbound call sites — Siamese towers,
+        two-tower recommenders): ONE repo layer instance is created and
+        called once per site, so every site produces its own graph node
+        while :class:`bigdl_tpu.nn.graph.Graph` keys the params subtree by
+        module instance — the call sites share weights exactly like the
+        reference's shared-layer handling."""
         cfg = spec["config"]
-        nodes: Dict[str, object] = {}
+        # layer name -> one output Node per call site (keras node_index)
+        nodes: Dict[str, list] = {}
         for lc in cfg["layers"]:
             name = lc.get("name") or lc["config"].get("name")
             cls = lc["class_name"]
@@ -81,20 +86,13 @@ class DefinitionLoader:
             if cls == "InputLayer" or not inbound:
                 shape = (lc["config"].get("batch_input_shape")
                          or lc["config"].get("batch_shape"))
-                nodes[name] = keras.Input(
-                    shape=tuple(int(d) for d in shape[1:]), name=name)
+                nodes[name] = [keras.Input(
+                    shape=tuple(int(d) for d in shape[1:]), name=name)]
                 continue
-            first = inbound[0]
-            if isinstance(first, dict):  # keras-3 {"args": [...]} form
+            if isinstance(inbound[0], dict):  # keras-3 {"args": [...]} form
                 raise ValueError(
                     "keras-3 functional JSON is not supported; re-save the "
                     "model with tf.keras (legacy h5/json)")
-            if len(inbound) > 1:
-                raise ValueError(
-                    f"layer {name!r} is shared ({len(inbound)} call sites); "
-                    "shared layers are out of scope (reference converter "
-                    "scope)")
-            parents = [nodes[p[0]] for p in first]
             if cls == "Merge":
                 layer = keras.Merge(
                     mode=lc["config"].get("mode", "sum"),
@@ -107,11 +105,19 @@ class DefinitionLoader:
                 layer = DefinitionLoader._convert_layer(lc)
             if name:
                 layer.set_name(name)
-            nodes[name] = layer(parents) if len(parents) > 1 \
-                else layer(parents[0])
+
+            def parent(ref):
+                # [name, node_index, tensor_index(, kwargs)]
+                return nodes[ref[0]][ref[1] if len(ref) > 1 else 0]
+
+            nodes[name] = [
+                layer(parents) if len(parents) > 1 else layer(parents[0])
+                for call in inbound
+                for parents in [[parent(p) for p in call]]]
 
         def endpoints(key):
-            return [nodes[entry[0]] for entry in cfg[key]]
+            return [nodes[e[0]][e[1] if len(e) > 1 else 0]
+                    for e in cfg[key]]
 
         inputs = endpoints("input_layers")
         outputs = endpoints("output_layers")

@@ -64,8 +64,14 @@ class FasterRCNN(Module):
         rpn = jnp.maximum(self.run_child(ctx, "rpn_conv", feat), 0.0)
         cls_scores = self.run_child(ctx, "rpn_cls", rpn)
         box_deltas = self.run_child(ctx, "rpn_box", rpn)
+        # rank proposals by P(object), not the raw obj logit: softmax each
+        # (bg, obj) channel pair like the reference pipeline (SoftMax over
+        # the 2A score map before Proposal; ADVICE r3)
+        n, c2a, fh, fw = cls_scores.shape
+        pair = cls_scores.reshape(n, 2, c2a // 2, fh, fw)
+        cls_probs = jax.nn.softmax(pair, axis=1).reshape(n, c2a, fh, fw)
         rois5, _, roi_valid = self.run_child(
-            ctx, "proposal", (cls_scores, box_deltas, im_info))
+            ctx, "proposal", (cls_probs, box_deltas, im_info))
         pooled = self.run_child(ctx, "roi_pool", (feat, rois5[:, 1:]))
         scores, deltas = self.run_child(ctx, "box_head", pooled)
         # zero the padded (invalid) proposals' probabilities so they fall

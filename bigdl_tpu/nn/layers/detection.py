@@ -145,21 +145,34 @@ class Anchor:
     def num_anchors(self) -> int:
         return len(self.ratios) * len(self.scales)
 
-    def base_anchors(self) -> jax.Array:
+    def base_anchors(self, base_size: Optional[float] = None) -> jax.Array:
+        """Exact reference math (``Anchor.scala:126-222``, the classic
+        py-faster-rcnn enumeration): base window ``[0, 0, base-1, base-1]``
+        centered at ``(base-1)/2``, ratio widths ROUNDED to integers, scale
+        enum preserving the center — so reference-trained RPN weights see
+        bit-identical anchors (ADVICE r3: the previous symmetric variant
+        had a systematic half-pixel offset)."""
+        base = float(base_size if base_size is not None else self.base_size)
+        ctr = 0.5 * (base - 1)
+        area = base * base
         anchors = []
         for r in self.ratios:
+            # floor(v + .5) = Scala Math.round (Python round() is banker's)
+            ws = float(math.floor(math.sqrt(area / r) + 0.5))
+            hs = float(math.floor(ws * r + 0.5))
             for s in self.scales:
-                size = self.base_size * s
-                w = size * math.sqrt(1.0 / r)
-                h = size * math.sqrt(r)
-                anchors.append([-w / 2, -h / 2, w / 2, h / 2])
+                hw = ws * s / 2 - 0.5
+                hh = hs * s / 2 - 0.5
+                anchors.append([ctr - hw, ctr - hh, ctr + hw, ctr + hh])
         return jnp.asarray(anchors, jnp.float32)
 
     def generate(self, feat_h: int, feat_w: int, stride: float) -> jax.Array:
-        """(A * H * W, 4) anchors in image coordinates."""
-        base = self.base_anchors()  # (A, 4)
-        shift_x = (jnp.arange(feat_w) + 0.5) * stride
-        shift_y = (jnp.arange(feat_h) + 0.5) * stride
+        """(A * H * W, 4) anchors in image coordinates. Shifts are
+        ``x * stride`` and the base size follows the stride when they
+        differ (``Anchor.scala:39-46,59-70``)."""
+        base = self.base_anchors(stride)  # (A, 4)
+        shift_x = jnp.arange(feat_w) * stride
+        shift_y = jnp.arange(feat_h) * stride
         sx, sy = jnp.meshgrid(shift_x, shift_y)
         shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (H*W, 4)
         return (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)

@@ -14,6 +14,7 @@ cross-replica statistics rather than replica-0's local view.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -62,13 +63,49 @@ def bn_train(x, gamma, beta, axes, eps):
     return y, mean, var
 
 
-def _bn_train_fwd(x, gamma, beta, axes, eps):
+# BN statistic-sweep implementation: "reduce" (XLA convert+reduce fusions,
+# VPU) or "dot" (both sweeps as lax.dot_general with bf16 inputs and fp32
+# MXU accumulation via preferred_element_type — mean contracts against
+# ones, sum-of-squares is x·x with the channel as a batch dim). Selectable
+# for A/B perf experiments (PERF_NOTES.md round-4); numerics of "dot" are
+# at least as good: the MXU multiplies bf16 exactly and accumulates fp32.
+# Read per-trace (not at import) so tests/experiments can flip it late.
+def _bn_stats_impl():
+    return os.environ.get("BIGDL_BN_STATS", "reduce")
+
+
+def _stats_reduce(x, axes):
     # two jnp sums, NOT a variadic lax.reduce: XLA-TPU fuses each
     # convert+square into its reduce and overlaps the sweeps; a measured
     # variadic-reduce variant was 16% SLOWER end-to-end (110 vs 95 ms/step
     # on ResNet-50 b256) because it lowers to a slower loop shape
     mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-    mean_sq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, dtype=jnp.float32)
+    mean_sq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes,
+                       dtype=jnp.float32)
+    return mean, mean_sq
+
+
+def _stats_dot(x, axes):
+    n = float(np.prod([x.shape[i] for i in axes]))
+    ch = tuple(i for i in range(x.ndim) if i not in axes)
+    ones = jnp.ones([x.shape[i] for i in axes], x.dtype)
+    s = lax.dot_general(
+        x, ones, ((tuple(axes), tuple(range(len(axes)))), ((), ())),
+        preferred_element_type=jnp.float32)
+    ssq = lax.dot_general(
+        x, x, ((tuple(axes), tuple(axes)), (ch, ch)),
+        preferred_element_type=jnp.float32)
+    return s.reshape(-1) / n, ssq.reshape(-1) / n
+
+
+def _bn_stats(x, axes):
+    if _bn_stats_impl() == "dot":
+        return _stats_dot(x, axes)
+    return _stats_reduce(x, axes)
+
+
+def _bn_train_fwd(x, gamma, beta, axes, eps):
+    mean, mean_sq = _bn_stats(x, axes)
     var = jnp.maximum(mean_sq - mean * mean, 0.0)
     ch = [i for i in range(x.ndim) if i not in axes][0]
     y, inv = _bn_apply(x, mean, var, gamma, beta, eps, ch)

@@ -59,16 +59,27 @@ def test_nms_is_jittable():
 
 
 def test_anchor_generation():
-    a = D.Anchor(ratios=(0.5, 1.0, 2.0), scales=(8.0,))
-    anchors = np.asarray(a.generate(4, 5, 16.0))
+    """Pins the exact reference convention (``Anchor.scala:126-222`` =
+    py-faster-rcnn's generate_anchors): the canonical base-16 table."""
+    a = D.Anchor(ratios=(0.5, 1.0, 2.0), scales=(8.0, 16.0, 32.0))
+    base = np.asarray(a.base_anchors())
+    want = np.array([
+        [-84., -40., 99., 55.], [-176., -88., 191., 103.],
+        [-360., -184., 375., 199.], [-56., -56., 71., 71.],
+        [-120., -120., 135., 135.], [-248., -248., 263., 263.],
+        [-36., -80., 51., 95.], [-80., -168., 95., 183.],
+        [-168., -344., 183., 359.]], "f")
+    np.testing.assert_allclose(base, want, atol=1e-4)
+
+    anchors = np.asarray(
+        D.Anchor(ratios=(0.5, 1.0, 2.0), scales=(8.0,)).generate(4, 5, 16.0))
     assert anchors.shape == (3 * 4 * 5, 4)
-    # center of first cell's anchors is (8, 8)
+    # reference shifts are x*stride: first cell anchors centered (7.5, 7.5)
     centers = (anchors[:3, :2] + anchors[:3, 2:]) / 2
-    np.testing.assert_allclose(centers, 8.0, atol=1e-4)
-    # ratio=1 anchor is square with side base*scale
-    w = anchors[1, 2] - anchors[1, 0]
-    h = anchors[1, 3] - anchors[1, 1]
-    np.testing.assert_allclose([w, h], 128.0, rtol=1e-5)
+    np.testing.assert_allclose(centers, 7.5, atol=1e-4)
+    # second grid cell = first shifted by exactly one stride in x
+    np.testing.assert_allclose(anchors[3] - anchors[0],
+                               [16., 0., 16., 0.], atol=1e-4)
 
 
 def test_prior_box_normalized(rng):
@@ -241,10 +252,11 @@ def test_proposal_layer_shapes_and_ranking():
     assert rois5.shape == (8, 5) and valid.shape == (8,)
     assert valid[0]  # at least the best proposal is valid
     assert rois5[0, 0] == 0.0  # batch index column
-    # best roi is the anchor at cell (2, 1): center ~ ((1+.5)*16, (2+.5)*16)
+    # best roi is the anchor at cell (2, 1): reference shift convention
+    # (Anchor.scala) puts its center at (1*16 + 7.5, 2*16 + 7.5)
     cx = (rois5[0, 1] + rois5[0, 3]) / 2
     cy = (rois5[0, 2] + rois5[0, 4]) / 2
-    assert abs(cx - 24.0) < 1e-3 and abs(cy - 40.0) < 1e-3
+    assert abs(cx - 23.5) < 1e-3 and abs(cy - 39.5) < 1e-3
     assert roi_scores[0] == 5.0
 
 

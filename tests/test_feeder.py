@@ -161,3 +161,35 @@ def test_multiprocess_producers_feed_trainer():
     params, state = spark_feeder.main(
         ["--nProducers", "2", "--nBatches", "2", "--batchSize", "8"])
     assert params is not None
+
+
+def test_feed_dataset_fail_unblocks_consumer():
+    """ADVICE r3: when the producer JOB dies before any producer connects,
+    ds.fail() must unblock a consumer stuck in batches() and stay sticky
+    across re-entry (retry loops must not re-block)."""
+    import threading
+    import time
+
+    from bigdl_tpu.dataset.feeder import SocketFeedDataSet
+
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=1)
+    got = {}
+
+    def consume():
+        try:
+            next(ds.batches(0, train=True))
+        except Exception as e:
+            got["error"] = e
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()  # blocked: nothing ever connected
+    ds.fail(RuntimeError("spark job exploded"))
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert isinstance(got["error"], IOError)
+    # sticky: a fresh epoch fails fast instead of blocking
+    with pytest.raises(IOError):
+        next(ds.batches(0, train=True))
+    ds.close()

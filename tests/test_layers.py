@@ -279,3 +279,40 @@ def test_maxpool_fused_backward_matches_select_and_scatter():
     m.fused_backward = False
     g_std = jax.grad(loss)(x)
     np.testing.assert_allclose(np.asarray(g_custom), np.asarray(g_std), rtol=1e-6)
+
+
+def test_bn_stats_dot_impl_matches_reduce(monkeypatch):
+    """The MXU BN-stats path (BIGDL_BN_STATS=dot, round-4 perf lever):
+    bit-comparable mean/var and matching train fwd+bwd vs the reduce
+    path."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.layers import norm
+
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(8, 5, 6, 7).astype("f4"))
+    m_r, sq_r = norm._stats_reduce(x, (0, 2, 3))
+    m_d, sq_d = norm._stats_dot(x, (0, 2, 3))
+    np.testing.assert_allclose(np.asarray(m_d), np.asarray(m_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sq_d), np.asarray(sq_r),
+                               rtol=1e-5, atol=1e-6)
+
+    gamma = jnp.ones(5) * 1.3
+    beta = jnp.zeros(5) + 0.2
+
+    def run(impl):
+        monkeypatch.setenv("BIGDL_BN_STATS", impl)
+
+        def loss(xx):
+            y, mean, var = norm.bn_train(xx, gamma, beta, (0, 2, 3), 1e-5)
+            return (y * y).sum() + mean.sum() + var.sum()
+
+        v, g = jax.value_and_grad(loss)(x)
+        return np.asarray(v), np.asarray(g)
+
+    v_r, g_r = run("reduce")
+    v_d, g_d = run("dot")
+    np.testing.assert_allclose(v_d, v_r, rtol=1e-5)
+    np.testing.assert_allclose(g_d, g_r, rtol=1e-4, atol=1e-5)
