@@ -370,6 +370,13 @@ _OPS: Dict[str, Callable] = {
     "LogicalNot": lambda i, n, c: jnp.logical_not(i[0]),
     "Select": lambda i, n, c: jnp.where(i[0], i[1], i[2]),
     "SelectV2": lambda i, n, c: jnp.where(i[0], i[1], i[2]),
+    # v1 cond-style Switch (outside while frames): both ports carry the
+    # value — both branches are computed and the paired Merge selects
+    # (reference executes these dynamically, ControlOps.scala:65; here the
+    # lowering is compute-both + select, and XLA DCEs the unused side of
+    # ops the select doesn't need)
+    "Switch": lambda i, n, c: (i[0], i[0]),
+    "RefSwitch": lambda i, n, c: (i[0], i[0]),
     "MatMul": _matmul,
     "BatchMatMul": _batch_matmul,
     "BatchMatMulV2": _batch_matmul,
@@ -797,8 +804,90 @@ class TFGraphModule(Module):
         self._exit_to_frame: Dict[str, _V1Frame] = {}
         if any(n.op in ("Enter", "RefEnter") for n in graph_def.node):
             self._build_frames()
+        # v1 cond-style Merges (tf.cond without frames): pred + true-input
+        self._cond_merges = self._analyze_cond_merges()
         # needed set: nodes reachable from outputs
         self._order = self._topo()
+
+    def _follow_identity(self, base: str) -> str:
+        """Skip Identity/Snapshot chains (pred_id pivots, Switch:1
+        wrappers) for pattern matching."""
+        for _ in range(8):
+            nd = self.nodes.get(base)
+            if nd is None or nd.op not in ("Identity", "Snapshot") \
+                    or not nd.input:
+                break
+            base = _ref(nd.input[0])[0]
+        return base
+
+    def _analyze_cond_merges(self) -> Dict[str, Tuple[str, int]]:
+        """For every Merge OUTSIDE a while frame, find the cond PREDICATE
+        whose Switch ports dominate its two inputs; the Merge lowers to
+        ``where(pred, true_branch, false_branch)``. Reference: SwitchOps /
+        MergeOps run data-driven (``DL/nn/tf/ControlOps.scala:65-107`` +
+        ``Scheduler.scala``); functionally both branches compute and the
+        select picks (dead side must be pure, which tf.cond guarantees).
+
+        tf.cond creates a SEPARATE Switch per captured tensor (named after
+        the consuming op), all sharing one predicate — so domination is
+        keyed on the Identity-normalized predicate, and nested conds are
+        handled by descending through inner Switches' data inputs."""
+        frame_members: set = set()
+        for fr in set(self._exit_to_frame.values()):
+            frame_members |= fr.members
+        out: Dict[str, Tuple[str, int]] = {}
+        self._cond_unsupported: Dict[str, str] = {}
+        for nd in self.graph_def.node:
+            if nd.op not in ("Merge", "RefMerge") or nd.name in frame_members:
+                continue
+            if len(nd.input) != 2:
+                # deferred: only an error if this Merge is actually
+                # reachable from the fetched outputs (fed interior inputs
+                # prune whole subgraphs — _topo's documented contract)
+                self._cond_unsupported[nd.name] = (
+                    f"v1 cond Merge {nd.name!r} with {len(nd.input)} inputs")
+                continue
+            sets = []
+            pred_ref_of: Dict[str, str] = {}
+            for ref in nd.input:
+                ports, stack, seen = set(), [_ref(ref)], set()
+                while stack:
+                    b, p = stack.pop()
+                    if (b, p) in seen:
+                        continue
+                    seen.add((b, p))
+                    n2 = self.nodes.get(b)
+                    if n2 is None:
+                        continue
+                    if n2.op in ("Switch", "RefSwitch"):
+                        key = self._follow_identity(_ref(n2.input[1])[0])
+                        ports.add((key, p))
+                        pred_ref_of.setdefault(key, n2.input[1])
+                        # descend through the data input too: a NESTED
+                        # cond's branches sit behind inner Switches but
+                        # are still dominated by the outer predicate
+                        stack.append(_ref(n2.input[0]))
+                        continue
+                    # control deps included: a branch returning a Const is
+                    # anchored to the cond pivot only via ^switch_t/f
+                    stack.extend((bb, max(pp, 0))
+                                 for bb, pp in map(_ref, n2.input))
+                sets.append(ports)
+            hit = next(((k, p) for (k, p) in sets[0]
+                        if (k, 1 - p) in sets[1]), None)
+            if hit is None and sets[0] and not sets[1]:
+                hit = next(iter(sets[0]))
+            elif hit is None and sets[1] and not sets[0]:
+                k, p = next(iter(sets[1]))
+                hit = (k, 1 - p)
+            if hit is None:
+                self._cond_unsupported[nd.name] = (
+                    f"cannot pair v1 Merge {nd.name!r} with a dominating "
+                    "Switch (non-cond dataflow Merge is unsupported)")
+                continue
+            k, p = hit
+            out[nd.name] = (pred_ref_of[k], 0 if p == 1 else 1)
+        return out
 
     def _build_frames(self):
         from collections import defaultdict
@@ -931,6 +1020,13 @@ class TFGraphModule(Module):
                     base, idx = _ref(ref)
                     if idx >= 0 and state.get(base) != 1:  # skip control deps
                         stack.append((base, False))
+                if name in self._cond_merges:
+                    # the select predicate: may be reachable only via
+                    # control deps (both branches Const), so depend on it
+                    # explicitly
+                    pb = _ref(self._cond_merges[name][0])[0]
+                    if state.get(pb) != 1:
+                        stack.append((pb, False))
         return order
 
     def build_params(self, rng):
@@ -1101,16 +1197,7 @@ class TFGraphModule(Module):
         can lower to differentiable ``lax.scan``. Returns None when the
         pattern doesn't hold (falls back to ``lax.while_loop``)."""
 
-        def follow(base):
-            # v1 lowering wraps Switch:1 in Identity ('while/Identity');
-            # skip such chains when pattern-matching
-            for _ in range(8):
-                nd = self.nodes.get(base)
-                if nd is None or nd.op not in ("Identity", "Snapshot") \
-                        or not nd.input:
-                    break
-                base = _ref(nd.input[0])[0]
-            return base
+        follow = self._follow_identity
 
         def static_value(ref):
             base = follow(_ref(ref)[0])
@@ -1177,6 +1264,26 @@ class TFGraphModule(Module):
                 self._eval_v1_frame(self._exit_to_frame[name], values, ctx)
                 continue
             node = self.nodes[name]
+            if node.op in ("Merge", "RefMerge"):
+                if name in self._cond_unsupported:
+                    raise NotImplementedError(self._cond_unsupported[name])
+                pred_ref, true_idx = self._cond_merges[name]
+                pb, pi = _ref(pred_ref)
+                pv = values[pb]
+                pred = pv[pi] if isinstance(pv, (tuple, list)) else pv
+                branches = []
+                for ref in node.input:
+                    b, idx = _ref(ref)
+                    v = values[b]
+                    branches.append(v[idx] if isinstance(v, (tuple, list))
+                                    else v)
+                sel = jnp.where(pred, branches[true_idx],
+                                branches[1 - true_idx])
+                # port 1 = value_index (which input produced the value)
+                vidx = jnp.where(pred, jnp.int32(true_idx),
+                                 jnp.int32(1 - true_idx))
+                values[name] = (sel, vidx)
+                continue
             if node.op == "Const":
                 if name in param_set:
                     values[name] = ctx.param(name.replace("/", "__"))

@@ -1,5 +1,8 @@
-"""Keras 1.2 model converter: JSON architecture + HDF5 weights -> the
-Keras tier.
+"""Keras model converter: JSON architecture + HDF5 weights -> the Keras
+tier. Accepts Keras-1.2 JSON (the reference's format), Keras-2/tf.keras
+legacy JSON, and Keras-3 ``to_json()`` functional/Sequential graphs
+(``__keras_tensor__`` inbound encoding + ``.weights.h5`` layout),
+including shared layers in all formats.
 
 Reference: ``PY/keras/converter.py`` (DefinitionLoader / WeightLoader for
 Keras 1.2.2 models) + ``PY/keras/backend.py`` (KerasModelWrapper).
@@ -51,9 +54,21 @@ class DefinitionLoader:
         if isinstance(layers_cfg, dict):  # keras 2.x nests under "layers"
             layers_cfg = layers_cfg["layers"]
         model = keras.Sequential()
+        pending_shape = None
         for lc in layers_cfg:
+            if lc["class_name"] == "InputLayer":
+                # keras-3 Sequential: shape rides a leading InputLayer
+                # ("batch_shape"), not the first real layer's config
+                shape = (lc["config"].get("batch_input_shape")
+                         or lc["config"].get("batch_shape"))
+                if shape:
+                    pending_shape = tuple(int(d) for d in shape[1:])
+                continue
             layer = DefinitionLoader._convert_layer(lc)
             if layer is not None:
+                if pending_shape is not None and layer._input_shape is None:
+                    layer._input_shape = pending_shape
+                pending_shape = None
                 model.add(layer)
         return model
 
@@ -89,10 +104,7 @@ class DefinitionLoader:
                 nodes[name] = [keras.Input(
                     shape=tuple(int(d) for d in shape[1:]), name=name)]
                 continue
-            if isinstance(inbound[0], dict):  # keras-3 {"args": [...]} form
-                raise ValueError(
-                    "keras-3 functional JSON is not supported; re-save the "
-                    "model with tf.keras (legacy h5/json)")
+            inbound = DefinitionLoader._normalize_inbound(inbound)
             if cls == "Merge":
                 layer = keras.Merge(
                     mode=lc["config"].get("mode", "sum"),
@@ -116,13 +128,45 @@ class DefinitionLoader:
                 for parents in [[parent(p) for p in call]]]
 
         def endpoints(key):
+            entries = cfg[key]
+            if entries and isinstance(entries[0], str):
+                entries = [entries]  # keras-3 single endpoint: flat triple
             return [nodes[e[0]][e[1] if len(e) > 1 else 0]
-                    for e in cfg[key]]
+                    for e in entries]
 
         inputs = endpoints("input_layers")
         outputs = endpoints("output_layers")
         return keras.Model(inputs[0] if len(inputs) == 1 else inputs,
                            outputs[0] if len(outputs) == 1 else outputs)
+
+    @staticmethod
+    def _normalize_inbound(inbound):
+        """Keras-2 inbound form passes through; Keras-3's
+        ``[{"args": [...], "kwargs": {...}}]`` form (one dict per call site,
+        tensors encoded as ``__keras_tensor__`` with a
+        ``keras_history = [layer, node_index, tensor_index]``) is flattened
+        to the keras-2 ``[[name, node_index, tensor_index], ...]`` lists."""
+        if not inbound or not isinstance(inbound[0], dict):
+            return inbound
+        calls = []
+        for node in inbound:
+            refs: list = []
+
+            def walk(v):
+                if isinstance(v, dict):
+                    if v.get("class_name") == "__keras_tensor__":
+                        refs.append(list(v["config"]["keras_history"]))
+                    else:
+                        for vv in v.values():
+                            walk(vv)
+                elif isinstance(v, (list, tuple)):
+                    for vv in v:
+                        walk(vv)
+
+            walk(node.get("args", []))
+            walk(node.get("kwargs", {}))
+            calls.append(refs)
+        return calls
 
     @staticmethod
     def _convert_layer(lc: Dict):

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-4 TPU measurement sequence (run when the tunnel is up).
+# Each leg appends to perf/artifacts/r4_measurements.txt.
+cd "$(dirname "$0")/.." || exit 1
+OUT=perf/artifacts/r4_measurements.txt
+echo "=== round-4 TPU measurements $(date -u +%FT%TZ) ===" >> "$OUT"
+
+leg() {
+  echo "--- $1 ---" | tee -a "$OUT"
+  shift
+  timeout 1500 "$@" 2>>/tmp/r4_stderr.log | tee -a "$OUT"
+}
+
+# 1. baseline bench (BN reduce impl, b128, HWIO) — supervisor wraps retry
+leg "bench baseline b128 reduce" python bench.py --no-host-pipeline
+# 2. BN stats via MXU dot_general (perf lever a) — env via `env`, not a
+# VAR=x prefix (bash leaks those past function calls)
+leg "bench b128 BN=dot" env BIGDL_BN_STATS=dot python bench.py --no-host-pipeline
+# 3. b256 re-sweep with HWIO (perf lever c)
+leg "bench b256 reduce" python bench.py --batch 256 --no-host-pipeline
+# 4. int8 vs fp32 inference (VERDICT item 6)
+leg "perf fwd fp32 b128" python -m bigdl_tpu.models.perf --model resnet50 --mode fwd -b 128
+leg "perf fwd int8 b128" python -m bigdl_tpu.models.perf --model resnet50 --mode fwd --int8 -b 128
+# 5. overlap async-flag experiment (VERDICT item 5)
+leg "overlap async flags" python perf/overlap_async.py
+
+echo "=== done $(date -u +%FT%TZ) ===" >> "$OUT"

@@ -217,3 +217,112 @@ def test_v1_loop_reading_unwritten_tensor_array_raises():
     m, params, state = _import(gd, ["x"], ["bad_read"])
     with pytest.raises(ValueError, match="read before any"):
         m.apply(params, np.zeros(3, "f"), state=state, training=False)
+
+
+@pytest.mark.parametrize("const_branch", [False, True])
+def test_v1_cond_switch_merge_matches_oracle(const_branch):
+    """v1 tf.cond (Switch/Merge outside a frame, reference
+    ``ControlOps.scala:65-107`` SwitchOps/MergeOps): lowered to
+    compute-both + select on the Switch predicate — including a branch
+    that returns a Const (anchored to the pivot only via control deps)."""
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [3], name="x")
+        pred = tf.reduce_sum(x) > 0.0
+        false_fn = (lambda: tf.zeros([3])) if const_branch \
+            else (lambda: x - 5.0)
+        y = tf.cond(pred, lambda: x * 2.0, false_fn)
+        tf.identity(y, name="out")
+        with v1.Session(graph=g) as sess:
+            w_pos = sess.run("out:0", {"x:0": np.array([1., 2., 3.], "f")})
+            w_neg = sess.run("out:0", {"x:0": np.array([-1., -2., 3.], "f")})
+        gd = g.as_graph_def()
+    assert {"Switch", "Merge"} <= {n.op for n in gd.node}
+
+    m, params, state = _import(gd, ["x"], ["out"])
+    for xv, want in [(np.array([1., 2., 3.], "f"), w_pos),
+                     (np.array([-1., -2., 3.], "f"), w_neg)]:
+        got, _ = m.apply(params, xv, state=state, training=False)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6,
+                                   err_msg=f"const_branch={const_branch}")
+
+
+def test_v1_variable_rnn_trains_via_session():
+    """Reference ``BigDLSessionImpl.train`` (``Session.scala:111``) on a
+    v1 graph: Variables feeding a while frame train through the
+    scan-lowered loop (grads flow into the frame's loop invariants)."""
+    from bigdl_tpu.interop.tf.loader import TFSession
+
+    T, B, I, H = 5, 8, 3, 4
+    rs = np.random.RandomState(0)
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [B, T, I], name="x")
+        y = v1.placeholder(tf.float32, [B, H], name="y")
+        W = v1.Variable(tf.constant(rs.randn(I + H, H).astype("f") * 0.4),
+                        name="W", use_resource=False)
+        in_ta = tf.TensorArray(tf.float32, T).unstack(
+            tf.transpose(x, [1, 0, 2]))
+
+        def body(t, h):
+            return t + 1, tf.tanh(
+                tf.matmul(tf.concat([in_ta.read(t), h], 1), W))
+
+        _, hT = v1.while_loop(lambda t, h: t < T, body,
+                              [tf.constant(0), tf.zeros([B, H])])
+        tf.identity(tf.reduce_mean((hT - y) ** 2), name="loss")
+        gd = g.as_graph_def()
+
+    g2 = tfpb.GraphDef()
+    g2.ParseFromString(gd.SerializeToString())
+    xv = rs.rand(64, T, I).astype("f")
+    yv = rs.rand(64, H).astype("f")
+    _, _, final_loss = TFSession(g2).train(
+        ["x", "y"], "loss", (xv, yv), n_steps=120, batch_size=8)
+    assert final_loss is not None and final_loss < 0.15
+
+
+def test_v1_nested_cond_and_const_const_cond():
+    """Code-review r4 regressions: (a) nested tf.cond — separate Switch
+    per capture site, so domination is keyed on the shared predicate;
+    (b) both branches Const — predicate reachable only via pivot control
+    deps, so the Merge depends on it explicitly in the topo order."""
+    xs = (np.array([1., 2., 3.], "f"), np.array([.1, .2, .3], "f"),
+          np.array([-1., -2., 3.], "f"))
+    with tf.Graph().as_default() as g:
+        x = v1.placeholder(tf.float32, [3], name="x")
+        p1 = tf.reduce_sum(x) > 0.0
+        p2 = tf.reduce_max(x) > 2.0
+        y = tf.cond(p1, lambda: tf.cond(p2, lambda: x * 2.0,
+                                        lambda: x * 3.0),
+                    lambda: x - 5.0)
+        z = tf.cond(p1, lambda: tf.zeros([3]), lambda: tf.ones([3]))
+        tf.identity(y, name="out")
+        tf.identity(z, name="out2")
+        with v1.Session(graph=g) as sess:
+            wants = [sess.run(["out:0", "out2:0"], {"x:0": xv})
+                     for xv in xs]
+        gd = g.as_graph_def()
+
+    m, params, state = _import(gd, ["x"], ["out", "out2"])
+    for xv, (w1, w2) in zip(xs, wants):
+        (g1, g2_), _ = m.apply(params, xv, state=state, training=False)
+        np.testing.assert_allclose(np.asarray(g1), w1, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g2_), w2, rtol=1e-6)
+
+
+def test_v1_unpairable_merge_pruned_by_fed_input_still_imports():
+    """Code-review r4: a non-cond dataflow Merge in a subgraph cut away by
+    feeding an interior input must not abort import (deferred error)."""
+    from tensorflow.python.ops import control_flow_ops
+
+    with tf.Graph().as_default() as g:
+        a = tf.constant([1.0])
+        b = tf.constant([2.0])
+        merged, _ = control_flow_ops.merge([a, b])
+        interior = tf.identity(merged, name="interior")
+        tf.identity(interior * 2.0, name="out")
+        gd = g.as_graph_def()
+
+    m, params, state = _import(gd, ["interior"], ["out"])
+    got, _ = m.apply(params, np.array([5.0], "f"), state=state,
+                     training=False)
+    np.testing.assert_allclose(np.asarray(got), [10.0])
