@@ -190,8 +190,13 @@ def run_bench(args):
 
     step = build_step(model, criterion, method)
 
+    # experimentation hook: JSON dict of TPU compiler options, passed via
+    # lower().compile(compiler_options=...) — this channel reaches the TPU
+    # compiler directly, bypassing the host-side XLA_FLAGS parsing that
+    # rejects xla_tpu_* flags on this tunneled runner (PERF_NOTES.md)
+    copts = json.loads(os.environ.get("BIGDL_BENCH_COMPILER_OPTS", "null"))
+
     def runner(n_iters):
-        @jax.jit
         def multi(params, mstate, ostate, x, y):
             # same resident batch each step, like DistriOptimizerPerf's dummy
             # data; the loop-carried params make steps dependency-chained so
@@ -202,7 +207,10 @@ def run_bench(args):
             )
             return losses
 
-        return multi
+        if copts:
+            return jax.jit(multi).lower(
+                params, mstate, ostate, x, y).compile(compiler_options=copts)
+        return jax.jit(multi)
 
     n1, n2 = (args.short, args.long) if on_tpu else (1, 3)
     m1, m2 = runner(n1), runner(n2)

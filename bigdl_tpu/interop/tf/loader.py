@@ -1039,6 +1039,18 @@ class TFGraphModule(Module):
     def _eval_op(self, node, args, ctx):
         if node.op in ("While", "StatelessWhile"):
             return self._eval_while(node, args, ctx)
+        if node.op in ("If", "StatelessIf"):
+            # cond_v2: then/else FunctionDefs -> lax.cond (both traced,
+            # one executed — the v2 analogue of the v1 Switch/Merge select)
+            then_f = self._functions[node.attr["then_branch"].func.name]
+            else_f = self._functions[node.attr["else_branch"].func.name]
+            pred, rest = args[0], list(args[1:])
+            out = lax.cond(
+                jnp.asarray(pred).reshape(()),
+                lambda ops: tuple(_eval_function(self, then_f, ops, ctx)),
+                lambda ops: tuple(_eval_function(self, else_f, ops, ctx)),
+                tuple(rest))
+            return out[0] if len(out) == 1 else out
         if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
             fdef = self._functions[node.attr["f"].func.name]
             outs = _eval_function(self, fdef, args, ctx)

@@ -120,9 +120,21 @@ def _bn_train_bwd(axes, eps, res, cts):
     mean_c = _bcast(mean, x.ndim, ch).astype(x.dtype)
     inv_c = _bcast(inv, x.ndim, ch).astype(x.dtype)
     xhat = (x - mean_c) * inv_c
-    # both reductions read (g, xhat) once; XLA fuses them into one pass
-    sum_g = jnp.sum(g, axis=axes, dtype=jnp.float32)
-    sum_g_xhat = jnp.sum((g * xhat), axis=axes, dtype=jnp.float32)
+    if _bn_stats_impl() == "dot":
+        # MXU: sum_g contracts g against ones; sum_g_xhat is g·xhat with
+        # the channel as batch dim — no materialized g*xhat product
+        axes_t = tuple(axes)
+        ones = jnp.ones([x.shape[i] for i in axes], g.dtype)
+        sum_g = lax.dot_general(
+            g, ones, ((axes_t, tuple(range(len(axes)))), ((), ())),
+            preferred_element_type=jnp.float32).reshape(-1)
+        sum_g_xhat = lax.dot_general(
+            g, xhat, ((axes_t, axes_t), ((ch,), (ch,))),
+            preferred_element_type=jnp.float32).reshape(-1)
+    else:
+        # both reductions read (g, xhat) once; XLA fuses them into one pass
+        sum_g = jnp.sum(g, axis=axes, dtype=jnp.float32)
+        sum_g_xhat = jnp.sum((g * xhat), axis=axes, dtype=jnp.float32)
     dgamma = sum_g_xhat
     dbeta = sum_g
     k1 = _bcast(inv * gamma, x.ndim, ch).astype(x.dtype)

@@ -578,3 +578,33 @@ def test_stock_tf2_resize_half_pixel_imports():
     params, state = m.init(jax.random.key(0))
     got, _ = m.apply(params, xv, state=state, training=False)
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_stock_tf_cond_v2_if_imports():
+    """TF2 cond (control-flow v2): StatelessIf + then/else FunctionDefs
+    lower onto lax.cond — the v2 analogue of the v1 Switch/Merge select."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    with tf.Graph().as_default() as g:
+        tf.compat.v1.enable_control_flow_v2()
+        x = tf.compat.v1.placeholder(tf.float32, [3], name="x")
+        y = tf.cond(tf.reduce_sum(x) > 0.0,
+                    lambda: x * 2.0, lambda: x - 5.0)
+        tf.identity(y, name="out")
+        with tf.compat.v1.Session(graph=g) as sess:
+            w_pos = sess.run("out:0", {"x:0": np.array([1., 2., 3.], "f")})
+            w_neg = sess.run("out:0", {"x:0": np.array([-9., 0., 1.], "f")})
+        gd = g.as_graph_def()
+    assert any(n.op in ("If", "StatelessIf") for n in gd.node), \
+        sorted({n.op for n in gd.node})
+
+    g2 = tfpb.GraphDef()
+    g2.ParseFromString(gd.SerializeToString())
+    m = TFGraphModule(g2, inputs=["x"], outputs=["out"])
+    params, state = m.init(jax.random.key(0))
+    for xv, want in [(np.array([1., 2., 3.], "f"), w_pos),
+                     (np.array([-9., 0., 1.], "f"), w_neg)]:
+        got, _ = m.apply(params, xv, state=state, training=False)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
