@@ -194,7 +194,7 @@ def run_bench(args):
     # lower().compile(compiler_options=...) — this channel reaches the TPU
     # compiler directly, bypassing the host-side XLA_FLAGS parsing that
     # rejects xla_tpu_* flags on this tunneled runner (PERF_NOTES.md)
-    copts = json.loads(os.environ.get("BIGDL_BENCH_COMPILER_OPTS", "null"))
+    copts = json.loads(os.environ.get("BIGDL_BENCH_COMPILER_OPTS") or "null")
 
     def runner(n_iters):
         def multi(params, mstate, ostate, x, y):

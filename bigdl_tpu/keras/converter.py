@@ -272,19 +272,33 @@ class WeightLoader:
                     })
                 return out
             if "layers" in g:  # Keras 3 .weights.h5 layout
+                def collect(grp):
+                    """Datasets of this group's ``vars`` plus nested
+                    sub-objects' (``cell/vars`` for RNN layers), in keras'
+                    save order."""
+                    ws = []
+                    if "vars" in grp:
+                        vg = grp["vars"]
+                        for k in sorted(vg.keys(), key=int):
+                            ws.append(np.asarray(vg[k]))
+                    for k in grp:
+                        if k != "vars" and hasattr(grp[k], "keys"):
+                            ws.extend(collect(grp[k]))
+                    return ws
+
                 for key in g["layers"]:
                     grp = g["layers"][key]
-                    if "vars" not in grp:
-                        continue
-                    vars_grp = grp["vars"]
-                    name = vars_grp.attrs.get("name", key)
+                    # group keys are class-derived ('simple_rnn'); the
+                    # LAYER name lives on the (possibly dataset-less)
+                    # direct vars group
+                    name = key
+                    if "vars" in grp and "name" in grp["vars"].attrs:
+                        name = grp["vars"].attrs["name"]
                     name = name.decode() if isinstance(name, bytes) else name
-                    keys = sorted(vars_grp.keys(), key=lambda k: int(k))
-                    out.append({
-                        "name": name,
-                        "weights": [np.asarray(vars_grp[k]) for k in keys],
-                        "weight_names": keys,
-                    })
+                    weights = collect(grp)
+                    if weights:
+                        out.append({"name": name, "weights": weights,
+                                    "weight_names": []})
                 return out
         raise ValueError(f"unrecognized Keras weight file layout in {path}")
 
@@ -309,6 +323,59 @@ class WeightLoader:
             return p
         if kind == "Embedding":
             return {"weight": weights[0]}
+        if kind == "SimpleRNN":
+            # [kernel (in,H), recurrent (H,H), bias] -> packed (in+H, H);
+            # use_bias=False saves no bias — overlay an explicit ZERO bias
+            # (the cell always owns a bias param; leaving the random init
+            # in place would be silently wrong)
+            w = np.concatenate([weights[0], weights[1]], axis=0)
+            b = weights[2] if len(weights) > 2 \
+                else np.zeros(w.shape[1], w.dtype)
+            return {"weight": w, "bias": b}
+        if kind == "LSTM":
+            if len(weights) == 12:
+                # Keras 1.2: per-gate [W,U,b] x (i, c, f, o) -> pack and
+                # reorder to this repo's (i, f, g=c, o)
+                Wg = {g: weights[3 * k] for k, g in enumerate("icfo")}
+                Ug = {g: weights[3 * k + 1] for k, g in enumerate("icfo")}
+                bg = {g: weights[3 * k + 2] for k, g in enumerate("icfo")}
+                kern = np.concatenate([Wg[g] for g in "ifco"], axis=1)
+                rec = np.concatenate([Ug[g] for g in "ifco"], axis=1)
+                bias = np.concatenate([bg[g] for g in "ifco"])
+            else:
+                # Keras 2/3: kernel (in,4H) + recurrent (H,4H) + bias (4H),
+                # gate order (i, f, c, o) == this repo's (i, f, g, o)
+                kern, rec = weights[0], weights[1]
+                bias = weights[2] if len(weights) > 2 \
+                    else np.zeros(kern.shape[1], kern.dtype)  # use_bias=False
+            return {"weight": np.concatenate([kern, rec], axis=0),
+                    "bias": bias}
+        if kind == "GRU":
+            kern, rec = weights[0], weights[1]
+            h = rec.shape[0]
+            bias = weights[2] if len(weights) > 2 \
+                else np.zeros((2, 3 * h), kern.dtype)  # use_bias=False
+            if bias.ndim != 2:
+                # reset_after=False applies the reset BEFORE the recurrent
+                # matmul — a different function than this repo's GRUCell
+                # (torch/cuDNN convention); no faithful weight mapping
+                raise ValueError(
+                    "GRU weight conversion requires reset_after=True "
+                    "(bias shape (2, 3H)); reset_after=False is a "
+                    "different recurrence and cannot be mapped")
+            kz, kr, kh = kern[:, :h], kern[:, h:2 * h], kern[:, 2 * h:]
+            rz_, rr, rh = rec[:, :h], rec[:, h:2 * h], rec[:, 2 * h:]
+            b_in, b_rec = bias[0], bias[1]
+            return {
+                # this repo's packed rz columns are (r | z)
+                "weight_rz": np.concatenate(
+                    [np.concatenate([kr, kz], axis=1),
+                     np.concatenate([rr, rz_], axis=1)], axis=0),
+                "bias_rz": np.concatenate(
+                    [b_in[h:2 * h] + b_rec[h:2 * h], b_in[:h] + b_rec[:h]]),
+                "weight_in": kh, "bias_in": b_in[2 * h:],
+                "weight_hn": rh, "bias_hn": b_rec[2 * h:],
+            }
         if kind == "BatchNormalization":
             # keras order: gamma, beta, moving_mean, moving_variance
             p = {"weight": weights[0], "bias": weights[1]}

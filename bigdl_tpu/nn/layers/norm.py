@@ -85,17 +85,27 @@ def _stats_reduce(x, axes):
     return mean, mean_sq
 
 
+def _dot_sums(a, b, axes):
+    """Per-channel ``(sum(a), sum(a*b))`` over ``axes`` as two MXU
+    dot_general contractions — bf16 inputs, fp32 accumulation, and no
+    materialized ``a*b`` product. ``a``/``b`` share one non-reduced
+    (channel) axis."""
+    axes_t = tuple(axes)
+    ch = tuple(i for i in range(a.ndim) if i not in axes)
+    ones = jnp.ones([a.shape[i] for i in axes], a.dtype)
+    s = lax.dot_general(
+        a, ones, ((axes_t, tuple(range(len(axes)))), ((), ())),
+        preferred_element_type=jnp.float32)
+    sab = lax.dot_general(
+        a, b, ((axes_t, axes_t), (ch, ch)),
+        preferred_element_type=jnp.float32)
+    return s.reshape(-1), sab.reshape(-1)
+
+
 def _stats_dot(x, axes):
     n = float(np.prod([x.shape[i] for i in axes]))
-    ch = tuple(i for i in range(x.ndim) if i not in axes)
-    ones = jnp.ones([x.shape[i] for i in axes], x.dtype)
-    s = lax.dot_general(
-        x, ones, ((tuple(axes), tuple(range(len(axes)))), ((), ())),
-        preferred_element_type=jnp.float32)
-    ssq = lax.dot_general(
-        x, x, ((tuple(axes), tuple(axes)), (ch, ch)),
-        preferred_element_type=jnp.float32)
-    return s.reshape(-1) / n, ssq.reshape(-1) / n
+    s, ssq = _dot_sums(x, x, axes)
+    return s / n, ssq / n
 
 
 def _bn_stats(x, axes):
@@ -121,16 +131,7 @@ def _bn_train_bwd(axes, eps, res, cts):
     inv_c = _bcast(inv, x.ndim, ch).astype(x.dtype)
     xhat = (x - mean_c) * inv_c
     if _bn_stats_impl() == "dot":
-        # MXU: sum_g contracts g against ones; sum_g_xhat is g·xhat with
-        # the channel as batch dim — no materialized g*xhat product
-        axes_t = tuple(axes)
-        ones = jnp.ones([x.shape[i] for i in axes], g.dtype)
-        sum_g = lax.dot_general(
-            g, ones, ((axes_t, tuple(range(len(axes)))), ((), ())),
-            preferred_element_type=jnp.float32).reshape(-1)
-        sum_g_xhat = lax.dot_general(
-            g, xhat, ((axes_t, axes_t), ((ch,), (ch,))),
-            preferred_element_type=jnp.float32).reshape(-1)
+        sum_g, sum_g_xhat = _dot_sums(g, xhat, axes)
     else:
         # both reductions read (g, xhat) once; XLA fuses them into one pass
         sum_g = jnp.sum(g, axis=axes, dtype=jnp.float32)

@@ -351,3 +351,85 @@ def test_keras3_sequential_json_oracle(tmp_path):
     m2 = load_keras(json_str=model.to_json(), hdf5_path=h5)
     got = m2.predict(x)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_keras3_recurrent_weights_convert_oracle(tmp_path):
+    """Recurrent weight conversion vs the live Keras-3 oracle: SimpleRNN /
+    LSTM (packed (in+H, gates) kernel, keras gate order i,f,c,o == this
+    repo's i,f,g,o) and GRU (reset_after=True mapping onto the split
+    r/z + candidate params). Weights ride keras-3's nested cell/vars h5
+    groups with the layer name on the dataset-less direct vars group."""
+    keras3 = pytest.importorskip("keras")
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(3, 6, 5).astype("f4")
+    for layer_cls, name in [(keras3.layers.SimpleRNN, "rnn"),
+                            (keras3.layers.LSTM, "lstm"),
+                            (keras3.layers.GRU, "gru")]:
+        model = keras3.Sequential([keras3.layers.Input((6, 5)),
+                                   layer_cls(4, name=name)])
+        want = np.asarray(model(x))
+        h5 = str(tmp_path / f"{name}.weights.h5")
+        model.save_weights(h5)
+        m2 = load_keras(json_str=model.to_json(), hdf5_path=h5)
+        got = m2.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_keras1_lstm_12_array_weights_convert(tmp_path):
+    """Keras-1.2 LSTM layout: 12 per-gate arrays in (i, c, f, o) order
+    reorder into the packed (i, f, g, o) kernel."""
+    rs = np.random.RandomState(1)
+    I, H = 5, 4
+    gates = {g: (rs.randn(I, H).astype("f4") * 0.4,
+                 rs.randn(H, H).astype("f4") * 0.4,
+                 rs.randn(H).astype("f4") * 0.1) for g in "icfo"}
+    weights = [a for g in "icfo" for a in gates[g]]
+    h5 = str(tmp_path / "k1_lstm.h5")
+    _write_keras1_h5(h5, [("l", weights)])
+    spec = json.dumps({
+        "class_name": "Sequential",
+        "config": [{"class_name": "LSTM", "config": {
+            "name": "l", "output_dim": H, "return_sequences": False,
+            "batch_input_shape": [None, 6, I]}}],
+    })
+    model = load_keras(json_str=spec, hdf5_path=h5)
+    x = rs.rand(2, 6, I).astype("f4")
+    got = model.predict(x)
+
+    # numpy LSTM oracle, gates i,f,g,o with sigmoid/tanh
+    W = np.concatenate([gates[g][0] for g in "ifco"], axis=1)
+    U = np.concatenate([gates[g][1] for g in "ifco"], axis=1)
+    b = np.concatenate([gates[g][2] for g in "ifco"])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((2, H), "f4")
+    c = np.zeros((2, H), "f4")
+    for t in range(6):
+        z = x[:, t] @ W + h @ U + b
+        i_, f_, g_, o_ = np.split(z, 4, axis=1)
+        c = sig(f_) * c + sig(i_) * np.tanh(g_)
+        h = sig(o_) * np.tanh(c)
+    np.testing.assert_allclose(got, h, rtol=1e-4, atol=1e-5)
+
+
+def test_keras3_no_bias_recurrent_converts_with_zero_bias(tmp_path):
+    """Code-review r4: use_bias=False layers must overlay explicit ZERO
+    biases (not leave the cell's random init in place), and a no-bias GRU
+    with reset_after=True must convert, not be misdiagnosed."""
+    keras3 = pytest.importorskip("keras")
+
+    rs = np.random.RandomState(3)
+    x = rs.rand(3, 6, 5).astype("f4")
+    for layer_cls, name in [(keras3.layers.LSTM, "lstm_nb"),
+                            (keras3.layers.GRU, "gru_nb")]:
+        model = keras3.Sequential([
+            keras3.layers.Input((6, 5)),
+            layer_cls(4, name=name, use_bias=False)])
+        want = np.asarray(model(x))
+        h5 = str(tmp_path / f"{name}.weights.h5")
+        model.save_weights(h5)
+        m2 = load_keras(json_str=model.to_json(), hdf5_path=h5)
+        got = m2.predict(x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
