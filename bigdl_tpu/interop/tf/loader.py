@@ -143,6 +143,33 @@ def _depthwise_conv2d(inp, node, ctx):
         feature_group_count=cin)
 
 
+def _conv2d_backprop_input(inp, node, ctx):
+    """Forward deconvolution: ``tf.nn.conv2d_transpose`` emits this op as
+    its FORWARD computation (it is only a "gradient op" when autodiff
+    authored it — those subgraphs are never imported here). Semantics =
+    transposed conv with the true conv's padding geometry."""
+    out_sizes, w, dy = inp  # (input_sizes, filter HWIO (h,w,out,in), dy)
+    strides = list(node.attr["strides"].list.i)
+    padding = node.attr["padding"].s.decode()
+    fmt = node.attr["data_format"].s.decode() or "NHWC"
+    if fmt != "NHWC":
+        raise NotImplementedError("Conv2DBackpropInput NCHW")
+    dil = list(node.attr["dilations"].list.i) or [1, 1, 1, 1]
+    if any(d != 1 for d in dil):
+        raise NotImplementedError(
+            f"dilated conv2d_transpose at {node.name!r} (dilations {dil})")
+    y = lax.conv_transpose(
+        dy, w, tuple(strides[1:3]), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), transpose_kernel=True)
+    want = tuple(int(d) for d in np.asarray(out_sizes).reshape(-1))
+    if tuple(y.shape) != want:
+        raise ValueError(
+            f"conv2d_transpose shape mismatch at {node.name!r}: produced "
+            f"{tuple(y.shape)}, graph expects {want} (odd output_shape "
+            "geometry not representable by lax.conv_transpose)")
+    return y
+
+
 def _bias_add(inp, node, ctx):
     x, b = inp
     fmt = node.attr["data_format"].s.decode() or "NHWC"
@@ -381,6 +408,7 @@ _OPS: Dict[str, Callable] = {
     "BatchMatMul": _batch_matmul,
     "BatchMatMulV2": _batch_matmul,
     "Conv2D": _conv2d,
+    "Conv2DBackpropInput": _conv2d_backprop_input,
     "DepthwiseConv2dNative": _depthwise_conv2d,
     "BiasAdd": _bias_add,
     "MaxPool": _max_pool,

@@ -303,7 +303,8 @@ class WeightLoader:
         raise ValueError(f"unrecognized Keras weight file layout in {path}")
 
     @staticmethod
-    def convert(kind: str, weights: List[np.ndarray], dim_ordering: str = "th"):
+    def convert(kind: str, weights: List[np.ndarray], dim_ordering: str = "th",
+                cfg: Optional[Dict] = None):
         """Keras-1.2 weight layout -> this repo's param dict(s).
         ``dim_ordering``: 'th' stores conv kernels OIHW (our native layout),
         'tf' (and tf.keras h5 files) stores HWIO."""
@@ -353,16 +354,22 @@ class WeightLoader:
         if kind == "GRU":
             kern, rec = weights[0], weights[1]
             h = rec.shape[0]
+            # the recurrence VARIANT comes from the layer config, never
+            # inferred from weight shapes (a no-bias GRU has no bias to
+            # inspect): reset_after=False applies the reset BEFORE the
+            # recurrent matmul — a different function than this repo's
+            # GRUCell (torch/cuDNN convention); no faithful weight mapping
+            if not (cfg or {}).get("reset_after", True):
+                raise ValueError(
+                    "GRU weight conversion requires reset_after=True; "
+                    "reset_after=False is a different recurrence and "
+                    "cannot be mapped")
             bias = weights[2] if len(weights) > 2 \
                 else np.zeros((2, 3 * h), kern.dtype)  # use_bias=False
             if bias.ndim != 2:
-                # reset_after=False applies the reset BEFORE the recurrent
-                # matmul — a different function than this repo's GRUCell
-                # (torch/cuDNN convention); no faithful weight mapping
                 raise ValueError(
-                    "GRU weight conversion requires reset_after=True "
-                    "(bias shape (2, 3H)); reset_after=False is a "
-                    "different recurrence and cannot be mapped")
+                    "GRU bias shape %s does not match reset_after=True "
+                    "(expected (2, 3H))" % (bias.shape,))
             kz, kr, kh = kern[:, :h], kern[:, h:2 * h], kern[:, 2 * h:]
             rz_, rr, rh = rec[:, :h], rec[:, h:2 * h], rec[:, 2 * h:]
             b_in, b_rec = bias[0], bias[1]
@@ -451,7 +458,7 @@ def load_keras(json_path: Optional[str] = None,
         default_ordering = "channels_last" if kind == "Conv2D" else "th"
         ordering = cfg.get("dim_ordering",
                            cfg.get("data_format", default_ordering))
-        conv = WeightLoader.convert(kind, info["weights"], ordering)
+        conv = WeightLoader.convert(kind, info["weights"], ordering, cfg)
         if isinstance(conv, tuple):
             pconv, sconv = conv
             overlay(params, lname, pconv)

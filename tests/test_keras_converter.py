@@ -433,3 +433,18 @@ def test_keras3_no_bias_recurrent_converts_with_zero_bias(tmp_path):
         got = m2.predict(x)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
                                    err_msg=name)
+
+
+def test_gru_reset_after_false_rejected_even_without_bias(tmp_path):
+    """Code-review r4: the GRU variant comes from layer CONFIG, not
+    inferred from bias shape — a no-bias reset_after=False GRU must be
+    rejected, not silently mapped onto the wrong recurrence."""
+    keras3 = pytest.importorskip("keras")
+
+    model = keras3.Sequential([
+        keras3.layers.Input((6, 5)),
+        keras3.layers.GRU(4, name="g", use_bias=False, reset_after=False)])
+    h5 = str(tmp_path / "g.weights.h5")
+    model.save_weights(h5)
+    with pytest.raises(ValueError, match="reset_after"):
+        load_keras(json_str=model.to_json(), hdf5_path=h5)

@@ -608,3 +608,36 @@ def test_stock_tf_cond_v2_if_imports():
                      (np.array([-9., 0., 1.], "f"), w_neg)]:
         got, _ = m.apply(params, xv, state=state, training=False)
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_stock_tf_conv2d_transpose_imports():
+    """Conv2DBackpropInput is tf.nn.conv2d_transpose's FORWARD op
+    (deconvolution — segmentation/GAN graphs), not only a gradient op;
+    lax.conv_transpose with transpose_kernel matches it exactly."""
+    tf = pytest.importorskip("tensorflow")
+
+    from bigdl_tpu.interop.tf.loader import TFGraphModule
+
+    rs = np.random.RandomState(0)
+    for pad, stride in [("SAME", 2), ("VALID", 2), ("SAME", 1)]:
+        xv = rs.rand(2, 5, 6, 3).astype("f4")
+        wv = rs.randn(3, 3, 4, 3).astype("f4") * 0.3  # (h, w, out, in)
+        with tf.Graph().as_default() as g:
+            x = tf.compat.v1.placeholder(tf.float32, [2, 5, 6, 3],
+                                         name="x")
+            oh = 5 * stride if pad == "SAME" else (5 - 1) * stride + 3
+            ow = 6 * stride if pad == "SAME" else (6 - 1) * stride + 3
+            y = tf.nn.conv2d_transpose(x, tf.constant(wv), [2, oh, ow, 4],
+                                       [1, stride, stride, 1], padding=pad)
+            tf.identity(y, name="out")
+            with tf.compat.v1.Session(graph=g) as sess:
+                want = sess.run("out:0", {"x:0": xv})
+            gd = g.as_graph_def()
+        assert any(n.op == "Conv2DBackpropInput" for n in gd.node)
+        g2 = tfpb.GraphDef()
+        g2.ParseFromString(gd.SerializeToString())
+        m = TFGraphModule(g2, inputs=["x"], outputs=["out"])
+        params, state = m.init(jax.random.key(0))
+        got, _ = m.apply(params, xv, state=state, training=False)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5, err_msg=f"{pad} s{stride}")
