@@ -46,7 +46,44 @@ class TFRecordWriter:
 
 
 def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
-    """Yield raw record payloads (reference ``TFRecordIterator``)."""
+    """Yield raw record payloads (reference ``TFRecordIterator``).
+
+    Fast path: one native C pass over the whole file validates both CRCs
+    and returns payload framing; Python slices records out of the buffer
+    (no per-record read()/struct/crc round-trips — the reference parses
+    records JVM-side for the same reason). Pure-python fallback when the
+    native library is unavailable."""
+    import mmap
+
+    from bigdl_tpu.native import native_available, tfrecord_scan
+
+    # (probe also rejects a stale prebuilt .so lacking the scan symbol)
+    if (native_available() and tfrecord_scan(b"") is not None
+            and os.path.getsize(path) > 0):
+        with open(path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            try:
+                pos = 0
+                while True:
+                    try:
+                        offs, lens, truncated = tfrecord_scan(
+                            mm, start=pos, verify=verify_crc)
+                    except IOError as e:
+                        raise IOError(f"{path}: {e}") from None
+                    for off, ln in zip(offs, lens):
+                        yield mm[off:off + ln]  # bytes copy of one record
+                    if truncated or not len(offs):
+                        # partial tail (shard still being written) ends the
+                        # stream after the complete records, matching the
+                        # streaming fallback's tolerance
+                        return
+                    pos = int(offs[-1] + lens[-1] + 4)
+                    if pos >= len(mm):
+                        return
+            finally:
+                mm.close()
+        return
+
     with open(path, "rb") as f:
         while True:
             header = f.read(12)

@@ -36,13 +36,20 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_NATIVE_DIR, "bigdl_native.cpp")
+        stale = (not os.path.exists(_LIB_PATH)
+                 or (os.path.exists(src)
+                     and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)))
+        if stale:
             try:
+                # make's own dependency rule rebuilds when the source is
+                # newer — a prebuilt stale .so would miss newer symbols
                 subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
                                capture_output=True, timeout=120)
             except Exception:
-                _build_failed = True
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    _build_failed = True
+                    return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -80,6 +87,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_float, ctypes.c_int,
         ]
+        if hasattr(lib, "bigdl_tfrecord_scan"):  # absent in a stale .so
+            lib.bigdl_tfrecord_scan.restype = ctypes.c_int64
+            lib.bigdl_tfrecord_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ]
         _lib = lib
     return _lib
 
@@ -261,3 +275,36 @@ def batch_hwc_to_nchw(images: np.ndarray, mean, std, scale: float = 1.0,
         n, h, w, c, mean.ctypes.data_as(ctypes.c_void_p),
         std.ctypes.data_as(ctypes.c_void_p), ctypes.c_float(scale), n_threads)
     return out
+
+
+def tfrecord_scan(buf, start: int = 0, cap: int = 65536,
+                  verify: bool = True):
+    """Native one-pass TFRecord framing scan over an in-memory/mmapped
+    file: returns ``(offsets, lengths, truncated)`` — int64 payload
+    positions with both CRCs validated in C, plus whether the buffer ends
+    mid-record (records before the truncation ARE returned, matching the
+    tolerant streaming reader's in-progress-shard behavior). Returns
+    None when the native library is unavailable. Raises IOError on a
+    corrupt CRC. ``buf`` is anything buffer-like (bytes, mmap).
+
+    ``cap`` bounds one call; resume from
+    ``offsets[-1] + lengths[-1] + 4``."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bigdl_tfrecord_scan"):
+        return None
+    arr = np.frombuffer(buf, np.uint8)  # zero-copy view; works on mmap
+    offsets = np.empty(cap, np.int64)
+    lengths = np.empty(cap, np.int64)
+    err = ctypes.c_int64(-1)
+    n = lib.bigdl_tfrecord_scan(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.size, start,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        lengths.ctypes.data_as(ctypes.c_void_p), cap, int(verify),
+        ctypes.byref(err))
+    # release the buffer export BEFORE raising: the exception traceback
+    # pins this frame, and a pinned export would make an mmap'd caller's
+    # close() fail with BufferError
+    del arr
+    if n == -1:
+        raise IOError(f"corrupt tfrecord crc at byte {err.value}")
+    return offsets[:n], lengths[:n], err.value >= 0

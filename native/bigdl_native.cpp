@@ -226,4 +226,47 @@ void bigdl_batch_hwc_to_nchw_f32(const uint8_t* src, float* dst, int64_t n,
   });
 }
 
+// ------------------------------------------------------- tfrecord scan
+// One native pass over an in-memory TFRecord file: validate the
+// length+payload CRCs and emit (payload offset, length) pairs so Python
+// slices records zero-copy instead of doing per-record read()+struct+crc
+// (the reference's record parsing is JVM-side for the same reason).
+// Returns #records parsed (stops at `cap`, clean EOF, or a truncated
+// trailing record). *err_off = -1 on clean EOF / cap; the truncation
+// start offset when the tail is partial (records before it ARE
+// returned); on a corrupt CRC returns -1 with the bad offset in
+// *err_off. All bounds math is unsigned: a crafted/corrupt 2^63-scale
+// length field must report truncation, never read out of bounds.
+int64_t bigdl_tfrecord_scan(const uint8_t* buf, int64_t len, int64_t start,
+                            int64_t* offsets, int64_t* lengths, int64_t cap,
+                            int verify, int64_t* err_off) {
+  int64_t pos = start, n = 0;
+  *err_off = -1;
+  while (n < cap) {
+    uint64_t avail = (uint64_t)(len - pos);
+    if (avail == 0) return n;  // clean EOF
+    if (avail < 12) { *err_off = pos; return n; }
+    uint64_t rec_len;
+    memcpy(&rec_len, buf + pos, 8);  // little-endian host assumed (x86/ARM)
+    uint32_t len_crc;
+    memcpy(&len_crc, buf + pos + 8, 4);
+    if (verify && bigdl_masked_crc32c(buf + pos, 8) != len_crc) {
+      *err_off = pos;
+      return -1;
+    }
+    if (avail < 16 || rec_len > avail - 16) { *err_off = pos; return n; }
+    uint32_t data_crc;
+    memcpy(&data_crc, buf + pos + 12 + rec_len, 4);
+    if (verify && bigdl_masked_crc32c(buf + pos + 12, rec_len) != data_crc) {
+      *err_off = pos;
+      return -1;
+    }
+    offsets[n] = pos + 12;
+    lengths[n] = (int64_t)rec_len;
+    n++;
+    pos += 16 + (int64_t)rec_len;
+  }
+  return n;
+}
+
 }  // extern "C"

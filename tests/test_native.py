@@ -3,6 +3,7 @@ buffer semantics, image-op parity vs numpy, TFRecord round-trip (reference:
 Crc32c.java framing + TFRecord I/O in DL/utils/tf)."""
 
 import os
+import struct
 import threading
 
 import numpy as np
@@ -213,3 +214,53 @@ def test_batch_hwc_to_nchw_matches_numpy():
     ref = ref.transpose(0, 3, 1, 2)
     np.testing.assert_allclose(out, ref, atol=1e-6)
     assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+
+
+def test_tfrecord_scan_native_framing_and_errors(tmp_path):
+    """Native one-pass TFRecord scan: framing matches the Python writer,
+    corrupt CRCs raise IOError, truncated tails return the complete
+    records with the truncated flag set (in-progress-shard tolerance)."""
+    from bigdl_tpu.dataset.tfrecord import TFRecordWriter, read_tfrecords
+    from bigdl_tpu.native import native_available, tfrecord_scan
+
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)] + [b""]
+    p = str(tmp_path / "t.tfrecord")
+    with TFRecordWriter(p) as w:
+        for b in payloads:
+            w.write(b)
+    assert list(read_tfrecords(p)) == payloads
+
+    if not native_available():
+        return
+    data = open(p, "rb").read()
+    offs, lens, trunc = tfrecord_scan(data)
+    assert len(offs) == len(payloads) and not trunc
+    assert [data[o:o + l] for o, l in zip(offs, lens)] == payloads
+    # chunked resume (cap smaller than the record count)
+    offs2, lens2, _ = tfrecord_scan(data, cap=7)
+    assert len(offs2) == 7 and list(offs2) == list(offs[:7])
+    resume = int(offs2[-1] + lens2[-1] + 4)
+    offs3, _, _ = tfrecord_scan(data, start=resume)
+    assert list(offs3) == list(offs[7:])
+
+    corrupt = bytearray(data)
+    corrupt[int(offs[3])] ^= 0xFF  # flip a payload byte
+    with pytest.raises(IOError):
+        tfrecord_scan(bytes(corrupt))
+    # truncated tail: complete records returned + truncated flag set
+    offs4, _, trunc4 = tfrecord_scan(data[:-2])
+    assert trunc4 and len(offs4) == len(payloads) - 1
+    # a crafted 2^63-scale length field must report truncation, not read
+    # out of bounds (unsigned bounds math in C)
+    evil = struct.pack("<Q", 1 << 63) + b"\x00" * 8
+    _, _, trunc5 = tfrecord_scan(evil, verify=False)
+    assert trunc5
+    # in-progress shard tolerance: the reader ends cleanly mid-header
+    part = str(tmp_path / "part.tfrecord")
+    open(part, "wb").write(data + b"\x01\x02\x03")
+    assert list(read_tfrecords(part)) == payloads
+    # and the reader surfaces corruption as IOError with the path
+    bad = str(tmp_path / "bad.tfrecord")
+    open(bad, "wb").write(bytes(corrupt))
+    with pytest.raises(IOError):
+        list(read_tfrecords(bad))
