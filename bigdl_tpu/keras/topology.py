@@ -101,15 +101,33 @@ class KerasModel(Module):
             self._jit_fwd = jax.jit(fwd)
         return self._jit_fwd
 
+    def _n_inputs(self) -> int:
+        return 1
+
     def predict(self, x, batch_size: int = 32):
         """Forward in batches; returns a stacked np.ndarray
-        (reference ``KerasModel.predict``, ``Topology.scala:149``)."""
+        (reference ``KerasModel.predict``, ``Topology.scala:149``).
+        Multi-input functional Models take ``x`` as a list/tuple of
+        arrays, batch-sliced together — dispatch is on the MODEL's input
+        arity, so a plain Python list of samples for a single-input model
+        still reads as one array."""
         params, state = self._require_params()
         fwd = self._forward_fn()
-        x = np.asarray(x)
+        multi = self._n_inputs() > 1
+        xs = [np.asarray(a) for a in x] if multi else [np.asarray(x)]
+        if multi:
+            if len(xs) != self._n_inputs():
+                raise ValueError(
+                    f"model has {self._n_inputs()} inputs; got {len(xs)}")
+            if any(len(a) != len(xs[0]) for a in xs):
+                raise ValueError(
+                    "multi-input predict needs equal-length inputs; got "
+                    f"{[len(a) for a in xs]} rows")
         outs = []
-        for i in range(0, len(x), batch_size):
-            outs.append(np.asarray(fwd(params, state, jnp.asarray(x[i:i + batch_size]))))
+        for i in range(0, len(xs[0]), batch_size):
+            batch = tuple(jnp.asarray(a[i:i + batch_size]) for a in xs)
+            outs.append(np.asarray(
+                fwd(params, state, batch if multi else batch[0])))
         return np.concatenate(outs, axis=0)
 
     def predict_classes(self, x, batch_size: int = 32):
@@ -205,6 +223,9 @@ class Model(KerasModel):
         self._modules["graph"] = self._graph
         outs = [output] if isinstance(output, Node) else list(output)
         self._output_shapes = [getattr(n, "keras_shape", None) for n in outs]
+
+    def _n_inputs(self) -> int:
+        return len(self._graph.inputs)
 
     def get_output_shape(self):
         return self._output_shapes[0] if len(self._output_shapes) == 1 else tuple(self._output_shapes)
