@@ -12,17 +12,17 @@ leg() {
 }
 
 # 1. baseline bench (BN reduce impl, b128, HWIO) — supervisor wraps retry
-leg "bench baseline b128 reduce" python bench.py --no-host-pipeline
+leg "bench baseline b128 reduce" python bench.py --no-host-pipeline --max-wait 300
 # 2. BN stats via MXU dot_general (perf lever a) — env via `env`, not a
 # VAR=x prefix (bash leaks those past function calls)
-leg "bench b128 BN=dot" env BIGDL_BN_STATS=dot python bench.py --no-host-pipeline
+leg "bench b128 BN=dot" env BIGDL_BN_STATS=dot python bench.py --no-host-pipeline --max-wait 300
 # 3. b256 re-sweep with HWIO (perf lever c)
-leg "bench b256 reduce" python bench.py --batch 256 --no-host-pipeline
+leg "bench b256 reduce" python bench.py --batch 256 --no-host-pipeline --max-wait 300
 # 3b. TPU compiler-option probes through compiler_options (bypasses the
 # host XLA_FLAGS parser that rejects xla_tpu_* on this tunnel) — scoped
 # VMEM sweep, a known lever for conv-heavy models
-leg "bench b128 vmem=49152" env BIGDL_BENCH_COMPILER_OPTS='{"xla_tpu_scoped_vmem_limit_kib":"49152"}' python bench.py --no-host-pipeline
-leg "bench b128 vmem=98304" env BIGDL_BENCH_COMPILER_OPTS='{"xla_tpu_scoped_vmem_limit_kib":"98304"}' python bench.py --no-host-pipeline
+leg "bench b128 vmem=49152" env BIGDL_BENCH_COMPILER_OPTS='{"xla_tpu_scoped_vmem_limit_kib":"49152"}' python bench.py --no-host-pipeline --max-wait 300
+leg "bench b128 vmem=98304" env BIGDL_BENCH_COMPILER_OPTS='{"xla_tpu_scoped_vmem_limit_kib":"98304"}' python bench.py --no-host-pipeline --max-wait 300
 # 4. int8 vs fp32 inference (VERDICT item 6)
 leg "perf fwd fp32 b128" python -m bigdl_tpu.models.perf --model resnet50 --mode fwd -b 128
 leg "perf fwd int8 b128" python -m bigdl_tpu.models.perf --model resnet50 --mode fwd --int8 -b 128
