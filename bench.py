@@ -234,10 +234,11 @@ def run_bench(args):
         return best
 
     # min-of-each-then-ONE-difference (min-of-differences is biased
-    # negative); 6 reps per leg tightens the +-2% tunnel jitter observed
-    # between rounds
-    t1 = timed(m1, 6)
-    t2 = timed(m2, 6)
+    # negative); 10 reps per leg tightens the +-2-4% tunnel jitter
+    # observed between same-config runs (43.76 vs 45.57 ms an hour
+    # apart on 2026-07-31) — each rep costs <1 s, compile dominates
+    t1 = timed(m1, 10)
+    t2 = timed(m2, 10)
     dt_step = (t2 - t1) / (n2 - n1)
     imgs_per_sec = batch / dt_step  # single chip: per-chip == total
 

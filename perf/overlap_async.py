@@ -23,33 +23,12 @@ means the collective is genuinely overlapped with backward compute.
 Appends an "async attempt" section to perf/artifacts/overlap_hlo_summary.txt.
 """
 import os
-import re
 import sys
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from overlap_probe import build_step  # noqa: E402
-
-
-def analyze(txt):
-    lines = txt.splitlines()
-    starts, pairs = {}, []
-    compute_re = re.compile(r"= \S+ (fusion|convolution|dot)\(")
-    for i, ln in enumerate(lines):
-        m = re.search(r"%((all-reduce|reduce-scatter|all-gather)"
-                      r"-start[\w.\-]*) =", ln)
-        if m:
-            starts[m.group(1)] = i
-        m2 = re.search(r"-done[\w.\-]*\(%((?:all-reduce|reduce-scatter|"
-                       r"all-gather)-start[\w.\-]*)", ln)
-        if m2 and m2.group(1) in starts:
-            s = starts[m2.group(1)]
-            between = sum(1 for j in range(s + 1, i)
-                          if compute_re.search(lines[j]))
-            pairs.append((m2.group(1), i - s, between))
-    sync = len(re.findall(r"= \S+ all-reduce\(", txt))
-    return pairs, sync
+from overlap_probe import analyze, build_step  # noqa: E402
 
 
 CONFIGS = [
@@ -102,11 +81,12 @@ def main():
             compiled = lowered.compile(compiler_options=opts) if opts \
                 else lowered.compile()
             txt = compiled.as_text()
-            pairs, sync = analyze(txt)
+            pairs, sync, biggest = analyze(txt)
             overl = [p for p in pairs if p[2] > 0]
             line = (f"{name:16s} OK: async pairs={len(pairs)} "
                     f"(overlapped={len(overl)}, compute-in-windows="
-                    f"{sum(p[2] for p in pairs)}), sync all-reduce={sync}")
+                    f"{sum(p[2] for p in pairs)}), sync collectives={sync} "
+                    f"(largest {biggest / 1e6:.1f} MB)")
             report.append(line)
             print(line, flush=True)
             for pname, dist, between in sorted(pairs, key=lambda p: -p[2])[:8]:

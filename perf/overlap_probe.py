@@ -22,6 +22,65 @@ import sys
 
 import numpy as np
 
+_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+                "f32": 4, "s32": 4, "u32": 4, "bf16": 2,
+                "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+                "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+                "f8e4m3fnuz": 1, "f8e5m2fnuz": 1}
+
+
+def _instr_bytes(line):
+    """Total payload bytes of an HLO instruction line's result type
+    (sums every `dtype[dims]` in the (possibly tuple) type)."""
+    total = 0
+    # result type = the text between " = " and the op name; a tuple type
+    # starts with "(" so splitting on "(" would eat it
+    typ = line.split(" = ", 1)[-1]
+    typ = re.split(r" [\w\-]+\(", typ, 1)[0]
+    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", typ):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+# the result type of a tuple-shaped instruction contains spaces —
+# "= (f32[64]{...}, f32[64]{...}) all-reduce(" — so patterns of the form
+# "= \S+ op(" silently miss them; match on " op(" instead
+_COMPUTE_RE = re.compile(r" (fusion|convolution|dot)\(")
+_SYNC_RE = re.compile(r" (all-reduce|reduce-scatter|all-gather)\(")
+
+
+def analyze(txt):
+    """Scan a post-scheduling HLO module for collective instructions.
+
+    Returns (pairs, sync_count, biggest_bytes): async -start/-done pairs
+    (with the count of compute instructions scheduled inside each
+    window), the number of synchronous collective instructions, and the
+    payload size of the largest one.
+    """
+    lines = txt.splitlines()
+    starts, pairs = {}, []
+    sync, biggest = 0, 0
+    for i, ln in enumerate(lines):
+        m = re.search(r"%((all-reduce|reduce-scatter|all-gather)"
+                      r"-start[\w.\-]*) =", ln)
+        if m:
+            starts[m.group(1)] = i
+        m2 = re.search(r"-done[\w.\-]*\(%((?:all-reduce|reduce-scatter|"
+                       r"all-gather)-start[\w.\-]*)", ln)
+        if m2 and m2.group(1) in starts:
+            s = starts[m2.group(1)]
+            between = sum(1 for j in range(s + 1, i)
+                          if " = " in lines[j] and _COMPUTE_RE.search(lines[j]))
+            pairs.append((m2.group(1), i - s, between))
+        if " = " in ln and _SYNC_RE.search(ln):
+            sync += 1
+            biggest = max(biggest, _instr_bytes(ln))
+    return pairs, sync, biggest
+
 
 def build_step():
     import jax
@@ -79,27 +138,12 @@ def main():
     with open("/tmp/overlap_hlo.txt", "w") as f:
         f.write(txt)
 
-    lines = txt.splitlines()
-    starts = {}
-    pairs = []
-    compute_re = re.compile(r"= \S+ (fusion|convolution|dot)\(")
-    for i, ln in enumerate(lines):
-        m = re.search(r"%((all-reduce|reduce-scatter|all-gather)"
-                      r"-start[\w.\-]*) =", ln)
-        if m:
-            starts[m.group(1)] = i
-        m2 = re.search(r"-done[\w.\-]*\(%((?:all-reduce|reduce-scatter|"
-                       r"all-gather)-start[\w.\-]*)", ln)
-        if m2 and m2.group(1) in starts:
-            s = starts[m2.group(1)]
-            between = sum(1 for j in range(s + 1, i)
-                          if compute_re.search(lines[j]))
-            pairs.append((m2.group(1), i - s, between))
-    sync = len(re.findall(r"= \S+ all-reduce\(", txt))
+    pairs, sync, biggest = analyze(txt)
     overlapped = [p for p in pairs if p[2] > 0]
     total_between = sum(p[2] for p in pairs)
     print(f"devices: {len(devs)} (v5e:2x2x1 AOT)")
-    print(f"async collective pairs: {len(pairs)}; sync all-reduce: {sync}")
+    print(f"async collective pairs: {len(pairs)}; sync collectives: {sync} "
+          f"(largest {biggest / 1e6:.1f} MB)")
     print(f"pairs with compute scheduled between start/done: "
           f"{len(overlapped)}/{len(pairs)} "
           f"(total compute ops inside windows: {total_between})")
