@@ -37,6 +37,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# The TPU plugin in this image force-sets JAX_PLATFORMS=axon at import
+# time, so the conventional env override is silently ignored; re-applying
+# it through jax.config (the override that actually sticks — see
+# tests/conftest.py) makes `JAX_PLATFORMS=cpu python bench.py` really
+# select the cpu backend (dev runs, dead-backend regression test).
+_env_platforms = os.environ.get("JAX_PLATFORMS")
+if _env_platforms:
+    jax.config.update("jax_platforms", _env_platforms)
+
 
 def measure_peak_flops(dtype=jnp.bfloat16, n=4096, short=128, long=512):
     """Empirical peak FLOP/s: dependency-chained n x n matmuls, differential.
@@ -234,9 +243,9 @@ def run_bench(args):
         return best
 
     # min-of-each-then-ONE-difference (min-of-differences is biased
-    # negative); 10 reps per leg tightens the +-2-4% tunnel jitter
-    # observed between same-config runs (43.76 vs 45.57 ms an hour
-    # apart on 2026-07-31) — each rep costs <1 s, compile dominates
+    # negative); 10 reps per leg tightens the up-to-±6% tunnel jitter
+    # observed between same-config runs (43.76 → 46.34 ms across one
+    # day on 2026-07-31) — each rep costs <1 s, compile dominates
     t1 = timed(m1, 10)
     t2 = timed(m2, 10)
     dt_step = (t2 - t1) / (n2 - n1)
@@ -327,7 +336,9 @@ def _emit_diagnostic(error, detail, attempts):
 
 
 _PROBE_SRC = (
-    "import jax, jax.numpy as jnp;"
+    "import os, jax, jax.numpy as jnp;"
+    "p = os.environ.get('JAX_PLATFORMS');"
+    "p and jax.config.update('jax_platforms', p);"
     "d = jax.devices();"
     "v = float(jnp.ones((8, 8)).sum());"
     "print(d[0].platform, flush=True)"

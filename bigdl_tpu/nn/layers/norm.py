@@ -114,6 +114,39 @@ def _bn_stats(x, axes):
     return _stats_reduce(x, axes)
 
 
+# Experimental (round-4 perf lever, OFF by default): compute the forward
+# batch statistics over only the first BIGDL_BN_STATS_SAMPLE rows of the
+# batch. The sampled mean/var are unbiased estimators with ~batch/sample
+# times the variance, applied under stop_gradient (gradients treat them
+# as constants — exact for the sampled formulation, and it removes the
+# backward's dx correction sweeps entirely). This deviates from the
+# reference's full-batch BN semantics and from proper ghost BN (which
+# normalizes each subgroup by its own stats and saves nothing); accuracy
+# under this mode is NOT validated on a full ImageNet run — it exists to
+# measure the model-level cost of the stat sweeps and as an opt-in
+# throughput lever.
+def _bn_stats_sample():
+    try:
+        return int(os.environ.get("BIGDL_BN_STATS_SAMPLE", "0"))
+    except ValueError:
+        return 0
+
+
+def bn_train_sampled(x, gamma, beta, axes, eps, sample, ch):
+    """Training BN with stats over ``x[:sample]``, stop-gradient applied.
+
+    Returns ``(y, mean, var)`` like :func:`bn_train`; plain autodiff is
+    exact here (the stats are constants under stop_gradient, so the
+    backward is just the per-channel scale plus the dgamma/dbeta sums).
+    """
+    xs = lax.slice_in_dim(x, 0, sample, axis=0)
+    mean, mean_sq = _bn_stats(xs, axes)
+    mean = lax.stop_gradient(mean)
+    var = lax.stop_gradient(jnp.maximum(mean_sq - mean * mean, 0.0))
+    y, _ = _bn_apply(x, mean, var, gamma, beta, eps, ch)
+    return y, mean, var
+
+
 def _bn_train_fwd(x, gamma, beta, axes, eps):
     mean, mean_sq = _bn_stats(x, axes)
     var = jnp.maximum(mean_sq - mean * mean, 0.0)
@@ -202,11 +235,19 @@ class BatchNormalization(Module):
             gamma = jnp.ones((self.n_output,), jnp.float32)
             beta = jnp.zeros((self.n_output,), jnp.float32)
         if ctx.training:
-            y, mean, var = bn_train(x, gamma, beta, axes, self.eps)
+            sample = _bn_stats_sample()
+            if 0 < sample < x.shape[0] and 0 in axes:
+                y, mean, var = bn_train_sampled(x, gamma, beta, axes,
+                                                self.eps, sample, ch)
+                n_stat = sample * float(np.prod(
+                    [x.shape[i] for i in axes if i != 0]))
+            else:
+                y, mean, var = bn_train(x, gamma, beta, axes, self.eps)
+                n_stat = float(np.prod([x.shape[i] for i in axes]))
             mean = lax.stop_gradient(mean)
             var = lax.stop_gradient(var)
             m = self.momentum
-            n = float(np.prod([x.shape[i] for i in axes]))
+            n = n_stat
             unbiased = var * (n / max(1.0, n - 1.0))
             ctx.put_state("running_mean", (1 - m) * ctx.get_state("running_mean") + m * mean)
             ctx.put_state("running_var", (1 - m) * ctx.get_state("running_var") + m * unbiased)

@@ -316,3 +316,56 @@ def test_bn_stats_dot_impl_matches_reduce(monkeypatch):
     v_d, g_d = run("dot")
     np.testing.assert_allclose(v_d, v_r, rtol=1e-5)
     np.testing.assert_allclose(g_d, g_r, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_sampled_stats(monkeypatch):
+    """BIGDL_BN_STATS_SAMPLE (experimental round-4 lever): forward batch
+    stats come from the first ``sample`` rows only, the whole batch is
+    normalized with them, and running stats use the sampled count for the
+    unbiased-variance correction. sample >= batch falls back to the full
+    path bit-exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.layers import norm
+    from bigdl_tpu.nn import SpatialBatchNormalization
+
+    x = jnp.asarray(np.random.RandomState(1).randn(8, 5, 6, 7).astype("f4"))
+    gamma = jnp.ones(5) * 1.1
+    beta = jnp.zeros(5) - 0.3
+
+    y_s, mean_s, var_s = norm.bn_train_sampled(x, gamma, beta, (0, 2, 3),
+                                               1e-5, 4, ch=1)
+    m_ref, sq_ref = norm._stats_reduce(x[:4], (0, 2, 3))
+    np.testing.assert_allclose(np.asarray(mean_s), np.asarray(m_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(var_s),
+        np.maximum(np.asarray(sq_ref) - np.asarray(m_ref) ** 2, 0.0),
+        rtol=1e-5, atol=1e-6)
+    # the APPLY covers the whole batch with the sampled stats
+    inv = 1.0 / np.sqrt(np.asarray(var_s) + 1e-5)
+    expect = ((np.asarray(x) - np.asarray(mean_s)[None, :, None, None])
+              * inv[None, :, None, None] * 1.1 - 0.3)
+    np.testing.assert_allclose(np.asarray(y_s), expect, rtol=1e-4, atol=1e-4)
+
+    # module path: knob on -> sampled stats feed the running-stat update
+    bn = SpatialBatchNormalization(5, momentum=1.0)
+    params, state = bn.init(jax.random.key(0))
+    monkeypatch.setenv("BIGDL_BN_STATS_SAMPLE", "4")
+    _, new_state = bn.apply(params, x, state=state, training=True)
+    n = 4 * 6 * 7
+    np.testing.assert_allclose(
+        np.asarray(new_state["running_var"]),
+        np.asarray(var_s) * (n / (n - 1.0)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["running_mean"]),
+                               np.asarray(mean_s), rtol=1e-5)
+
+    # knob >= batch: identical to the default full-batch path
+    monkeypatch.setenv("BIGDL_BN_STATS_SAMPLE", "8")
+    y_full, st_full = bn.apply(params, x, state=state, training=True)
+    monkeypatch.delenv("BIGDL_BN_STATS_SAMPLE")
+    y_off, st_off = bn.apply(params, x, state=state, training=True)
+    np.testing.assert_array_equal(np.asarray(y_full), np.asarray(y_off))
+    np.testing.assert_array_equal(np.asarray(st_full["running_var"]),
+                                  np.asarray(st_off["running_var"]))
