@@ -112,7 +112,11 @@ def main(argv=None):
             p, ms, os_ = c
 
             def loss_fn(pp):
-                out, nms = model.apply(pp, x, state=ms, training=True)
+                # fixed dropout rng: fine for throughput (mask compute cost
+                # is identical every step), required by Dropout-bearing
+                # models (inception/vgg/alexnet) in training mode
+                out, nms = model.apply(pp, x, state=ms, training=True,
+                                       rng=jax.random.key(1))
                 return crit.forward(out.astype(jnp.float32), y), nms
 
             (loss, nms), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
