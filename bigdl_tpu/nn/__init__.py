@@ -63,6 +63,9 @@ from bigdl_tpu.nn.layers.recurrent import (
     LSTMPeepholeCell,
     GRUCell,
     ConvLSTMPeepholeCell,
+    ConvLSTMPeephole3DCell,
+    ConvLSTMPeephole,
+    ConvLSTMPeephole3D,
     MultiRNNCell,
     Recurrent,
     BiRecurrent,

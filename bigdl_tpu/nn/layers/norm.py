@@ -69,6 +69,10 @@ def bn_train(x, gamma, beta, axes, eps):
 # ones, sum-of-squares is x·x with the channel as a batch dim). Selectable
 # for A/B perf experiments (PERF_NOTES.md round-4); numerics of "dot" are
 # at least as good: the MXU multiplies bf16 exactly and accumulates fp32.
+# "frozen" is a PERF DIAGNOSTIC ONLY (round-5): constant stats forward and
+# no stat sums backward — mathematically WRONG training, it exists to
+# measure the end-to-end cost of every BN stat sweep at once (the ceiling
+# any fused-stats kernel could win back). Never use it to train.
 # Read per-trace (not at import) so tests/experiments can flip it late.
 def _bn_stats_impl():
     return os.environ.get("BIGDL_BN_STATS", "reduce")
@@ -109,8 +113,13 @@ def _stats_dot(x, axes):
 
 
 def _bn_stats(x, axes):
-    if _bn_stats_impl() == "dot":
+    impl = _bn_stats_impl()
+    if impl == "dot":
         return _stats_dot(x, axes)
+    if impl == "frozen":  # diagnostic: no sweeps at all (see note above)
+        ch = [i for i in range(x.ndim) if i not in axes][0]
+        c = x.shape[ch]
+        return jnp.zeros((c,), jnp.float32), jnp.ones((c,), jnp.float32)
     return _stats_reduce(x, axes)
 
 
@@ -121,10 +130,12 @@ def _bn_stats(x, axes):
 # as constants — exact for the sampled formulation, and it removes the
 # backward's dx correction sweeps entirely). This deviates from the
 # reference's full-batch BN semantics and from proper ghost BN (which
-# normalizes each subgroup by its own stats and saves nothing); accuracy
-# under this mode is NOT validated on a full ImageNet run — it exists to
-# measure the model-level cost of the stat sweeps and as an opt-in
-# throughput lever.
+# normalizes each subgroup by its own stats and saves nothing).
+# VALIDATED HARMFUL (round 5): ResNet-20 on the real-data digits recipe,
+# sample=32/batch=128, converges to 91.9% val top-1 vs the full-batch
+# control's 98.3% with a visibly unstable curve
+# (perf/artifacts/r5_digits_curve.txt). The +2.3% throughput is not worth
+# 6.4 accuracy points: keep OFF; retained only as a perf diagnostic.
 def _bn_stats_sample():
     try:
         return int(os.environ.get("BIGDL_BN_STATS_SAMPLE", "0"))
@@ -138,6 +149,13 @@ def bn_train_sampled(x, gamma, beta, axes, eps, sample, ch):
     Returns ``(y, mean, var)`` like :func:`bn_train`; plain autodiff is
     exact here (the stats are constants under stop_gradient, so the
     backward is just the per-channel scale plus the dgamma/dbeta sums).
+
+    SPMD caveat: under a sharded batch axis the first ``sample`` GLOBAL
+    rows all live on shard 0, so the stats become one shard's data (a
+    biased sample if shards see non-iid data) and XLA must broadcast
+    them to the other chips. A per-shard slice (strided rows) would
+    avoid both; not done because the knob is experimental, off by
+    default, and single-chip-motivated (advisor round-4 finding).
     """
     xs = lax.slice_in_dim(x, 0, sample, axis=0)
     mean, mean_sq = _bn_stats(xs, axes)
@@ -159,11 +177,44 @@ def _bn_train_bwd(axes, eps, res, cts):
     x, gamma, mean, inv = res
     ch = [i for i in range(x.ndim) if i not in axes][0]
     g, _, _ = cts  # cotangents for mean/var outputs are ignored (see doc)
+    impl = _bn_stats_impl()
+    if impl in ("frozen", "frozen_bwd"):
+        # diagnostic: no backward sums (frozen_bwd keeps real fwd stats)
+        k1 = _bcast(inv * gamma, x.ndim, ch).astype(x.dtype)
+        zero = jnp.zeros_like(gamma)
+        return k1 * g, zero, zero
     n = float(np.prod([x.shape[i] for i in axes]))
+    if impl in ("bwdx", "bwdx_dot"):
+        # x-based backward (round-5): never materialize xhat. Algebra:
+        #   sum_g_xhat = (sum(g*x) - mean*sum(g)) * inv
+        #   dx = k1*(g - mg - xhat*mgx) = k1*g + a - b*x
+        # with per-channel a = k1*(mgx*inv*mean - mg), b = k1*mgx*inv —
+        # the sweeps read (g, x) once and the full-size xhat/product
+        # tensors of the textbook formulation simply don't exist.
+        # Measured (TPU v5e, b128 ResNet-50): the textbook backward costs
+        # 7.6 ms/step of the 43.97 ms step; this formulation removes most
+        # of it (PERF_NOTES.md round-5).
+        if impl == "bwdx_dot":
+            sum_g, sum_gx = _dot_sums(g, x, axes)
+        else:
+            sum_g = jnp.sum(g, axis=axes, dtype=jnp.float32)
+            sum_gx = jnp.sum(g * x, axis=axes, dtype=jnp.float32)
+        sum_g_xhat = (sum_gx - mean * sum_g) * inv
+        dgamma = sum_g_xhat
+        dbeta = sum_g
+        k1v = inv * gamma
+        mg = sum_g / n
+        mgx = sum_g_xhat / n
+        a = k1v * (mgx * inv * mean - mg)
+        b = k1v * mgx * inv
+        k1 = _bcast(k1v, x.ndim, ch).astype(x.dtype)
+        dx = k1 * g + _bcast(a, x.ndim, ch).astype(x.dtype) \
+            - _bcast(b, x.ndim, ch).astype(x.dtype) * x
+        return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
     mean_c = _bcast(mean, x.ndim, ch).astype(x.dtype)
     inv_c = _bcast(inv, x.ndim, ch).astype(x.dtype)
     xhat = (x - mean_c) * inv_c
-    if _bn_stats_impl() == "dot":
+    if impl == "dot":
         sum_g, sum_g_xhat = _dot_sums(g, xhat, axes)
     else:
         # both reductions read (g, xhat) once; XLA fuses them into one pass

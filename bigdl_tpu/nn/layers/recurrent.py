@@ -276,6 +276,88 @@ class ConvLSTMPeepholeCell(Cell):
         return (h, c), h
 
 
+class ConvLSTMPeephole3DCell(Cell):
+    """3-D convolutional LSTM with peepholes (reference
+    ``ConvLSTMPeephole3D.scala``). State is (batch, channels, D, H, W).
+
+    Matches the reference's structure: a biased input convolution
+    (``kernel_i``) and an UNbiased recurrent convolution (``kernel_c``),
+    both SAME-padded stride 1 (the reference's ``padding = -1``), with
+    multiplicative peepholes from the cell state into i/f/o (its
+    ``CMul(Array(1, outputSize, 1, 1, 1))``). Gates are packed into
+    4*out channels per conv so the MXU sees two large convolutions per
+    step instead of eight small ones.
+    """
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, with_peephole: bool = True,
+                 weight_init: Optional[InitializationMethod] = None):
+        super().__init__()
+        assert stride == 1, "ConvLSTM state must keep spatial dims (stride 1)"
+        self.input_size = input_size
+        self.hidden_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.with_peephole = with_peephole
+        self.weight_init = weight_init or Xavier()
+
+    def build_params(self, rng):
+        ki, kc = self.kernel_i, self.kernel_c
+        cin, cout = self.input_size, self.hidden_size
+        init = self.weight_init
+        fan_i, fan_c = cin * ki ** 3, cout * kc ** 3
+        p = {
+            "weight_i": init(fold_in_str(rng, "wi"),
+                             (4 * cout, cin, ki, ki, ki), fan_i, 4 * cout * ki ** 3),
+            "bias": Zeros()(fold_in_str(rng, "b"), (4 * cout,), fan_i, cout),
+            # recurrent conv is bias-free in the reference (withBias = false)
+            "weight_h": init(fold_in_str(rng, "wh"),
+                             (4 * cout, cout, kc, kc, kc), fan_c, 4 * cout * kc ** 3),
+        }
+        if self.with_peephole:
+            p["peep_i"] = Zeros()(fold_in_str(rng, "pi"), (cout,), cout, cout)
+            p["peep_f"] = Zeros()(fold_in_str(rng, "pf"), (cout,), cout, cout)
+            p["peep_o"] = Zeros()(fold_in_str(rng, "po"), (cout,), cout, cout)
+        return p
+
+    def init_carry(self, batch, dtype=jnp.float32, input_shape=None):
+        assert input_shape is not None and len(input_shape) == 4, (
+            "ConvLSTM3D needs the (C, D, H, W) per-step input shape to size its state"
+        )
+        shape = (batch, self.hidden_size) + tuple(input_shape[-3:])
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    @staticmethod
+    def _conv3d_same(x, w, k):
+        pad = [(k // 2, (k - 1) - k // 2)] * 3
+        return lax.conv_general_dilated(
+            x, w, (1, 1, 1), pad,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+
+    def step(self, ctx: Context, carry, x):
+        h_prev, c_prev = carry
+        wi = ctx.param("weight_i").astype(x.dtype)
+        wh = ctx.param("weight_h").astype(x.dtype)
+        b = ctx.param("bias").astype(x.dtype)
+        z = (self._conv3d_same(x, wi, self.kernel_i)
+             + self._conv3d_same(h_prev, wh, self.kernel_c)
+             + b[None, :, None, None, None])
+        i, f, g, o = jnp.split(z, 4, axis=1)
+
+        def peep(name):
+            return ctx.param(name).astype(x.dtype)[None, :, None, None, None]
+
+        if self.with_peephole:
+            i = i + peep("peep_i") * c_prev
+            f = f + peep("peep_f") * c_prev
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if self.with_peephole:
+            o = o + peep("peep_o") * c
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
 class MultiRNNCell(Cell):
     """Stack of cells applied at each timestep (reference
     ``MultiRNNCell.scala``)."""
@@ -412,3 +494,15 @@ def GRU(input_size, hidden_size, **kw) -> Recurrent:
 
 def SimpleRNN(input_size, hidden_size, **kw) -> Recurrent:
     return Recurrent(RnnCell(input_size, hidden_size, **kw))
+
+
+def ConvLSTMPeephole(input_size, output_size, **kw) -> Recurrent:
+    """Sequence-level 2-D conv-LSTM over (B, T, C, H, W) (reference
+    ``ConvLSTMPeephole.scala`` wrapped in ``Recurrent``)."""
+    return Recurrent(ConvLSTMPeepholeCell(input_size, output_size, **kw))
+
+
+def ConvLSTMPeephole3D(input_size, output_size, **kw) -> Recurrent:
+    """Sequence-level 3-D conv-LSTM over (B, T, C, D, H, W) (reference
+    ``ConvLSTMPeephole3D.scala`` wrapped in ``Recurrent``)."""
+    return Recurrent(ConvLSTMPeephole3DCell(input_size, output_size, **kw))

@@ -38,16 +38,48 @@ from bigdl_tpu.optim.optimizer import Optimizer
 
 class DistriOptimizer(Optimizer):
     def __init__(self, model, dataset, criterion, batch_size=None, config=None,
-                 mesh: Optional[Mesh] = None, zero1: bool = True):
+                 mesh: Optional[Mesh] = None, zero1: bool = True,
+                 overlap_buckets: int = 0):
         super().__init__(model, dataset, criterion, batch_size, config)
         self.engine = Engine.init(config)
         self.mesh = mesh or self.engine.mesh()
-        self.zero1 = zero1
+        # overlap mode builds an explicit shard_map step with bucketed
+        # psums fired inside the backward (the reference's layer-wise
+        # async sync, ParallelOptimizer.scala:481) — params and optimizer
+        # state stay replicated there, so it excludes ZeRO-1 sharding
+        # (use parallel.overlap.make_zero1_overlap_step for RS+AG)
+        self.overlap_buckets = int(overlap_buckets)
+        self.zero1 = zero1 and not self.overlap_buckets
         dp = self.config.dp_axis
         if self.batch_size % self.mesh.shape[dp] != 0:
             raise ValueError(
                 f"batch size {self.batch_size} not divisible by dp={self.mesh.shape[dp]}"
             )
+
+    def _build_step(self):
+        if not self.overlap_buckets:
+            return super()._build_step()
+        if set(self.optim_methods) != {"__all__"}:
+            raise ValueError(
+                "overlap_buckets requires a single optim method (__all__)")
+        from bigdl_tpu.parallel.overlap import make_ddp_overlap_step
+
+        base = make_ddp_overlap_step(
+            self.model, self.criterion, self.optim_methods["__all__"],
+            self.mesh, axis=self.config.dp_axis,
+            num_buckets=self.overlap_buckets,
+            cast_input=self.config.dtypes.cast_compute,
+            grad_clip=self.grad_clip, with_rng=True)
+
+        def step(params, mstate, ostates, x, y, rng, epoch):
+            # adapt the shared builder to the Optimizer loop's
+            # dict-of-methods state shape (single method enforced above)
+            p, ms, os_, loss = base(params, mstate, ostates["__all__"],
+                                    x, y, epoch, rng)
+            return p, ms, {"__all__": os_}, loss
+
+        data_sharding, _ = self._shardings()
+        return jax.jit(step, donate_argnums=(0, 1, 2)), data_sharding
 
     def _param_spec(self, leaf) -> P:
         """ZeRO-1-style spec: shard the largest divisible dim over dp,

@@ -35,6 +35,15 @@ from bigdl_tpu.parallel.ring_attention import ring_attention
 from bigdl_tpu.parallel.ulysses import ulysses_attention
 from bigdl_tpu.parallel.pipeline import Pipeline, pipeline_apply
 from bigdl_tpu.parallel.moe import MoE, SwitchFFN
+from bigdl_tpu.parallel.overlap import (
+    fold_token,
+    make_buckets,
+    make_ddp_overlap_step,
+    make_zero1_overlap_step,
+    tag_grad_sync,
+    zero1_init_state,
+    zero1_state_sharding,
+)
 
 __all__ = [
     "MeshSpec", "make_mesh", "use_mesh", "current_mesh", "constrain",
@@ -43,4 +52,7 @@ __all__ = [
     "ring_attention", "ulysses_attention",
     "Pipeline", "pipeline_apply",
     "MoE", "SwitchFFN",
+    "make_buckets", "tag_grad_sync", "fold_token",
+    "make_ddp_overlap_step", "make_zero1_overlap_step",
+    "zero1_init_state", "zero1_state_sharding",
 ]

@@ -1,0 +1,187 @@
+"""Round-5 VERDICT item 1b: Pallas fused 1x1-conv (matmul) + BN-stats
+epilogue kernel, microbenchmarked against XLA's fused conv+stats.
+
+The BN stat bucket (9.4 ms/step measured via BIGDL_BN_STATS=frozen) is
+VPU-op-bound; every XLA-level reformulation lost (rounds 3-5, seven
+formulations). The remaining lever: compute the stats IN the conv
+kernel's epilogue while the MXU is busy — the reference does the CPU
+analogue in ``DL/nn/mkldnn/Fusion.scala:36-120``. 1x1 convs are plain
+matmuls (y[b,co,hw] = sum_ci w[co,ci] x[b,ci,hw]) so a block-matmul
+kernel with a per-channel sum/sum-of-squares accumulator is the cleanest
+test of the idea; the large-spatial layer1/layer2 shapes carry most of
+the stat bytes.
+
+Measures, per shape, differential-timed (same scheme as bench.py):
+  a) XLA conv1x1 alone
+  b) XLA conv1x1 + stats (what the model does today; stats fuse into
+     the conv epilogue where XLA can)
+  c) Pallas matmul+stats kernel (computes y, sum, sumsq in one pass)
+Verdict per shape: c vs b.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=64, n2=320, reps=7):
+    """micro_conv.py's proven harness: fn maps carry -> (carry, fetch);
+    the carry chain defeats loop-invariant hoisting; differential timing
+    cancels dispatch overhead."""
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def chain(x, m):
+    return x + (m * 1e-30).astype(x.dtype)
+
+
+def conv1x1_stats_kernel(x_ref, w_ref, y_ref, st_ref, *, n_prog):
+    """One (co-tile, batch, hw-tile) grid step: y = w @ x on the MXU,
+    stats accumulated on the VPU while the next tile's DMA runs.
+
+    Both stats live in ONE stacked (2, bm, 1) ref: two separate outputs
+    with identical BlockSpecs aliased to the same VMEM window on real
+    hardware (interpret mode was correct), corrupting the sums."""
+    i = pl.program_id(0)  # co tile (major: stat blocks revisited across b, j)
+    b = pl.program_id(1)
+    j = pl.program_id(2)
+
+    y = jax.lax.dot_general(
+        w_ref[...], x_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (bm, bn)
+    y_ref[0] = y.astype(y_ref.dtype)
+
+    @pl.when(jnp.logical_and(b == 0, j == 0))
+    def _init():
+        st_ref[...] = jnp.zeros_like(st_ref)
+
+    st_ref[0] += jnp.sum(y, axis=1, keepdims=True)
+    st_ref[1] += jnp.sum(y * y, axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn"))
+def conv1x1_stats_pallas(x, w, bm=256, bn=None):
+    """x: (B, Ci, HW) bf16, w: (Co, Ci) bf16 ->
+    y: (B, Co, HW) bf16, s: (Co, 1) f32, s2: (Co, 1) f32.
+
+    bn defaults to the full HW row: ResNet spatial sizes (56*56=3136,
+    28*28=784) are not multiples of 128, and Pallas TPU only allows a
+    non-divisible last block dim when it equals the array dim."""
+    B, Ci, HW = x.shape
+    bn = bn or HW
+    Co = w.shape[0]
+    bm = min(bm, Co)  # small-Co layers (e.g. 256->64): one whole-Co tile
+    grid = (Co // bm, B, HW // bn)
+    return pl.pallas_call(
+        functools.partial(conv1x1_stats_kernel, n_prog=grid),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, Ci, bn), lambda i, b, j: (b, 0, j)),
+            pl.BlockSpec((bm, Ci), lambda i, b, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bm, bn), lambda i, b, j: (b, i, j)),
+            pl.BlockSpec((2, bm, 1), lambda i, b, j: (0, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Co, HW), x.dtype),
+            jax.ShapeDtypeStruct((2, Co, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(x, w)
+
+
+def xla_conv(x4, w4):
+    return lax.conv_general_dilated(
+        x4, w4, (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def xla_conv_stats(x4, w4):
+    y = xla_conv(x4, w4)
+    s = jnp.sum(y, axis=(0, 2, 3), dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(y.astype(jnp.float32)), axis=(0, 2, 3))
+    return y, s, s2
+
+
+def main():
+    shapes = [
+        # (B, Ci, Co, H, W) — ResNet-50 b128 1x1 convs, early layers
+        (128, 64, 256, 56, 56),
+        (128, 256, 64, 56, 56),
+        (128, 128, 512, 28, 28),
+        (128, 512, 128, 28, 28),
+    ]
+    for B, Ci, Co, H, W in shapes:
+        HW = H * W
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.rand(B, Ci, H, W) - 0.5, jnp.bfloat16)
+        w = jnp.asarray((rs.rand(Co, Ci) - 0.5) * 0.1, jnp.bfloat16)
+        x3 = x.reshape(B, Ci, HW)
+        w4 = w.reshape(Co, Ci, 1, 1)
+
+        # numerics check (y exact vs XLA; stats at fp32-accumulation tol)
+        y_p, st_p = conv1x1_stats_pallas(x3, w)
+        s_p, s2_p = st_p[0], st_p[1]
+        y_x, s_x, s2_x = xla_conv_stats(x, w4)
+        np.testing.assert_allclose(
+            np.asarray(y_p.reshape(B, Co, H, W)).astype(np.float32),
+            np.asarray(y_x).astype(np.float32), rtol=2e-2, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(s_p[:, 0]), np.asarray(s_x),
+                                   rtol=2e-2, atol=2.0)
+        np.testing.assert_allclose(np.asarray(s2_p[:, 0]), np.asarray(s2_x),
+                                   rtol=2e-2, atol=2.0)
+
+        fl = 2 * B * HW * Ci * Co
+
+        def f_conv(c):
+            xx, _ = c
+            y = xla_conv(xx.reshape(B, Ci, H, W), w4)
+            m = jnp.sum(y, dtype=jnp.float32) * 1e-30
+            return (chain(xx, m), jnp.float32(0)), m
+
+        def f_both(c):
+            xx, _ = c
+            y, s, s2 = xla_conv_stats(xx.reshape(B, Ci, H, W), w4)
+            m = (s.sum() + s2.sum()) * 1e-30
+            return (chain(xx, m), jnp.float32(0)), m
+
+        def f_pal(c):
+            xx, _ = c
+            y, st = conv1x1_stats_pallas(xx, w)
+            m = st.sum() * 1e-30
+            return (chain(xx, m), jnp.float32(0)), m
+
+        carry = (x3, jnp.float32(0))
+        t_conv = timed(f_conv, carry)
+        t_both = timed(f_both, carry)
+        t_pal = timed(f_pal, carry)
+        print(f"({B},{Ci}->{Co},{H}x{W}): XLA conv {t_conv*1e3:.3f} ms "
+              f"({fl/t_conv/1e12:.0f} TF) | XLA conv+stats {t_both*1e3:.3f} ms "
+              f"| pallas fused {t_pal*1e3:.3f} ms ({fl/t_pal/1e12:.0f} TF) "
+              f"| stats-overhead XLA {1e3*(t_both-t_conv):+.3f} ms "
+              f"| pallas vs XLA-both {1e3*(t_pal-t_both):+.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,11 +38,17 @@ def _instr_bytes(line):
     typ = line.split(" = ", 1)[-1]
     typ = re.split(r" [\w\-]+\(", typ, 1)[0]
     for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", typ):
+        if dt not in _DTYPE_BYTES:
+            # fail loudly: silently assuming 4 bytes for e.g. a sub-byte
+            # s4/u4 type would overstate the measured collective payloads
+            # this probe's wire-bytes conclusions rest on
+            raise ValueError(f"unknown HLO dtype {dt!r} in: {line.strip()!r}; "
+                             "add it to _DTYPE_BYTES")
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES.get(dt, 4)
+        total += n * _DTYPE_BYTES[dt]
     return total
 
 

@@ -1,0 +1,134 @@
+"""Round-5 convergence proof on the real chip (VERDICT r4 item 4).
+
+The prescribed run — ResNet-20/CIFAR-10 to >=91% — is IMPOSSIBLE on this
+rig: the image has zero network egress and no CIFAR-10 copy exists on
+disk (searched /, found only the reference's 6 test PNGs and a 32-image
+MNIST test pickle). This script is the closest achievable substitute:
+REAL data (sklearn's 1,797 handwritten-digit images), the EXACT CIFAR
+recipe machinery — ``models.resnet.build_cifar(depth=20)``, the
+reference's pad-4/random-crop augmentation (``BGRImgRdmCropper``
+analogue), SGD+momentum+weight-decay with an epoch-step schedule, the
+real ``DistriOptimizer`` loop with per-epoch validation and TrainSummary
+— run end-to-end on the TPU, recording the full loss/accuracy curve.
+
+Second half of the verdict item: the same recipe under
+``BIGDL_BN_STATS_SAMPLE=32`` to measure the sampled-BN knob's accuracy
+impact (its accuracy was explicitly unvalidated, nn/layers/norm.py).
+
+Usage: python perf/r5_train_digits.py [--sample N] [--epochs E]
+Appends results to perf/artifacts/r5_digits_curve.txt.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ART = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "artifacts", "r5_digits_curve.txt")
+
+
+def load_digits_as_cifar():
+    """sklearn digits (8x8 grey, 0..16) -> (N, 3, 32, 32) float32,
+    normalized, nearest-upsampled x4; deterministic 1500/297 split."""
+    import numpy as np
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = d.images.astype("float32") / 16.0  # (N, 8, 8) in [0, 1]
+    x = x.repeat(4, axis=1).repeat(4, axis=2)  # (N, 32, 32)
+    x = (x - 0.5) / 0.5
+    x = np.stack([x, x, x], axis=1)  # (N, 3, 32, 32)
+    y = d.target.astype("int32")
+    rs = np.random.RandomState(0)
+    order = rs.permutation(len(y))
+    x, y = x[order], y[order]
+    return (x[:1500], y[:1500]), (x[1500:], y[1500:])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sample", type=int, default=0,
+                    help="BIGDL_BN_STATS_SAMPLE value (0 = full-batch BN)")
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    if args.sample:
+        os.environ["BIGDL_BN_STATS_SAMPLE"] = str(args.sample)
+
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as optim
+    from bigdl_tpu.core.rng import RandomGenerator
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.image import RandomCropper
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.transformer import FunctionTransformer
+    from bigdl_tpu.models.resnet import build_cifar
+    from bigdl_tpu.optim.schedules import EpochStep
+
+    (xtr, ytr), (xte, yte) = load_digits_as_cifar()
+    platform = jax.devices()[0].platform
+
+    # reference CIFAR recipe shape: pad-4 random crop (TrainCIFAR10.scala
+    # pipeline; HFlip deliberately omitted — digits are chiral)
+    elems = [(xtr[i], int(ytr[i])) for i in range(len(ytr))]
+    ds = (DataSet.array(elems, rng=RandomGenerator(5))
+          >> RandomCropper(32, 32, pad=4, rng=RandomGenerator(6))
+          >> FunctionTransformer(lambda t: Sample(t[0], t[1]))
+          >> SampleToMiniBatch(args.batch))
+    val_ds = DataSet.tensors(xte, yte)
+
+    model = build_cifar(depth=20, class_num=10)
+    opt = optim.DistriOptimizer(model, ds, nn.CrossEntropyCriterion(),
+                                batch_size=args.batch)
+    opt.set_optim_method(optim.SGD(
+        learning_rate=0.05, momentum=0.9, weight_decay=1e-4, dampening=0.0,
+        nesterov=True, schedule=EpochStep(15, 0.2)))
+    opt.set_end_when(optim.Trigger.max_epoch(args.epochs))
+    opt.set_validation(optim.Trigger.every_epoch(), val_ds,
+                       [optim.Top1Accuracy()], batch_size=len(yte))
+
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+    logdir = "/tmp/r5_digits_logs"
+    tag = f"sample{args.sample}" if args.sample else "control"
+    ts = TrainSummary(logdir, tag)
+    vs = ValidationSummary(logdir, tag)
+    opt.set_train_summary(ts)
+    opt.set_val_summary(vs)
+
+    t0 = time.perf_counter()
+    opt.optimize()
+    wall = time.perf_counter() - t0
+
+    losses = ts.read_scalar("Loss")
+    accs = vs.read_scalar("Top1Accuracy")
+    ts.close(); vs.close()
+
+    with open(ART, "a") as f:
+        def emit(s=""):
+            print(s, flush=True)
+            f.write(s + "\n")
+
+        emit(f"=== r5 digits->ResNet-20 run [{tag}] platform={platform} "
+             f"epochs={args.epochs} wall={wall:.0f}s "
+             f"({time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}) ===")
+        emit(f"train samples=1500 test samples=297 batch={args.batch} "
+             f"augment=pad4-randcrop recipe=SGD(0.05,m0.9,wd1e-4,nesterov,"
+             f"EpochStep(15,0.2))")
+        emit("loss curve (every ~10th step): " + " ".join(
+            f"{r[1]:.3f}" for r in losses[::10]))
+        emit("val top-1 by epoch: " + " ".join(
+            f"{r[1]:.4f}" for r in accs))
+        final = max(r[1] for r in accs[-5:])
+        emit(f"final val top-1 (best of last 5 epochs): {final:.4f}")
+        emit()
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -317,6 +317,12 @@ def test_bn_stats_dot_impl_matches_reduce(monkeypatch):
     np.testing.assert_allclose(v_d, v_r, rtol=1e-5)
     np.testing.assert_allclose(g_d, g_r, rtol=1e-4, atol=1e-5)
 
+    # round-5 x-based backward (never materializes xhat; dx = k1*g + a - b*x)
+    for impl in ("bwdx", "bwdx_dot"):
+        v_x, g_x = run(impl)
+        np.testing.assert_allclose(v_x, v_r, rtol=1e-5)
+        np.testing.assert_allclose(g_x, g_r, rtol=1e-4, atol=1e-5)
+
 
 def test_bn_sampled_stats(monkeypatch):
     """BIGDL_BN_STATS_SAMPLE (experimental round-4 lever): forward batch

@@ -1,0 +1,191 @@
+"""Overlapped gradient synchronization (parallel/overlap.py).
+
+The reference implements layer-wise async gradient sync
+(``ParallelOptimizer.scala:481``, ``DistriParameterSynchronizer.scala:66``);
+here the equivalent is bucketed collectives issued inside the backward via
+``jax.custom_vjp``. Parallelism must not change the math: every flavor is
+checked for numerical equality against the single-device computation on
+the 8-virtual-device CPU mesh (the reference's ``local[N]`` spec trick).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.optim as optim
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.optim.optim_method import SGD, Adam
+from bigdl_tpu.parallel.overlap import (
+    make_buckets,
+    make_ddp_overlap_step,
+    make_zero1_overlap_step,
+    zero1_init_state,
+    zero1_state_sharding,
+)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+
+
+def _model():
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                         nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 10))
+
+
+def _data(b=32):
+    x = jnp.asarray(np.random.RandomState(0).randn(b, 16), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, (b,)))
+    return x, y
+
+
+def _single_device_train(model, crit, method, params, mstate, ostate,
+                         x, y, steps):
+    def loss_fn(p):
+        out, _ = model.apply(p, x, state=mstate, training=True)
+        return crit.forward(out, y)
+
+    for it in range(steps):
+        _, g = jax.value_and_grad(loss_fn)(params)
+        params, ostate = method.update(g, params, ostate, jnp.int32(it))
+    return params
+
+
+def test_make_buckets_contiguous_cover():
+    leaves = [np.zeros((s,), np.float32) for s in (100, 5, 5, 200, 50, 1)]
+    buckets = make_buckets(leaves, 3)
+    assert len(buckets) <= 3
+    flat = [i for b in buckets for i in b]
+    assert flat == list(range(len(leaves)))  # contiguous, ordered, complete
+    assert make_buckets(leaves, 1) == [list(range(6))]
+    assert make_buckets([], 4) == []
+
+
+@pytest.mark.parametrize("num_buckets", [1, 3])
+def test_ddp_overlap_matches_single_device(num_buckets):
+    mesh = _mesh()
+    model, crit = _model(), nn.CrossEntropyCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    params, mstate = model.init(jax.random.key(0))
+    ostate = method.init_state(params)
+    x, y = _data()
+
+    p_ref = _single_device_train(
+        model, crit, SGD(learning_rate=0.1, momentum=0.9),
+        params, mstate, method.init_state(params), x, y, steps=3)
+
+    step = make_ddp_overlap_step(model, crit, method, mesh,
+                                 num_buckets=num_buckets)
+    p, ms, os_ = params, mstate, ostate
+    for it in range(3):
+        p, ms, os_, loss = step(p, ms, os_, x, y, jnp.int32(it))
+
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert float(loss) > 0
+
+
+@pytest.mark.parametrize("method_cls", [
+    lambda: SGD(learning_rate=0.1, momentum=0.9),
+    lambda: Adam(learning_rate=0.01),
+])
+def test_zero1_overlap_matches_single_device(method_cls):
+    mesh = _mesh()
+    model, crit = _model(), nn.CrossEntropyCriterion()
+    params, mstate = model.init(jax.random.key(0))
+    x, y = _data()
+
+    method = method_cls()
+    p_ref = _single_device_train(model, crit, method_cls(), params, mstate,
+                                 method_cls().init_state(params), x, y, 3)
+
+    oz = zero1_init_state(method, params, mesh, num_buckets=3)
+    oz = zero1_state_sharding(oz, mesh)
+    step = make_zero1_overlap_step(model, crit, method, mesh, oz,
+                                   num_buckets=3)
+    p, ms = params, mstate
+    for it in range(3):
+        p, ms, oz, loss = step(p, ms, oz, x, y, jnp.int32(it))
+
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_zero1_state_is_sharded():
+    """ZeRO-1 point: every shard holds 1/n of the optimizer state."""
+    mesh = _mesh()
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    oz = zero1_state_sharding(
+        zero1_init_state(method, params, mesh, num_buckets=2), mesh)
+    vec = next(l for l in jax.tree_util.tree_leaves(oz)
+               if getattr(l, "ndim", 0) == 1)
+    shard_shapes = {s.data.shape for s in vec.addressable_shards}
+    assert shard_shapes == {(vec.shape[0] // 8,)}
+
+
+def test_distri_optimizer_overlap_equivalence(tmp_path):
+    """DistriOptimizer(overlap_buckets=K) trains to the same weights as
+    the auto-sharded DistriOptimizer on identical data (deterministic
+    model, same seed, same schedule)."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(128, 16).astype(np.float32)
+    w = rs.randn(16, 1).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)[:, 0]
+
+    from bigdl_tpu.core.rng import RandomGenerator
+
+    results = []
+    for overlap in (0, 3):
+        # fresh seeded rng per run: the default generator is a process
+        # singleton whose shuffle stream would otherwise differ between
+        # the two optimize() calls
+        ds = DataSet.tensors(x, y, rng=RandomGenerator(7)) >> SampleToMiniBatch(64)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 2), nn.LogSoftMax())
+        opt = optim.DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                    batch_size=64,
+                                    overlap_buckets=overlap)
+        opt.set_optim_method(SGD(learning_rate=0.5))
+        opt.set_end_when(optim.Trigger.max_epoch(3))
+        params, _ = opt.optimize()
+        results.append(params)
+
+    for a, b in zip(jax.tree_util.tree_leaves(results[0]),
+                    jax.tree_util.tree_leaves(results[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_overlap_trains_bn_model():
+    """A BatchNorm-containing conv net trains under the overlap step
+    (running stats are shard-averaged; loss must decrease)."""
+    mesh = _mesh()
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.SpatialBatchNormalization(8), nn.ReLU(),
+        nn.SpatialAveragePooling(8, 8, 8, 8), nn.Reshape((8,)),
+        nn.Linear(8, 4))
+    crit = nn.CrossEntropyCriterion()
+    method = SGD(learning_rate=0.05, momentum=0.9)
+    params, mstate = model.init(jax.random.key(1))
+    ostate = method.init_state(params)
+    x = jnp.asarray(np.random.RandomState(3).randn(32, 3, 8, 8), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(4).randint(0, 4, (32,)))
+
+    step = make_ddp_overlap_step(model, crit, method, mesh, num_buckets=2)
+    losses = []
+    p, ms, os_ = params, mstate, ostate
+    for it in range(8):
+        p, ms, os_, loss = step(p, ms, os_, x, y, jnp.int32(it))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # running stats were updated and are finite
+    leaves = jax.tree_util.tree_leaves(ms)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)

@@ -198,3 +198,40 @@ def test_conv_lstm_standalone_and_stacked(rng):
     p2, _ = stack.init(rng)
     y2, _ = stack.apply(p2, jnp.ones((1, 3, 2, 8, 8)))
     assert y2.shape == (1, 3, 3, 8, 8)
+
+
+def test_conv_lstm_3d(rng):
+    """ConvLSTMPeephole3D (reference ConvLSTMPeephole3D.scala): forward
+    shape, gradient flow into both convs and the peepholes, and the
+    even-kernel SAME-padding path."""
+    from bigdl_tpu.nn import ConvLSTMPeephole3D, ConvLSTMPeephole3DCell
+
+    layer = ConvLSTMPeephole3D(2, 4, kernel_i=3, kernel_c=3)
+    params, _ = layer.init(rng)
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 3, 2, 4, 5, 6),
+                    jnp.float32)
+    y, _ = layer.apply(params, x)
+    assert y.shape == (2, 3, 4, 4, 5, 6)
+
+    def loss(p):
+        out, _ = layer.apply(p, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    for name in ("weight_i", "weight_h", "bias", "peep_i", "peep_f", "peep_o"):
+        assert float(jnp.abs(g["cell"][name]).sum()) > 0, name
+
+    # mismatched kernels (reference kernelI != kernelC) + no peephole
+    cell = ConvLSTMPeephole3DCell(2, 3, kernel_i=5, kernel_c=3,
+                                  with_peephole=False)
+    p2, _ = cell.init(rng)
+    y2, _ = cell.apply(p2, jnp.ones((1, 2, 4, 4, 4)))
+    assert y2.shape == (1, 3, 4, 4, 4)
+    assert "peep_i" not in p2
+
+    # EVEN kernel: exercises the asymmetric (k//2, k-1-k//2) SAME padding
+    # (lo=2/hi=1 for k=4) — state spatial dims must still match the input
+    cell4 = ConvLSTMPeephole3DCell(2, 3, kernel_i=4, kernel_c=2)
+    p4, _ = cell4.init(rng)
+    y4, _ = cell4.apply(p4, jnp.ones((1, 2, 4, 5, 6)))
+    assert y4.shape == (1, 3, 4, 5, 6)
