@@ -445,6 +445,7 @@ def supervise(args):
                 line = next((ln for ln in reversed(out.splitlines())
                              if ln.startswith("{") and '"metric"' in ln), None)
                 rep = None
+                parse_err = None
                 if rc == 0 and line:
                     try:
                         rep = json.loads(line)
@@ -452,7 +453,7 @@ def supervise(args):
                         # truncated pipe write on a dying tunnel: treat as
                         # a failed rep, never crash the supervisor (it must
                         # always emit exactly one JSON line)
-                        last_err = "worker emitted unparseable JSON: %r" \
+                        parse_err = "worker emitted unparseable JSON: %r" \
                             % line[:200]
                 if rep is not None:
                     results.append(rep)
@@ -463,7 +464,8 @@ def supervise(args):
                     if len(results) >= max(1, args.best_of):
                         break
                     continue  # next rep immediately; probe re-checks tunnel
-                last_err = "worker rc=%d: %s" % (rc, err.strip()[-600:])
+                last_err = parse_err or \
+                    "worker rc=%d: %s" % (rc, err.strip()[-600:])
             except subprocess.TimeoutExpired:
                 last_err = "worker timed out after %.0fs" % args.worker_timeout
 

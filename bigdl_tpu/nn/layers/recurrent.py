@@ -255,10 +255,14 @@ class ConvLSTMPeepholeCell(Cell):
         h_prev, c_prev = carry
         w = ctx.param("weight").astype(x.dtype)
         b = ctx.param("bias").astype(x.dtype)
-        pad = self.kernel // 2
+        # asymmetric SAME padding so EVEN kernels also preserve the
+        # spatial state dims (symmetric k//2 grows them and the second
+        # timestep's carry add fails)
+        k = self.kernel
+        pad = (k // 2, (k - 1) - k // 2)
         z = lax.conv_general_dilated(
             jnp.concatenate([x, h_prev], axis=1), w, (1, 1),
-            [(pad, pad), (pad, pad)],
+            [pad, pad],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         ) + b[None, :, None, None]
         i, f, g, o = jnp.split(z, 4, axis=1)

@@ -15,10 +15,18 @@ TPU-native design:
 - the Linear matmul runs as a true int8 x int8 -> int32
   ``lax.dot_general`` (``preferred_element_type=int32``) — on TPU this is
   the MXU's native int8 path at double the bf16 throughput;
-- convolutions compute with the quantized integer values in float
-  (numerically identical: products ≤ 127², exactly representable), since
-  int8 ``conv_general_dilated`` support varies by backend — the XLA TPU
-  compiler still constant-folds the dequantization into the conv epilogue.
+- convolutions compute the quantized integer values in f32 by default
+  (exact for products; partial sums can round past 2^24 — see the int32
+  path). A TRUE int8 conv exists behind ``BIGDL_INT8_CONV=dot`` (im2col
+  + one s8 x s8 -> s32 ``dot_general``), but it is a parity/exactness
+  tier, NOT a speed tier: round-5 measurements show XLA's int8 MATMUL
+  does hit the MXU's native int8 path at ~1.9x bf16 (350 TOP/s,
+  ``perf/micro_int8.py`` — which is why ``QuantizedLinear`` uses it),
+  while for convs the im2col patch traffic, int32 output transposes and
+  per-layer activation quantization cost 10x more than the matmul saves
+  (136.7 ms/fwd im2col vs 42.3 float-int vs 14.4 bf16, ResNet-50 b128;
+  ``perf/artifacts/r5_int8.txt``). The reference's conv-int8 win was
+  CPU-VNNI-specific (``DL/nn/mkldnn/Perf.scala:56``).
 
 ``quantize(module, params)`` returns a NEW (module, params) pair; the
 original float model is untouched (reference semantics).
@@ -27,6 +35,7 @@ original float model is untouched (reference semantics).
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -141,24 +150,72 @@ class QuantizedSpatialConvolution(Module):
     def build_state(self):
         return {"act_absmax": jnp.zeros((), jnp.float32)}
 
+    def _int8_dot_path(self, xq, wq):
+        """Kernel-point-decomposed TRUE int8 conv: one s8 x s8 -> s32
+        ``dot_general`` per (kh, kw) tap, accumulated in int32.
+
+        Round-5 measurement: XLA's int8 conv lowering upcasts (5x slower
+        than bf16) but its int8 MATMUL hits the MXU's native int8 path at
+        ~350 TOP/s = 1.9x the measured bf16 peak (`perf/micro_int8.py`).
+        Decomposing the conv into KH*KW shifted matmuls rides that path;
+        int32 accumulation is also EXACT where the old float path could
+        round (partial sums can exceed 2^24). NCHW, groups == 1.
+        """
+        B, I, H, W = xq.shape
+        O, _, KH, KW = wq.shape
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        ph, pw = self.pad
+        if ph or pw:
+            xq = jnp.pad(xq, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        Ho = (Hp - ((KH - 1) * dh + 1)) // sh + 1
+        Wo = (Wp - ((KW - 1) * dw + 1)) // sw + 1
+        # im2col, ONE dot: a first per-tap-accumulation formulation wrote
+        # and re-read the (O, B, N) int32 accumulator once per tap (9x for
+        # 3x3) and measured 192 ms/fwd vs bf16's 14.4 — the patches concat
+        # keeps everything int8 and the int32 output is written once
+        taps = []
+        for kh in range(KH):
+            for kw in range(KW):
+                taps.append(lax.slice(
+                    xq, (0, 0, kh * dh, kw * dw),
+                    (B, I, kh * dh + (Ho - 1) * sh + 1,
+                     kw * dw + (Wo - 1) * sw + 1),
+                    (1, 1, sh, sw)).reshape(B, I, Ho * Wo))
+        # tap order must match: the concat is (kh, kw)-major blocks of I
+        # channels, so the weights flatten as (O, kh, kw, I)
+        xs_all = taps[0] if len(taps) == 1 else jnp.concatenate(taps, axis=1)
+        w2 = wq.transpose(0, 2, 3, 1).reshape(O, KH * KW * I)
+        # (O, K) x (B, K, N) contracting K -> (O, B, N)
+        acc = lax.dot_general(
+            w2, xs_all, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc.transpose(1, 0, 2).reshape(B, O, Ho, Wo)
+
     def forward(self, ctx: Context, x):
         from bigdl_tpu.nn.layers.conv import _dimension_numbers, _padding
 
-        wq = ctx.param("weight_q").astype(jnp.float32)
         scale_w = ctx.param("scale")
         xf = x.astype(jnp.float32)
         if ctx.training:  # calibration pass: record the running absmax
             ctx.put_state("act_absmax", jnp.maximum(
                 ctx.get_state("act_absmax"), jnp.max(jnp.abs(xf))))
         xq, scale_x = _quantize_activation(xf, ctx.param("act_scale"))
-        y = lax.conv_general_dilated(
-            xq.astype(jnp.float32), wq,
-            window_strides=self.stride,
-            padding=_padding(*self.pad),
-            rhs_dilation=self.dilation,
-            feature_group_count=self.n_group,
-            dimension_numbers=_dimension_numbers(self.data_format),
-        )
+        use_dot = (self.n_group == 1 and self.data_format == "NCHW"
+                   and self.pad[0] >= 0 and self.pad[1] >= 0  # -1 = SAME
+                   and os.environ.get("BIGDL_INT8_CONV", "float") == "dot")
+        if use_dot:
+            y = self._int8_dot_path(xq, ctx.param("weight_q")).astype(jnp.float32)
+        else:
+            y = lax.conv_general_dilated(
+                xq.astype(jnp.float32), ctx.param("weight_q").astype(jnp.float32),
+                window_strides=self.stride,
+                padding=_padding(*self.pad),
+                rhs_dilation=self.dilation,
+                feature_group_count=self.n_group,
+                dimension_numbers=_dimension_numbers(self.data_format),
+            )
         if self.data_format == "NCHW":
             y = y * (scale_x * scale_w)[None, :, None, None]
             if self.with_bias:

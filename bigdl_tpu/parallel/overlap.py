@@ -95,26 +95,18 @@ def _psum_tag(axis_name: str, n: int):
 
     def bwd(_, cots):
         tok_cot, *leaf_cots = cots
-        # the token rides INSIDE the psum tuple: bucket i's all-reduce
-        # then CONSUMES bucket i+1's all-reduce output — a real data
-        # dependency the AllReduceCombiner cannot merge away. (Two
-        # weaker schemes were measured insufficient: a token chain
-        # outside the psums, and optimization_barrier gating — XLA
-        # expands barriers away before the combiner runs, and both times
-        # the buckets were re-merged into one 102 MB post-backward
-        # all-reduce; perf/artifacts/overlap_sched_r5.txt history.)
-        # chain through the LEAF DATA: this bucket's smallest leaf input
-        # absorbs min(|token|, 0) — exactly 0 at runtime, not provably so
-        # to the simplifier — and the outgoing token is derived from this
-        # bucket's all-reduce OUTPUT. The all-reduces therefore depend on
-        # each other directly. (Three weaker schemes measured: a token
-        # chain beside the psums, optimization_barrier gating — expanded
-        # away before the combiner — and a token element inside the psum
-        # tuple, which an AR-splitting pass separated back out into
-        # scalar all-reduces; each time the leaf all-reduces were
-        # re-merged into one 102 MB post-backward collective.)
-        # EVERY leaf is gated (an AR-splitting pass was measured peeling
-        # ungated elements out of the bucket and re-combining them)
+        # chain through the LEAF DATA: every leaf input of this bucket's
+        # psum absorbs min(|token|, 0) — exactly 0 at runtime, not
+        # provably so to the simplifier — so bucket i's all-reduce
+        # depends directly on bucket i+1's output. Every leaf must be
+        # gated: an AR-splitting pass was measured peeling ungated
+        # elements out of the bucket and re-combining them. (Three
+        # weaker schemes also measured and rejected: a token chain
+        # beside the psums, optimization_barrier gating — barriers are
+        # expanded away before the combiner — and a token element inside
+        # the psum tuple, which the splitter separated back out; each
+        # time the leaf all-reduces were re-merged into one 102 MB
+        # post-backward collective.)
         leaf_cots = [
             g + jnp.minimum(jnp.abs(tok_cot), 0.0).astype(g.dtype)
             for g in leaf_cots

@@ -1,0 +1,129 @@
+"""VERDICT r4 item 7: int8 on the MXU — a Pallas microbenchmark, or the
+definitive impossibility evidence.
+
+Round-4 finding: XLA's int8 conv lowering on this chip/stack UPCASTS
+(int8 fwd inference 42.3 ms vs bf16 8.39 ms at b128) — int8 is a
+memory/parity tier, not a speed tier (the reference's int8 win was
+CPU-VNNI-specific, ``DL/nn/mkldnn/Perf.scala:56``). This probes one
+level deeper: hand the MXU an int8 matmul directly through every channel
+available and record what the hardware/stack actually does:
+
+  a) XLA ``lax.dot_general`` s8 x s8 -> s32 (preferred_element_type)
+  b) Pallas kernel: s8 refs, ``jnp.dot(..., preferred_element_type=s32)``
+  c) bf16 baseline of the same shape
+
+If (b) compiles and beats (c), the quantized tier gets a real speed
+path; if Mosaic rejects or runs it at upcast speed, that error/number is
+the impossibility note for PERF_NOTES.
+
+Shapes: large square matmuls (the best case int8 could hope for — if it
+loses here, conv shapes lose harder).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=32, n2=160, reps=7):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def pallas_int8_matmul(a, b, bm=512, bn=512):
+    """(M, K) s8 @ (K, N) s8 -> (M, N) s32 block matmul."""
+    M, K = a.shape
+    _, N = b.shape
+
+    def kern(a_ref, b_ref, o_ref):
+        o_ref[...] = jax.lax.dot_general(
+            a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    return pl.pallas_call(
+        kern,
+        grid=(M // bm, N // bn),
+        in_specs=[pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+                  pl.BlockSpec((K, bn), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(a, b)
+
+
+def main():
+    M = K = N = 4096
+    fl = 2 * M * K * N
+    rs = np.random.RandomState(0)
+    a8 = jnp.asarray(rs.randint(-127, 128, (M, K)), jnp.int8)
+    b8 = jnp.asarray(rs.randint(-127, 128, (K, N)), jnp.int8)
+    abf = jnp.asarray(rs.rand(M, K) - 0.5, jnp.bfloat16)
+    bbf = jnp.asarray(rs.rand(K, N) - 0.5, jnp.bfloat16)
+
+    # correctness first (small slice vs numpy)
+    try:
+        yp = pallas_int8_matmul(a8, b8)
+        ref = np.asarray(a8[:8].astype(np.int32)) @ np.asarray(b8.astype(np.int32))
+        np.testing.assert_array_equal(np.asarray(yp[:8]), ref)
+        pallas_ok = True
+        print("pallas int8 matmul: numerics exact", flush=True)
+    except Exception as e:
+        pallas_ok = False
+        print(f"pallas int8 matmul FAILED TO LOWER/RUN: {type(e).__name__}: "
+              f"{str(e)[:600]}", flush=True)
+
+    def f_bf16(c):
+        x, _ = c
+        y = jnp.dot(x, bbf, preferred_element_type=jnp.float32)
+        # nonlinear reduction: a y[0] (or plain sum) consumer lets the
+        # simplifier collapse the whole dot to a sliced/summed dot and
+        # the "measurement" reads 0.002 ms (observed)
+        m = jnp.max(jnp.abs(y)) * 1e-30
+        return (x + m.astype(x.dtype), jnp.float32(0)), m
+    dt = timed(f_bf16, (abf, jnp.float32(0)))
+    print(f"bf16 XLA dot {M}^3: {dt*1e3:.3f} ms  {fl/dt/1e12:.0f} TFLOP/s", flush=True)
+
+    def f_xla8(c):
+        x, _ = c
+        y = lax.dot_general(x, b8, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+        m = jnp.max(jnp.abs(y))
+        return (x + (m % 2).astype(x.dtype), jnp.int32(0)), m
+    try:
+        dt = timed(f_xla8, (a8, jnp.int32(0)))
+        print(f"s8 XLA dot {M}^3: {dt*1e3:.3f} ms  {fl/dt/1e12:.0f} TOP/s", flush=True)
+    except Exception as e:
+        print(f"s8 XLA dot failed: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+    if pallas_ok:
+        def f_pal8(c):
+            x, _ = c
+            y = pallas_int8_matmul(x, b8)
+            m = jnp.max(jnp.abs(y))
+            return (x + (m % 2).astype(x.dtype), jnp.int32(0)), m
+        try:
+            dt = timed(f_pal8, (a8, jnp.int32(0)))
+            print(f"s8 pallas dot {M}^3: {dt*1e3:.3f} ms  {fl/dt/1e12:.0f} TOP/s", flush=True)
+        except Exception as e:
+            print(f"s8 pallas timing failed: {type(e).__name__}: {str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -125,3 +125,34 @@ def test_calibrated_static_scales_match_dynamic():
     out_static, _ = qmodel.apply(cparams, x, training=False)
     np.testing.assert_allclose(np.asarray(out_dyn), np.asarray(out_static),
                                atol=2e-2)
+
+
+def test_int8_dot_conv_matches_float_path(monkeypatch):
+    """BIGDL_INT8_CONV=dot (im2col + one s8 x s8 -> s32 dot) must agree
+    with the float-int conv path — guards the tap-ordering invariant
+    between the patch concat and the (O, kh, kw, I) weight flatten."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.quantized import quantize
+
+    for stride, pad, k in [(1, 1, 3), (2, 1, 3), (1, 0, 1),
+                           (2, 3, 7), (1, 2, 4)]:
+        model = nn.Sequential(nn.SpatialConvolution(
+            3, 8, k, k, stride_w=stride, stride_h=stride,
+            pad_w=pad, pad_h=pad))
+        params, state = model.init(jax.random.key(0))
+        qm, qp = quantize(model, params)
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(2, 3, 12, 12), jnp.float32)
+
+        monkeypatch.setenv("BIGDL_INT8_CONV", "float")
+        y_f, _ = qm.apply(qp, x, state=state, training=False)
+        monkeypatch.setenv("BIGDL_INT8_CONV", "dot")
+        y_d, _ = qm.apply(qp, x, state=state, training=False)
+        assert y_f.shape == y_d.shape, (k, stride, pad)
+        np.testing.assert_allclose(np.asarray(y_d), np.asarray(y_f),
+                                   rtol=1e-5, atol=1e-5)
