@@ -202,6 +202,10 @@ class QuantizedSpatialConvolution(Module):
             ctx.put_state("act_absmax", jnp.maximum(
                 ctx.get_state("act_absmax"), jnp.max(jnp.abs(xf))))
         xq, scale_x = _quantize_activation(xf, ctx.param("act_scale"))
+        # read per-trace like BIGDL_BN_STATS (norm.py): flippable late in
+        # tests/experiments, but NOTE a cached jit trace keeps the path it
+        # was traced with — re-jit (new shapes or fresh function) after
+        # changing the env var
         use_dot = (self.n_group == 1 and self.data_format == "NCHW"
                    and self.pad[0] >= 0 and self.pad[1] >= 0  # -1 = SAME
                    and os.environ.get("BIGDL_INT8_CONV", "float") == "dot")

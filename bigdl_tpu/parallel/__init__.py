@@ -33,7 +33,12 @@ from bigdl_tpu.parallel.tp import (
 )
 from bigdl_tpu.parallel.ring_attention import ring_attention
 from bigdl_tpu.parallel.ulysses import ulysses_attention
-from bigdl_tpu.parallel.pipeline import Pipeline, pipeline_apply
+from bigdl_tpu.parallel.pipeline import (
+    HeteroPipeline,
+    Pipeline,
+    make_pp_train_step,
+    pipeline_apply,
+)
 from bigdl_tpu.parallel.moe import MoE, SwitchFFN
 from bigdl_tpu.parallel.overlap import (
     fold_token,
@@ -50,7 +55,7 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear",
     "TensorParallelAttention", "TensorParallelFFN",
     "ring_attention", "ulysses_attention",
-    "Pipeline", "pipeline_apply",
+    "Pipeline", "pipeline_apply", "HeteroPipeline", "make_pp_train_step",
     "MoE", "SwitchFFN",
     "make_buckets", "tag_grad_sync", "fold_token",
     "make_ddp_overlap_step", "make_zero1_overlap_step",

@@ -1,0 +1,153 @@
+"""VERDICT r4 item 5: host-infeed roofline, stage by stage.
+
+The recorded host-pipeline number (r4: 15 img/s at 2.3 MB/s; r5: 47 at
+7.4 MB/s) needs an explanation, not a shrug. This measures each stage of
+the feed path separately on THIS rig and checks that the end-to-end
+overlapped pipeline achieves ~min(stage rates) — i.e., that the
+double-buffered ``device_prefetch`` genuinely overlaps and the observed
+number is a measured bottleneck (the tunnel), not a pipeline defect.
+
+Stages (ImageNet-shape b128 uint8 NCHW batches, 0.147 MB/image):
+  1. produce   — TensorDataSet sliced fast path, host only
+  2. stage     — same through the host_prefetch background thread
+  3. transfer  — jax.device_put bandwidth, batch-sized payloads
+  4. compute   — resident-batch train-step rate (from bench.py, given)
+  5. end2end   — bench.py's run_host_pipeline (device_prefetch overlap)
+
+Also measures a transform-chain produce rate (pad-4 crop augmentation)
+as the decode/augment analogue for the host-CPU side of the roofline.
+
+Appends to perf/artifacts/r5_feeder_roofline.txt.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ART = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "artifacts", "r5_feeder_roofline.txt")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.prefetch import device_prefetch, host_prefetch
+
+    out = []
+
+    def emit(s):
+        print(s, flush=True)
+        out.append(s)
+
+    platform = jax.devices()[0].platform
+    emit(f"=== r5 feeder roofline (platform={platform}, "
+         f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}) ===")
+
+    batch = 128
+    n = 8 * batch
+    x = (np.random.rand(n, 3, 224, 224) * 255).astype(np.uint8)
+    y = np.random.randint(0, 1000, (n,)).astype(np.int32)
+    img_mb = x[0].nbytes / 1e6
+
+    # 1. produce: sliced fast path, host only
+    ds = DataSet.tensors(x, y)
+    it = ds.batches(batch, train=True)
+    next(it)
+    t0 = time.perf_counter()
+    for _ in range(32):
+        next(it)
+    produce_rate = 32 * batch / (time.perf_counter() - t0)
+    emit(f"1. produce (TensorDataSet slice):        {produce_rate:10.0f} img/s")
+
+    # 1b. augmentation-chain produce (decode/augment analogue):
+    # per-sample pad-4 random crop on 224x224 uint8, Python-side
+    from bigdl_tpu.core.rng import RandomGenerator
+    from bigdl_tpu.dataset.image import RandomCropper
+
+    elems = [(x[i], int(y[i])) for i in range(256)]
+    crop = RandomCropper(224, 224, pad=4, rng=RandomGenerator(3))
+
+    def aug_iter():
+        while True:
+            yield from crop.apply(iter(elems))
+
+    ait = aug_iter()
+    next(ait)
+    t0 = time.perf_counter()
+    for _ in range(512):
+        next(ait)
+    aug_rate = 512 / (time.perf_counter() - t0)
+    emit(f"1b. augment chain (pad4 crop, 1 thread): {aug_rate:10.0f} img/s")
+
+    # 2. stage: through the host_prefetch thread
+    it = host_prefetch(ds.batches(batch, train=True), depth=4)
+    next(it)
+    t0 = time.perf_counter()
+    for _ in range(32):
+        next(it)
+    stage_rate = 32 * batch / (time.perf_counter() - t0)
+    emit(f"2. stage (host_prefetch thread):         {stage_rate:10.0f} img/s")
+
+    # 3. transfer: device_put bandwidth at batch size. Measured BEFORE
+    # and (below) AFTER the end-to-end leg: the tunnel bandwidth swings
+    # on a minutes scale (10-31 MB/s observed within one run), so a
+    # single probe in a different sub-window mis-attributes the ratio.
+    probe = x[:batch]
+    fetch = jax.jit(lambda a: jnp.float32(a).sum())
+    float(fetch(jax.device_put(probe)))
+
+    def xfer_probe():
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(fetch(jax.device_put(probe)))
+            best = min(best, time.perf_counter() - t0)
+        return probe.nbytes / best / 1e6
+
+    xfer_mbps = xfer_probe()
+    xfer_rate = xfer_mbps / img_mb
+    emit(f"3. transfer before e2e (device_put b{batch}): {xfer_rate:8.0f} img/s "
+         f"({xfer_mbps:.1f} MB/s)")
+
+    # 5. end2end: bench.py's overlapped host pipeline (includes compute)
+    from bench import run_host_pipeline
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+
+    on_tpu = platform in ("tpu", "axon")
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    model = resnet.build_imagenet(50, 1000,
+                                  kernel_format="HWIO" if on_tpu else "OIHW")
+    e2e = run_host_pipeline(model, CrossEntropyCriterion(),
+                            SGD(learning_rate=0.1, momentum=0.9),
+                            batch, 24, dtype)
+    emit(f"5. end-to-end overlapped host pipeline:  {e2e:10.0f} img/s")
+    xfer_mbps2 = xfer_probe()
+    xfer_rate2 = xfer_mbps2 / img_mb
+    emit(f"3b. transfer after e2e:                  {xfer_rate2:10.0f} img/s "
+         f"({xfer_mbps2:.1f} MB/s)")
+
+    bound = min(produce_rate, stage_rate, (xfer_rate + xfer_rate2) / 2)
+    emit(f"   bottleneck bound = min(1,2,3) =       {bound:10.0f} img/s "
+         f"(compute measured separately ~2900 on this chip)")
+    emit(f"   end2end / bound ratio: {e2e / bound:.2f}  -> >=0.8 means the "
+         f"double-buffered pipeline really overlaps; the observed number "
+         f"IS the bottleneck stage, not pipeline overhead")
+    emit("   projection, real TPU-VM host (no tunnel): PCIe/DMA sustains "
+         "GB/s-scale infeed (>6,800 img/s per GB/s at 0.147 MB/img), so "
+         "the binding stage becomes host augment/decode: "
+         f"~{aug_rate:.0f} img/s/thread measured here -> a 100+-thread "
+         "TPU-VM host sustains the chip's ~2,900 img/s with ~single-digit "
+         "thread counts per chip; the reference solves the same problem "
+         "with its MTLabeledBGRImgToBatch thread pool.")
+    with open(ART, "a") as f:
+        f.write("\n".join(out) + "\n\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -131,8 +131,6 @@ def test_int8_dot_conv_matches_float_path(monkeypatch):
     """BIGDL_INT8_CONV=dot (im2col + one s8 x s8 -> s32 dot) must agree
     with the float-int conv path — guards the tap-ordering invariant
     between the patch concat and the (O, kh, kw, I) weight flatten."""
-    import itertools
-
     import jax
     import jax.numpy as jnp
 
