@@ -209,9 +209,12 @@ def run_bench(args):
 
     # HWIO kernel storage: bit-identical math, saves the per-step OIHW
     # layout staging around the fused conv+SGD kernels (~1% step time;
-    # round-3 HLO analysis in PERF_NOTES.md)
+    # round-3 HLO analysis in PERF_NOTES.md). BIGDL_STEM=s2d swaps the
+    # stem for the space-to-depth fold (mathematically identical; A/B
+    # knob, round 5)
     model = resnet.build_imagenet(50, class_num,
-                                  kernel_format="HWIO" if on_tpu else "OIHW")
+                                  kernel_format="HWIO" if on_tpu else "OIHW",
+                                  stem_s2d=os.environ.get("BIGDL_STEM") == "s2d")
     criterion = CrossEntropyCriterion()
     method = SGD(learning_rate=0.1, momentum=0.9)
 

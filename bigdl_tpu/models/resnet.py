@@ -30,6 +30,51 @@ def _conv(cin, cout, k, stride=1, pad=0, data_format="NCHW",
     )
 
 
+class Conv1SpaceToDepth(nn.Module):
+    """The ImageNet stem conv (7x7/2, 3->64) computed via the MLPerf
+    space-to-depth trick: fold 2x2 pixel blocks into channels so the
+    MXU's contraction dim sees 12 input channels instead of 3, and run
+    the mathematically IDENTICAL 4x4/1 convolution on the folded layout.
+
+    Derivation: with original index ``2*oh + kh - 3`` (stride 2, pad 3)
+    and ``kh = 2*kh' + p - 1`` (p the 2-pixel phase), the sum becomes a
+    stride-1 conv over folded index ``oh + kh' - 2`` — kernel 4, padding
+    (2, 1). Weights stay stored in the canonical (64, 3, 7, 7) layout
+    (checkpoint/serializer compatible); the fold is a 9.4K-element
+    pad+reshape recomputed per step (negligible). Zero-padded taps make
+    the result exactly the original convolution up to fp summation
+    order. NCHW only (the bench layout).
+    """
+
+    def __init__(self, cout: int = 64):
+        super().__init__()
+        self.cout = cout
+
+    def build_params(self, rng):
+        from bigdl_tpu.core.rng import fold_in_str
+        w = MsraFiller()(fold_in_str(rng, "w"), (self.cout, 3, 7, 7),
+                         3 * 49, self.cout * 49)
+        return {"weight": w}
+
+    def forward(self, ctx, x):
+        import jax.numpy as jnp
+
+        w = ctx.param("weight").astype(x.dtype)  # (O, 3, 7, 7)
+        O = w.shape[0]
+        B, C, H, W = x.shape
+        xf = (x.reshape(B, C, H // 2, 2, W // 2, 2)
+              .transpose(0, 1, 3, 5, 2, 4)
+              .reshape(B, C * 4, H // 2, W // 2))  # channel order (c, p, q)
+        wp = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))  # tap -1 -> zero
+        wf = (wp.reshape(O, C, 4, 2, 4, 2)
+              .transpose(0, 1, 3, 5, 2, 4)
+              .reshape(O, C * 4, 4, 4))
+        import jax.lax as lax
+        return lax.conv_general_dilated(
+            xf, wf, (1, 1), [(2, 1), (2, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 def _bn(n, zero_init=False, data_format="NCHW"):
     # reference zero-inits the last BN gamma of each block when
     # optnet/warm-up recipes are on (ResNet.scala getShortcut/iChannels)
@@ -110,7 +155,8 @@ IMAGENET_CFG = {
 def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = "B",
                    zero_init_residual: bool = True,
                    data_format: str = "NCHW",
-                   kernel_format: str = "OIHW") -> nn.Sequential:
+                   kernel_format: str = "OIHW",
+                   stem_s2d: bool = False) -> nn.Sequential:
     """ImageNet ResNet (reference ``ResNet.apply`` dataset=ImageNet branch).
 
     ``data_format="NHWC"`` builds the channels-last variant (input
@@ -129,9 +175,13 @@ def build_imagenet(depth: int = 50, class_num: int = 1000, shortcut_type: str = 
     mixed = data_format == "MIXED"
     df, kf = ("NCHW", kernel_format) if mixed else (data_format, kernel_format)
 
+    if stem_s2d and df != "NCHW":
+        raise ValueError("stem_s2d supports the NCHW layout only")
+    stem_conv = (Conv1SpaceToDepth(64) if stem_s2d
+                 else _conv(3, 64, 7, 2, 3, data_format=df,
+                            kernel_format=kf))
     model = nn.Sequential(
-        _conv(3, 64, 7, 2, 3, data_format=df,
-              kernel_format=kf).set_name("conv1"),
+        stem_conv.set_name("conv1"),
         _bn(64, data_format=df),
         nn.ReLU(),
         nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, data_format=df),

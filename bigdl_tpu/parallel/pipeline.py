@@ -242,12 +242,33 @@ class HeteroPipeline:
 
     def apply(self, params, states, x, training: bool = False, rng=None):
         """Returns ``(outputs [batch, ...], new_states)`` — both
-        replicated over the pp axis."""
+        replicated over the pp axis.
+
+        Constraint (inherent to the ring schedule): every stage must map
+        a microbatch to the SAME shape and dtype — the ppermute buffers
+        are sized once from the input. Width-changing stages need an
+        embedding into a common activation shape.
+        """
         n = self.n_stages
         b = x.shape[0]
         if b % self.n_micro:
             raise ValueError(
                 f"batch {b} not divisible into {self.n_micro} microbatches")
+        mb = (b // self.n_micro,) + x.shape[1:]
+        xm = jax.ShapeDtypeStruct(mb, x.dtype)
+        for i, m in enumerate(self.stages):
+            # probe in eval mode: shapes are identical and no rng is
+            # needed (Dropout in training mode would demand one)
+            out_sd = jax.eval_shape(
+                lambda p, s, a, m=m: m.apply(p, a, state=s,
+                                             training=False)[0],
+                params[f"stage{i}"], states[f"stage{i}"], xm)
+            if out_sd.shape != mb or out_sd.dtype != x.dtype:
+                raise ValueError(
+                    f"pipeline stage {i} maps {mb}/{x.dtype} -> "
+                    f"{out_sd.shape}/{out_sd.dtype}; every stage must "
+                    "preserve the microbatch shape and dtype (the ring "
+                    "schedule's buffers are sized once from the input)")
         xs = x.reshape((self.n_micro, b // self.n_micro) + x.shape[1:])
         body = functools.partial(
             _hetero_body, self._stage_fns(), n, self.n_micro, self.axis_name)
@@ -285,7 +306,7 @@ def make_pp_train_step(pipeline: "HeteroPipeline", criterion, method):
         new_p, new_os = method.update(grads, params, ostate, it)
         return new_p, new_states, new_os, loss
 
-    return jax.jit(step, static_argnums=())
+    return jax.jit(step)
 
 
 class Pipeline:

@@ -35,7 +35,7 @@ def main():
     import numpy as np
 
     from bigdl_tpu.dataset.dataset import DataSet
-    from bigdl_tpu.dataset.prefetch import device_prefetch, host_prefetch
+    from bigdl_tpu.dataset.prefetch import host_prefetch
 
     out = []
 

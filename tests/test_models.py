@@ -149,3 +149,27 @@ def test_resnet_mixed_layout_matches_nchw():
     o2, _ = m2.apply(p2, x, state=s2, training=True)
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_space_to_depth_stem_matches_conv1():
+    """Conv1SpaceToDepth (MLPerf fold; build_imagenet(stem_s2d=True)) is
+    mathematically identical to the 7x7/s2 stem convolution."""
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.resnet import Conv1SpaceToDepth
+
+    conv = nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False)
+    s2d = Conv1SpaceToDepth(64)
+    p_ref, _ = conv.init(jax.random.key(1))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 64, 64), jnp.float32)
+    y_ref, _ = conv.apply(p_ref, x)
+    y_s2d, _ = s2d.apply({"weight": p_ref["weight"]}, x)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               atol=1e-4)
+    # and it trains: gradient flows to the canonical (64,3,7,7) weight
+    g = jax.grad(lambda p: float(0) + jnp.sum(s2d.apply(p, x)[0] ** 2))(
+        {"weight": p_ref["weight"]})
+    assert g["weight"].shape == (64, 3, 7, 7)
+    assert float(jnp.abs(g["weight"]).sum()) > 0
