@@ -22,4 +22,5 @@ reference's unit strategy: tiny fixtures, no network downloads).
 | ``keras_train``             | ``example/keras/Train``                         |
 | ``language_model``          | ``example/languagemodel/PTBWordLM``             |
 | ``recommendation``          | NCF over movielens (LookupTable + HitRatio/NDCG) |
+| ``parallel_training``       | ``ParallelOptimizer``/ZeRO-style sync + pipeline (beyond-reference axes) |
 """

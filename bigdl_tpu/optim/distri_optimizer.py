@@ -39,7 +39,7 @@ from bigdl_tpu.optim.optimizer import Optimizer
 class DistriOptimizer(Optimizer):
     def __init__(self, model, dataset, criterion, batch_size=None, config=None,
                  mesh: Optional[Mesh] = None, zero1: bool = True,
-                 overlap_buckets: int = 0):
+                 overlap_buckets: int = 0, overlap_wire_dtype=None):
         super().__init__(model, dataset, criterion, batch_size, config)
         self.engine = Engine.init(config)
         self.mesh = mesh or self.engine.mesh()
@@ -49,6 +49,15 @@ class DistriOptimizer(Optimizer):
         # state stay replicated there, so it excludes ZeRO-1 sharding
         # (use parallel.overlap.make_zero1_overlap_step for RS+AG)
         self.overlap_buckets = int(overlap_buckets)
+        # wire compression for the bucketed collectives (e.g. jnp.bfloat16
+        # — the reference's per-layer fp16 blocks,
+        # DistriParameterSynchronizer.scala:96); None = exact fp32 wire
+        if overlap_wire_dtype is not None and not self.overlap_buckets:
+            raise ValueError(
+                "overlap_wire_dtype only applies to the bucketed overlap "
+                "step — pass overlap_buckets=K as well (the auto-sharded "
+                "path's collective dtype is chosen by XLA)")
+        self.overlap_wire_dtype = overlap_wire_dtype
         self.zero1 = zero1 and not self.overlap_buckets
         dp = self.config.dp_axis
         if self.batch_size % self.mesh.shape[dp] != 0:
@@ -69,7 +78,8 @@ class DistriOptimizer(Optimizer):
             self.mesh, axis=self.config.dp_axis,
             num_buckets=self.overlap_buckets,
             cast_input=self.config.dtypes.cast_compute,
-            grad_clip=self.grad_clip, with_rng=True)
+            grad_clip=self.grad_clip, with_rng=True,
+            wire_dtype=self.overlap_wire_dtype)
 
         def step(params, mstate, ostates, x, y, rng, epoch):
             # adapt the shared builder to the Optimizer loop's

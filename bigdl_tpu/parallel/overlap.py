@@ -72,19 +72,20 @@ def make_buckets(leaves: Sequence[Any], num_buckets: int) -> List[List[int]]:
 
 # --------------------------------------------------- DDP bucket psum ----
 
-def _psum_tag(axis_name: str, n: int):
+def _psum_tag(axis_name: str, n: int, wire_dtype=None):
     """custom_vjp identity over ``(token, *leaves)``; backward psums the
     leaf cotangents (one tuple all-reduce per bucket) and divides by the
     axis size — local-mean grads in, global-mean grads out.
 
-    The token threads a data dependency BETWEEN buckets: each backward
-    returns a token cotangent that depends (via ``optimization_barrier``,
-    which neither the algebraic simplifier nor DCE can remove) on its own
-    psum result. Chained through :func:`tag_grad_sync`, bucket i's psum
-    cannot be combined with bucket i+1's — without this, XLA's
-    AllReduceCombiner was measured re-merging all buckets into ONE
-    post-backward 102 MB all-reduce (perf/artifacts/overlap_sched_r5.txt),
-    silently undoing the overlap."""
+    The token threads a data dependency BETWEEN buckets (see the inline
+    note in ``bwd``) so the AllReduceCombiner cannot re-merge the
+    buckets into one post-backward collective.
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``) compresses the collective
+    payload — the reference ships per-layer fp16 blocks the same way
+    (``DistriParameterSynchronizer.scala:96``); gradients are cast for
+    the wire and accumulated back in their original dtype. None = exact.
+    """
 
     @jax.custom_vjp
     def tag(tok, *leaves):
@@ -95,6 +96,9 @@ def _psum_tag(axis_name: str, n: int):
 
     def bwd(_, cots):
         tok_cot, *leaf_cots = cots
+        dtypes = [g.dtype for g in leaf_cots]
+        if wire_dtype is not None:
+            leaf_cots = [g.astype(wire_dtype) for g in leaf_cots]
         # chain through the LEAF DATA: every leaf input of this bucket's
         # psum absorbs min(|token|, 0) — exactly 0 at runtime, not
         # provably so to the simplifier — so bucket i's all-reduce
@@ -119,13 +123,15 @@ def _psum_tag(axis_name: str, n: int):
         tok_out = tok_cot + sum(
             jnp.minimum(jnp.abs(jnp.ravel(g)[0]), 0.0).astype(tok_cot.dtype)
             for g in summed)
-        return (tok_out, *(g / n for g in summed))
+        return (tok_out, *(g.astype(dt) / n
+                           for g, dt in zip(summed, dtypes)))
 
     tag.defvjp(fwd, bwd)
     return tag
 
 
-def tag_grad_sync(params, axis_name: str, n: int, num_buckets: int = 4):
+def tag_grad_sync(params, axis_name: str, n: int, num_buckets: int = 4,
+                  wire_dtype=None):
     """Tag a param pytree so its gradient is synchronized bucket-by-bucket
     during the backward pass. Must run inside ``shard_map`` over
     ``axis_name``. Returns ``(params, token)`` — params unchanged in
@@ -142,7 +148,7 @@ def tag_grad_sync(params, axis_name: str, n: int, num_buckets: int = 4):
     (``DistriParameterSynchronizer.scala:96``)."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     out = list(leaves)
-    tag = _psum_tag(axis_name, n)
+    tag = _psum_tag(axis_name, n, wire_dtype)
     tok = jnp.zeros((), leaves[0].dtype if leaves else jnp.float32)
     for idx_group in make_buckets(leaves, num_buckets):
         tok, *synced = tag(tok, *(out[i] for i in idx_group))
@@ -230,7 +236,8 @@ def _rs_tag(axis_name: str, n: int, layout: _BucketLayout):
 def make_ddp_overlap_step(model, criterion, method, mesh: Mesh,
                           axis: str = "dp", num_buckets: int = 4,
                           compute_dtype=None, cast_input=None,
-                          grad_clip=None, with_rng: bool = False):
+                          grad_clip=None, with_rng: bool = False,
+                          wire_dtype=None):
     """Data-parallel train step with bucketed overlap-eligible gradient
     all-reduce. Signature: ``step(params, mstate, ostate, x, y, it[, rng])
     -> (params, mstate, ostate, loss)`` with params/state replicated and
@@ -253,7 +260,7 @@ def make_ddp_overlap_step(model, criterion, method, mesh: Mesh,
             x = x.astype(compute_dtype)
 
         def loss_fn(p):
-            p, tok = tag_grad_sync(p, axis, n, num_buckets)
+            p, tok = tag_grad_sync(p, axis, n, num_buckets, wire_dtype)
             kw = {"rng": rng} if rng is not None else {}
             out, new_ms = model.apply(p, x, state=mstate, training=True, **kw)
             out = jax.tree_util.tree_map(

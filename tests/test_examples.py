@@ -171,3 +171,9 @@ def test_maskrcnn_cli_predict_and_evaluate():
                         "--depth", "18", "--minSize", "96",
                         "--maxSize", "128", "--nImages", "2"])
     assert 0.0 <= ap <= 1.0
+
+
+def test_parallel_training_example_runs():
+    from bigdl_tpu.examples import parallel_training
+
+    assert parallel_training.main(["--steps", "2"]) == 0

@@ -189,3 +189,29 @@ def test_overlap_trains_bn_model():
     # running stats were updated and are finite
     leaves = jax.tree_util.tree_leaves(ms)
     assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+
+def test_ddp_overlap_bf16_wire():
+    """wire_dtype=bf16 (the reference's fp16-block wire compression,
+    DistriParameterSynchronizer.scala:96): grads ride the collective in
+    bf16; training still tracks the exact-wire run to bf16 tolerance."""
+    mesh = _mesh()
+    model, crit = _model(), nn.CrossEntropyCriterion()
+    params, mstate = model.init(jax.random.key(0))
+    x, y = _data()
+
+    results = []
+    for wire in (None, jnp.bfloat16):
+        method = SGD(learning_rate=0.1, momentum=0.9)
+        step = make_ddp_overlap_step(model, crit, method, mesh,
+                                     num_buckets=3, wire_dtype=wire)
+        p, ms, os_ = params, mstate, method.init_state(params)
+        for it in range(3):
+            p, ms, os_, loss = step(p, ms, os_, x, y, jnp.int32(it))
+        results.append(p)
+
+    for a, b in zip(jax.tree_util.tree_leaves(results[0]),
+                    jax.tree_util.tree_leaves(results[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-2, rtol=5e-2)
+        assert a.dtype == b.dtype  # params stay in their original dtype
