@@ -75,8 +75,10 @@ def main(argv=None):
     p, ms = model.init(jax.random.key(0))
     oz = zero1_state_sharding(
         zero1_init_state(method, p, mesh, num_buckets=2), mesh)
+    # the model ends in LogSoftMax, so pair it with ClassNLLCriterion
+    # (CrossEntropyCriterion expects raw logits and would double-log-softmax)
     zstep = make_zero1_overlap_step(
-        model, nn.CrossEntropyCriterion(), method, mesh, oz, num_buckets=2)
+        model, nn.ClassNLLCriterion(), method, mesh, oz, num_buckets=2)
     xb = jnp.asarray(x[:batch])
     yb = jnp.asarray(y[:batch])
     for it in range(args.steps):

@@ -9,6 +9,7 @@ Inputs are tuples of arrays (the ``Table`` Activity).
 
 from __future__ import annotations
 
+import os
 from functools import reduce
 
 import jax.numpy as jnp
@@ -18,6 +19,17 @@ from bigdl_tpu.nn.module import Context, Module
 
 class CAddTable(Module):
     def forward(self, ctx: Context, x):
+        # BIGDL_RESIDUAL_ADD=pallas (read per-trace, like BIGDL_BN_STATS):
+        # measured-REJECTED perf experiment kept for the record — the
+        # Pallas kernel wins the standalone microbench (464 vs 269 GB/s,
+        # perf/micro_resadd2.py) but LOSES 2x end-to-end (1454 vs 2808
+        # img/s, perf/artifacts/r5_resadd_ab.txt): the custom-call
+        # boundary forces neighbors out of the adds' fusion
+        # neighborhoods. Default (plain XLA add) is the right choice.
+        if (len(x) == 2
+                and os.environ.get("BIGDL_RESIDUAL_ADD") == "pallas"):
+            from bigdl_tpu.ops.pallas_add import residual_add
+            return residual_add(x[0], x[1])
         return reduce(jnp.add, x)
 
 

@@ -1,0 +1,126 @@
+"""Round-5 follow-up on the 'add x16 = 4.47 ms' profile bucket.
+
+The r5 per-instruction profile attributes ~4.5 ms/step to 16 standalone
+`add` instructions (~0.28 ms each) — the residual-gradient joins whose
+producers (conv dgrads) and consumers (convs) can't absorb them. The
+question: do those adds run at the chip's memory bandwidth (nothing to
+win) or far below it (a fixable lowering)? The round-3 bucket table
+assumed "streaming ~3 TB/s", under which the adds would look ~4-10x too
+slow; this measures what a standalone add ACTUALLY achieves.
+
+  a) plain XLA add, result CARRIED through the scan so it must
+     materialize (a reduction-only consumer lets XLA skip the output
+     write and overstates bandwidth)
+  b) marginal cost of an add BETWEEN two convs (inherits conv layouts;
+     differential, so overlap with the convs is included)
+  c) a trivial Pallas streaming add of the same shape, same carry
+
+Traffic accounting for (a)/(c): read x + read y + write z = 3 streams
+of the (128,256,56,56) bf16 tensor (205 MB each, 616 MB total).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(fn, carry, n1=32, n2=160, reps=7):
+    def runner(n):
+        @jax.jit
+        def multi(c):
+            out, r = lax.scan(lambda c, _: fn(c), c, None, length=n)
+            return r
+        return multi
+    m1, m2 = runner(n1), runner(n2)
+    np.asarray(m1(carry)); np.asarray(m2(carry))
+    t1s, t2s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(m1(carry)); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(m2(carry)); t2s.append(time.perf_counter() - t0)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
+
+
+def chain(x, m):
+    return x + (m * 1e-30).astype(x.dtype)
+
+
+def pallas_add(a, b):
+    B, C, HW = a.shape
+
+    def kern(a_ref, b_ref, o_ref):
+        o_ref[...] = a_ref[...] + b_ref[...]
+
+    return pl.pallas_call(
+        kern, grid=(B,),
+        in_specs=[pl.BlockSpec((1, C, HW), lambda i: (i, 0, 0))] * 2,
+        out_specs=pl.BlockSpec((1, C, HW), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, C, HW), a.dtype),
+    )(a, b)
+
+
+def main():
+    B, C, H, W = 128, 256, 56, 56
+    HW = H * W
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.rand(B, C, H, W) - 0.5, jnp.bfloat16)
+    b = jnp.asarray(rs.rand(B, C, H, W) - 0.5, jnp.bfloat16)
+    nbytes = 3 * a.nbytes  # read a, read b, write out
+
+    def f_add(c):
+        # the sum becomes the next carry: it MUST materialize (not fuse
+        # into a reduction), and each iteration depends on the last.
+        # Values drift (x accumulates y per iter) — irrelevant for timing.
+        x, y = c
+        z = x + y
+        return (z, y), z[0, 0, 0].astype(jnp.float32)
+    dt = timed(f_add, (a, b))
+    print(f"a) plain add (B,C,H,W) bf16 (materialized): {dt*1e3:.3f} ms  "
+          f"{nbytes/dt/1e9:.0f} GB/s of {nbytes/1e6:.0f} MB", flush=True)
+
+    # b) add between convs: time(conv+conv+add) - time(conv+conv)
+    w = jnp.asarray((rs.rand(C, C, 1, 1) - 0.5) * 0.05, jnp.bfloat16)
+
+    def two_convs(c):
+        x, y = c
+        y1 = lax.conv_general_dilated(x, w, (1, 1), "VALID",
+                                      dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y2 = lax.conv_general_dilated(y, w, (1, 1), "VALID",
+                                      dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        m = (jnp.max(jnp.abs(y1)) + jnp.max(jnp.abs(y2))).astype(jnp.float32) * 1e-30
+        return (chain(x, m), chain(y, m)), m
+
+    def two_convs_add(c):
+        x, y = c
+        y1 = lax.conv_general_dilated(x, w, (1, 1), "VALID",
+                                      dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y2 = lax.conv_general_dilated(y, w, (1, 1), "VALID",
+                                      dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        z = y1 + y2
+        m = jnp.max(jnp.abs(z)).astype(jnp.float32) * 1e-30
+        return (chain(x, m), chain(y, m)), m
+
+    dt0 = timed(two_convs, (a, b), n1=16, n2=80)
+    dt1 = timed(two_convs_add, (a, b), n1=16, n2=80)
+    print(f"b) conv+conv: {dt0*1e3:.3f} ms; +add: {dt1*1e3:.3f} ms; "
+          f"marginal add {1e3*(dt1-dt0):+.3f} ms "
+          f"({nbytes/max(dt1-dt0,1e-9)/1e9:.0f} GB/s)", flush=True)
+
+    a3 = a.reshape(B, C, HW)
+    b3 = b.reshape(B, C, HW)
+
+    def f_pal(c):
+        x, y = c
+        z = pallas_add(x, y)
+        return (z, y), z[0, 0, 0].astype(jnp.float32)
+    dt = timed(f_pal, (a3, b3))
+    print(f"c) pallas add (materialized): {dt*1e3:.3f} ms  "
+          f"{nbytes/dt/1e9:.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
