@@ -14,6 +14,10 @@ A ground-up JAX/XLA/pjit/Pallas rebuild of the capabilities of BigDL
 - Local and distributed optimizers with triggers, validation, checkpoints
   (reference: ``DL/optim/*``).
 - Model zoo (LeNet-5, ResNet, Inception-v1, VGG, PTB LSTM, autoencoder).
+- Serving tier (``bigdl_tpu.serving``): dynamic-batching
+  ``InferenceService`` with admission control, deadlines, and SLO
+  metrics (replacing the reference's one-request-per-forward
+  ``PredictionService.scala`` model pool).
 
 Compute is JAX on TPU: MXU-friendly matmuls/convs in bfloat16 with fp32
 masters, XLA fusion instead of hand-scheduled MKL-DNN primitives, and

@@ -1,0 +1,24 @@
+"""shard_map across jax versions.
+
+Newer jax exports ``jax.shard_map`` (with ``check_vma``); 0.4.x ships it
+as ``jax.experimental.shard_map.shard_map`` (with ``check_rep``, the
+older name for the same replication/varying-manual-axes check). The
+parallel tier targets the new spelling; this shim keeps it importable —
+and the mesh/overlap tests runnable — on the 0.4.x images too.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+
+except ImportError:  # jax < 0.5: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from bigdl_tpu.parallel._compat import shard_map
 
 
 # --------------------------------------------------------- bucketing ----
@@ -68,6 +68,20 @@ def make_buckets(leaves: Sequence[Any], num_buckets: int) -> List[List[int]]:
         buckets[-1].append(i)
         acc += s
     return buckets
+
+
+# ----------------------------------------------------- chain gating ----
+
+def _zero_gate(x, dtype):
+    """``min(|x|, 0)`` — exactly 0 at runtime but not provably so to the
+    algebraic simplifier, so adding it creates a REAL dataflow edge on
+    ``x`` that survives XLA's passes. This is the load-bearing
+    anti-combiner trick behind the bucket chain: do not replace it with
+    ``0.0 * x`` (the simplifier folds that) or ``optimization_barrier``
+    (expanded away before the AllReduceCombiner runs, and its diff rule
+    only exists on newer jax). Every gating site in this file must use
+    this one helper so the idiom cannot drift."""
+    return jnp.minimum(jnp.abs(x), 0.0).astype(dtype)
 
 
 # --------------------------------------------------- DDP bucket psum ----
@@ -100,29 +114,23 @@ def _psum_tag(axis_name: str, n: int, wire_dtype=None):
         if wire_dtype is not None:
             leaf_cots = [g.astype(wire_dtype) for g in leaf_cots]
         # chain through the LEAF DATA: every leaf input of this bucket's
-        # psum absorbs min(|token|, 0) — exactly 0 at runtime, not
-        # provably so to the simplifier — so bucket i's all-reduce
-        # depends directly on bucket i+1's output. Every leaf must be
-        # gated: an AR-splitting pass was measured peeling ungated
-        # elements out of the bucket and re-combining them. (Three
-        # weaker schemes also measured and rejected: a token chain
-        # beside the psums, optimization_barrier gating — barriers are
-        # expanded away before the combiner — and a token element inside
-        # the psum tuple, which the splitter separated back out; each
-        # time the leaf all-reduces were re-merged into one 102 MB
-        # post-backward collective.)
-        leaf_cots = [
-            g + jnp.minimum(jnp.abs(tok_cot), 0.0).astype(g.dtype)
-            for g in leaf_cots
-        ]
+        # psum absorbs the zero gate of the token, so bucket i's
+        # all-reduce depends directly on bucket i+1's output. Every leaf
+        # must be gated: an AR-splitting pass was measured peeling
+        # ungated elements out of the bucket and re-combining them.
+        # (Three weaker schemes also measured and rejected: a token chain
+        # beside the psums, optimization_barrier gating, and a token
+        # element inside the psum tuple, which the splitter separated
+        # back out; each time the leaf all-reduces were re-merged into
+        # one 102 MB post-backward collective.)
+        leaf_cots = [g + _zero_gate(tok_cot, g.dtype) for g in leaf_cots]
         summed = lax.psum(tuple(leaf_cots), axis_name)
         # ...and EVERY element's output feeds the outgoing token: with a
         # single-element token source, the combiner was measured peeling
         # the non-source elements out of the bucket (their outputs carry
         # no chain dependency) and merging them into a later bucket's AR
         tok_out = tok_cot + sum(
-            jnp.minimum(jnp.abs(jnp.ravel(g)[0]), 0.0).astype(tok_cot.dtype)
-            for g in summed)
+            _zero_gate(jnp.ravel(g)[0], tok_cot.dtype) for g in summed)
         return (tok_out, *(g.astype(dt) / n
                            for g, dt in zip(summed, dtypes)))
 
@@ -158,8 +166,10 @@ def tag_grad_sync(params, axis_name: str, n: int, num_buckets: int = 4,
 
 
 def fold_token(loss, tok):
-    """Attach the chain token to the loss without changing its value."""
-    return lax.optimization_barrier((loss, tok.astype(loss.dtype)))[0]
+    """Attach the chain token to the loss without changing its value
+    (:func:`_zero_gate` keeps the dependency alive through the
+    simplifier and stays differentiable on every jax version)."""
+    return loss + _zero_gate(tok, loss.dtype)
 
 
 # ------------------------------------------------- ZeRO-1 RS bucket ----
@@ -210,25 +220,49 @@ def _rs_tag(axis_name: str, n: int, layout: _BucketLayout):
     def bwd(_, cots):
         tok_cot, *leaf_cots = cots
         flat = layout.flatten(leaf_cots)
-        # chain the collective on the previous bucket's token with REAL
-        # arithmetic (optimization_barrier is expanded away before the
-        # combiner runs — see _psum_tag): min(|tok|, 0) is exactly 0 at
-        # runtime but not provably so to the algebraic simplifier, and
+        # chain the collective on the previous bucket's token
+        # (:func:`_zero_gate`; see _psum_tag for the measured rationale):
         # the in-place add makes this reduce-scatter's input depend on
         # the previous one's output
-        tnz = jnp.minimum(jnp.abs(tok_cot), 0.0).astype(flat.dtype)
-        flat = flat.at[0].add(tnz)
+        flat = flat.at[0].add(_zero_gate(tok_cot, flat.dtype))
         chunk = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
                                  tiled=True) / n
         idx = lax.axis_index(axis_name)
         full = jnp.zeros((layout.padded,), flat.dtype)
         full = lax.dynamic_update_slice(full, chunk, (idx * layout.chunk,))
-        tok_cot = tok_cot + jnp.minimum(
-            jnp.abs(chunk[0]), 0.0).astype(tok_cot.dtype)
+        tok_cot = tok_cot + _zero_gate(chunk[0], tok_cot.dtype)
         return (tok_cot, *layout.unflatten(full))
 
     tag.defvjp(fwd, bwd)
     return tag
+
+
+# -------------------------------------------- module-state reduction ----
+
+#: Per-leaf cross-shard reduction policy for module state after the step.
+#: Keyed by the leaf's own dict key: leaves named here reduce with the
+#: given collective; every other inexact leaf reduces with ``pmean``
+#: (SyncBN-mean running stats). Running EXTREMA must not be averaged:
+#: the int8 calibration absmax (``nn/quantized.py`` ``act_absmax``) is a
+#: running max over observed activations, and a mean across shards would
+#: shrink the calibrated scale as the shard count grows (ADVICE round 5).
+STATE_REDUCE_POLICY: Dict[str, str] = {"act_absmax": "max"}
+
+
+def _reduce_module_state(new_ms, axis_name: str):
+    """Cross-shard module-state sync with the per-leaf policy above."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(new_ms)
+    out = []
+    for path, leaf in flat:
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            out.append(leaf)
+            continue
+        key = next((p.key for p in reversed(path)
+                    if isinstance(p, jax.tree_util.DictKey)), None)
+        how = STATE_REDUCE_POLICY.get(key, "mean")
+        out.append(lax.pmax(leaf, axis_name) if how == "max"
+                   else lax.pmean(leaf, axis_name))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 # ------------------------------------------------------ step builders ----
@@ -246,10 +280,20 @@ def make_ddp_overlap_step(model, criterion, method, mesh: Mesh,
     ``grad_clip`` and ``with_rng`` — keep one implementation of the
     semantics).
 
-    Module state (BN running stats) is averaged across shards after the
-    step (SyncBN-mean running stats; batch statistics themselves stay
-    per-shard — same semantics as torch DDP, a documented deviation from
-    the auto-sharded path's exact global statistics).
+    Criterion contract: the loss must be an UNWEIGHTED MEAN over local
+    batch rows (``size_average=True``, no per-class ``weights``). The
+    bucket collectives divide summed cotangents by the dp axis size,
+    which equals the global-batch gradient only under that contract — a
+    sum loss is mis-scaled by 1/n and a weighted mean normalizes by the
+    local (not global) weight sum. ``DistriOptimizer._build_step``
+    enforces this; callers using the builder directly must too.
+
+    Module state (BN running stats) is synced across shards after the
+    step with a per-leaf policy (:data:`STATE_REDUCE_POLICY`): means for
+    running averages (SyncBN-mean running stats; batch statistics
+    themselves stay per-shard — same semantics as torch DDP, a documented
+    deviation from the auto-sharded path's exact global statistics), max
+    for running extrema like the int8 calibration ``act_absmax``.
     """
     n = mesh.shape[axis]
 
@@ -275,9 +319,7 @@ def make_ddp_overlap_step(model, criterion, method, mesh: Mesh,
         if grad_clip is not None:
             grads = grad_clip(grads)
         new_p, new_os = method.update(grads, params, ostate, it)
-        new_ms = jax.tree_util.tree_map(
-            lambda s: lax.pmean(s, axis) if jnp.issubdtype(
-                jnp.asarray(s).dtype, jnp.inexact) else s, new_ms)
+        new_ms = _reduce_module_state(new_ms, axis)
         return new_p, new_ms, new_os, lax.pmean(loss, axis)
 
     repl, shard = P(), P(axis)
@@ -341,7 +383,12 @@ def make_zero1_overlap_step(model, criterion, method, mesh: Mesh,
 
     Restriction: the optim method must be elementwise in params/grads
     (SGD/Adam/RMSprop/...); norm-based methods (LARS) would see chunk
-    norms. That is the standard ZeRO-1 contract.
+    norms. That is the standard ZeRO-1 contract. The criterion contract
+    of :func:`make_ddp_overlap_step` applies identically here: an
+    unweighted mean loss, because the reduce-scatter divides summed
+    cotangents by the dp axis size. Module state syncs with the same
+    per-leaf :data:`STATE_REDUCE_POLICY` (mean for running averages, max
+    for calibration extrema).
 
     Signature: ``step(params, mstate, ostate, x, y, it)`` with ``ostate``
     from :func:`zero1_init_state` sharded by :func:`zero1_state_sharding`
@@ -402,9 +449,7 @@ def make_zero1_overlap_step(model, criterion, method, mesh: Mesh,
                 new_leaves[i] = v
 
         new_p = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        new_ms = jax.tree_util.tree_map(
-            lambda s: lax.pmean(s, axis) if jnp.issubdtype(
-                jnp.asarray(s).dtype, jnp.inexact) else s, new_ms)
+        new_ms = _reduce_module_state(new_ms, axis)
         return new_p, new_ms, new_ostate, lax.pmean(loss, axis)
 
     repl, shard = P(), P(axis)

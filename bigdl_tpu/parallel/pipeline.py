@@ -24,7 +24,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from bigdl_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.parallel.mesh import mark_varying, ring_perm

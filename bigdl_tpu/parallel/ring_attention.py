@@ -116,7 +116,7 @@ def make_ring_attention(mesh, axis_name: str, causal: bool = False):
     Returns a function (q, k, v) -> out operating on GLOBAL arrays whose
     sequence dim (axis 2) is sharded over ``axis_name``.
     """
-    from jax import shard_map
+    from bigdl_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)

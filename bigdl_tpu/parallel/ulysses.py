@@ -52,7 +52,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def make_ulysses_attention(mesh, axis_name: str, causal: bool = False):
     """shard_map wrapper over GLOBAL (b, h, s, d) arrays, seq sharded."""
-    from jax import shard_map
+    from bigdl_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)

@@ -1,0 +1,35 @@
+"""Serving tier: dynamic-batching inference with admission control,
+deadlines, and SLO metrics.
+
+The reference's serving story is ``PredictionService.scala:56`` — a
+blocking-queue pool of cloned models, one request per forward. On a TPU
+that wastes nearly all the hardware: throughput lives in batch
+occupancy, and a jitted executable recompiles per input shape. This
+package supplies the TPU-native translation:
+
+- :class:`InferenceService` — ``submit``/``predict`` front door with
+  bounded-queue backpressure, per-request deadlines, warmup, and
+  graceful close;
+- :class:`DynamicBatcher` — worker thread aggregating requests into
+  bucket-padded micro-batches (bounded compiled-executable set);
+- :class:`ServingMetrics` — served/rejected/expired counters, batch and
+  latency distributions, padding waste.
+
+``optim.predictor.PredictionService`` is now a thin compatibility shim
+over :class:`InferenceService`.
+"""
+
+from bigdl_tpu.serving.batcher import DynamicBatcher, bucket_sizes_for
+from bigdl_tpu.serving.errors import DeadlineExceeded, Overloaded, ServingError
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.service import InferenceService
+
+__all__ = [
+    "DynamicBatcher",
+    "DeadlineExceeded",
+    "InferenceService",
+    "Overloaded",
+    "ServingError",
+    "ServingMetrics",
+    "bucket_sizes_for",
+]

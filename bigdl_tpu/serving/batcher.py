@@ -1,0 +1,270 @@
+"""Dynamic micro-batching: the throughput engine of the serving tier.
+
+A single worker thread drains a bounded request queue into micro-batches
+under a ``(max_batch_size, max_wait_ms)`` policy: the first request opens
+a batch window, the window closes when either the batch is full or the
+wait budget is spent, and one jitted forward serves the whole batch.
+
+Shape discipline: a jitted forward recompiles per input shape, so
+batches are padded UP to the nearest **bucket** size (powers of two up
+to ``max_batch_size``) — the compiled-executable set is bounded at
+``len(bucket_sizes)`` per feature shape, however traffic fluctuates.
+Padding rows repeat row 0 (any valid row works; padding outputs are
+sliced off before scatter) and are charged to the padding-waste metric.
+
+The reference's ``PredictionService.scala:56`` answer to concurrency is
+a blocking-queue pool of cloned models, one request per forward; here
+the pool collapses to one compiled executable and concurrency becomes
+batch occupancy — the TPU-native translation.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import jax
+
+from bigdl_tpu.optim.predictor import _split_batch
+from bigdl_tpu.serving.errors import DeadlineExceeded, Overloaded
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+
+class _Request:
+    """One enqueued inference request: an UNBATCHED feature tree, the
+    future its row lands in, and its timing/deadline bookkeeping
+    (``deadline`` is an absolute ``time.monotonic()`` instant)."""
+
+    __slots__ = ("x", "future", "t_submit", "deadline")
+
+    def __init__(self, x: Any, future: Future,
+                 t_submit: float, deadline: Optional[float]):
+        self.x = x
+        self.future = future
+        self.t_submit = t_submit
+        self.deadline = deadline
+
+
+def _worker_loop(batcher_ref: "weakref.ref[DynamicBatcher]",
+                 q: _queue.Queue) -> None:
+    """Batcher worker body. While IDLE it holds only the queue and a weak
+    ref — never the batcher — so a batcher whose owner forgot ``close()``
+    becomes collectable and its worker exits, instead of leaking a thread
+    pinning the model and params forever. The strong ref is taken only
+    for the duration of processing one batch."""
+    while True:
+        try:
+            first = q.get(timeout=0.05)
+        except _queue.Empty:
+            batcher = batcher_ref()
+            if batcher is None or batcher._closed:
+                return
+            del batcher
+            continue
+        batcher = batcher_ref()
+        if batcher is None:
+            # owner was collected with requests still queued: nobody will
+            # ever run them — fail their futures rather than strand them
+            for r in _drain(q, first):
+                if not r.future.done():
+                    r.future.set_exception(RuntimeError(
+                        "serving batcher was garbage-collected with "
+                        "requests in flight"))
+            return
+        batcher._consume(first)
+        del batcher
+
+
+def _drain(q: _queue.Queue, first: "_Request") -> List["_Request"]:
+    reqs = [first]
+    while True:
+        try:
+            reqs.append(q.get_nowait())
+        except _queue.Empty:
+            return reqs
+
+
+def bucket_sizes_for(max_batch_size: int) -> List[int]:
+    """Powers of two up to ``max_batch_size`` (which is always included
+    as the top bucket, power of two or not)."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    sizes, b = [], 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return sizes
+
+
+class DynamicBatcher:
+    """Queue + worker thread turning request streams into bucket-padded
+    micro-batches.
+
+    ``forward`` takes one BATCHED feature tree and returns the batched
+    output tree (it closes over params/state — see
+    :class:`~bigdl_tpu.serving.service.InferenceService`).
+    """
+
+    def __init__(self, forward: Callable[[Any], Any], *,
+                 max_batch_size: int = 8, max_wait_ms: float = 2.0,
+                 max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.forward = forward
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self.bucket_sizes = bucket_sizes_for(self.max_batch_size)
+        self.metrics = metrics or ServingMetrics()
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.max_queue)
+        self._closed = False
+        # serializes the closed-check-then-put against close() setting the
+        # flag: without it a submit could land a request AFTER close()'s
+        # final drain, stranding its future forever
+        self._admit_lock = threading.Lock()
+        # the thread targets a module function holding only a WEAK ref:
+        # a bound-method target would keep an unclosed batcher (and the
+        # model/params its forward closes over) alive forever
+        self._worker = threading.Thread(
+            target=_worker_loop, args=(weakref.ref(self), self._q),
+            name="bigdl-serving-batcher", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------ admission ----
+
+    def submit(self, req: _Request) -> None:
+        """Enqueue or reject-now: a full queue raises :class:`Overloaded`
+        on the CALLER's thread (backpressure, never unbounded buffering)."""
+        with self._admit_lock:
+            if self._closed:
+                raise RuntimeError("serving batcher is closed")
+            try:
+                self._q.put_nowait(req)
+            except _queue.Full:
+                self.metrics.record_rejected()
+                raise Overloaded(self._q.qsize(), self.max_queue) from None
+        self.metrics.set_queue_depth(self._q.qsize())
+
+    # --------------------------------------------------------- worker ----
+
+    def _consume(self, first: _Request) -> None:
+        """Collect one batch window starting from ``first``, then execute."""
+        reqs = [first]
+        t_open = time.monotonic()
+        while len(reqs) < self.max_batch_size:
+            remaining = self.max_wait_s - (time.monotonic() - t_open)
+            if remaining <= 0:
+                break
+            try:
+                reqs.append(self._q.get(timeout=remaining))
+            except _queue.Empty:
+                break
+        self.metrics.set_queue_depth(self._q.qsize())
+        try:
+            self._execute(reqs)
+        except Exception as e:  # never let the worker die silently:
+            # a dead worker strands every future forever
+            self.metrics.record_failed(len(reqs))
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def bucket(self, n: int) -> int:
+        """Smallest bucket >= n."""
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.max_batch_size
+
+    def _execute(self, reqs: List[_Request]) -> None:
+        now = time.monotonic()
+        live: List[_Request] = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                # dropped BEFORE taking a batch slot: an expired request
+                # must never displace a servable one
+                self.metrics.record_expired()
+                r.future.set_exception(DeadlineExceeded(
+                    now - r.t_submit, r.deadline - r.t_submit))
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+        if not live:
+            return
+
+        flat0, treedef = jax.tree_util.tree_flatten(live[0].x)
+        ok: List[_Request] = [live[0]]
+        rows: List[List[Any]] = [flat0]
+        for r in live[1:]:
+            flat, td = jax.tree_util.tree_flatten(r.x)
+            if td != treedef or any(
+                    np.shape(a) != np.shape(b) for a, b in zip(flat, flat0)):
+                r.future.set_exception(ValueError(
+                    "request feature tree structure/shape differs from the "
+                    "batch it was grouped with; one InferenceService serves "
+                    "one input signature"))
+                self.metrics.record_failed()
+                continue
+            ok.append(r)
+            rows.append(flat)
+        live = ok
+
+        n = len(rows)
+        b = self.bucket(n)
+        pad = b - n
+        batched = jax.tree_util.tree_unflatten(treedef, [
+            np.stack(list(col) + [col[0]] * pad)
+            for col in zip(*rows)
+        ])
+        t_exec = time.monotonic()
+        try:
+            out = self.forward(batched)
+        except Exception as e:  # compile/runtime failure: fail the batch
+            self.metrics.record_failed(len(live))
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        self.metrics.record_batch(n, b)
+        per_row = _split_batch(out, n)
+        t_done = time.monotonic()
+        for r, row in zip(live, per_row):
+            if not r.future.done():
+                r.future.set_result(row)
+                self.metrics.record_served(
+                    t_done - r.t_submit, t_exec - r.t_submit)
+
+    # -------------------------------------------------------- shutdown ----
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop accepting; with ``drain`` (default) the worker finishes
+        every queued request before exiting, otherwise queued futures fail
+        with ``RuntimeError``."""
+        with self._admit_lock:
+            # under the lock, every admitted request is in the queue BEFORE
+            # the flag flips: the worker (or the final sweep below) sees it
+            self._closed = True
+
+        def _fail_queued():
+            while True:
+                try:
+                    r = self._q.get_nowait()
+                except _queue.Empty:
+                    return
+                if not r.future.done():
+                    r.future.set_exception(
+                        RuntimeError("serving batcher closed before request ran"))
+
+        if not drain:
+            _fail_queued()
+        self._worker.join(timeout)
+        # the worker's idle branch can observe Empty just before a
+        # pre-close put landed and then exit on the closed flag — sweep
+        # the queue once more rather than strand such a future
+        _fail_queued()

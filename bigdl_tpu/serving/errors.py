@@ -1,0 +1,37 @@
+"""Typed serving failures.
+
+Both are delivered two ways: ``InferenceService.submit`` RAISES
+``Overloaded`` (admission control happens on the caller's thread, before
+a queue slot is taken), while ``DeadlineExceeded`` is set ON the
+request's future (expiry is detected by the batcher worker when the
+request would otherwise occupy a batch slot).
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-tier failures."""
+
+
+class Overloaded(ServingError):
+    """The request queue is at its configured bound; the request was
+    rejected without being enqueued (backpressure, not buffering)."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        super().__init__(
+            f"serving queue full ({queue_depth}/{max_queue}); request rejected")
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired while it waited in the queue; it was
+    dropped before occupying a forward slot."""
+
+    def __init__(self, waited_s: float, deadline_s: float):
+        super().__init__(
+            f"request deadline {deadline_s * 1e3:.1f} ms exceeded after "
+            f"waiting {waited_s * 1e3:.1f} ms")
+        self.waited_s = waited_s
+        self.deadline_s = deadline_s

@@ -1,0 +1,149 @@
+"""SLO metrics for the serving tier.
+
+Lock-protected counters plus bounded-reservoir latency histograms — the
+serving analogue of ``utils/profiling.py``'s per-module wall-time table:
+cheap enough to stay on in production (O(1) per request, fixed memory),
+rich enough for the BENCH serving column (requests/sec, p50/p95/p99,
+batch-size distribution, padding waste).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class _Reservoir:
+    """Fixed-size uniform sample of a stream (Vitter's algorithm R): the
+    percentiles stay unbiased however long the service runs, with memory
+    bounded at ``size`` floats. Caller holds the metrics lock."""
+
+    def __init__(self, size: int, seed: int = 0):
+        self.size = size
+        self.seen = 0
+        self.values: List[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, v: float) -> None:
+        self.seen += 1
+        if len(self.values) < self.size:
+            self.values.append(v)
+        else:
+            j = self._rng.randrange(self.seen)
+            if j < self.size:
+                self.values[j] = v
+
+    def percentiles(self, qs) -> Optional[List[float]]:
+        if not self.values:
+            return None
+        return [float(p) for p in np.percentile(self.values, qs)]
+
+
+class ServingMetrics:
+    """Counters + histograms for one :class:`InferenceService`.
+
+    All mutators take the internal lock; ``snapshot()`` returns a plain
+    dict (JSON-able) and ``format_table()`` a fixed-width dump in the
+    style of ``utils/profiling.format_times``.
+    """
+
+    LATENCY_QS = (50, 95, 99)
+
+    def __init__(self, reservoir_size: int = 2048):
+        self._lock = threading.Lock()
+        self.served = 0
+        self.rejected = 0
+        self.expired = 0
+        self.failed = 0          # forward raised; futures got the exception
+        self.forwards = 0        # executed forward calls (batches)
+        self.batched_rows = 0    # real rows that went through a forward
+        self.padded_rows = 0     # padding rows added to reach a bucket size
+        self.queue_depth = 0
+        self._batch_sizes: Dict[int, int] = {}   # real rows per forward
+        self._latency = _Reservoir(reservoir_size)      # end-to-end seconds
+        self._queue_wait = _Reservoir(reservoir_size)   # submit -> drain
+
+    # ------------------------------------------------------- mutators ----
+
+    def record_batch(self, n_real: int, n_padded: int) -> None:
+        with self._lock:
+            self.forwards += 1
+            self.batched_rows += n_real
+            self.padded_rows += n_padded - n_real
+            self._batch_sizes[n_real] = self._batch_sizes.get(n_real, 0) + 1
+
+    def record_served(self, latency_s: float, queue_wait_s: float) -> None:
+        with self._lock:
+            self.served += 1
+            self._latency.add(latency_s)
+            self._queue_wait.add(queue_wait_s)
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_expired(self) -> None:
+        with self._lock:
+            self.expired += 1
+
+    def record_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+
+    # -------------------------------------------------------- readers ----
+
+    def snapshot(self) -> dict:
+        """Point-in-time dict of every counter and distribution."""
+        with self._lock:
+            padded_total = self.batched_rows + self.padded_rows
+            lat = self._latency.percentiles(self.LATENCY_QS)
+            wait = self._queue_wait.percentiles(self.LATENCY_QS)
+            return {
+                "served": self.served,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "failed": self.failed,
+                "forwards": self.forwards,
+                "queue_depth": self.queue_depth,
+                "batch_size_dist": dict(sorted(self._batch_sizes.items())),
+                "mean_batch_size": (self.batched_rows / self.forwards
+                                    if self.forwards else 0.0),
+                # fraction of executed rows that were padding
+                "padding_waste": (self.padded_rows / padded_total
+                                  if padded_total else 0.0),
+                "latency_ms": None if lat is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, lat)},
+                "queue_wait_ms": None if wait is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, wait)},
+                "latency_samples": self._latency.seen,
+            }
+
+    def format_table(self) -> str:
+        """Pretty table like ``profiling.format_times``'s getTimes dump."""
+        s = self.snapshot()
+        lines = [f"{'metric':<26} {'value':>18}"]
+
+        def row(name, value):
+            lines.append(f"{name:<26} {value:>18}")
+
+        for k in ("served", "rejected", "expired", "failed", "forwards",
+                  "queue_depth"):
+            row(k, s[k])
+        row("mean_batch_size", f"{s['mean_batch_size']:.2f}")
+        row("padding_waste", f"{s['padding_waste'] * 100:.1f}%")
+        dist = " ".join(f"{k}:{v}" for k, v in s["batch_size_dist"].items())
+        row("batch_size_dist", dist or "-")
+        for key in ("latency_ms", "queue_wait_ms"):
+            if s[key]:
+                for q, v in s[key].items():
+                    row(f"{key[:-3]}_{q}(ms)", f"{v:.3f}")
+        return "\n".join(lines)

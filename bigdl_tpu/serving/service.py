@@ -1,0 +1,135 @@
+"""InferenceService — the serving front door.
+
+``submit(x, deadline=None) -> Future`` (or the blocking ``predict``)
+feeds a :class:`~bigdl_tpu.serving.batcher.DynamicBatcher`; concurrent
+callers are aggregated into hardware-sized micro-batches behind one
+jitted forward. Robustness is built in, not bolted on:
+
+- **admission control** — a bounded queue; at the bound ``submit``
+  raises :class:`~bigdl_tpu.serving.errors.Overloaded` immediately
+  (shed load at the door, don't buffer into an ever-growing tail);
+- **deadlines** — per-request, in seconds from submit; an expired
+  request is dropped before wasting a forward slot and its future fails
+  with :class:`~bigdl_tpu.serving.errors.DeadlineExceeded`;
+- **warmup** — pre-compile every batch bucket before traffic arrives,
+  so no live request pays a compile;
+- **graceful close** — stop admitting, drain in-flight work, join the
+  worker.
+
+Metrics (:class:`~bigdl_tpu.serving.metrics.ServingMetrics`) track
+served/rejected/expired counts, batch-size and latency distributions,
+and padding waste — the numbers ``bench.py --mode serving`` reports.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from bigdl_tpu.serving.batcher import DynamicBatcher, _Request
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+
+def _model_forward(model):
+    def forward(params, state, x):
+        out, _ = model.apply(params, x, state=state, training=False)
+        return out
+    return forward
+
+
+class InferenceService:
+    """Dynamic-batching inference over one model / one input signature.
+
+    ``forward_fn`` (signature ``(params, state, batched_x) -> batched
+    out``) overrides the default jitted ``model.apply`` — tests use it to
+    count compilations; production can pass an AOT-compiled executable.
+    """
+
+    def __init__(self, model, params, state=None, *,
+                 max_batch_size: int = 8, max_wait_ms: float = 2.0,
+                 max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 forward_fn=None):
+        self.model = model
+        self.params = params
+        self.state = state or {}
+        self.metrics = metrics or ServingMetrics()
+        # jit a closure over the MODEL, never a bound method: a jitted
+        # bound method puts the service in a cycle through the C++ pjit
+        # object, which the GC cannot break — an unclosed service would
+        # leak itself plus params forever
+        self._fwd = forward_fn if forward_fn is not None else jax.jit(
+            _model_forward(model))
+        self._signature = None  # (treedef, leaf shapes/dtypes) of request 1
+        self._sig_lock = threading.Lock()  # check-and-set must be atomic
+        self.batcher = DynamicBatcher(
+            self._forward_batch, max_batch_size=max_batch_size,
+            max_wait_ms=max_wait_ms, max_queue=max_queue,
+            metrics=self.metrics)
+
+    def _forward_batch(self, batched_x):
+        return self._fwd(self.params, self.state, batched_x)
+
+    # ------------------------------------------------------ submission ----
+
+    def submit(self, x, deadline: Optional[float] = None) -> Future:
+        """Enqueue one UNBATCHED feature tree; returns the future of its
+        unbatched output tree. ``deadline`` is seconds from now; raises
+        :class:`Overloaded` when the queue is at its bound."""
+        x = jax.tree_util.tree_map(np.asarray, x)
+        self._check_signature(x)
+        now = time.monotonic()
+        fut: Future = Future()
+        req = _Request(x, fut, now,
+                       None if deadline is None else now + float(deadline))
+        self.batcher.submit(req)  # raises Overloaded / RuntimeError(closed)
+        return fut
+
+    def _check_signature(self, x) -> None:
+        """One service serves one input signature (structure + per-leaf
+        shape/dtype, fixed by the first request or warmup): mismatches are
+        rejected at the door, before they can poison a batch."""
+        leaves, treedef = jax.tree_util.tree_flatten(x)
+        sig = (treedef, tuple((l.shape, l.dtype.str) for l in leaves))
+        with self._sig_lock:
+            if self._signature is None:
+                self._signature = sig
+            elif sig != self._signature:
+                raise ValueError(
+                    f"request feature signature {sig[1]} does not match "
+                    f"this service's signature {self._signature[1]}; one "
+                    "InferenceService serves one input signature")
+
+    def predict(self, x, timeout: Optional[float] = None,
+                deadline: Optional[float] = None):
+        """Blocking convenience wrapper: ``submit(...).result(timeout)``."""
+        return self.submit(x, deadline=deadline).result(timeout)
+
+    # -------------------------------------------------------- lifecycle ----
+
+    def warmup(self, example_x, buckets: Optional[Sequence[int]] = None) -> None:
+        """Compile every bucket shape BEFORE traffic arrives: one forward
+        per bucket size, built by tiling one example feature tree. Live
+        requests then never pay a compile (the reference warms its model
+        pool by cloning; here the pool is the executable cache)."""
+        example_x = jax.tree_util.tree_map(np.asarray, example_x)
+        self._check_signature(example_x)
+        for b in (buckets or self.batcher.bucket_sizes):
+            batched = jax.tree_util.tree_map(
+                lambda a: np.stack([a] * b), example_x)
+            jax.block_until_ready(self._forward_batch(batched))
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop admitting new requests and (by default) drain queued ones."""
+        self.batcher.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "InferenceService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

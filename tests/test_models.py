@@ -82,6 +82,7 @@ def test_autoencoder_reconstruction_shape():
     assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
 
 
+@pytest.mark.slow
 def test_graft_entry_contract():
     import importlib.util
     from pathlib import Path

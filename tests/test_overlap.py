@@ -64,6 +64,7 @@ def test_make_buckets_contiguous_cover():
     assert make_buckets([], 4) == []
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("num_buckets", [1, 3])
 def test_ddp_overlap_matches_single_device(num_buckets):
     mesh = _mesh()
@@ -89,6 +90,7 @@ def test_ddp_overlap_matches_single_device(num_buckets):
     assert float(loss) > 0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method_cls", [
     lambda: SGD(learning_rate=0.1, momentum=0.9),
     lambda: Adam(learning_rate=0.01),
@@ -130,6 +132,7 @@ def test_zero1_state_is_sharded():
     assert shard_shapes == {(vec.shape[0] // 8,)}
 
 
+@pytest.mark.slow
 def test_distri_optimizer_overlap_equivalence(tmp_path):
     """DistriOptimizer(overlap_buckets=K) trains to the same weights as
     the auto-sharded DistriOptimizer on identical data (deterministic
@@ -163,6 +166,7 @@ def test_distri_optimizer_overlap_equivalence(tmp_path):
                                    atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_overlap_trains_bn_model():
     """A BatchNorm-containing conv net trains under the overlap step
     (running stats are shard-averaged; loss must decrease)."""
@@ -191,6 +195,7 @@ def test_overlap_trains_bn_model():
     assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
 
 
+@pytest.mark.slow
 def test_ddp_overlap_bf16_wire():
     """wire_dtype=bf16 (the reference's fp16-block wire compression,
     DistriParameterSynchronizer.scala:96): grads ride the collective in
@@ -215,3 +220,62 @@ def test_ddp_overlap_bf16_wire():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-2, rtol=5e-2)
         assert a.dtype == b.dtype  # params stay in their original dtype
+
+
+class _CalibLinear(nn.Linear):
+    """Float Linear recording a running input absmax — the calibration
+    pattern of the int8 layers (``nn/quantized.py`` ``act_absmax``)
+    without the int8 params jax.grad cannot differentiate."""
+
+    def build_state(self):
+        return {"act_absmax": jnp.zeros((), jnp.float32)}
+
+    def forward(self, ctx, x):
+        if ctx.training:
+            ctx.put_state("act_absmax", jnp.maximum(
+                ctx.get_state("act_absmax"), jnp.max(jnp.abs(x))))
+        return super().forward(ctx, x)
+
+
+def test_overlap_state_reduce_policy_absmax():
+    """Running extrema in module state must cross-shard reduce with pmax,
+    not pmean (STATE_REDUCE_POLICY): a mean of per-shard maxima would
+    shrink the int8 calibration scale as the shard count grows."""
+    mesh = _mesh()
+    model = nn.Sequential(_CalibLinear(16, 10))
+    params, mstate = model.init(jax.random.key(0))
+    crit = nn.CrossEntropyCriterion()
+    method = SGD(learning_rate=0.0)
+    x, y = _data()
+    step = make_ddp_overlap_step(model, crit, method, mesh, num_buckets=2)
+    _, ms, _, _ = step(params, mstate, method.init_state(params),
+                       x, y, jnp.int32(0))
+    got = float(jax.tree_util.tree_leaves(ms)[0])
+    want = float(np.abs(np.asarray(x)).max())            # global running max
+    mean_of_maxima = float(np.abs(np.asarray(x).reshape(8, -1, 16))
+                           .max(axis=(1, 2)).mean())     # the old pmean bug
+    assert abs(got - want) < 1e-6
+    assert abs(got - mean_of_maxima) > 1e-3  # the distinction is observable
+
+
+def test_distri_optimizer_overlap_rejects_non_mean_criterion():
+    """The bucket collectives divide psum'd cotangents by the dp axis
+    size — only correct for an unweighted mean loss. Sum losses and
+    weighted criteria must be refused, not silently mis-scaled."""
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+    mesh = _mesh()
+    x, y = _data(64)
+    ds = DataSet.tensors(np.asarray(x), np.asarray(y)) >> SampleToMiniBatch(32)
+
+    def build(crit):
+        opt = DistriOptimizer(_model(), ds, crit, batch_size=32, mesh=mesh,
+                              overlap_buckets=2)
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        return opt._build_step()
+
+    with pytest.raises(ValueError, match="size_average"):
+        build(nn.CrossEntropyCriterion(size_average=False))
+    with pytest.raises(ValueError, match="unweighted"):
+        build(nn.ClassNLLCriterion(weights=jnp.ones(10)))
+    build(nn.CrossEntropyCriterion())  # the contract-conforming case

@@ -1,0 +1,291 @@
+"""Serving tier (bigdl_tpu/serving): dynamic batching correctness,
+admission control, deadlines, compile bounds, and metrics.
+
+The load-bearing properties, per the subsystem contract:
+
+- batched outputs are identical to per-request ``Predictor.predict``;
+- concurrent traffic executes measurably fewer forwards than requests;
+- the compiled-shape set is bounded by the bucket count;
+- a full queue rejects with ``Overloaded`` (never unbounded growth);
+- expired deadlines fail fast without occupying a forward slot.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn import Linear, LogSoftMax, ReLU, Sequential
+from bigdl_tpu.optim.predictor import PredictionService, Predictor
+from bigdl_tpu.serving import (
+    DeadlineExceeded, InferenceService, Overloaded, ServingMetrics,
+    bucket_sizes_for,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = (Sequential().add(Linear(8, 16)).add(ReLU())
+             .add(Linear(16, 4)).add(LogSoftMax()))
+    params, state = model.init(jax.random.key(0))
+    x = np.random.RandomState(0).rand(64, 8).astype("float32")
+    return model, params, state, x
+
+
+class _CountingForward:
+    """Records the batch sizes each forward executes with — the
+    compile-counting wrapper (one jit cache entry per distinct shape)."""
+
+    def __init__(self, model):
+        self.base = jax.jit(
+            lambda p, s, xb: model.apply(p, xb, state=s, training=False)[0])
+        self.sizes = []
+        self._lock = threading.Lock()
+
+    def __call__(self, params, state, xb):
+        with self._lock:
+            self.sizes.append(int(np.shape(jax.tree_util.tree_leaves(xb)[0])[0]))
+        return self.base(params, state, xb)
+
+
+class _GatedForward(_CountingForward):
+    """Blocks every forward on an event — lets tests pile up a known
+    queue state before the worker makes progress."""
+
+    def __init__(self, model):
+        super().__init__(model)
+        self.gate = threading.Event()
+
+    def __call__(self, params, state, xb):
+        self.gate.wait(timeout=30)
+        return super().__call__(params, state, xb)
+
+
+def test_bucket_sizes():
+    assert bucket_sizes_for(8) == [1, 2, 4, 8]
+    assert bucket_sizes_for(6) == [1, 2, 4, 6]
+    assert bucket_sizes_for(1) == [1]
+    with pytest.raises(ValueError):
+        bucket_sizes_for(0)
+
+
+def test_concurrent_requests_batch_and_match_predictor(setup):
+    """The acceptance property: >= 32 concurrent requests at
+    max_batch_size=8 run in measurably fewer forwards than requests
+    (mean executed batch >= 2), outputs equal per-request
+    ``Predictor.predict``, and compiled shapes stay within the buckets."""
+    model, params, state, x = setup
+    fwd = _GatedForward(model)
+    svc = InferenceService(model, params, state, max_batch_size=8,
+                           max_wait_ms=20.0, max_queue=64, forward_fn=fwd)
+    n = 40
+    outs = [None] * n
+
+    def call(i):
+        outs[i] = svc.predict(x[i], timeout=30)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    # hold the gate until the queue is loaded so batches actually form
+    # (without it a fast CPU forward could drain requests one at a time)
+    deadline = time.monotonic() + 10
+    while svc.batcher._q.qsize() < n - 8 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    fwd.gate.set()
+    for t in threads:
+        t.join()
+    svc.close()
+
+    snap = svc.metrics.snapshot()
+    assert snap["served"] == n
+    assert snap["forwards"] < n
+    assert snap["mean_batch_size"] >= 2.0
+    # every executed shape is a bucket; distinct compiled shapes bounded
+    assert set(fwd.sizes) <= set(svc.batcher.bucket_sizes)
+    assert len(set(fwd.sizes)) <= len(svc.batcher.bucket_sizes)
+    cache_size = getattr(fwd.base, "_cache_size", lambda: None)()
+    if cache_size is not None:
+        assert cache_size <= len(svc.batcher.bucket_sizes)
+
+    expected = Predictor(model, params, state).predict(x[:n])
+    for i in range(n):
+        np.testing.assert_array_equal(np.asarray(outs[i]),
+                                      np.asarray(expected[i]))
+
+
+def test_overload_rejects_immediately_and_bounds_queue(setup):
+    model, params, state, x = setup
+    fwd = _GatedForward(model)
+    svc = InferenceService(model, params, state, max_batch_size=4,
+                           max_wait_ms=1.0, max_queue=4, forward_fn=fwd)
+    futures, rejected = [], 0
+    # worker blocks inside the first forward; the queue holds at most 4 —
+    # every submit past (in-flight batch + 4 queued) must reject NOW
+    for i in range(32):
+        try:
+            futures.append(svc.submit(x[i % len(x)]))
+        except Overloaded:
+            rejected += 1
+        assert svc.batcher._q.qsize() <= 4  # the bound is never exceeded
+    assert rejected > 0
+    assert len(futures) <= 4 + 4  # queue bound + one in-flight batch
+    fwd.gate.set()
+    for f in futures:
+        f.result(timeout=30)  # accepted requests still complete
+    svc.close()
+    snap = svc.metrics.snapshot()
+    assert snap["rejected"] == rejected
+    assert snap["served"] == len(futures)
+
+
+def test_deadline_expired_fails_fast_without_forward_slot(setup):
+    model, params, state, x = setup
+    fwd = _GatedForward(model)
+    svc = InferenceService(model, params, state, max_batch_size=8,
+                           max_wait_ms=1.0, max_queue=16, forward_fn=fwd)
+    blocked = svc.submit(x[0])          # occupies the worker at the gate
+    time.sleep(0.05)                    # let the first batch window close
+    doomed = svc.submit(x[1], deadline=0.01)
+    live = svc.submit(x[2])             # no deadline; same queued batch
+    time.sleep(0.1)                     # deadline passes while queued
+    fwd.gate.set()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    assert np.asarray(live.result(timeout=30)).shape == (4,)
+    blocked.result(timeout=30)
+    svc.close()
+    snap = svc.metrics.snapshot()
+    assert snap["expired"] == 1
+    assert snap["served"] == 2
+    # the expired request never took a forward slot: executed rows cover
+    # exactly the two served requests plus the first blocked one
+    assert sum(fwd.sizes) == 2
+
+
+def test_warmup_precompiles_every_bucket(setup):
+    model, params, state, x = setup
+    fwd = _CountingForward(model)
+    svc = InferenceService(model, params, state, max_batch_size=8,
+                           forward_fn=fwd)
+    svc.warmup(x[0])
+    assert sorted(set(fwd.sizes)) == svc.batcher.bucket_sizes
+    n_warm = len(fwd.sizes)
+    svc.predict(x[0], timeout=30)  # traffic adds no new shape
+    assert set(fwd.sizes[n_warm:]) <= set(svc.batcher.bucket_sizes)
+    svc.close()
+
+
+def test_metrics_snapshot_and_table(setup):
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_batch_size=4,
+                           max_wait_ms=5.0)
+    for i in range(10):
+        svc.predict(x[i], timeout=30)
+    svc.close()
+    snap = svc.metrics.snapshot()
+    assert snap["served"] == 10 and snap["rejected"] == 0
+    assert snap["latency_samples"] == 10
+    lat = snap["latency_ms"]
+    assert lat and lat["p50"] <= lat["p95"] <= lat["p99"]
+    assert 0.0 <= snap["padding_waste"] < 1.0
+    assert sum(k * v for k, v in snap["batch_size_dist"].items()) == 10
+    table = svc.metrics.format_table()
+    assert "served" in table and "latency_p99" in table
+
+
+def test_metrics_reservoir_bounded():
+    m = ServingMetrics(reservoir_size=16)
+    for i in range(1000):
+        m.record_served(i / 1000.0, 0.0)
+    snap = m.snapshot()
+    assert snap["served"] == 1000 and snap["latency_samples"] == 1000
+    assert len(m._latency.values) == 16
+
+
+def test_mismatched_signature_rejected_at_submit(setup):
+    """One service serves one input signature (pinned by the first
+    request or warmup): a mismatched request is rejected at the door
+    with ValueError, before it can poison a batch or compile a new
+    shape; conforming traffic is unaffected."""
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_batch_size=8,
+                           max_wait_ms=1.0)
+    first = svc.submit(x[0])  # pins the signature
+    with pytest.raises(ValueError, match="signature"):
+        svc.submit(np.zeros((5,), "float32"))  # wrong feature shape
+    with pytest.raises(ValueError, match="signature"):
+        svc.submit(x[1].astype("float64"))     # wrong dtype
+    assert np.asarray(first.result(timeout=30)).shape == (4,)
+    assert np.asarray(svc.predict(x[2], timeout=30)).shape == (4,)
+    svc.close()
+
+
+def test_close_drains_then_rejects(setup):
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_batch_size=8,
+                           max_wait_ms=1.0)
+    futures = [svc.submit(x[i]) for i in range(12)]
+    svc.close()  # default: drain
+    for f in futures:
+        assert np.asarray(f.result(timeout=30)).shape == (4,)
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit(x[0])
+
+
+def test_prediction_service_shim_batches_under_hood(setup):
+    """The compatibility shim keeps the old predict/served API but serves
+    concurrent callers in micro-batches."""
+    model, params, state, x = setup
+    svc = PredictionService(model, params, state, n_concurrent=4,
+                            max_wait_ms=20.0)
+    n = 24
+    outs = [None] * n
+
+    def call(i):
+        outs[i] = svc.predict(x[i])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert svc.served == n
+    assert svc.metrics.forwards <= n  # batched (equality only if fully serial)
+    full, _ = model.apply(params, x[:n], state=state)
+    for i in (0, 7, n - 1):
+        np.testing.assert_allclose(np.asarray(outs[i]), np.asarray(full)[i],
+                                   rtol=1e-5)
+    svc.close()
+
+
+def test_serving_demo_example_runs():
+    from bigdl_tpu.examples import serving_demo
+
+    snap = serving_demo.main(["-c", "4", "-n", "32", "-w", "20"])
+    assert snap["served"] == 32 and snap["forwards"] <= 32
+
+
+def test_unclosed_service_is_garbage_collectable(setup):
+    """An InferenceService whose owner forgot close() must not leak: the
+    worker holds only a weak ref while idle and the jitted forward closes
+    over the model (never a bound method), so dropping the last strong
+    ref collects the service and the worker thread exits."""
+    import gc
+    import weakref
+
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_wait_ms=1.0)
+    svc.predict(x[0], timeout=30)
+    sref = weakref.ref(svc)
+    worker = svc.batcher._worker
+    del svc
+    deadline = time.monotonic() + 10
+    while sref() is not None and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.02)
+    assert sref() is None, "unclosed InferenceService leaked"
+    worker.join(timeout=10)
+    assert not worker.is_alive()
