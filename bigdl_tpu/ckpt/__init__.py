@@ -1,0 +1,24 @@
+"""bigdl_tpu.ckpt — fault-tolerant checkpointing.
+
+``CheckpointManager`` is the front door: async saves that never block the
+step loop, atomic size+sha256-verified commits through ``MANIFEST.json``,
+restore with fallback to the previous good checkpoint, keep-last-N /
+keep-every-K retention, and SIGTERM preemption handling. The byte format
+stays in ``bigdl_tpu/utils/checkpoint.py`` — both layers read each
+other's files.
+"""
+
+from bigdl_tpu.ckpt.manager import (
+    CheckpointInFlightError,
+    CheckpointManager,
+    SaveHandle,
+)
+from bigdl_tpu.ckpt.manifest import ManifestEntry, load_manifest
+
+__all__ = [
+    "CheckpointInFlightError",
+    "CheckpointManager",
+    "ManifestEntry",
+    "SaveHandle",
+    "load_manifest",
+]

@@ -1,0 +1,453 @@
+"""CheckpointManager: async, crash-consistent checkpointing.
+
+The fault-tolerance tier above the format layer
+(``bigdl_tpu/utils/checkpoint.py``). One manager owns one checkpoint
+directory and provides:
+
+- **async saves** — ``save()`` snapshots the device pytrees to host numpy
+  on the calling thread (the cheap part: a device->host copy that must
+  complete before the train step donates those buffers), then serializes
+  and writes on a single background worker so the step loop never blocks
+  on msgpack or disk. ``wait()``/``close()`` drain in-flight saves; a
+  second ``save()`` of a tag still in flight raises
+  :class:`CheckpointInFlightError`.
+- **atomic, verified commits** — blob bytes go to ``<tag>.ckpt.tmp``,
+  are fsynced, and renamed in; size + sha256 are then recorded in
+  ``MANIFEST.json`` via write-staging-then-``os.replace``. A crash at any
+  point leaves either the old or the new manifest — never a torn
+  checkpoint — and an unreferenced blob is just garbage for the GC.
+- **restore with fallback** — :meth:`restore_latest` verifies each
+  manifest entry (size + sha256 + deserialization) newest-first and falls
+  back to the previous committed entry on corruption instead of raising.
+- **retention** — keep-last-N plus keep-every-K-steps GC of blobs,
+  sidecars, and stale staging files, applied after each commit.
+- **preemption** — :meth:`install_preemption_hook` registers a SIGTERM
+  (by default) handler that only sets a flag; the training loop polls
+  :attr:`preemption_requested` at step boundaries and saves with
+  ``preempted=True``, which marks the manifest entry so a resuming run
+  can tell an intentional milestone from an eviction save.
+
+Reference: the driver checkpoint that blocks between iterations
+(``AbstractOptimizer.scala:205``) and the retry window that trusts an
+mtime scan (``DistriOptimizer.scala:881-960, :986``); the async-snapshot /
+verified-commit design follows Orbax's async checkpointing and Meta's
+Check-N-Run (PAPERS.md) — on TPUs preemption is the dominant failure mode
+and blocking saves the dominant checkpoint cost, and both are avoidable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from bigdl_tpu.ckpt.manifest import (
+    ManifestEntry,
+    apply_retention,
+    fsync_dir,
+    load_manifest,
+    sha256_bytes,
+    write_manifest,
+)
+from bigdl_tpu.utils.checkpoint import (
+    deserialize_payload,
+    latest_checkpoint,
+    load_checkpoint,
+    serialize_payload,
+)
+
+log = logging.getLogger("bigdl_tpu.ckpt")
+
+
+class CheckpointInFlightError(RuntimeError):
+    """A save of this tag is already being written."""
+
+
+class SaveHandle:
+    """Handle for one (possibly in-flight) save."""
+
+    def __init__(self, tag: str, future: "Future[ManifestEntry]"):
+        self.tag = tag
+        self._future = future
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout: Optional[float] = None) -> ManifestEntry:
+        """Block until committed; returns the manifest entry (or raises the
+        worker's exception)."""
+        return self._future.result(timeout)
+
+
+def _host_snapshot(tree):
+    """Device->host copy on the CALLING thread. This must finish before
+    returning: the train loop donates the param/state buffers to the next
+    step, and a donated jax array read later from the worker thread would
+    be a use-after-free. numpy leaves pass through by reference (already
+    immutable-by-contract once handed to save)."""
+    from bigdl_tpu.utils.checkpoint import _to_numpy
+
+    return _to_numpy(tree)
+
+
+class CheckpointManager:
+    """Front door for fault-tolerant checkpointing of one directory.
+
+    Thread model: ``save()`` may be called from any single training
+    thread; serialization, writes, manifest commits, and GC all run on one
+    worker thread, so commits are ordered and GC never races a write.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        keep_last_n: Optional[int] = None,
+        keep_every_k_steps: Optional[int] = None,
+        async_save: bool = True,
+        fsync: bool = True,
+        max_pending: int = 2,
+    ):
+        self.directory = str(directory)
+        self.keep_last_n = keep_last_n
+        self.keep_every_k_steps = keep_every_k_steps
+        self.async_save = async_save
+        self.fsync = fsync
+        # backpressure bound: each queued save holds a full host snapshot
+        # of params+state, so an unbounded queue on a slow disk would eat
+        # host memory one model-copy per trigger until OOM; past the bound
+        # save() blocks on the oldest commit (Orbax does the same)
+        self.max_pending = max(1, int(max_pending))
+        os.makedirs(self.directory, exist_ok=True)
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt-writer")
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, SaveHandle] = {}
+        self._closed = False
+        self._preempted = threading.Event()
+        self._prev_handlers: List[Tuple[int, Any]] = []
+
+    # ------------------------------------------------------------- save --
+    def save(
+        self,
+        tag: str,
+        params: Any,
+        module_state: Any = None,
+        optim_state: Any = None,
+        meta: Optional[Dict[str, Any]] = None,
+        *,
+        step: Optional[int] = None,
+        blocking: Optional[bool] = None,
+        preempted: bool = False,
+    ) -> SaveHandle:
+        """Snapshot now, commit in the background. Returns a handle;
+        ``blocking=True`` (or ``async_save=False``) waits for the commit."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        meta = dict(meta or {})
+        if step is None:
+            step = int(meta.get("iteration", 0))
+        while True:  # backpressure BEFORE snapshotting (caps peak memory)
+            with self._lock:
+                pending = [h for h in self._inflight.values() if not h.done()]
+            if len(pending) < self.max_pending:
+                break
+            try:
+                pending[0].result()  # block on the oldest in-flight commit
+            except Exception:
+                pass  # surfaced by wait()/the failing handle's owner
+        snapshot = {
+            "params": _host_snapshot(params),
+            "module_state": _host_snapshot(module_state or {}),
+            "optim_state": _host_snapshot(optim_state or {}),
+        }
+        with self._lock:
+            live = self._inflight.get(tag)
+            if live is not None and not live.done():
+                raise CheckpointInFlightError(
+                    f"checkpoint '{tag}' already has a save in flight")
+            # prune handles that committed cleanly (tags are unique per
+            # step, so a long run would otherwise hold one dead handle per
+            # save); failed ones stay so wait() still surfaces the error
+            for t in [t for t, h in self._inflight.items()
+                      if h.done() and h._future.exception() is None]:
+                del self._inflight[t]
+            future = self._pool.submit(
+                self._commit, tag, snapshot, meta, step, preempted)
+            handle = SaveHandle(tag, future)
+            self._inflight[tag] = handle
+        if blocking or (blocking is None and not self.async_save):
+            handle.result()
+        return handle
+
+    def _commit(self, tag, snapshot, meta, step, preempted) -> ManifestEntry:
+        blob = serialize_payload(snapshot["params"], snapshot["module_state"],
+                                 snapshot["optim_state"])
+        meta.setdefault("wall_time", time.time())
+        final = os.path.join(self.directory, f"{tag}.ckpt")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        # legacy sidecar: keeps latest_checkpoint()/load_checkpoint() able
+        # to read a manager directory without the manifest
+        side_tmp = final[: -len(".ckpt")] + ".meta.json.tmp"
+        with open(side_tmp, "w") as fh:
+            json.dump(meta, fh)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        os.replace(side_tmp, final[: -len(".ckpt")] + ".meta.json")
+        if self.fsync:
+            fsync_dir(self.directory)
+
+        entry = ManifestEntry(
+            tag=tag, file=os.path.basename(final), step=int(step),
+            size=len(blob), sha256=sha256_bytes(blob),
+            wall_time=float(meta["wall_time"]), meta=meta,
+            preempted=bool(preempted),
+        )
+        entries = load_manifest(self.directory)
+        if not entries:
+            # first commit into a pre-manifest directory: adopt the legacy
+            # single-file checkpoints into the manifest (hashing them once)
+            # so they join the verified fallback chain and the retention
+            # policy, instead of being GC'd as unreferenced orphans
+            entries = self._adopt_legacy_entries(exclude=entry.file)
+        entries = [e for e in entries if e.tag != tag]
+        entries.append(entry)
+        kept = apply_retention(entries, self.keep_last_n,
+                               self.keep_every_k_steps)
+        write_manifest(self.directory, kept, fsync=self.fsync)
+        self._gc(referenced={k.file for k in kept})
+        return entry
+
+    def _adopt_legacy_entries(self, exclude: str) -> List[ManifestEntry]:
+        adopted = []
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".ckpt") or name == exclude:
+                continue
+            side = os.path.join(self.directory,
+                                name[: -len(".ckpt")] + ".meta.json")
+            blob_path = os.path.join(self.directory, name)
+            try:
+                with open(side) as fh:
+                    meta = json.load(fh)
+                with open(blob_path, "rb") as fh:
+                    blob = fh.read()
+            except (OSError, ValueError):
+                continue  # sidecar-less or unreadable: a torn legacy save
+            adopted.append(ManifestEntry(
+                tag=name[: -len(".ckpt")], file=name,
+                step=int(meta.get("iteration", 0)), size=len(blob),
+                sha256=sha256_bytes(blob),
+                wall_time=float(meta.get("wall_time", 0.0)), meta=meta))
+        adopted.sort(key=lambda e: (e.step, e.wall_time))
+        if adopted:
+            log.info("adopted %d legacy checkpoint(s) into the manifest",
+                     len(adopted))
+        return adopted
+
+    def _gc(self, referenced) -> None:
+        """Remove every blob/sidecar the manifest doesn't reference, and
+        any stale staging files. Covers retention-dropped entries AND
+        orphans from a crash between blob rename and manifest replace —
+        once a manifest exists, unreferenced blobs are unreachable through
+        restore_latest(), so they are pure garbage. Runs on the worker
+        thread AFTER the manifest commit, so a crash during GC only leaves
+        extra files, never a manifest pointing at a deleted blob. No other
+        write is concurrent (single worker), so every ``*.tmp`` seen here
+        is a dead survivor."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            stale = (
+                name.endswith(".tmp")
+                or (name.endswith(".ckpt") and name not in referenced)
+                or (name.endswith(".meta.json")
+                    and name[: -len(".meta.json")] + ".ckpt" not in referenced)
+            )
+            if stale:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # ---------------------------------------------------------- restore --
+    def restore_latest(
+        self, template: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Tuple[Dict[str, Any], ManifestEntry]]:
+        """Newest verifiable checkpoint as ``(payload, entry)``, walking
+        back through the manifest on corruption; None when nothing is
+        restorable. Payload keys: params / module_state / optim_state."""
+        from bigdl_tpu.ckpt.manifest import verify_entry
+
+        self.wait(raise_errors=False)  # an in-flight commit may be newest
+        entries = load_manifest(self.directory)
+        for entry in reversed(entries):
+            blob = verify_entry(self.directory, entry)
+            if blob is None:
+                log.warning(
+                    "checkpoint '%s' failed verification (missing, "
+                    "truncated, or checksum mismatch); falling back to the "
+                    "previous manifest entry", entry.tag)
+                continue
+            try:
+                payload = deserialize_payload(blob, template)
+            except Exception as e:
+                # the sha256 already proved these are the exact bytes we
+                # wrote, so this is a template/structure mismatch (model or
+                # optim-method change), not corruption — every other entry
+                # would fail identically, and silently walking back would
+                # end in a from-scratch restart that GCs the user's
+                # progress. Raise the config error loudly instead.
+                raise ValueError(
+                    f"checkpoint '{entry.tag}' passed checksum "
+                    "verification but does not deserialize against the "
+                    "provided template — structure/config mismatch (e.g. "
+                    "a different model or optim method), not disk "
+                    "corruption") from e
+            return payload, entry
+        if entries:
+            # every manifest entry failed verification: do NOT fall through
+            # to the unverified scan — it would happily return the same
+            # corrupt blob the checksum walk just rejected
+            log.error("no manifest entry in %s survived verification",
+                      self.directory)
+            return None
+        # pre-manifest directory (written by the legacy single-file layer):
+        # fall back to the unverified mtime scan so old runs stay resumable
+        legacy = latest_checkpoint(self.directory)
+        if legacy is not None:
+            try:
+                payload, meta = load_checkpoint(legacy, template)
+            except Exception:
+                log.warning("legacy checkpoint %s unreadable", legacy,
+                            exc_info=True)
+                return None
+            tag = os.path.basename(legacy)[: -len(".ckpt")]
+            entry = ManifestEntry(
+                tag=tag, file=os.path.basename(legacy),
+                step=int(meta.get("iteration", 0)), size=-1, sha256="",
+                wall_time=float(meta.get("wall_time", 0.0)), meta=meta)
+            return payload, entry
+        return None
+
+    # ------------------------------------------------------- lifecycle --
+    def wait(self, raise_errors: bool = True) -> None:
+        """Drain every in-flight save. With ``raise_errors=False`` failed
+        saves are logged (never silently dropped) instead of raised."""
+        with self._lock:
+            handles = list(self._inflight.values())
+        first_error = None
+        for h in handles:
+            try:
+                h.result()
+            except Exception as e:
+                log.error("checkpoint '%s' failed to commit: %s", h.tag, e)
+                if first_error is None:
+                    first_error = e
+        with self._lock:
+            for tag in [t for t, h in self._inflight.items() if h.done()]:
+                del self._inflight[tag]
+        if first_error is not None and raise_errors:
+            raise first_error
+
+    def close(self) -> None:
+        """Drain, release the worker, and uninstall any signal hooks.
+        Errors from in-flight saves are logged, not raised — close() runs
+        on shutdown paths where raising would mask the original failure."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.wait(raise_errors=False)
+        finally:
+            self._pool.shutdown(wait=True)
+            self.uninstall_preemption_hook()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def mark_preempted(self, tag: str) -> None:
+        """Flip an existing entry's ``preempted`` flag via a manifest-only
+        rewrite. This is the cheap path when preemption lands on a step
+        whose blob is already committed: milliseconds, vs re-snapshotting
+        and re-writing a potentially multi-GB blob inside the eviction
+        grace window. Runs on the writer thread (ordered after any
+        in-flight commit) and blocks until durable."""
+        def _mark():
+            entries = load_manifest(self.directory)
+            for e in entries:
+                if e.tag == tag:
+                    e.preempted = True
+            write_manifest(self.directory, entries, fsync=self.fsync)
+
+        self._pool.submit(_mark).result()
+
+    # ------------------------------------------------------ preemption --
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preempted.is_set()
+
+    def request_preemption(self) -> None:
+        """Manually request a preemption save (what the signal hook does)."""
+        self._preempted.set()
+
+    def clear_preemption(self) -> None:
+        self._preempted.clear()
+
+    def install_preemption_hook(self, signals=(signal.SIGTERM,)) -> bool:
+        """Arm SIGTERM (TPU eviction notice) to request an immediate save
+        at the next step boundary. Only a flag is set in the handler —
+        everything else (snapshot, write, manifest) happens on normal
+        threads, because signal context allows almost nothing safely.
+        Returns False (with a warning) off the main thread, where CPython
+        forbids installing handlers."""
+        try:
+            for sig in signals:
+                prev = signal.signal(sig, self._on_signal)
+                self._prev_handlers.append((sig, prev))
+        except ValueError:
+            log.warning("cannot install preemption hook off the main "
+                        "thread; call request_preemption() instead")
+            return False
+        return True
+
+    def uninstall_preemption_hook(self) -> None:
+        while self._prev_handlers:
+            sig, prev = self._prev_handlers.pop()
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+
+    def _on_signal(self, signum, frame) -> None:
+        self._preempted.set()
+
+    # -------------------------------------------------------- queries --
+    def entries(self) -> List[ManifestEntry]:
+        """Committed entries, oldest -> newest."""
+        return load_manifest(self.directory)
+
+    @property
+    def last_step(self) -> Optional[int]:
+        entries = load_manifest(self.directory)
+        return entries[-1].step if entries else None

@@ -1,0 +1,156 @@
+"""Checkpoint manifest: the single source of truth for committed saves.
+
+A checkpoint directory holds blobs (``<tag>.ckpt``, written by the format
+layer in ``bigdl_tpu/utils/checkpoint.py``) plus one ``MANIFEST.json``
+recording, per committed save, the blob name, its byte size, its sha256,
+the training step, host counters, and a ``preempted`` flag. Every update
+rewrites the whole manifest to a staging file, fsyncs, and ``os.replace``s
+it over the old one — a crash at ANY point leaves either the previous or
+the new manifest on disk, never a torn one, and a blob is only *committed*
+once the manifest that references it has been replaced in. Blobs without a
+manifest entry (a crash between blob rename and manifest replace) are
+garbage, collected by the retention pass.
+
+Reference: the driver's ``getLatestFile`` mtime scan
+(``DistriOptimizer.scala:986``) trusted the filesystem listing; Check-N-Run
+style verified checkpoints record size+checksum at commit so restore can
+prove integrity instead of assuming it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+MANIFEST_NAME = "MANIFEST.json"
+_VERSION = 1
+
+
+@dataclasses.dataclass
+class ManifestEntry:
+    """One committed checkpoint."""
+
+    tag: str
+    file: str                     # blob basename, relative to the directory
+    step: int
+    size: int
+    sha256: str
+    wall_time: float
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    preempted: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ManifestEntry":
+        known = {f.name for f in dataclasses.fields(ManifestEntry)}
+        return ManifestEntry(**{k: v for k, v in d.items() if k in known})
+
+
+def sha256_bytes(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def manifest_path(directory: str) -> str:
+    return os.path.join(directory, MANIFEST_NAME)
+
+
+def load_manifest(directory: str) -> List[ManifestEntry]:
+    """Entries oldest -> newest; [] when the manifest is absent or its
+    JSON is corrupt (the blobs may still be fine — the caller's legacy
+    scan is the availability path of last resort). A manifest that EXISTS
+    but cannot be read (EACCES/EIO) raises: treating it as absent would
+    silently downgrade restore to the unverified legacy scan."""
+    path = manifest_path(directory)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return []
+    except ValueError:
+        return []
+    entries = []
+    for raw in doc.get("entries", []):
+        try:
+            entries.append(ManifestEntry.from_json(raw))
+        except TypeError:
+            continue  # unknown/partial entry from a future or corrupt writer
+    return entries
+
+
+def fsync_dir(directory: str) -> None:
+    """Durability for the rename itself (POSIX: os.replace is atomic but
+    only durable once the directory entry is synced)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_manifest(directory: str, entries: List[ManifestEntry],
+                   fsync: bool = True) -> str:
+    """Atomically replace the manifest with ``entries`` (oldest -> newest)."""
+    path = manifest_path(directory)
+    tmp = path + ".tmp"
+    doc = {
+        "version": _VERSION,
+        "updated": time.time(),
+        "entries": [e.to_json() for e in entries],
+    }
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.flush()
+        if fsync:
+            os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        fsync_dir(directory)
+    return path
+
+
+def verify_entry(directory: str, entry: ManifestEntry) -> Optional[bytes]:
+    """Return the blob bytes iff size and sha256 match; None otherwise."""
+    path = os.path.join(directory, entry.file)
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError:
+        return None
+    if len(blob) != entry.size or sha256_bytes(blob) != entry.sha256:
+        return None
+    return blob
+
+
+def apply_retention(
+    entries: List[ManifestEntry],
+    keep_last_n: Optional[int],
+    keep_every_k_steps: Optional[int],
+) -> List[ManifestEntry]:
+    """Entries to KEEP (oldest -> newest). The newest entry is always kept;
+    an entry survives if it is among the last N or its step is a multiple
+    of K (the Check-N-Run "milestone" rule)."""
+    if not entries:
+        return []
+    keep = set()
+    if keep_last_n is None and keep_every_k_steps is None:
+        return list(entries)
+    n = keep_last_n if keep_last_n is not None else 1
+    for e in entries[-max(1, n):]:
+        keep.add(e.tag)
+    if keep_every_k_steps:
+        for e in entries:
+            if e.step % keep_every_k_steps == 0 and e.step > 0:
+                keep.add(e.tag)
+    keep.add(entries[-1].tag)
+    return [e for e in entries if e.tag in keep]

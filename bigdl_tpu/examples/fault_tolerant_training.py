@@ -1,0 +1,121 @@
+"""Fault-tolerant training demo: async checkpoints, preemption, resume.
+
+The ``bigdl_tpu.ckpt`` workflow end to end on synthetic data:
+
+1. ``--preempt-at K`` simulates a TPU eviction by SIGTERM-ing the process
+   from the input pipeline at batch K. The armed preemption hook
+   (``set_checkpoint(handle_preemption=True)``) turns that into a final
+   synchronous save marked ``preempted`` in ``MANIFEST.json``, and
+   ``optimize()`` returns cleanly instead of dying mid-step.
+2. Rerunning the SAME command resumes: ``auto_resume=True`` restores the
+   newest committed checkpoint before the first step and trains on to
+   ``--iters``. ``--corrupt`` truncates the newest blob first to show the
+   verified restore falling back to the previous good checkpoint.
+
+Reference: the driver retry window (``DistriOptimizer.scala:881-960``)
+recovers the same way, but from blocking unverified saves; here the saves
+are async (the step loop pays only a device->host snapshot) and each
+restore is checksum-verified.
+
+Run it twice to see both phases::
+
+    python -m bigdl_tpu.examples.fault_tolerant_training --preempt-at 6
+    python -m bigdl_tpu.examples.fault_tolerant_training
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import optim
+from bigdl_tpu.ckpt import load_manifest
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import TensorDataSet
+
+
+def _data(n=512, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(1, 8).astype(np.float32)
+    y = (x @ w.T > 0).astype(np.int32)[:, 0]
+    return x, y
+
+
+class _EvictingDataSet(TensorDataSet):
+    """Sends this process a real SIGTERM before batch N — the same signal
+    a TPU preemption notice delivers."""
+
+    def __init__(self, x, y, at):
+        super().__init__(x, y)
+        self.at = at
+        self.count = 0
+
+    def batches(self, batch_size, train, partial_batch=False):
+        for b in super().batches(batch_size, train, partial_batch):
+            self.count += 1
+            if self.at and self.count == self.at:
+                print(f"[demo] simulating preemption: SIGTERM at batch {self.count}")
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield b
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("fault-tolerant-training")
+    ap.add_argument("--workdir", default="/tmp/bigdl_tpu_ft_demo")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--preempt-at", type=int, default=0,
+                    help="SIGTERM self before batch N (0 = train to --iters)")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="truncate the newest blob before resuming, to "
+                         "demonstrate checksum-verified fallback")
+    args = ap.parse_args(argv)
+
+    if args.corrupt:
+        entries = load_manifest(args.workdir)
+        if entries:
+            blob = os.path.join(args.workdir, entries[-1].file)
+            with open(blob, "r+b") as fh:
+                fh.truncate(16)
+            print(f"[demo] truncated {entries[-1].tag} — restore must fall back")
+
+    x, y = _data()
+    if args.preempt_at:
+        ds = _EvictingDataSet(x, y, args.preempt_at)
+    else:
+        ds = DataSet.tensors(x, y) >> SampleToMiniBatch(args.batchSize)
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    opt = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                               batch_size=args.batchSize)
+    # keep the input pipeline on the training thread so the simulated
+    # eviction lands near the batch that triggers it (the feeder thread
+    # otherwise races several batches ahead on tiny data)
+    opt.host_prefetch_depth = 0
+    opt.set_optim_method(optim.SGD(learning_rate=0.5, momentum=0.9))
+    opt.set_end_when(optim.Trigger.max_iteration(args.iters))
+    opt.set_checkpoint(
+        args.workdir, optim.Trigger.several_iteration(args.save_every),
+        keep_last_n=3, keep_every_k_steps=10,
+        handle_preemption=True, auto_resume=True)
+
+    params, _ = opt.optimize()
+    opt.checkpoint_manager.close()
+
+    entries = load_manifest(args.workdir)
+    tail = [(e.tag, e.step, "preempted" if e.preempted else "committed")
+            for e in entries[-3:]]
+    print(f"[demo] stopped at iteration {opt.state.iteration}, "
+          f"loss {opt.state.loss:.4f}; manifest tail: {tail}")
+    return opt
+
+
+if __name__ == "__main__":
+    main()

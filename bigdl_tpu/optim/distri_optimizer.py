@@ -137,6 +137,17 @@ class DistriOptimizer(Optimizer):
         data_sharding, _ = self._shardings()
         return jax.jit(step, donate_argnums=(0, 1, 2)), data_sharding
 
+    def _should_write_checkpoint(self) -> bool:
+        """Single-writer rule: under ``jax.distributed`` every host runs
+        this driver loop, but only process 0 commits to the checkpoint
+        directory — N hosts racing the same ``MANIFEST.json`` would tear
+        the commit protocol. Within one host, ZeRO-1-sharded leaves are
+        reassembled by the snapshot's ``np.asarray`` (all shards are
+        addressable on a single-host mesh); truly multi-host sharded
+        checkpoints, where no single host holds every shard, are a
+        ROADMAP follow-up."""
+        return jax.process_index() == 0
+
     def _param_spec(self, leaf) -> P:
         """ZeRO-1-style spec: shard the largest divisible dim over dp,
         replicate otherwise. Applied to params and optimizer buffers (the

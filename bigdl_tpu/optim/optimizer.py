@@ -35,8 +35,8 @@ from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.module import Criterion, Module
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.ckpt import CheckpointManager
 from bigdl_tpu.optim.trigger import TrainingState, Trigger
-from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint, save_checkpoint
 
 log = logging.getLogger("bigdl_tpu.optim")
 
@@ -81,6 +81,12 @@ class Optimizer:
         self._data_sharding = None
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
+        self.checkpoint_manager: Optional[CheckpointManager] = None
+        self._auto_resume = False
+        # iteration of the last save OR restore: re-arms the checkpoint
+        # trigger across a resume so a restored run doesn't immediately
+        # re-save the step it just loaded
+        self._last_ckpt_iteration = -1
         self.train_summary = None
         self.val_summary = None
         self.grad_clip: Optional[Callable] = None
@@ -117,9 +123,35 @@ class Optimizer:
         self.val_batch_size = batch_size
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(
+        self,
+        path: str,
+        trigger: Trigger,
+        *,
+        async_save: bool = True,
+        keep_last_n: Optional[int] = None,
+        keep_every_k_steps: Optional[int] = None,
+        handle_preemption: bool = False,
+        auto_resume: bool = False,
+    ) -> "Optimizer":
+        """Checkpoint to ``path`` whenever ``trigger`` fires, through a
+        :class:`~bigdl_tpu.ckpt.CheckpointManager` (async verified commits;
+        ``async_save=False`` forces the legacy blocking behavior).
+        ``handle_preemption`` arms SIGTERM to commit a final checkpoint at
+        the next step boundary and stop cleanly; ``auto_resume`` makes
+        ``optimize()`` restore the newest committed checkpoint from
+        ``path`` before the first step, so a preempted-and-rescheduled job
+        continues where it stopped just by rerunning the same command."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self._auto_resume = auto_resume
+        if self.checkpoint_manager is not None:
+            self.checkpoint_manager.close()
+        self.checkpoint_manager = CheckpointManager(
+            path, async_save=async_save, keep_last_n=keep_last_n,
+            keep_every_k_steps=keep_every_k_steps)
+        if handle_preemption:
+            self.checkpoint_manager.install_preemption_hook()
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -262,6 +294,13 @@ class Optimizer:
         checkpoint / summaries, epoch accounting by records processed, and
         checkpoint-based retry on failure (:881-960).
         """
+        if self._auto_resume and self.checkpoint_manager is not None:
+            self._auto_resume = False  # once per optimizer, not per retry
+            # resume from manifest-committed entries or a legacy
+            # pre-manifest directory; when nothing is restorable — empty
+            # dir or every entry corrupt — reset_on_missing=False keeps
+            # any set_model_and_state warm-start params
+            self._restore_latest(reset_on_missing=False)
         retries = 0
         while True:
             try:
@@ -280,29 +319,43 @@ class Optimizer:
                     time.sleep(self.config.failure_retry_interval_sec)
                 self._restore_latest()
 
-    def _restore_latest(self):
-        ckpt = latest_checkpoint(self.checkpoint_path)
-        if ckpt is None:
-            self._params = None
-            self._optim_state = None
-            self._module_state = None
-            return
+    def _restore_latest(self, reset_on_missing: bool = True):
+        if self.checkpoint_manager is None:
+            self.checkpoint_manager = CheckpointManager(self.checkpoint_path)
         self._ensure_initialized()
-        payload, meta = load_checkpoint(
-            ckpt,
+        restored = self.checkpoint_manager.restore_latest(
             {
                 "params": self._params,
                 "module_state": self._module_state,
                 "optim_state": self._optim_state,
-            },
+            }
         )
+        if restored is None:
+            # nothing restorable. On the retry path, restart fresh (the
+            # reference's semantics); on auto-resume, reset_on_missing is
+            # False so warm-start params survive.
+            if reset_on_missing:
+                self._params = None
+                self._optim_state = None
+                self._module_state = None
+            self._last_ckpt_iteration = -1
+            return
+        payload, entry = restored
         self._params = payload["params"]
         self._module_state = payload["module_state"]
         self._optim_state = payload["optim_state"]
+        meta = entry.meta
         self.state = TrainingState(
             epoch=meta.get("epoch", 1),
-            iteration=meta.get("iteration", 0),
+            iteration=meta.get("iteration", entry.step),
             records_processed_this_epoch=meta.get("records", 0),
+        )
+        # re-arm: the trigger state now points at an already-saved step
+        self._last_ckpt_iteration = self.state.iteration
+        log.info(
+            "restored checkpoint '%s' (iteration %d, epoch %d%s)",
+            entry.tag, self.state.iteration, self.state.epoch,
+            ", from a preemption save" if entry.preempted else "",
         )
 
     def _train_batches(self):
@@ -367,6 +420,23 @@ class Optimizer:
                 self._run_validation()
             if self.checkpoint_trigger is not None and self.checkpoint_trigger(state):
                 self._save_checkpoint()
+            mgr = self.checkpoint_manager
+            if mgr is not None and mgr.preemption_requested:
+                # SIGTERM (TPU eviction) landed since the last boundary:
+                # commit NOW, synchronously, and stop — the process is
+                # about to die and an uncommitted async save would be lost
+                log.warning(
+                    "preemption requested: committing checkpoint at "
+                    "iteration %d and stopping", state.iteration)
+                if state.iteration == self._last_ckpt_iteration:
+                    # the trigger's save of this very step may be in
+                    # flight: drain it, then flip the marker with a
+                    # manifest-only rewrite (no blob re-commit)
+                    mgr.wait()
+                    mgr.mark_preempted(f"model.iter{state.iteration}")
+                else:
+                    self._save_checkpoint(preempted=True, blocking=True)
+                break
             if state.epoch_finished:
                 state.epoch += 1
                 state.records_processed_this_epoch = 0
@@ -374,6 +444,11 @@ class Optimizer:
                 if self.end_when(state):
                     break
                 state.epoch_finished = False
+        if self.checkpoint_manager is not None:
+            # drain in-flight async saves: once optimize() returns, every
+            # triggered checkpoint is committed (and write errors surface
+            # here rather than vanishing with the worker thread)
+            self.checkpoint_manager.wait()
         return self._params, self._module_state
 
     # ------------------------------------------------ validation ---------
@@ -413,9 +488,17 @@ class Optimizer:
         return results
 
     # ------------------------------------------------ checkpoint ---------
-    def _save_checkpoint(self):
-        save_checkpoint(
-            self.checkpoint_path,
+    def _should_write_checkpoint(self) -> bool:
+        """Single-process default: always write. DistriOptimizer narrows
+        this to one writer per job."""
+        return True
+
+    def _save_checkpoint(self, preempted: bool = False, blocking: bool = False):
+        if not self._should_write_checkpoint():
+            return
+        if self.state.iteration == self._last_ckpt_iteration and not preempted:
+            return  # this step is already on disk (e.g. just restored)
+        self.checkpoint_manager.save(
             f"model.iter{self.state.iteration}",
             self._params,
             self._module_state,
@@ -426,7 +509,11 @@ class Optimizer:
                 "records": self.state.records_processed_this_epoch,
                 "loss": self.state.loss,
             },
+            step=self.state.iteration,
+            blocking=blocking,
+            preempted=preempted,
         )
+        self._last_ckpt_iteration = self.state.iteration
 
 
 class LocalOptimizer(Optimizer):

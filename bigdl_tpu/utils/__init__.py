@@ -1,4 +1,10 @@
-from bigdl_tpu.utils.checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint
+from bigdl_tpu.utils.checkpoint import (
+    deserialize_payload,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+    serialize_payload,
+)
 from bigdl_tpu.utils.serializer import (
     SerializationError,
     load_module,

@@ -1,4 +1,4 @@
-"""Checkpoint save/load.
+"""Checkpoint FORMAT layer (serialization + legacy single-file API).
 
 Reference: ``Optimizer.setCheckpoint`` (``DL/optim/Optimizer.scala:198``),
 ``AbstractOptimizer.checkpoint`` (``AbstractOptimizer.scala:205``) saving
@@ -9,8 +9,16 @@ Reference: ``Optimizer.setCheckpoint`` (``DL/optim/Optimizer.scala:198``),
 TPU-native: a checkpoint is the (params, module-state, optim-state) pytree
 triple serialized with flax's msgpack (+ a JSON sidecar for host counters:
 epoch, iteration, records-processed — the reference's ``endEpoch``/
-``recordsProcessedThisEpoch`` state keys). Orbax-grade async/multi-host
-checkpointing can layer on later; this format is the stable core.
+``recordsProcessedThisEpoch`` state keys).
+
+This module is the stable FORMAT core: :func:`serialize_payload` /
+:func:`deserialize_payload` define the bytes, and the thin
+``save_checkpoint``/``load_checkpoint``/``latest_checkpoint`` trio remains
+as the legacy single-file API. Fault tolerance — async saves, verified
+atomic manifest commits, restore fallback, retention, preemption — lives
+one tier up in ``bigdl_tpu.ckpt.CheckpointManager``, which writes this
+same format (every ``CheckpointManager`` blob is loadable with
+:func:`load_checkpoint` and vice versa).
 """
 
 from __future__ import annotations
@@ -30,6 +38,31 @@ def _to_numpy(tree):
     return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
 
 
+def serialize_payload(params: Any, module_state: Any = None,
+                      optim_state: Any = None) -> bytes:
+    """The checkpoint wire format: the (params, module_state, optim_state)
+    triple as flax msgpack bytes. Device arrays are fetched to host here."""
+    return serialization.to_bytes({
+        "params": _to_numpy(params),
+        "module_state": _to_numpy(module_state or {}),
+        "optim_state": _to_numpy(optim_state or {}),
+    })
+
+
+def deserialize_payload(blob: bytes, template: Optional[Dict[str, Any]] = None):
+    """Inverse of :func:`serialize_payload`. With a ``template`` (pytrees
+    from a fresh ``init``), leaves come back with the correct tree
+    structure; without, raw nested dicts."""
+    target = None
+    if template is not None:
+        target = {
+            "params": template.get("params"),
+            "module_state": template.get("module_state") or {},
+            "optim_state": template.get("optim_state") or {},
+        }
+    return serialization.from_bytes(target, blob)
+
+
 def save_checkpoint(
     path: str,
     tag: str,
@@ -40,12 +73,7 @@ def save_checkpoint(
 ) -> str:
     """Write ``<path>/<tag>.ckpt`` (+ ``.meta.json``). Returns the file path."""
     os.makedirs(path, exist_ok=True)
-    payload = {
-        "params": _to_numpy(params),
-        "module_state": _to_numpy(module_state or {}),
-        "optim_state": _to_numpy(optim_state or {}),
-    }
-    blob = serialization.to_bytes(payload)
+    blob = serialize_payload(params, module_state, optim_state)
     f = os.path.join(path, f"{tag}.ckpt")
     tmp = f + ".tmp"
     with open(tmp, "wb") as fh:
@@ -64,14 +92,7 @@ def load_checkpoint(file: str, template: Optional[Dict[str, Any]] = None):
     without, returns raw nested dicts."""
     with open(file, "rb") as fh:
         blob = fh.read()
-    target = None
-    if template is not None:
-        target = {
-            "params": template.get("params"),
-            "module_state": template.get("module_state") or {},
-            "optim_state": template.get("optim_state") or {},
-        }
-    payload = serialization.from_bytes(target, blob)
+    payload = deserialize_payload(blob, template)
     meta_path = file[: -len(".ckpt")] + ".meta.json"
     meta = {}
     if os.path.exists(meta_path):
@@ -82,19 +103,36 @@ def load_checkpoint(file: str, template: Optional[Dict[str, Any]] = None):
 
 def latest_checkpoint(path: str, prefix: str = "") -> Optional[str]:
     """Newest ``*.ckpt`` by embedded iteration number then mtime
-    (reference: ``getLatestFile``)."""
+    (reference: ``getLatestFile``).
+
+    Hardened against the debris a crashed save leaves behind: staging
+    files (``*.tmp``) are never candidates, a blob whose ``.meta.json``
+    sidecar is missing is skipped (the legacy writer commits blob-then-
+    sidecar, so a sidecar-less blob is a torn save with unknowable
+    epoch/iteration counters), and a file vanishing mid-scan (concurrent
+    retention GC) is ignored rather than crashing the scan."""
     if not os.path.isdir(path):
         return None
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return None
     best: Tuple[int, float, Optional[str]] = (-1, -1.0, None)
-    for name in os.listdir(path):
-        if not name.endswith(".ckpt") or not name.startswith(prefix):
+    for name in names:
+        if (name.endswith(".tmp") or not name.endswith(".ckpt")
+                or not name.startswith(prefix)):
+            continue
+        full = os.path.join(path, name)
+        if not os.path.exists(full[: -len(".ckpt")] + ".meta.json"):
             continue
         m = re.search(r"(\d+)", name)
         it = int(m.group(1)) if m else 0
-        full = os.path.join(path, name)
-        key = (it, os.path.getmtime(full), full)
-        if (key[0], key[1]) > (best[0], best[1]):
-            best = key
+        try:
+            mtime = os.path.getmtime(full)
+        except OSError:
+            continue
+        if (it, mtime) > (best[0], best[1]):
+            best = (it, mtime, full)
     return best[2]
 
 
@@ -168,6 +206,11 @@ def save_checkpoint_async(
     """Non-blocking checkpoint write (the TPU-native answer to the
     reference's checkpoint stall: ``AbstractOptimizer.checkpoint``
     blocks the driver between iterations, ``AbstractOptimizer.scala:205``).
+
+    .. deprecated:: kept as the thin legacy shim only. New code should use
+       ``bigdl_tpu.ckpt.CheckpointManager``, which adds verified manifest
+       commits, in-flight guards, backpressure, retention GC, and
+       preemption handling on top of this same file format.
 
     jax arrays are immutable, so the live (params, state) pytrees are
     snapshotted by reference for free — the device->host transfer and the

@@ -177,3 +177,22 @@ def test_parallel_training_example_runs():
     from bigdl_tpu.examples import parallel_training
 
     assert parallel_training.main(["--steps", "2"]) == 0
+
+
+def test_fault_tolerant_training_example_preempt_then_resume(tmp_path):
+    """The ckpt demo: a simulated eviction commits a preempted manifest
+    entry; rerunning the same command auto-resumes past it to --iters."""
+    from bigdl_tpu.ckpt import load_manifest
+    from bigdl_tpu.examples import fault_tolerant_training
+
+    wd = str(tmp_path / "ft")
+    opt = fault_tolerant_training.main(
+        ["--workdir", wd, "--iters", "20", "--preempt-at", "6"])
+    stopped_at = opt.state.iteration
+    assert stopped_at < 20
+    entries = load_manifest(wd)
+    assert entries[-1].preempted and entries[-1].step == stopped_at
+
+    opt2 = fault_tolerant_training.main(["--workdir", wd, "--iters", "20"])
+    assert opt2.state.iteration >= 20
+    assert load_manifest(wd)[-1].step >= 20

@@ -96,5 +96,14 @@ def test_two_process_distributed_psum(tmp_path):
         if any(k in joined for k in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
                                      "Address already in use")):
             pytest.skip(f"distributed runtime unavailable: {joined[-400:]}")
+        if "Multiprocess computations aren't implemented on the CPU backend" in joined:
+            # environment limitation, not a regression: this jaxlib build's
+            # CPU backend has no cross-process collective support, so the
+            # two-process proof cannot run here at all (it does on any
+            # TPU/GPU backend and on jaxlib CPU builds with Gloo)
+            pytest.skip(
+                "distributed runtime unavailable on this jaxlib: "
+                "INVALID_ARGUMENT: Multiprocess computations aren't "
+                "implemented on the CPU backend.")
         raise AssertionError(joined)
     assert all("OK" in o for o in outs), outs
