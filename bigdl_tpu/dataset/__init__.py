@@ -13,5 +13,10 @@ from bigdl_tpu.dataset.dataset import (
     TransformedDataSet,
     DataSet,
 )
-from bigdl_tpu.dataset.prefetch import device_prefetch, device_put_batch
+from bigdl_tpu.dataset.parallel_pipeline import (
+    ParallelTransformer,
+    PipelineStats,
+    parallelize_chain,
+)
+from bigdl_tpu.dataset.prefetch import device_prefetch, device_put_batch, host_prefetch
 from bigdl_tpu.dataset import image, datasets

@@ -158,6 +158,18 @@ class TransformedDataSet(AbstractDataSet):
     def data(self, train: bool) -> Iterator[Any]:
         return self.transformer.apply(self.base.data(train))
 
+    def parallel(self, n_workers: int, **kwargs) -> "TransformedDataSet":
+        """Fan this dataset's elementwise transformer run across a worker
+        pool (see :func:`bigdl_tpu.dataset.parallel_pipeline
+        .parallelize_chain`); batching/shuffle stages stay serial.
+        ``Optimizer.set_data_pipeline`` does the same wiring with the
+        optimizer's seed and stats."""
+        from bigdl_tpu.dataset.parallel_pipeline import parallelize_chain
+
+        return TransformedDataSet(
+            self.base, parallelize_chain(self.transformer, n_workers,
+                                         **kwargs))
+
 
 class DataSet:
     """Factory namespace (reference: object ``DataSet`` at

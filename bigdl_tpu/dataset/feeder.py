@@ -193,6 +193,13 @@ class SocketFeedDataSet(AbstractDataSet):
                 self._open_producers -= 1
                 done = (self._open_producers == 0
                         and self._connected == self.n_producers)
+                if error is not None and self._failed is None:
+                    # sticky: once any producer died mid-stream, every
+                    # future epoch must fail fast — re-entering batches()
+                    # after the error marker drained must not let the
+                    # healthy producers' remainder pass for a clean
+                    # end-of-stream (truncated data as EOF)
+                    self._failed = error
             if error is not None:
                 self._queue.put(_StreamError(error))
             elif done:

@@ -129,6 +129,8 @@ class MTImageToBatch(Transformer):
     threaded over the batch). Python fallback built in (see
     ``native.batch_hwc_to_nchw``)."""
 
+    elementwise = False  # N:1 batch assembly — stays outside a worker pool
+
     def __init__(self, batch_size: int, means, stds, scale: float = 1.0,
                  n_threads: int = 4, partial_batch: bool = False):
         self.batch_size = batch_size

@@ -7,16 +7,25 @@ host batch preparation with device compute. ``device_prefetch`` keeps
 optionally sharding the batch over a mesh's dp axis (replacing the
 reference's per-partition locality pinning,
 ``ZippedPartitionsWithLocalityRDD.scala:28``).
+
+Both stages feed the per-stage observability layer
+(:class:`~bigdl_tpu.dataset.parallel_pipeline.PipelineStats`) when given
+``stats=``: items/bytes per stage, producer stall and consumer starve
+time, and queue occupancy — the counters ``bench.py --mode pipeline``
+turns into per-stage img/s.
 """
 
 from __future__ import annotations
 
-import collections
-import itertools
+import threading
+import time
 from typing import Iterator, Optional
 
 import jax
 
+from bigdl_tpu.dataset.parallel_pipeline import (
+    Closed, CloseableQueue, PipelineStats, nbytes_of,
+)
 from bigdl_tpu.dataset.sample import MiniBatch
 
 
@@ -33,75 +42,120 @@ def device_prefetch(
     sharding=None,
     buffer_size: int = 2,
     host_depth: int = 0,
+    stats: Optional[PipelineStats] = None,
 ):
     """Yield (input, target) device trees, keeping a small pipeline of
     transfers in flight ahead of compute. ``host_depth > 0`` additionally
     runs the host pipeline in a background thread (see
-    :func:`host_prefetch`) so decode/augment overlaps device compute."""
+    :func:`host_prefetch`) so decode/augment overlaps device compute.
+    ``buffer_size <= 0`` falls back to unbuffered transfer-per-batch
+    iteration (no in-flight pipeline; every batch still flows — a
+    non-positive buffer must never silently drop the stream)."""
     if host_depth > 0:
-        batches = host_prefetch(batches, host_depth)
-    queue = collections.deque()
+        batches = host_prefetch(batches, host_depth, stats=stats)
+    st = stats.stage("transfer") if stats is not None else None
     batches = iter(batches)
-    for batch in itertools.islice(batches, buffer_size):
-        queue.append(device_put_batch(batch, sharding))
-    while queue:
-        out = queue.popleft()
+
+    def put_tracked(batch):
+        if st is not None:
+            st.record(batch.size() if hasattr(batch, "size") else 1,
+                      nbytes_of(batch))
+        return device_put_batch(batch, sharding)
+
+    def pull():
+        """next() with the wait attributed as this stage starving."""
+        if st is None:
+            return next(batches, None)
+        t0 = time.perf_counter()
         nxt = next(batches, None)
+        st.record_starve(time.perf_counter() - t0)
+        return nxt
+
+    if buffer_size <= 0:
+        while True:
+            nxt = pull()
+            if nxt is None:
+                return
+            yield put_tracked(nxt)
+        return
+
+    queue = []
+    while len(queue) < buffer_size:
+        nxt = pull()
+        if nxt is None:
+            break
+        queue.append(put_tracked(nxt))
+    while queue:
+        out = queue.pop(0)
+        nxt = pull()
         if nxt is not None:
-            queue.append(device_put_batch(nxt, sharding))
+            queue.append(put_tracked(nxt))
         yield out
 
 
-def host_prefetch(items: Iterator, depth: int = 4) -> Iterator:
+def host_prefetch(
+    items: Iterator,
+    depth: int = 4,
+    stats: Optional[PipelineStats] = None,
+    stage: str = "stage",
+) -> Iterator:
     """Run the producing iterator in a background thread, buffering up to
     ``depth`` ready items (the host-side staging stage between the input
     pipeline and device infeed — reference analogue: the ThreadPool-driven
     ``MTLabeledBGRImgToBatch`` batcher).
 
     Items (MiniBatches / arrays) cross threads by reference through a
-    bounded ``queue.Queue`` — no serialization. (Byte-record streams have
-    their own native-ring staging in ``TFRecordPrefetcher``.) The producer
+    bounded :class:`CloseableQueue` — no serialization, and no poll loops:
+    a producer blocked on a full queue sleeps on a condition that consumer
+    gets and shutdown both notify, so an idle prefetch thread costs zero
+    wakeups (the old implementation burned one every 50 ms). The producer
     thread shuts down promptly when the consumer abandons the generator
-    (the normal way training loops exit an infinite batch stream).
+    (the normal way training loops exit an infinite batch stream), and a
+    producer exception fails the consumer after the buffered items drain.
     """
-    import queue as _queue
-    import threading
-
-    q: _queue.Queue = _queue.Queue(maxsize=depth)
-    _SENTINEL = object()
-    stop = threading.Event()
+    q = CloseableQueue(depth)
+    st = stats.stage(stage) if stats is not None else None
     err: list = []
 
     def produce():
         try:
             for item in items:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.05)
-                        break
-                    except _queue.Full:
-                        continue
-                if stop.is_set():
-                    return
+                stalled = q.put(item)
+                if st is not None:
+                    st.record_stall(stalled)
+        except Closed:
+            pass  # consumer walked away; queue already aborted
         except BaseException as e:  # surface pipeline errors to the consumer
             err.append(e)
         finally:
-            while not stop.is_set():
+            q.close()  # graceful: consumer drains buffered items, then ends
+            # retire the upstream pipeline deterministically (a parallel
+            # worker pool upstream shuts its workers/processes down in
+            # its generator finally — don't leave that to GC racing
+            # interpreter exit)
+            close = getattr(items, "close", None)
+            if close is not None:
                 try:
-                    q.put(_SENTINEL, timeout=0.05)
-                    break
-                except _queue.Full:
-                    continue
+                    close()
+                except BaseException:
+                    pass
 
-    t = threading.Thread(target=produce, daemon=True)
+    t = threading.Thread(target=produce, name="host-prefetch", daemon=True)
     t.start()
     try:
         while True:
-            item = q.get()
-            if item is _SENTINEL:
+            try:
+                item, starved = q.get()
+            except Closed:
                 if err:
                     raise err[0]
                 return
+            if st is not None:
+                st.record_starve(starved)
+                st.record_queue(q.qsize(), q.maxsize)
+                st.record(1, nbytes_of(item))
             yield item
     finally:
-        stop.set()  # unblock and retire the producer on early exit
+        q.abort()  # unblock and retire the producer on early exit
+        t.join(timeout=10)  # bounded: upstream teardown completes before
+        # the training loop returns (worker pools terminate/drain here)

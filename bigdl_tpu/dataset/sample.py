@@ -162,6 +162,8 @@ class SampleToSparseMiniBatch:
     """Transformer: group sparse-feature samples into SparseMiniBatches
     (reference pairs ``SparseMiniBatch`` with ``SampleToMiniBatch``)."""
 
+    elementwise = False  # N:1 grouping — stays outside a worker pool
+
     def __init__(self, batch_size: int, max_nnz: Optional[int] = None,
                  partial_batch: bool = False):
         self.batch_size = batch_size

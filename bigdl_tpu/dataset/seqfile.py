@@ -224,6 +224,9 @@ class BGRImgToLocalSeqFile(Transformer):
     ``BGRImgToLocalSeqFile.scala``: value = 4-byte BE width + height +
     raw bytes; key = "name\\nlabel" when ``has_name``)."""
 
+    elementwise = False  # N:1 block grouping + on-disk writer state —
+    # pooled copies would all write {base}_0.seq concurrently
+
     def __init__(self, block_size: int, base_file_name: str,
                  has_name: bool = False):
         self.block_size = block_size

@@ -22,6 +22,12 @@ from bigdl_tpu.dataset.sample import MiniBatch, PaddingParam, Sample
 
 
 class Transformer:
+    #: True when the transformer maps each element independently (1 in ->
+    #: 0..k out, no cross-element state) — the worker pool
+    #: (``parallel_pipeline``) may fan such stages across workers.
+    #: Stream-stateful stages (batching, shuffling) set this False.
+    elementwise = True
+
     def apply(self, it: Iterator[Any]) -> Iterator[Any]:
         raise NotImplementedError
 
@@ -31,10 +37,28 @@ class Transformer:
     def __rshift__(self, other: "Transformer") -> "Transformer":
         return ChainedTransformer(self, other)
 
+    def parallel(self, n_workers: int, **kwargs) -> "Transformer":
+        """Run this (elementwise) transformer on a pool of ``n_workers``
+        workers — see :class:`bigdl_tpu.dataset.parallel_pipeline
+        .ParallelTransformer` (``ordered=``, ``processes=``, ``depth=``,
+        ``chunk=``, ``base_seed=``, ``stats=``). Any ``>>`` chain opts in
+        with one call::
+
+            pipeline = (aug >> flip).parallel(8) >> SampleToMiniBatch(128)
+        """
+        from bigdl_tpu.dataset.parallel_pipeline import ParallelTransformer
+
+        return ParallelTransformer(self, n_workers, **kwargs)
+
 
 class ChainedTransformer(Transformer):
     def __init__(self, first: Transformer, second: Transformer):
         self.first, self.second = first, second
+
+    @property
+    def elementwise(self):  # a chain is elementwise iff all its links are
+        return (getattr(self.first, "elementwise", True)
+                and getattr(self.second, "elementwise", True))
 
     def apply(self, it):
         return self.second.apply(self.first.apply(it))
@@ -54,6 +78,8 @@ class SampleToMiniBatch(Transformer):
     """Group samples into MiniBatches (reference: ``SampleToMiniBatch``,
     ``Transformer.scala:309``). ``partial_batch``: emit the trailing
     incomplete batch (the reference drops it in training)."""
+
+    elementwise = False  # N:1 grouping — must stay outside a worker pool
 
     def __init__(
         self,
@@ -80,6 +106,8 @@ class SampleToMiniBatch(Transformer):
 
 class Shuffle(Transformer):
     """Full-buffer shuffle (reference: ``CachedDistriDataSet.shuffle``)."""
+
+    elementwise = False  # whole-stream state
 
     def __init__(self, rng: Optional[RandomGenerator] = None):
         self.rng = rng or RandomGenerator.default()

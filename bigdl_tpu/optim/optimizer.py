@@ -97,6 +97,13 @@ class Optimizer:
         self._optim_state = None
         # background host-pipeline depth (0 disables the feeder thread)
         self.host_prefetch_depth = 2
+        # parallel input pipeline (0 workers = serial transformer chain)
+        self.pipeline_n_workers = 0
+        self.pipeline_depth = 2
+        self.pipeline_ordered = True
+        self.pipeline_processes = False
+        self.pipeline_chunk = 1
+        self.pipeline_stats = None
         self._rng = jax.random.key(self.config.seed)
 
     # ------------------------------------------------ builder setters ----
@@ -152,6 +159,39 @@ class Optimizer:
             keep_every_k_steps=keep_every_k_steps)
         if handle_preemption:
             self.checkpoint_manager.install_preemption_hook()
+        return self
+
+    def set_data_pipeline(
+        self,
+        n_workers: int = 0,
+        *,
+        depth: int = 2,
+        ordered: bool = True,
+        processes: bool = False,
+        chunk: int = 1,
+        host_depth: Optional[int] = None,
+        stats=None,
+    ) -> "Optimizer":
+        """Configure the parallel host input pipeline (reference analogue:
+        ``MTLabeledBGRImgToBatch``'s thread pool). With ``n_workers > 0``
+        and a transformed dataset, the elementwise run of the transformer
+        chain fans out across a worker pool
+        (:mod:`bigdl_tpu.dataset.parallel_pipeline`) with deterministic
+        per-element augmentation seeds; batching/shuffle stages stay
+        serial. ``host_depth`` overrides the staging-thread buffer.
+        Per-stage counters land in ``self.pipeline_stats`` (a
+        :class:`~bigdl_tpu.dataset.parallel_pipeline.PipelineStats`) and
+        are folded into the step metrics each log interval."""
+        from bigdl_tpu.dataset.parallel_pipeline import PipelineStats
+
+        self.pipeline_n_workers = int(n_workers)
+        self.pipeline_depth = depth
+        self.pipeline_ordered = ordered
+        self.pipeline_processes = processes
+        self.pipeline_chunk = chunk
+        if host_depth is not None:
+            self.host_prefetch_depth = host_depth
+        self.pipeline_stats = stats or PipelineStats()
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -361,11 +401,29 @@ class Optimizer:
     def _train_batches(self):
         """Training MiniBatch stream. Array-backed datasets take the
         sliced fast path (one fancy-index gather per batch); datasets
-        already composed with ``>> SampleToMiniBatch`` stream as built."""
-        from bigdl_tpu.dataset.dataset import TensorDataSet
+        already composed with ``>> SampleToMiniBatch`` stream as built.
+        With ``set_data_pipeline(n_workers>0)`` and a transformed dataset,
+        the elementwise run of the chain fans out across the worker
+        pool."""
+        from bigdl_tpu.dataset.dataset import TensorDataSet, TransformedDataSet
 
         if isinstance(self.dataset, TensorDataSet):
             return self.dataset.batches(self.batch_size, train=True)
+        if (self.pipeline_n_workers > 0
+                and isinstance(self.dataset, TransformedDataSet)):
+            from bigdl_tpu.dataset.parallel_pipeline import parallelize_chain
+
+            chain = parallelize_chain(
+                self.dataset.transformer,
+                self.pipeline_n_workers,
+                depth=self.pipeline_depth,
+                ordered=self.pipeline_ordered,
+                processes=self.pipeline_processes,
+                chunk=self.pipeline_chunk,
+                base_seed=self.config.seed,
+                stats=self.pipeline_stats,
+            )
+            return chain.apply(self.dataset.base.data(train=True))
         return self.dataset.data(train=True)
 
     def _optimize_impl(self):
@@ -378,7 +436,8 @@ class Optimizer:
         state = self.state
 
         for x, y in device_prefetch(batches, data_sharding,
-                                    host_depth=self.host_prefetch_depth):
+                                    host_depth=self.host_prefetch_depth,
+                                    stats=self.pipeline_stats):
             if self.end_when(state):
                 break
             t0 = time.time()
@@ -407,6 +466,21 @@ class Optimizer:
                     "Epoch %d iteration %d: loss %.6f, lr %.5g. Throughput is %.1f records/second.",
                     state.epoch, state.iteration, loss, lr, bsz / max(dt, 1e-9),
                 )
+                if self.pipeline_stats is not None:
+                    # per-stage input-pipeline gauges next to the step
+                    # metrics: a starving transfer stage or a stalling
+                    # augment pool shows up here, not in a profiler run
+                    for sname, s in self.pipeline_stats.snapshot().items():
+                        self.metrics.set(
+                            f"pipeline {sname} items/s", s["items_per_sec"])
+                        self.metrics.set(
+                            f"pipeline {sname} stall s", s["stall_s"])
+                        self.metrics.set(
+                            f"pipeline {sname} starve s", s["starve_s"])
+                        if s["queue_cap"]:
+                            self.metrics.set(
+                                f"pipeline {sname} queue occupancy",
+                                s["queue_mean"] / s["queue_cap"])
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss, state.iteration)
                 self.train_summary.add_scalar("Throughput", bsz / max(dt, 1e-9), state.iteration)

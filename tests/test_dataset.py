@@ -129,6 +129,57 @@ def test_tensor_dataset_sliced_batches_fast_path():
             assert y[j] == lab
 
 
+def test_device_prefetch_nonpositive_buffer_falls_back_to_unbuffered():
+    """Regression (ISSUE 4 satellite): buffer_size<=0 used to seed an
+    empty deque whose `while queue` loop never started — every batch was
+    silently dropped. It must fall back to unbuffered iteration."""
+    ds = DataSet.tensors(
+        np.random.RandomState(0).rand(32, 4).astype(np.float32), np.arange(32) % 3
+    )
+    for buffer_size in (0, -1):
+        batches = SampleToMiniBatch(8).apply(ds.data(train=False))
+        out = list(device_prefetch(batches, buffer_size=buffer_size))
+        assert len(out) == 4, f"buffer_size={buffer_size} dropped batches"
+        x, y = out[0]
+        assert x.shape == (8, 4) and y.shape == (8,)
+
+
+def test_host_prefetch_blocked_producer_wakes_on_abandon():
+    """The producer blocked on a FULL queue must be woken by the
+    consumer walking away (condition notify, not a poll tick)."""
+    import threading
+    import time as _time
+
+    from bigdl_tpu.dataset.prefetch import host_prefetch
+
+    before = threading.active_count()
+    # depth 1 and a fast producer: it will sit blocked in put()
+    gen = host_prefetch(iter(np.zeros((100, 2))), depth=1)
+    next(gen)
+    _time.sleep(0.1)  # producer now blocked on the full queue
+    t0 = _time.monotonic()
+    gen.close()
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline and threading.active_count() > before:
+        _time.sleep(0.02)
+    assert threading.active_count() <= before
+    assert _time.monotonic() - t0 < 2.0
+
+
+def test_host_prefetch_records_stats():
+    from bigdl_tpu.dataset import PipelineStats
+    from bigdl_tpu.dataset.prefetch import host_prefetch
+
+    stats = PipelineStats()
+    items = [np.full((4,), i, np.float32) for i in range(10)]
+    out = list(host_prefetch(iter(items), depth=3, stats=stats))
+    assert len(out) == 10
+    snap = stats.snapshot()["stage"]
+    assert snap["items"] == 10
+    assert snap["mb"] == pytest.approx(10 * 16 / 1e6)
+    assert snap["queue_cap"] == 3
+
+
 def test_host_prefetch_thread_and_errors():
     from bigdl_tpu.dataset.prefetch import host_prefetch
 

@@ -2,6 +2,7 @@
 north-star shim; see ``dataset/feeder.py`` docstring)."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -83,6 +84,103 @@ def test_socket_feed_trains_local_optimizer():
     ds.close()
     w = np.asarray(params["weight"]).T  # Linear stores (out, in)
     np.testing.assert_allclose(w, w_true, atol=0.1)
+
+
+def test_bound_address_resolves_port_zero():
+    """Port 0 in the bind address must resolve to the real assigned port
+    via bound_address (what drivers hand to remote producers)."""
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=1)
+    host, port = ds.bound_address
+    assert host == "127.0.0.1"
+    assert port != 0
+    # the resolved address really accepts a connection
+    with BatchFeedClient((host, port)) as c:
+        c.push(np.zeros((2, 2), np.float32))
+    got = list(ds.batches(0, train=False))
+    assert len(got) == 1
+    ds.close()
+
+
+def test_many_producers_interleaving_frames():
+    """N>2 producers pushing concurrently (barrier-released so their
+    frames genuinely interleave on the accept/reader paths): every batch
+    arrives intact, end-of-stream only after ALL producers finish."""
+    n_producers, per = 4, 8
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=n_producers,
+                           depth=4)
+    addr = ds.bound_address
+    barrier = threading.Barrier(n_producers)
+
+    def produce(p):
+        barrier.wait()  # connect + stream all at once
+        with BatchFeedClient(addr) as c:
+            for i in range(per):
+                c.push(np.full((2, 3), p * 100 + i, np.float32),
+                       np.asarray([p, i], np.int32))
+
+    threads = [threading.Thread(target=produce, args=(p,), daemon=True)
+               for p in range(n_producers)]
+    for t in threads:
+        t.start()
+    got = list(ds.batches(0, train=False))
+    for t in threads:
+        t.join()
+    ds.close()
+    assert len(got) == n_producers * per
+    seen = set()
+    for mb in got:
+        p, i = (int(v) for v in mb.get_target())
+        np.testing.assert_array_equal(
+            mb.get_input(), np.full((2, 3), p * 100 + i, np.float32))
+        seen.add((p, i))
+    assert seen == {(p, i) for p in range(n_producers) for i in range(per)}
+
+
+def test_one_producer_fails_while_others_continue():
+    """One producer dying mid-frame among N healthy ones must fail the
+    consumer with the sticky IOError — a truncated stream must never
+    pass for a clean end even while other producers keep pushing."""
+    import socket
+    import struct
+
+    from bigdl_tpu.dataset.feeder import _MAGIC
+
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=3)
+    addr = ds.bound_address
+    healthy_started = threading.Event()
+
+    def healthy(p):
+        with BatchFeedClient(addr) as c:
+            c.push(np.full((2, 2), p, np.float32))
+            healthy_started.set()
+            time.sleep(0.2)  # keep the connection open past the failure
+            c.push(np.full((2, 2), p + 10, np.float32))
+
+    def bad():
+        healthy_started.wait(5)
+        s = socket.socket()
+        s.connect(addr)
+        s.sendall(_MAGIC)
+        s.sendall(struct.pack(">I", 1))    # promises one array...
+        s.sendall(struct.pack(">Q", 999))  # ...header...
+        s.close()                          # ...dies mid-frame
+
+    threads = [threading.Thread(target=healthy, args=(p,), daemon=True)
+               for p in range(2)] + [threading.Thread(target=bad,
+                                                      daemon=True)]
+    for t in threads:
+        t.start()
+    # first raise: the sticky-flag path or the in-stream marker,
+    # whichever the consumer hits first
+    with pytest.raises(IOError, match="failed"):
+        list(ds.batches(0, train=False))
+    # sticky: re-entering batches() keeps failing fast instead of
+    # serving the healthy producers' remainder as a clean stream
+    with pytest.raises(IOError, match="failed"):
+        list(ds.batches(0, train=False))
+    for t in threads:
+        t.join()
+    ds.close()
 
 
 def test_producer_death_mid_frame_raises():
