@@ -1,0 +1,133 @@
+"""Continuous-batching demo: staggered generation clients against a
+GenerationEngine, routed by model name, vs the static baseline.
+
+N client threads submit mixed-length prompts with mixed generation
+targets through a :class:`~bigdl_tpu.serving.ModelRouter` front door; the
+:class:`~bigdl_tpu.serving.GenerationEngine` behind it admits each prompt
+into a free KV slot BETWEEN decode steps and retires finished sequences
+mid-flight, so short requests never wait for long ones. The run ends with
+the token-level metrics table (TTFT, tokens/sec, slot occupancy) and a
+head-to-head against run-to-completion static batching over the same
+jitted kernels — the scheduling win shows even on one CPU core.
+
+Run: ``python -m bigdl_tpu.examples.continuous_batching_demo -n 24``
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+import jax
+import numpy as np
+
+
+def build_lm(vocab_size: int = 128):
+    from bigdl_tpu.nn.layers.attention import Transformer
+
+    # large enough that the jitted step dwarfs host bookkeeping — with a
+    # toy model the scheduler's Python overhead would drown the win
+    return Transformer(vocab_size=vocab_size, hidden_size=160, num_heads=4,
+                       filter_size=320, num_hidden_layers=2)
+
+
+def main(argv=None):
+    from bigdl_tpu.serving import (
+        DecodeKernels, GenerationEngine, ModelRouter, Overloaded,
+        static_generate,
+    )
+
+    ap = argparse.ArgumentParser("continuous-batching-demo")
+    ap.add_argument("-n", "--requests", type=int, default=24,
+                    help="total generation requests")
+    ap.add_argument("-c", "--concurrency", type=int, default=6,
+                    help="client threads")
+    ap.add_argument("-s", "--slots", type=int, default=4,
+                    help="engine slot-table size")
+    ap.add_argument("--max-len", type=int, default=96,
+                    help="KV cache length (prompt + generation)")
+    ap.add_argument("--short", type=int, default=4,
+                    help="short requests' max_new_tokens")
+    ap.add_argument("--long", type=int, default=48,
+                    help="long requests' max_new_tokens")
+    args = ap.parse_args(argv)
+
+    vocab = 128
+    model = build_lm(vocab)
+    params, _ = model.init(jax.random.key(0))
+    kernels = DecodeKernels(model)
+
+    rs = np.random.RandomState(0)
+    requests = []
+    for i in range(args.requests):
+        plen = int(rs.randint(2, 13))
+        prompt = rs.randint(1, vocab, (plen,)).tolist()
+        requests.append((prompt, args.short if i % 2 == 0 else args.long))
+
+    engine = GenerationEngine(
+        model, params, max_slots=args.slots, max_len=args.max_len,
+        max_prompt_len=16, max_queue=max(64, 2 * args.requests),
+        kernels=kernels)
+    engine.warmup()  # compile decode + every prompt bucket before traffic
+
+    router = ModelRouter()
+    router.register("lm", engine)
+
+    outs = [None] * args.requests
+    rejected = [0] * args.concurrency
+
+    def client(cid: int) -> None:
+        time.sleep(0.002 * cid)  # clients come up out of phase: the
+        # engine demonstrably admits latecomers into a RUNNING loop
+        # stride partition: exactly `requests` total across all clients;
+        # submit the whole stride first (streams are futures — the engine
+        # packs them into slots as they free up), then consume each
+        streams = {}
+        for i in range(cid, args.requests, args.concurrency):
+            prompt, mnt = requests[i]
+            try:
+                streams[i] = router.submit("lm", prompt, max_new_tokens=mnt)
+            except Overloaded:
+                rejected[cid] += 1
+        for i, stream in streams.items():
+            outs[i] = [tok for tok in stream]  # tokens arrive per step
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cont_wall = time.monotonic() - t0
+    snap = engine.metrics.snapshot()
+    print(engine.metrics.format_table())
+    router.close()
+
+    served = [o for o in outs if o is not None]
+    cont_tokens = sum(len(o) for o in served)
+
+    t0 = time.monotonic()
+    souts, static_steps = static_generate(
+        model, params, requests, max_slots=args.slots, max_len=args.max_len,
+        kernels=kernels, prompt_buckets=engine.prompt_buckets)
+    static_wall = time.monotonic() - t0
+    static_tokens = sum(len(o) for o in souts)
+
+    cont_tps = cont_tokens / cont_wall
+    static_tps = static_tokens / static_wall
+    print(f"continuous: {cont_tokens} tokens in {cont_wall * 1e3:.0f} ms "
+          f"({cont_tps:.0f} tok/s, {snap['decode_steps']} decode steps, "
+          f"occupancy {snap['slot_occupancy'] * 100:.0f}%)")
+    print(f"static    : {static_tokens} tokens in {static_wall * 1e3:.0f} ms "
+          f"({static_tps:.0f} tok/s, {static_steps} decode steps)")
+    print(f"continuous batching = {cont_tps / static_tps:.2f}x static "
+          f"run-to-completion")
+    snap["continuous_vs_static"] = cont_tps / static_tps
+    snap["rejected_clients"] = sum(rejected)
+    return snap
+
+
+if __name__ == "__main__":
+    main()

@@ -95,16 +95,33 @@ class Attention(Module):
         if cache is not None:
             ck, cv = cache
             idx = cache_index if cache_index is not None else 0
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, idx, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, idx, 0))
+            if getattr(idx, "ndim", 0) == 1:
+                # per-row write offsets (continuous batching: every slot in
+                # the batch sits at its own decode position)
+                def upd(c, kv, i):
+                    return jax.lax.dynamic_update_slice(c, kv, (0, i, 0))
+
+                ck = jax.vmap(upd)(ck, k.astype(ck.dtype), idx)
+                cv = jax.vmap(upd)(cv, v.astype(cv.dtype), idx)
+                rows = idx[:, None] + jnp.arange(q.shape[2])[None, :]
+                cols = jnp.arange(ck.shape[2])
+                validity = jnp.where(
+                    cols[None, None, :] <= rows[:, :, None], 0.0, -1e9,
+                )[:, None, :, :]
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, 0, idx, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, 0, idx, 0))
+                # a cache implies decode: mask both future in-chunk positions
+                # and unwritten cache slots — key col j is valid for local
+                # query row i iff j <= idx + i (never rely on the caller's
+                # bias for this)
+                rows = idx + jnp.arange(q.shape[2])[:, None]
+                cols = jnp.arange(ck.shape[2])[None, :]
+                validity = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
             k, v = ck, cv
             new_cache = (ck, cv)
-            # a cache implies decode: mask both future in-chunk positions and
-            # unwritten cache slots — key col j is valid for local query row i
-            # iff j <= idx + i (never rely on the caller's bias for this)
-            rows = idx + jnp.arange(q.shape[2])[:, None]
-            cols = jnp.arange(ck.shape[2])[None, :]
-            validity = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
             bias = validity if bias is None else bias + validity
 
         drop = self.attention_dropout if ctx.training else 0.0
@@ -225,6 +242,8 @@ class Transformer(Module):
             raise ValueError(transformer_type)
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.num_hidden_layers = num_hidden_layers
         self.padding_value = padding_value
         self.embedding_dropout = embedding_dropout
         self.transformer_type = transformer_type
@@ -268,6 +287,73 @@ class Transformer(Module):
 
     def _padding_bias(self, ids):
         return attention_bias_from_padding((ids == self.padding_value))
+
+    # ---------------------------------------------- incremental decoding ----
+    # The serving tier's step API (bigdl_tpu/serving/engine.py): a slot-table
+    # KV cache of FIXED shapes so one jitted decode step serves every
+    # admission/retirement pattern without recompiling. All three methods are
+    # pure functions of (params, cache, ...) — jit/donate them freely.
+
+    def _decoder_names(self):
+        return [n for n in self._modules if n.startswith("decoder_")]
+
+    def init_cache(self, max_slots: int, max_len: int, dtype=jnp.float32):
+        """Zeroed per-layer KV slot table:
+        ``{layer: (K, V)}`` with K/V of shape
+        ``(max_slots, num_heads, max_len, head_dim)``. Slot contents are
+        only ever read through the causal/position mask, so a freed slot's
+        stale keys are invisible until a prefill overwrites them."""
+        if self.transformer_type != LANGUAGE_MODEL:
+            raise ValueError("incremental decoding needs a language_model "
+                             "transformer (decoder-only)")
+        head_dim = self.hidden_size // self.num_heads
+        shape = (max_slots, self.num_heads, max_len, head_dim)
+        return {name: (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for name in self._decoder_names()}
+
+    def prefill(self, params, cache, slot, tokens, length):
+        """Run one PADDED prompt ``tokens`` (P,) through the decoder,
+        writing its keys/values into rows 0..P-1 of ``slot``'s cache lane;
+        returns ``(next-token logits (vocab,), new_cache)`` where the logits
+        are read at position ``length - 1`` (the last REAL token — pad
+        garbage beyond it is causally masked now and overwritten by later
+        decode steps before it could ever be attended)."""
+        ctx = Context(params, {}, False, None)
+        h = self._embed(ctx, tokens[None])
+        new_cache = dict(cache)
+        for name in self._decoder_names():
+            ck, cv = cache[name]
+            lane = (jax.lax.dynamic_slice_in_dim(ck, slot, 1, axis=0),
+                    jax.lax.dynamic_slice_in_dim(cv, slot, 1, axis=0))
+            h, (nk, nv) = self._modules[name].forward(
+                ctx.child(name), h, cache=lane, cache_index=0)
+            new_cache[name] = (
+                jax.lax.dynamic_update_slice_in_dim(ck, nk, slot, axis=0),
+                jax.lax.dynamic_update_slice_in_dim(cv, nv, slot, axis=0))
+        h = self.run_child(ctx, "final_norm", h)
+        logits = self._logits(ctx, h)
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                            keepdims=False)
+        return last[0], new_cache
+
+    def decode_step(self, params, cache, tokens, positions):
+        """One decode step for EVERY slot at once: ``tokens`` (S,) are each
+        slot's current token, ``positions`` (S,) the cache row it occupies.
+        Returns ``(logits (S, vocab), new_cache)``. Rows are independent —
+        a slot's output never depends on what other slots hold, which is
+        what makes retire-and-readmit between steps safe."""
+        ctx = Context(params, {}, False, None)
+        emb = ctx.param("embedding")
+        x = emb[tokens][:, None, :] * (self.hidden_size ** 0.5)
+        max_len = jax.tree_util.tree_leaves(cache)[0].shape[2]
+        pe = position_encoding(max_len, self.hidden_size, x.dtype)
+        x = x + pe[positions][:, None, :]
+        new_cache = dict(cache)
+        for name in self._decoder_names():
+            x, new_cache[name] = self._modules[name].forward(
+                ctx.child(name), x, cache=cache[name], cache_index=positions)
+        x = self.run_child(ctx, "final_norm", x)
+        return self._logits(ctx, x)[:, 0, :], new_cache
 
     def forward(self, ctx: Context, x):
         if self.transformer_type == LANGUAGE_MODEL:

@@ -1,5 +1,5 @@
-"""Serving tier: dynamic-batching inference with admission control,
-deadlines, and SLO metrics.
+"""Serving tier: dynamic batching, continuous-batching generation, and
+multi-model routing with admission control, deadlines, and SLO metrics.
 
 The reference's serving story is ``PredictionService.scala:56`` — a
 blocking-queue pool of cloned models, one request per forward. On a TPU
@@ -8,28 +8,60 @@ occupancy, and a jitted executable recompiles per input shape. This
 package supplies the TPU-native translation:
 
 - :class:`InferenceService` — ``submit``/``predict`` front door with
-  bounded-queue backpressure, per-request deadlines, warmup, and
-  graceful close;
+  bounded-queue backpressure, per-request deadlines, warmup, atomic
+  hot-reload, and graceful close;
 - :class:`DynamicBatcher` — worker thread aggregating requests into
   bucket-padded micro-batches (bounded compiled-executable set);
+- :class:`GenerationEngine` — continuous-batching autoregressive
+  decoding over a fixed-shape KV slot table: admission and retirement
+  happen BETWEEN decode steps, per-request tokens stream through
+  :class:`GenerationStream` iterator-futures;
+- :class:`ModelRouter` — one ``submit(model, x)`` front door over N
+  registered backends with per-model quotas;
+- :func:`watch_checkpoints` — poll a ckpt-tier ``MANIFEST.json`` and
+  hot-reload a running service on each new committed entry;
 - :class:`ServingMetrics` — served/rejected/expired counters, batch and
-  latency distributions, padding waste.
+  latency distributions, padding waste, and the token-level generation
+  fields (TTFT, tokens/sec, slot occupancy).
 
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """
 
 from bigdl_tpu.serving.batcher import DynamicBatcher, bucket_sizes_for
-from bigdl_tpu.serving.errors import DeadlineExceeded, Overloaded, ServingError
+from bigdl_tpu.serving.engine import (
+    DecodeKernels,
+    GenerationEngine,
+    GenerationStream,
+    static_generate,
+)
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+    StreamCancelled,
+    UnknownModel,
+)
+from bigdl_tpu.serving.hot_reload import CheckpointWatcher, watch_checkpoints
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.router import ModelRouter
 from bigdl_tpu.serving.service import InferenceService
 
 __all__ = [
-    "DynamicBatcher",
+    "CheckpointWatcher",
     "DeadlineExceeded",
+    "DecodeKernels",
+    "DynamicBatcher",
+    "GenerationEngine",
+    "GenerationStream",
     "InferenceService",
+    "ModelRouter",
     "Overloaded",
     "ServingError",
     "ServingMetrics",
+    "StreamCancelled",
+    "UnknownModel",
     "bucket_sizes_for",
+    "static_generate",
+    "watch_checkpoints",
 ]

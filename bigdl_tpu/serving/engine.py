@@ -1,0 +1,705 @@
+"""GenerationEngine — continuous-batching autoregressive generation.
+
+PR 1's :class:`~bigdl_tpu.serving.service.InferenceService` batches
+run-to-completion requests, the wrong shape for autoregressive decoding:
+one long sequence holds the whole micro-batch hostage and new requests
+wait for the full batch to finish. This module is the iteration-level
+scheduler (Orca, OSDI '22; vLLM's slot-managed KV cache, SOSP '23 —
+PAPERS.md): the unit of scheduling is ONE decode step, not one request.
+
+Design, in XLA terms:
+
+- **fixed-shape slot table** — the KV cache is ``(max_slots, heads,
+  max_len, head_dim)`` per layer, built once by ``model.init_cache``.
+  The jitted decode step closes over nothing dynamic: tokens ``(S,)``
+  and positions ``(S,)`` are the only per-step inputs, so the loop
+  compiles exactly once at warmup and NEVER recompiles, however
+  admissions and retirements reshuffle the slots (test-enforced via the
+  :class:`DecodeKernels` trace counters).
+- **donated cache** — the cache pytree is donated to every prefill and
+  decode call, so the steady-state loop allocates no new cache buffers.
+- **admission between steps** — new requests prefill into free slots at
+  decode-step boundaries (one bucket-padded prompt forward each);
+  finished sequences (EOS, max-tokens, deadline expiry, cancel) retire
+  mid-flight and free their slot immediately.
+- **iterator-futures** — ``submit`` returns a :class:`GenerationStream`
+  that yields tokens as the loop produces them; time-to-first-token and
+  per-stream tokens/sec land in the shared
+  :class:`~bigdl_tpu.serving.metrics.ServingMetrics`.
+
+:func:`static_generate` is the run-to-completion baseline over the SAME
+jitted kernels — ``bench.py --mode serving --generate`` and the CI smoke
+gate measure continuous vs static tokens/sec with it (the win is
+scheduling, so it shows even on one core).
+
+Sampling is greedy (argmax inside the jitted step): deterministic for a
+fixed model+prompt regardless of admission order or slot assignment,
+which the tests rely on. Swap :class:`DecodeKernels` for a sampling
+variant when temperature is needed.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.serving.batcher import bucket_sizes_for
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    StreamCancelled,
+)
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+_SENTINEL = object()
+
+
+class _TraceCounts:
+    """Mutable trace counters, deliberately a separate tiny object: the
+    jitted closures capture THIS (and the model), never the object that
+    owns the pjit executables — a closure capturing the owner would put
+    it in a cycle through the C++ pjit object, which the GC cannot
+    break, leaking model+params on an unclosed engine."""
+
+    __slots__ = ("prefill", "decode")
+
+    def __init__(self):
+        self.prefill = 0
+        self.decode = 0
+
+
+class DecodeKernels:
+    """The jitted ``(prefill, decode)`` pair over a decode-capable model
+    (one exposing ``init_cache`` / ``prefill`` / ``decode_step``, e.g.
+    ``nn.Transformer`` in ``language_model`` mode).
+
+    Greedy argmax sampling happens INSIDE the jitted step so only the
+    ``int32`` next-token vector crosses to the host each iteration.
+    ``prefill_traces`` / ``decode_traces`` increment only when XLA
+    actually traces (= compiles) — the compile-count assertions in the
+    tests read them. The cache argument is donated: the steady-state
+    loop never reallocates cache buffers.
+    """
+
+    def __init__(self, model, *, donate: bool = True):
+        self.model = model
+        self.counts = _TraceCounts()
+        counts = self.counts
+
+        def prefill(params, cache, slot, tokens, length):
+            counts.prefill += 1
+            logits, cache = model.prefill(params, cache, slot, tokens, length)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        def decode(params, cache, tokens, positions):
+            counts.decode += 1
+            logits, cache = model.decode_step(params, cache, tokens, positions)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        dn = (1,) if donate else ()
+        self._prefill = jax.jit(prefill, donate_argnums=dn)
+        self._decode = jax.jit(decode, donate_argnums=dn)
+
+    @property
+    def prefill_traces(self) -> int:
+        return self.counts.prefill
+
+    @property
+    def decode_traces(self) -> int:
+        return self.counts.decode
+
+    def prefill(self, params, cache, slot: int, tokens, length: int):
+        """-> (first generated token, new cache); donates ``cache``."""
+        return self._prefill(params, cache, int(slot),
+                             np.asarray(tokens, np.int32), int(length))
+
+    def decode(self, params, cache, tokens, positions):
+        """-> (next token per slot (S,), new cache); donates ``cache``."""
+        return self._decode(params, cache, np.asarray(tokens, np.int32),
+                            np.asarray(positions, np.int32))
+
+
+class GenerationStream:
+    """Iterator-future for one generation request.
+
+    The engine pushes tokens as decode steps complete; the consumer
+    either iterates (``for tok in stream`` — single-pass, yields each
+    token once then raises the terminal error, if any) or blocks for the
+    whole sequence with :meth:`result`. :meth:`cancel` asks the engine
+    to retire the slot at the next step boundary (the stream then ends
+    with :class:`StreamCancelled`; tokens produced so far stay readable
+    via :attr:`tokens`).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tokens: List[int] = []
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._callbacks: List[Callable[["GenerationStream"], None]] = []
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    # ------------------------------------------------- engine side ----
+
+    def _push(self, token: int, now: float) -> None:
+        with self._lock:
+            if self.t_first is None:
+                self.t_first = now
+            self._tokens.append(token)
+        self._q.put(token)
+
+    def _finish(self, error: Optional[BaseException] = None,
+                now: Optional[float] = None) -> None:
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._error = error
+            self.t_done = now if now is not None else time.monotonic()
+            callbacks = list(self._callbacks)
+            self._done.set()
+        self._q.put(_SENTINEL)
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:
+                log.exception("GenerationStream done-callback failed")
+
+    # ----------------------------------------------- consumer side ----
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream finishes; the full token list (raises
+        the stream's terminal error instead, e.g. ``DeadlineExceeded``)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation stream did not finish in time")
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def cancel(self) -> None:
+        """Ask the engine to retire this request at the next step
+        boundary (no-op once the stream is done)."""
+        self._cancelled = True
+
+    def add_done_callback(self, fn: Callable[["GenerationStream"], None]) -> None:
+        with self._lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    # ------------------------------------------------------ queries ----
+
+    @property
+    def tokens(self) -> List[int]:
+        """Tokens produced so far (snapshot copy)."""
+        with self._lock:
+            return list(self._tokens)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit -> first token, seconds (None before the first token)."""
+        return None if self.t_first is None else self.t_first - self.t_submit
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new_tokens", "deadline", "stream")
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 deadline: Optional[float], stream: GenerationStream):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline
+        self.stream = stream
+
+
+class _SlotState:
+    """Host-side bookkeeping for one occupied slot."""
+
+    __slots__ = ("req", "last_token", "position", "generated", "t_admit")
+
+    def __init__(self, req: _GenRequest, last_token: int, position: int,
+                 generated: int, t_admit: float):
+        self.req = req
+        self.last_token = last_token
+        self.position = position          # cache row the NEXT token writes
+        self.generated = generated
+        self.t_admit = t_admit
+
+
+class _Core:
+    """State shared between the engine facade and the loop thread:
+    request/stream bookkeeping only, nothing heavy — so the loop can
+    fail every stream and exit even if the facade (holding params,
+    cache, and the jitted kernels) has been garbage-collected."""
+
+    __slots__ = ("cond", "pending", "active", "free", "closed", "drain")
+
+    def __init__(self, max_slots: int):
+        self.cond = threading.Condition()
+        self.pending: "deque[_GenRequest]" = deque()
+        self.active: Dict[int, _SlotState] = {}
+        self.free: List[int] = list(range(max_slots))
+        self.closed = False
+        self.drain = True
+
+
+def _fail_streams(core: _Core, error: BaseException) -> None:
+    with core.cond:
+        reqs = list(core.pending) + [s.req for s in core.active.values()]
+        core.pending.clear()
+        core.free.extend(core.active.keys())
+        core.active.clear()
+    for r in reqs:
+        if not r.stream.done:
+            r.stream._finish(error)
+
+
+def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
+                 core: _Core) -> None:
+    """Loop thread body. Holds only a weak ref to the engine while idle
+    (same discipline as the batcher worker): an engine whose owner
+    forgot ``close()`` becomes collectable and the loop exits, failing
+    any stranded streams, instead of pinning params + KV cache forever."""
+    while True:
+        with core.cond:
+            while not core.pending and not core.active and not core.closed:
+                core.cond.wait(timeout=0.05)
+                if engine_ref() is None:
+                    break
+            if core.closed:
+                if not core.drain:
+                    _fail_streams(core, RuntimeError(
+                        "generation engine closed before request ran"))
+                    return
+                if not core.pending and not core.active:
+                    return
+        engine = engine_ref()
+        if engine is None:
+            _fail_streams(core, RuntimeError(
+                "generation engine was garbage-collected with requests "
+                "in flight"))
+            return
+        try:
+            engine._step()
+        except Exception as e:
+            # a broken step cannot be retried: the donated cache may be
+            # consumed — fail every stream loudly and stop the loop
+            engine._failed = e
+            log.exception("generation engine step failed; engine stopped")
+            _fail_streams(core, e)
+            return
+        del engine
+
+
+class GenerationEngine:
+    """Continuous-batching generation front door over one decode-capable
+    model (``init_cache`` / ``prefill`` / ``decode_step`` — see
+    ``nn.Transformer``).
+
+    ``submit(prompt, max_new_tokens=..., deadline=...)`` returns a
+    :class:`GenerationStream`; a persistent loop thread admits pending
+    prompts into free slots between decode steps, decodes every active
+    slot per iteration, and retires finished sequences mid-flight.
+    Admission control mirrors :class:`InferenceService`: a full pending
+    queue raises :class:`Overloaded` on the caller's thread.
+
+    ``warmup()`` compiles the decode step (once — its shapes never
+    change) and every prompt bucket; call it before traffic so no
+    request pays a compile. ``reload(params)`` swaps weights atomically
+    between steps (see the hot-reload satellite).
+    """
+
+    def __init__(self, model, params, *, max_slots: int = 8,
+                 max_len: int = 256, max_prompt_len: Optional[int] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 cache_dtype=jnp.float32,
+                 kernels: Optional[DecodeKernels] = None):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if max_len < 2:
+            raise ValueError("max_len must be >= 2 (prompt + 1 token)")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.max_prompt_len = int(max_prompt_len or max(1, max_len // 2))
+        if not 1 <= self.max_prompt_len < self.max_len:
+            raise ValueError(
+                f"max_prompt_len {self.max_prompt_len} must be in "
+                f"[1, max_len) = [1, {self.max_len})")
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.pad_id = int(pad_id)
+        self.max_queue = int(max_queue)
+        self.metrics = metrics or ServingMetrics()
+        self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
+        self.kernels = kernels or DecodeKernels(model)
+        self._params = params
+        self._cache = model.init_cache(self.max_slots, self.max_len,
+                                       cache_dtype)
+        self._failed: Optional[BaseException] = None
+        self._core = _Core(self.max_slots)
+        self._thread = threading.Thread(
+            target=_engine_loop, args=(weakref.ref(self), self._core),
+            name="bigdl-serving-engine", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------ submission ----
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None) -> GenerationStream:
+        """Enqueue one prompt (sequence of token ids). ``max_new_tokens``
+        caps generation (default: whatever fits in ``max_len``);
+        ``deadline`` is seconds from now — an expired request retires
+        mid-flight with :class:`DeadlineExceeded` on its stream. Raises
+        :class:`Overloaded` when the pending queue is at its bound."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds max_prompt_len "
+                f"{self.max_prompt_len}")
+        room = self.max_len - len(prompt)
+        mnt = room if max_new_tokens is None else min(int(max_new_tokens), room)
+        if mnt < 1:
+            raise ValueError("no room to generate even one token")
+        stream = GenerationStream()
+        now = stream.t_submit
+        req = _GenRequest(prompt, mnt,
+                          None if deadline is None else now + float(deadline),
+                          stream)
+        core = self._core
+        with core.cond:
+            if self._failed is not None:
+                raise RuntimeError(
+                    "generation engine stopped after a step failure"
+                ) from self._failed
+            if core.closed:
+                raise RuntimeError("generation engine is closed")
+            if len(core.pending) >= self.max_queue:
+                self.metrics.record_rejected()
+                raise Overloaded(len(core.pending), self.max_queue)
+            core.pending.append(req)
+            depth = len(core.pending)
+            core.cond.notify_all()
+        self.metrics.set_queue_depth(depth)
+        return stream
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_new_tokens: Optional[int] = None,
+                 deadline: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           deadline=deadline).result(timeout)
+
+    # ------------------------------------------------- loop internals ----
+    # Everything below here runs on the loop thread only (except warmup,
+    # which the caller must run before traffic).
+
+    def _step(self) -> None:
+        """One scheduler iteration: admit pending prompts into free slots,
+        then one decode step over every active slot."""
+        core = self._core
+        while True:
+            with core.cond:
+                if not core.pending or not core.free:
+                    break
+                req = core.pending.popleft()
+                depth = len(core.pending)
+            self.metrics.set_queue_depth(depth)
+            self._admit(req)
+        with core.cond:
+            active = sorted(core.active.items())
+        if active:
+            self._decode_once(active)
+
+    def _admit(self, req: _GenRequest) -> None:
+        now = time.monotonic()
+        why = self._retire_why(None, req, now)
+        if why is not None:
+            self._finish_request(req, why, now, queue_wait=None)
+            return
+        core = self._core
+        with core.cond:
+            core.free.sort()
+            slot = core.free.pop(0)
+        n = len(req.prompt)
+        bucket = next(b for b in self.prompt_buckets if b >= n)
+        padded = np.full((bucket,), self.pad_id, np.int32)
+        padded[:n] = req.prompt
+        tok_dev, self._cache = self.kernels.prefill(
+            self._params, self._cache, slot, padded, n)
+        tok = int(np.asarray(tok_dev))
+        now = time.monotonic()
+        self.metrics.record_prefill(n, bucket, now - req.stream.t_submit)
+        req.stream._push(tok, now)
+        st = _SlotState(req, tok, n, 1, now)
+        why = self._retire_why(st, req, now)
+        if why is None:
+            with core.cond:
+                core.active[slot] = st
+        else:
+            with core.cond:
+                core.free.append(slot)
+            self._finish_slot(st, why, now)
+
+    def _decode_once(self, active: List[Tuple[int, _SlotState]]) -> None:
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        for slot, st in active:
+            tokens[slot] = st.last_token
+            positions[slot] = st.position
+        toks_dev, self._cache = self.kernels.decode(
+            self._params, self._cache, tokens, positions)
+        toks = np.asarray(toks_dev)
+        now = time.monotonic()
+        self.metrics.record_decode_step(len(active), self.max_slots)
+        retired = []
+        for slot, st in active:
+            tok = int(toks[slot])
+            st.last_token = tok
+            st.position += 1
+            st.generated += 1
+            st.req.stream._push(tok, now)
+            why = self._retire_why(st, st.req, now)
+            if why is not None:
+                retired.append((slot, st, why))
+        if retired:
+            core = self._core
+            with core.cond:
+                for slot, _, _ in retired:
+                    core.active.pop(slot, None)
+                    core.free.append(slot)
+            for _, st, why in retired:
+                self._finish_slot(st, why, now)
+
+    def _retire_why(self, st: Optional[_SlotState], req: _GenRequest,
+                    now: float) -> Optional[str]:
+        """Retirement disposition, or None to keep decoding. Order:
+        explicit cancel wins, a normally-completed sequence beats a
+        deadline that expired on the same step."""
+        if req.stream.cancelled:
+            return "cancelled"
+        if st is not None:
+            if self.eos_id is not None and st.last_token == self.eos_id:
+                return "done"
+            if st.generated >= req.max_new_tokens:
+                return "done"
+            if st.position >= self.max_len:
+                return "done"
+        if req.deadline is not None and now > req.deadline:
+            return "expired"
+        return None
+
+    def _finish_slot(self, st: _SlotState, why: str, now: float) -> None:
+        self._finish_request(st.req, why, now,
+                             queue_wait=st.t_admit - st.req.stream.t_submit,
+                             generated=st.generated)
+
+    def _finish_request(self, req: _GenRequest, why: str, now: float, *,
+                        queue_wait: Optional[float],
+                        generated: int = 0) -> None:
+        stream = req.stream
+        dur = now - stream.t_submit
+        if why == "expired":
+            self.metrics.record_expired()
+            stream._finish(DeadlineExceeded(
+                dur, req.deadline - stream.t_submit), now)
+        elif why == "cancelled":
+            stream._finish(StreamCancelled(
+                "generation stream cancelled by its consumer"), now)
+        else:
+            self.metrics.record_served(dur, queue_wait or 0.0)
+            self.metrics.record_stream(generated, dur)
+            stream._finish(None, now)
+
+    # -------------------------------------------------------- lifecycle ----
+
+    def warmup(self) -> None:
+        """Compile the decode step and every prompt-bucket prefill BEFORE
+        traffic arrives. Must run before the first submit (it touches the
+        cache from the caller's thread); the garbage keys it writes are
+        causally invisible and overwritten by real admissions."""
+        core = self._core
+        with core.cond:
+            if core.pending or core.active:
+                raise RuntimeError("warmup() must run before traffic")
+        _, self._cache = self.kernels.decode(
+            self._params, self._cache,
+            np.zeros((self.max_slots,), np.int32),
+            np.zeros((self.max_slots,), np.int32))
+        for bucket in self.prompt_buckets:
+            _, self._cache = self.kernels.prefill(
+                self._params, self._cache, 0,
+                np.full((bucket,), self.pad_id, np.int32), bucket)
+        jax.block_until_ready(self._cache)
+
+    def reload(self, params, state: Any = None) -> None:
+        """Swap decode params atomically between steps: a decode/prefill
+        call reads ``self._params`` exactly once, so every step sees one
+        consistent tree — never torn halves. Signature-checked: matching
+        shapes/dtypes mean the jitted step is NOT recompiled. ``state``
+        is accepted for :func:`watch_checkpoints` symmetry but must be
+        empty — incremental decode is stateless."""
+        from bigdl_tpu.serving.service import require_matching_signature
+
+        if state:
+            raise ValueError(
+                "GenerationEngine.reload takes params only: incremental "
+                "decode runs stateless (no BN-style buffers)")
+        require_matching_signature("params", self._params, params)
+        # device_put once: host arrays would re-transfer every step and
+        # miss the jit cache (uncommitted args key a different executable)
+        self._params = jax.device_put(params)
+        self.metrics.record_reload()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop admitting; with ``drain`` (default) the loop keeps
+        stepping until every pending and in-flight stream finishes,
+        otherwise they fail with ``RuntimeError``."""
+        core = self._core
+        with core.cond:
+            core.closed = True
+            core.drain = drain
+            core.cond.notify_all()
+        self._thread.join(timeout)
+        if not self._thread.is_alive():
+            # the loop has exited: a request that raced the close flag in
+            # must fail rather than strand its consumer. NOT safe while
+            # the loop lives (a timed-out drain join) — it would fail
+            # streams the loop is still legitimately serving and
+            # double-free their slots mid-step.
+            _fail_streams(core, RuntimeError(
+                "generation engine closed before request ran"))
+
+    def __enter__(self) -> "GenerationEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --------------------------------------------------------- queries ----
+
+    @property
+    def active_slots(self) -> int:
+        with self._core.cond:
+            return len(self._core.active)
+
+    @property
+    def pending_requests(self) -> int:
+        with self._core.cond:
+            return len(self._core.pending)
+
+    @property
+    def free_slots(self) -> List[int]:
+        with self._core.cond:
+            return sorted(self._core.free)
+
+    @property
+    def decode_compilations(self) -> int:
+        return self.kernels.decode_traces
+
+    @property
+    def prefill_compilations(self) -> int:
+        return self.kernels.prefill_traces
+
+
+def static_generate(model, params, requests, *, max_slots: int,
+                    max_len: int, eos_id: Optional[int] = None,
+                    pad_id: int = 0, cache_dtype=jnp.float32,
+                    kernels: Optional[DecodeKernels] = None,
+                    prompt_buckets: Optional[Sequence[int]] = None):
+    """Run-to-completion static batching BASELINE over the same jitted
+    kernels the engine uses: admit ``max_slots`` requests, decode until
+    EVERY one finishes (the longest sequence holds the whole batch
+    hostage), only then admit the next group. ``requests`` is a sequence
+    of ``(prompt, max_new_tokens)``; returns ``(token lists, decode
+    steps executed)``. This is the comparison the bench/CI smoke gate
+    runs — continuous batching must beat it on mixed lengths because it
+    retires short sequences mid-flight instead of idling their slots."""
+    kernels = kernels or DecodeKernels(model)
+    requests = [([int(t) for t in p], int(m)) for p, m in requests]
+    buckets = list(prompt_buckets
+                   or bucket_sizes_for(max(len(p) for p, _ in requests)))
+    cache = model.init_cache(max_slots, max_len, cache_dtype)
+    outputs: List[Optional[List[int]]] = [None] * len(requests)
+    total_steps = 0
+    for base in range(0, len(requests), max_slots):
+        group = requests[base:base + max_slots]
+        states = []
+        for slot, (prompt, mnt) in enumerate(group):
+            n = len(prompt)
+            bucket = next(b for b in buckets if b >= n)
+            padded = np.full((bucket,), pad_id, np.int32)
+            padded[:n] = prompt
+            tok_dev, cache = kernels.prefill(params, cache, slot, padded, n)
+            tok = int(np.asarray(tok_dev))
+            target = min(mnt, max_len - n)
+            states.append({
+                "tokens": [tok], "last": tok, "pos": n,
+                "target": target,
+                "done": (eos_id is not None and tok == eos_id) or target <= 1,
+            })
+        while not all(s["done"] for s in states):
+            tokens = np.zeros((max_slots,), np.int32)
+            positions = np.zeros((max_slots,), np.int32)
+            for slot, s in enumerate(states):
+                tokens[slot] = s["last"]
+                positions[slot] = s["pos"]
+            toks_dev, cache = kernels.decode(params, cache, tokens, positions)
+            toks = np.asarray(toks_dev)
+            total_steps += 1
+            for slot, s in enumerate(states):
+                if s["done"]:
+                    continue
+                tok = int(toks[slot])
+                s["tokens"].append(tok)
+                s["last"] = tok
+                s["pos"] += 1
+                if ((eos_id is not None and tok == eos_id)
+                        or len(s["tokens"]) >= s["target"]
+                        or s["pos"] >= max_len):
+                    s["done"] = True
+        for i, s in enumerate(states):
+            outputs[base + i] = s["tokens"]
+    return outputs, total_steps

@@ -1,10 +1,11 @@
 """Typed serving failures.
 
-Both are delivered two ways: ``InferenceService.submit`` RAISES
-``Overloaded`` (admission control happens on the caller's thread, before
-a queue slot is taken), while ``DeadlineExceeded`` is set ON the
-request's future (expiry is detected by the batcher worker when the
-request would otherwise occupy a batch slot).
+Delivery convention: admission-time failures (``Overloaded``,
+``UnknownModel``) RAISE on the caller's thread, before a queue slot is
+taken; in-flight failures (``DeadlineExceeded``, ``StreamCancelled``)
+are set ON the request's future/stream, detected by the batcher or
+generation-engine worker at the point the request would otherwise
+occupy a forward slot or decode step.
 """
 
 from __future__ import annotations
@@ -15,14 +16,36 @@ class ServingError(RuntimeError):
 
 
 class Overloaded(ServingError):
-    """The request queue is at its configured bound; the request was
-    rejected without being enqueued (backpressure, not buffering)."""
+    """The request queue (or a router's per-model in-flight quota) is at
+    its configured bound; the request was rejected without being enqueued
+    (backpressure, not buffering). ``model`` names the saturated backend
+    when the rejection came from a :class:`ModelRouter` quota."""
 
-    def __init__(self, queue_depth: int, max_queue: int):
+    def __init__(self, queue_depth: int, max_queue: int,
+                 model: "str | None" = None):
+        where = f"model '{model}'" if model else "serving queue"
         super().__init__(
-            f"serving queue full ({queue_depth}/{max_queue}); request rejected")
+            f"{where} full ({queue_depth}/{max_queue}); request rejected")
         self.queue_depth = queue_depth
         self.max_queue = max_queue
+        self.model = model
+
+
+class UnknownModel(ServingError):
+    """A router request named a model no backend is registered under."""
+
+    def __init__(self, name: str, available):
+        avail = ", ".join(sorted(available)) or "<none>"
+        super().__init__(
+            f"no model '{name}' registered (available: {avail})")
+        self.name = name
+        self.available = sorted(available)
+
+
+class StreamCancelled(ServingError):
+    """The generation stream was cancelled by its consumer; the slot was
+    retired at the next decode-step boundary. Tokens produced before the
+    cancel are still available on the stream."""
 
 
 class DeadlineExceeded(ServingError):

@@ -65,6 +65,18 @@ class ServingMetrics:
         self._batch_sizes: Dict[int, int] = {}   # real rows per forward
         self._latency = _Reservoir(reservoir_size)      # end-to-end seconds
         self._queue_wait = _Reservoir(reservoir_size)   # submit -> drain
+        # token-level generation counters (GenerationEngine); zero for a
+        # plain InferenceService, whose snapshot/table keep PR-1 shape
+        self.prefills = 0        # admitted prompts (one prefill forward each)
+        self.prefill_tokens = 0  # real prompt tokens prefetched into caches
+        self.prefill_padded = 0  # pad tokens added to reach a prompt bucket
+        self.decode_steps = 0    # executed decode iterations
+        self.decode_active = 0   # sum over steps of slots actually serving
+        self.decode_slot_rows = 0  # sum over steps of total slots (capacity)
+        self.tokens_out = 0      # generated tokens streamed to consumers
+        self.reloads = 0         # hot param swaps (reload/watch_checkpoints)
+        self._ttft = _Reservoir(reservoir_size)         # submit -> 1st token
+        self._stream_rate = _Reservoir(reservoir_size)  # per-stream tokens/s
 
     # ------------------------------------------------------- mutators ----
 
@@ -97,6 +109,40 @@ class ServingMetrics:
         with self._lock:
             self.queue_depth = depth
 
+    # ------------------------------------------ generation mutators ----
+
+    def record_prefill(self, n_prompt: int, n_padded: int,
+                       ttft_s: Optional[float] = None) -> None:
+        """One admitted prompt: ``n_prompt`` real tokens padded up to the
+        ``n_padded`` bucket, plus the first generated token (prefill emits
+        it); ``ttft_s`` is submit -> first token."""
+        with self._lock:
+            self.prefills += 1
+            self.prefill_tokens += n_prompt
+            self.prefill_padded += n_padded - n_prompt
+            self.tokens_out += 1
+            if ttft_s is not None:
+                self._ttft.add(ttft_s)
+
+    def record_decode_step(self, n_active: int, n_slots: int) -> None:
+        """One decode iteration serving ``n_active`` of ``n_slots`` slots
+        (each active slot emits one token)."""
+        with self._lock:
+            self.decode_steps += 1
+            self.decode_active += n_active
+            self.decode_slot_rows += n_slots
+            self.tokens_out += n_active
+
+    def record_stream(self, n_tokens: int, duration_s: float) -> None:
+        """One finished stream's token rate (generated / submit->done)."""
+        with self._lock:
+            if duration_s > 0:
+                self._stream_rate.add(n_tokens / duration_s)
+
+    def record_reload(self) -> None:
+        with self._lock:
+            self.reloads += 1
+
     # -------------------------------------------------------- readers ----
 
     def snapshot(self) -> dict:
@@ -125,6 +171,25 @@ class ServingMetrics:
                     f"p{q}": round(v * 1e3, 3)
                     for q, v in zip(self.LATENCY_QS, wait)},
                 "latency_samples": self._latency.seen,
+                # token-level generation fields: NEW KEYS ONLY (PR-1
+                # consumers index by key, so additions are compatible)
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "tokens_out": self.tokens_out,
+                "reloads": self.reloads,
+                "slot_occupancy": (self.decode_active / self.decode_slot_rows
+                                   if self.decode_slot_rows else 0.0),
+                "prompt_padding_waste": (
+                    self.prefill_padded
+                    / (self.prefill_tokens + self.prefill_padded)
+                    if self.prefill_tokens + self.prefill_padded else 0.0),
+                "ttft_ms": None if (t := self._ttft.percentiles(
+                    self.LATENCY_QS)) is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, t)},
+                "stream_tokens_per_sec": None if (r := self._stream_rate.
+                                                  percentiles((50,))) is None
+                else round(r[0], 2),
             }
 
     def format_table(self) -> str:
@@ -146,4 +211,21 @@ class ServingMetrics:
             if s[key]:
                 for q, v in s[key].items():
                     row(f"{key[:-3]}_{q}(ms)", f"{v:.3f}")
+        # token-level rows are APPENDED, and only when generation actually
+        # happened: a plain InferenceService table stays byte-identical to
+        # the PR-1 golden output (extend, don't reorder — test-enforced)
+        if s["prefills"] or s["decode_steps"] or s["tokens_out"]:
+            row("tokens_out", s["tokens_out"])
+            row("prefills", s["prefills"])
+            row("decode_steps", s["decode_steps"])
+            row("slot_occupancy", f"{s['slot_occupancy'] * 100:.1f}%")
+            row("prompt_padding_waste",
+                f"{s['prompt_padding_waste'] * 100:.1f}%")
+            if s["ttft_ms"]:
+                for q, v in s["ttft_ms"].items():
+                    row(f"ttft_{q}(ms)", f"{v:.3f}")
+            if s["stream_tokens_per_sec"] is not None:
+                row("stream_tokens/s_p50", f"{s['stream_tokens_per_sec']:.2f}")
+        if s["reloads"]:
+            row("reloads", s["reloads"])
         return "\n".join(lines)

@@ -1,0 +1,206 @@
+"""ModelRouter — the multi-model serving front door.
+
+One ``submit(model_name, x)`` surface dispatching to N registered
+backends — :class:`~bigdl_tpu.serving.service.InferenceService` for
+run-to-completion prediction, :class:`~bigdl_tpu.serving.engine.
+GenerationEngine` for continuous-batching generation, or anything
+duck-typing their ``submit``/``metrics``/``close`` trio. Each backend
+keeps its own queue, batching policy, and compiled executables; the
+router adds the cross-model concerns:
+
+- **per-model in-flight quotas** — a saturated model rejects with
+  :class:`Overloaded` (tagged with the model name) while every other
+  model keeps serving; quotas are decremented when the future/stream
+  completes, so they bound true in-flight work, not just queue depth;
+- **typed routing errors** — an unregistered name raises
+  :class:`UnknownModel` listing what IS available;
+- **aggregate observability** — ``snapshot()`` and ``format_table()``
+  fold every backend's :class:`ServingMetrics` into one per-model view.
+
+The reference's analogue is one ``PredictionService`` per model with
+client-side routing; here routing is server-side so quotas, metrics,
+and lifecycle live in one place.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from bigdl_tpu.serving.errors import Overloaded, UnknownModel
+
+_SNAP_COLS = ("served", "rejected", "expired", "failed", "tokens_out")
+
+
+class _Backend:
+    __slots__ = ("backend", "max_inflight", "inflight", "owned")
+
+    def __init__(self, backend, max_inflight: Optional[int], owned: bool):
+        self.backend = backend
+        self.max_inflight = max_inflight
+        self.inflight = 0
+        self.owned = owned
+
+
+class ModelRouter:
+    """Multi-model front door over named serving backends.
+
+    ``register`` is cheap and can happen while traffic flows to other
+    models; ``close()`` closes every backend registered with
+    ``owned=True`` (the default) — pass ``owned=False`` for backends
+    whose lifecycle someone else manages.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._backends: Dict[str, _Backend] = {}
+        self._closed = False
+
+    # ----------------------------------------------------- registry ----
+
+    def register(self, name: str, backend, *,
+                 max_inflight: Optional[int] = None,
+                 owned: bool = True) -> "ModelRouter":
+        """Add a backend under ``name``. ``max_inflight`` bounds
+        concurrently outstanding requests for THIS model (None =
+        unbounded at the router; the backend's own queue still applies).
+        Returns self for chaining."""
+        if max_inflight is not None and max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1 (or None)")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            if name in self._backends:
+                raise ValueError(f"model '{name}' already registered")
+            self._backends[name] = _Backend(backend, max_inflight, owned)
+        return self
+
+    def unregister(self, name: str, *, close: bool = False):
+        """Remove ``name``; with ``close`` also close the backend.
+        In-flight requests already submitted keep running."""
+        with self._lock:
+            b = self._backends.pop(name, None)
+        if b is None:
+            raise UnknownModel(name, self.names())
+        if close:
+            b.backend.close()
+        return b.backend
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._backends)
+
+    def backend(self, name: str):
+        with self._lock:
+            b = self._backends.get(name)
+        if b is None:
+            raise UnknownModel(name, self.names())
+        return b.backend
+
+    # ----------------------------------------------------- dispatch ----
+
+    def submit(self, model_name: str, x, **kwargs):
+        """Route one request: returns whatever the backend's ``submit``
+        returns (a ``Future`` for an InferenceService, a
+        ``GenerationStream`` for a GenerationEngine) — extra kwargs
+        (``deadline``, ``max_new_tokens``, ...) pass straight through.
+        Raises :class:`UnknownModel` for unregistered names and
+        :class:`Overloaded` (with the model name) at the quota."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            b = self._backends.get(model_name)
+            if b is None:
+                raise UnknownModel(model_name, sorted(self._backends))
+            if b.max_inflight is not None and b.inflight >= b.max_inflight:
+                metrics = getattr(b.backend, "metrics", None)
+                if metrics is not None:
+                    # the backend never sees a quota-shed request: count
+                    # it here so `rejected` means "shed load" regardless
+                    # of WHICH bound (queue or quota) did the shedding
+                    metrics.record_rejected()
+                raise Overloaded(b.inflight, b.max_inflight,
+                                 model=model_name)
+            # count BEFORE submitting: two racing submits must not both
+            # slip under the quota, and the done-callback may fire on
+            # another thread the instant submit returns
+            b.inflight += 1
+        try:
+            handle = b.backend.submit(x, **kwargs)
+        except BaseException:
+            with self._lock:
+                b.inflight -= 1
+            raise
+        handle.add_done_callback(lambda _h: self._release(b))
+        return handle
+
+    def _release(self, b: _Backend) -> None:
+        with self._lock:
+            b.inflight -= 1
+
+    def predict(self, model_name: str, x,
+                timeout: Optional[float] = None, **kwargs):
+        """Blocking convenience: ``submit(...).result(timeout)`` —
+        works for both futures and generation streams."""
+        return self.submit(model_name, x, **kwargs).result(timeout)
+
+    # ------------------------------------------------ observability ----
+
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            b = self._backends.get(name)
+        if b is None:
+            raise UnknownModel(name, self.names())
+        return b.inflight
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-model dict: router-level in-flight/quota plus the
+        backend's full metrics snapshot."""
+        with self._lock:
+            items = list(self._backends.items())
+        out: Dict[str, dict] = {}
+        for name, b in items:
+            snap = b.backend.metrics.snapshot()
+            snap["inflight"] = b.inflight
+            snap["max_inflight"] = b.max_inflight
+            out[name] = snap
+        return out
+
+    def format_table(self) -> str:
+        """One row per model: the cross-model counters plus p99 latency
+        (per-backend detail lives in each backend's own table)."""
+        snaps = self.snapshot()
+        header = (f"{'model':<16} {'inflight':>8} {'quota':>6} "
+                  + " ".join(f"{c:>9}" for c in _SNAP_COLS)
+                  + f" {'p99_ms':>9}")
+        lines = [header]
+        for name in sorted(snaps):
+            s = snaps[name]
+            quota = s["max_inflight"]
+            lat = s.get("latency_ms") or {}
+            lines.append(
+                f"{name:<16} {s['inflight']:>8} "
+                f"{'-' if quota is None else quota:>6} "
+                + " ".join(f"{s.get(c, 0):>9}" for c in _SNAP_COLS)
+                + f" {lat.get('p99', float('nan')):>9.3f}")
+        return "\n".join(lines)
+
+    # ----------------------------------------------------- lifecycle ----
+
+    def close(self, drain: bool = True) -> None:
+        """Close every OWNED backend (drain by default) and refuse new
+        traffic. Foreign (``owned=False``) backends are left running."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            backends = list(self._backends.values())
+        for b in backends:
+            if b.owned:
+                b.backend.close(drain=drain)
+
+    def __enter__(self) -> "ModelRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -42,6 +42,28 @@ def _model_forward(model):
     return forward
 
 
+def tree_signature(tree):
+    """(treedef, per-leaf (shape, dtype)) — the compile signature of a
+    pytree as jit sees it: two trees with equal signatures hit the same
+    compiled executable."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return treedef, tuple(
+        (tuple(np.shape(l)), np.result_type(l).str) for l in leaves)
+
+
+def require_matching_signature(kind: str, old, new) -> None:
+    """Raise ``ValueError`` unless ``new`` has the exact tree structure
+    and per-leaf shapes/dtypes of ``old`` — the hot-reload contract:
+    matching signatures guarantee the jitted forward is NOT recompiled
+    (weights are traced arguments, only their shapes are baked in)."""
+    old_sig, new_sig = tree_signature(old), tree_signature(new)
+    if old_sig != new_sig:
+        raise ValueError(
+            f"reload {kind} signature mismatch: structure or leaf "
+            f"shapes/dtypes differ from the serving tree (a different "
+            f"model/config cannot be hot-swapped into a running service)")
+
+
 class InferenceService:
     """Dynamic-batching inference over one model / one input signature.
 
@@ -56,8 +78,10 @@ class InferenceService:
                  metrics: Optional[ServingMetrics] = None,
                  forward_fn=None):
         self.model = model
-        self.params = params
-        self.state = state or {}
+        # params+state live in ONE tuple so a reload is a single atomic
+        # reference swap: a batch reads the tuple once and always sees a
+        # matched pair, never one new half and one old (test-enforced)
+        self._weights = (params, state or {})
         self.metrics = metrics or ServingMetrics()
         # jit a closure over the MODEL, never a bound method: a jitted
         # bound method puts the service in a cycle through the C++ pjit
@@ -73,7 +97,37 @@ class InferenceService:
             metrics=self.metrics)
 
     def _forward_batch(self, batched_x):
-        return self._fwd(self.params, self.state, batched_x)
+        params, state = self._weights  # one read: reload can't tear a batch
+        return self._fwd(params, state, batched_x)
+
+    @property
+    def params(self):
+        return self._weights[0]
+
+    @property
+    def state(self):
+        return self._weights[1]
+
+    def reload(self, params, state=None) -> None:
+        """Hot-swap serving weights atomically between batches — the
+        training-to-serving handoff without restart. The new trees are
+        signature-checked against the serving ones (same structure, leaf
+        shapes and dtypes), which guarantees the jitted forward is NOT
+        recompiled; a mismatch (different model/config) raises
+        ``ValueError`` and the old weights keep serving. A batch already
+        in flight finishes on the weights it started with; the next batch
+        sees the new pair — never a torn mix (test-enforced)."""
+        old_params, old_state = self._weights
+        require_matching_signature("params", old_params, params)
+        if state is not None:
+            require_matching_signature("state", old_state, state)
+        # device_put once at reload: host arrays (e.g. a deserialized
+        # checkpoint) would otherwise re-transfer per batch AND miss the
+        # jit cache (an uncommitted arg keys a different executable)
+        params = jax.device_put(params)
+        state = old_state if state is None else jax.device_put(state)
+        self._weights = (params, state)
+        self.metrics.record_reload()
 
     # ------------------------------------------------------ submission ----
 

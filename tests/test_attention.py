@@ -110,6 +110,50 @@ class TestAttentionLayer:
         inc = jnp.concatenate(outs, axis=1)
         np.testing.assert_allclose(np.asarray(inc), np.asarray(full), atol=1e-5)
 
+    def test_kv_cache_per_slot_positions(self, rng):
+        """Vector cache_index (one offset per batch row — the continuous
+        batching slot table): each row decodes at its OWN position and
+        matches the scalar-index path run per row."""
+        from bigdl_tpu.nn.module import Context
+
+        m = Attention(hidden_size=16, num_heads=2)
+        params, _ = m.init(rng)
+        L = 8
+        x = jax.random.normal(jax.random.key(2), (3, L, 16))
+
+        # per-row reference: scalar-index incremental decode, row at a time
+        refs, caches = [], []
+        offsets = [0, 3, 5]  # row r has already decoded `offsets[r]` steps
+        for r, off in enumerate(offsets):
+            cache = (jnp.zeros((1, 2, L, 8)), jnp.zeros((1, 2, L, 8)))
+            out = None
+            for t in range(off + 1):
+                ctx = Context(params, {}, False, None)
+                out, cache = m.forward(ctx, x[r : r + 1, t : t + 1],
+                                       cache=cache, cache_index=t)
+            refs.append(out)
+            caches.append(cache)
+
+        # batched: one forward with a (B,) position vector; each row's
+        # cache lane carries its own scalar history (re-writing the same
+        # k/v at the row's offset is idempotent)
+        cache = tuple(jnp.concatenate([c[i] for c in caches], axis=0)
+                      for i in range(2))
+        positions = jnp.asarray(offsets, jnp.int32)
+        step = jnp.stack([x[r, off] for r, off in enumerate(offsets)])[:, None]
+        ctx = Context(params, {}, False, None)
+        out, new_cache = m.forward(ctx, step, cache=cache,
+                                   cache_index=positions)
+        for r in range(3):
+            np.testing.assert_allclose(np.asarray(out[r : r + 1]),
+                                       np.asarray(refs[r]), atol=1e-5)
+        # the write landed at each row's own offset: caches agree too
+        for i in range(2):
+            np.testing.assert_allclose(
+                np.asarray(new_cache[i]),
+                np.asarray(jnp.concatenate([c[i] for c in caches], axis=0)),
+                atol=1e-5)
+
 
 class TestTransformer:
     def test_lm_forward_backward(self, rng):
@@ -166,3 +210,59 @@ class TestTransformer:
         o1, _ = m.apply(params, x, training=False)
         o2, _ = m.apply(params, x, training=False)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+
+class TestTransformerDecodeAPI:
+    """The serving-tier step API: a slot-table KV cache over the
+    decoder-only Transformer must reproduce the full causal forward
+    exactly, per slot, whatever slot a sequence lands in."""
+
+    @pytest.fixture()
+    def lm(self, rng):
+        m = Transformer(vocab_size=50, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+        params, _ = m.init(rng)
+        return m, params
+
+    def test_init_cache_shapes_and_lm_only(self, lm, rng):
+        m, params = lm
+        cache = m.init_cache(4, 16)
+        assert sorted(cache) == ["decoder_0", "decoder_1"]
+        for k, v in cache.values():
+            assert k.shape == v.shape == (4, 4, 16, 8)
+        mt = Transformer(vocab_size=30, hidden_size=16, num_heads=2,
+                         filter_size=32, num_hidden_layers=1,
+                         transformer_type=TRANSLATION)
+        with pytest.raises(ValueError, match="language_model"):
+            mt.init_cache(2, 8)
+
+    def test_prefill_then_decode_matches_full_forward(self, lm):
+        m, params = lm
+        ids = np.array([5, 11, 2, 29, 7, 3], np.int32)
+        full, _ = m.apply(params, jnp.asarray(ids[None]))
+        full = np.asarray(full)[0]  # (6, vocab)
+
+        cache = m.init_cache(3, 12)
+        # prompt of 4 PADDED to 8, written into slot 1; logits at len-1
+        padded = np.zeros(8, np.int32)
+        padded[:4] = ids[:4]
+        logits, cache = m.prefill(params, cache, 1, jnp.asarray(padded), 4)
+        np.testing.assert_allclose(np.asarray(logits), full[3], atol=1e-5)
+
+        # decode positions 4, 5 in slot 1 while slot 0 carries a DIFFERENT
+        # sequence — rows are independent
+        other = np.array([9, 1, 8], np.int32)
+        ofull, _ = m.apply(params, jnp.asarray(other[None]))
+        pad2 = np.zeros(8, np.int32)
+        pad2[:3] = other
+        olog, cache = m.prefill(params, cache, 0, jnp.asarray(pad2), 3)
+        np.testing.assert_allclose(np.asarray(olog), np.asarray(ofull)[0, 2],
+                                   atol=1e-5)
+        for t in (4, 5):
+            toks = np.zeros(3, np.int32)
+            pos = np.zeros(3, np.int32)
+            toks[1], pos[1] = ids[t], t
+            step_logits, cache = m.decode_step(
+                params, cache, jnp.asarray(toks), jnp.asarray(pos))
+            np.testing.assert_allclose(np.asarray(step_logits)[1], full[t],
+                                       atol=1e-5)

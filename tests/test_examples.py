@@ -173,6 +173,19 @@ def test_maskrcnn_cli_predict_and_evaluate():
     assert 0.0 <= ap <= 1.0
 
 
+def test_continuous_batching_demo_runs():
+    """The generation-serving demo: staggered clients through the router,
+    every request served, and the engine's token accounting adds up."""
+    from bigdl_tpu.examples import continuous_batching_demo
+
+    snap = continuous_batching_demo.main(
+        ["-n", "12", "-c", "4", "-s", "2", "--long", "24"])
+    assert snap["served"] == 12 and snap["rejected_clients"] == 0
+    assert snap["prefills"] == 12 and snap["tokens_out"] > 12
+    assert snap["ttft_ms"] is not None
+    assert snap["continuous_vs_static"] > 0
+
+
 def test_parallel_training_example_runs():
     from bigdl_tpu.examples import parallel_training
 

@@ -268,6 +268,214 @@ def test_serving_demo_example_runs():
     assert snap["served"] == 32 and snap["forwards"] <= 32
 
 
+def test_reload_swaps_params_and_checks_signature(setup):
+    """Hot-reload: new same-signature params serve the very next batch;
+    a structurally different tree is rejected and the old weights keep
+    serving (training-to-serving handoff must be fail-safe)."""
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_wait_ms=1.0)
+    before = np.asarray(svc.predict(x[0], timeout=30))
+
+    params2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0, params)
+    svc.reload(params2)
+    after = np.asarray(svc.predict(x[0], timeout=30))
+    expected, _ = model.apply(params2, x[:1], state=state)
+    np.testing.assert_allclose(after, np.asarray(expected)[0], rtol=1e-5)
+    assert not np.allclose(before, after)
+
+    with pytest.raises(ValueError, match="signature"):
+        svc.reload({"wrong": np.zeros(3, "float32")})
+    with pytest.raises(ValueError, match="signature"):  # dtype change
+        svc.reload(jax.tree_util.tree_map(
+            lambda a: np.asarray(a, "float64"), params2))
+    np.testing.assert_allclose(np.asarray(svc.predict(x[0], timeout=30)),
+                               after, rtol=1e-6)  # old weights still serve
+    assert svc.metrics.snapshot()["reloads"] == 1
+    svc.close()
+
+
+def test_reload_same_shapes_never_recompiles(setup):
+    """Matching signatures hit the already-compiled executable: the jit
+    cache size is identical before and after a reload."""
+    model, params, state, x = setup
+    svc = InferenceService(model, params, state, max_batch_size=4,
+                           max_wait_ms=1.0)
+    svc.warmup(x[0])
+    cache_size = getattr(svc._fwd, "_cache_size", None)
+    if cache_size is None:
+        pytest.skip("jit cache introspection unavailable on this jax")
+    n_compiled = cache_size()
+    svc.reload(jax.tree_util.tree_map(lambda a: np.asarray(a) + 1, params))
+    svc.predict(x[0], timeout=30)
+    assert cache_size() == n_compiled
+    svc.close()
+
+
+def test_reload_never_tears_a_midflight_batch():
+    """The acceptance property: params are two leaves that every reload
+    keeps equal; the forward reports both. Under a reload hammer, every
+    response must see one consistent pair — a torn batch (one new leaf,
+    one old) would surface as a mismatched row — and every submitted
+    request must complete (zero dropped)."""
+    import jax.numpy as jnp
+
+    def forward(params, state, xb):
+        n = jnp.shape(jax.tree_util.tree_leaves(xb)[0])[0]
+        pair = jnp.stack([params["a"], params["b"]])
+        return jnp.broadcast_to(pair, (n, 2))
+
+    params = {"a": np.float32(0.0), "b": np.float32(0.0)}
+    svc = InferenceService(model=None, params=params, state={},
+                           max_batch_size=8, max_wait_ms=0.5,
+                           max_queue=512, forward_fn=forward)
+    stop = threading.Event()
+
+    def hammer():
+        v = 0.0
+        while not stop.is_set():
+            v += 1.0
+            svc.reload({"a": np.float32(v), "b": np.float32(v)})
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        outs = []
+        for i in range(300):
+            outs.append(svc.submit(np.float32(i)))
+        results = [np.asarray(f.result(timeout=30)) for f in outs]
+    finally:
+        stop.set()
+        t.join()
+        svc.close()
+    assert len(results) == 300  # zero dropped
+    for r in results:
+        assert r[0] == r[1], f"torn params observed: {r}"
+    assert svc.metrics.snapshot()["reloads"] > 0
+
+
+def test_watch_checkpoints_reloads_on_new_commit(setup, tmp_path):
+    """Training-to-serving handoff: a CheckpointManager commit appears
+    in MANIFEST.json and the watcher hot-swaps it into the running
+    service without restart; a pre-existing commit is adopted at start
+    (reload_existing) or skipped (baseline-only)."""
+    from bigdl_tpu.ckpt import CheckpointManager
+    from bigdl_tpu.serving import watch_checkpoints
+
+    model, params, state, x = setup
+    ckdir = str(tmp_path / "ck")
+    scaled = jax.tree_util.tree_map(lambda a: np.asarray(a) * 3.0, params)
+    with CheckpointManager(ckdir, fsync=False) as mgr:
+        mgr.save("model.iter1", scaled, state, {},
+                 meta={"iteration": 1}, blocking=True)
+
+        svc = InferenceService(model, params, state, max_wait_ms=1.0)
+        watcher = watch_checkpoints(svc, ckdir, poll_interval=0.02)
+        deadline = time.monotonic() + 10
+        while watcher.reloads < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert watcher.reloads == 1  # existing commit adopted at start
+        expected, _ = model.apply(scaled, x[:1], state=state)
+        np.testing.assert_allclose(
+            np.asarray(svc.predict(x[0], timeout=30)),
+            np.asarray(expected)[0], rtol=1e-5)
+
+        scaled5 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 5.0,
+                                         params)
+        mgr.save("model.iter2", scaled5, state, {},
+                 meta={"iteration": 2}, blocking=True)
+        deadline = time.monotonic() + 10
+        while watcher.reloads < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert watcher.reloads == 2 and watcher.last_entry.step == 2
+        expected5, _ = model.apply(scaled5, x[:1], state=state)
+        np.testing.assert_allclose(
+            np.asarray(svc.predict(x[0], timeout=30)),
+            np.asarray(expected5)[0], rtol=1e-5)
+        watcher.stop(timeout=10)
+
+        # baseline-only mode: the existing tip is NOT reloaded
+        svc2 = InferenceService(model, params, state, max_wait_ms=1.0)
+        with watch_checkpoints(svc2, ckdir, poll_interval=0.02,
+                               reload_existing=False) as w2:
+            time.sleep(0.1)
+            assert w2.reloads == 0
+            assert w2.last_entry.tag == "model.iter2"
+        svc2.close()
+        svc.close()
+
+
+def test_watch_checkpoints_skips_unloadable_tip_until_new_commit(setup,
+                                                                 tmp_path):
+    """A committed checkpoint that cannot be hot-swapped (different
+    model config -> signature mismatch) is tried ONCE, memoized, and
+    skipped on every later poll — no per-poll blob re-read — and the
+    next good commit recovers the watcher."""
+    from bigdl_tpu.ckpt import CheckpointManager
+    from bigdl_tpu.serving import watch_checkpoints
+
+    model, params, state, x = setup
+    ckdir = str(tmp_path / "ck")
+    with CheckpointManager(ckdir, fsync=False) as mgr:
+        # a structurally different tree: reload must reject it
+        mgr.save("model.iter1", {"alien": np.zeros((3, 3), "float32")},
+                 {}, {}, meta={"iteration": 1}, blocking=True)
+        svc = InferenceService(model, params, state, max_wait_ms=1.0)
+        watcher = watch_checkpoints(svc, ckdir, poll_interval=0.01)
+        deadline = time.monotonic() + 10
+        while watcher.last_error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert isinstance(watcher.last_error, ValueError)
+        assert watcher.reloads == 0
+        time.sleep(0.05)  # several polls: the bad tip must stay memoized
+        assert watcher._skip_tag == "model.iter1"
+        assert svc.metrics.snapshot()["reloads"] == 0
+
+        good = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0, params)
+        mgr.save("model.iter2", good, state, {},
+                 meta={"iteration": 2}, blocking=True)
+        deadline = time.monotonic() + 10
+        while watcher.reloads < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert watcher.reloads == 1 and watcher.last_error is None
+        watcher.stop(timeout=10)
+        svc.close()
+
+
+def test_metrics_table_extends_without_reordering():
+    """The PR-1 golden contract: a service with no generation traffic
+    renders EXACTLY the old rows in the old order; token-level rows are
+    appended strictly after them once generation counters move."""
+    m = ServingMetrics()
+    m.record_batch(3, 4)
+    m.record_served(0.010, 0.004)
+    m.record_rejected()
+    base_lines = m.format_table().splitlines()
+    labels = [ln.split()[0] for ln in base_lines]
+    assert labels == [
+        "metric", "served", "rejected", "expired", "failed", "forwards",
+        "queue_depth", "mean_batch_size", "padding_waste",
+        "batch_size_dist", "latency_p50(ms)", "latency_p95(ms)",
+        "latency_p99(ms)", "queue_wait_p50(ms)", "queue_wait_p95(ms)",
+        "queue_wait_p99(ms)",
+    ]
+    # generation traffic appends, never reorders or edits, the base rows
+    m.record_prefill(5, 8, 0.002)
+    m.record_decode_step(3, 4)
+    m.record_stream(12, 0.1)
+    m.record_reload()
+    full_lines = m.format_table().splitlines()
+    assert full_lines[:len(base_lines)] == base_lines
+    extra = [ln.split()[0] for ln in full_lines[len(base_lines):]]
+    assert extra == ["tokens_out", "prefills", "decode_steps",
+                     "slot_occupancy", "prompt_padding_waste",
+                     "ttft_p50(ms)", "ttft_p95(ms)", "ttft_p99(ms)",
+                     "stream_tokens/s_p50", "reloads"]
+    snap = m.snapshot()
+    assert snap["tokens_out"] == 4 and snap["prefills"] == 1
+    assert snap["slot_occupancy"] == 0.75
+    assert snap["prompt_padding_waste"] == pytest.approx(3 / 8)
+
+
 def test_unclosed_service_is_garbage_collectable(setup):
     """An InferenceService whose owner forgot close() must not leak: the
     worker holds only a weak ref while idle and the jitted forward closes
