@@ -245,14 +245,31 @@ def run_generation_bench(args):
     is scheduling (slot occupancy), not parallelism, so the >= 1.5x
     ``--smoke`` gate holds even on a 1-core runner. Tokens/sec counts
     generated tokens only (prompt prefill tokens are reported
-    separately via the metrics snapshot)."""
+    separately via the metrics snapshot).
+
+    PR 6: both schedulers run over the PAGED + sampling kernels
+    (``PagedDecodeKernels`` — block-table KV cache, in-step sampling,
+    chunked prefill). New columns: the CAPACITY comparison — at the
+    KV-byte budget of ``slots`` dense lanes, how many concurrent
+    sequences of a 4:1 short:long mix the page pool admits (measured by
+    replaying admission through the real ``PagePool``; smoke gate
+    >= 2x) — and ``--sample``, which runs the whole workload with
+    temperature/top-k/top-p per request. Sampled streams derive their
+    seed from the request, so continuous and static MUST still produce
+    identical tokens (the mismatch gate covers sampling too)."""
     from bigdl_tpu.nn.layers.attention import Transformer
-    from bigdl_tpu.serving import DecodeKernels, GenerationEngine, static_generate
+    from bigdl_tpu.serving import (
+        GenerationEngine,
+        PagePool,
+        PagedDecodeKernels,
+        static_generate,
+    )
 
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
     smoke = args.smoke
     slots = args.serve_slots
+    page_size = args.page_size
     # smoke/CPU: a model small enough to compile in seconds but large
     # enough that the jitted step dwarfs the loop's Python bookkeeping
     if on_tpu:
@@ -265,7 +282,7 @@ def run_generation_bench(args):
         max_len, short_new, long_new = 104, 3, 72
     max_prompt = 16
     params, _ = model.init(jax.random.key(0))
-    kernels = DecodeKernels(model)
+    kernels = PagedDecodeKernels(model)
 
     rs = np.random.RandomState(0)
     n_requests = args.requests or 4 * slots
@@ -280,16 +297,19 @@ def run_generation_bench(args):
         # gate keeps a wide margin against scheduler jitter on shared
         # CI runners (a 50/50 mix measured 1.44-1.62x — too close)
         requests.append((prompt, long_new if i % 4 == 3 else short_new))
+    sample_spec = (dict(temperature=0.8, top_k=40, top_p=0.95)
+                   if args.sample else {})
 
     engine = GenerationEngine(
         model, params, max_slots=slots, max_len=max_len,
         max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
-        kernels=kernels)
+        kernels=kernels, page_size=page_size, seed=0)
     engine.warmup()
 
     # continuous: submit everything, the engine packs slots between steps
     t0 = time.perf_counter()
-    streams = [engine.submit(p, max_new_tokens=m) for p, m in requests]
+    streams = [engine.submit(p, max_new_tokens=m, **sample_spec)
+               for p, m in requests]
     outs = [s.result(timeout=600) for s in streams]
     cont_wall = time.perf_counter() - t0
     cont_tokens = sum(len(o) for o in outs)
@@ -302,9 +322,31 @@ def run_generation_bench(args):
     t0 = time.perf_counter()
     souts, static_steps = static_generate(
         model, params, requests, max_slots=slots, max_len=max_len,
-        kernels=kernels, prompt_buckets=engine.prompt_buckets)
+        kernels=kernels, prompt_buckets=engine.prompt_buckets,
+        page_size=page_size, seed=0,
+        sampling=[sample_spec] * n_requests if args.sample else None)
     static_wall = time.perf_counter() - t0
     static_tokens = sum(len(o) for o in souts)
+
+    # capacity column: at the KV-byte budget of `slots` DENSE lanes, how
+    # many concurrent sequences of a 4:1 short:long mix does the page
+    # pool admit? Replayed through the real allocator (full reservation
+    # at admission, exactly what the engine commits to).
+    from bigdl_tpu.serving.paging import pages_per_lane
+
+    budget_pages = slots * pages_per_lane(max_len, page_size)  # dense budget
+    pool = PagePool(budget_pages, page_size, max_len)
+    cap_rs = np.random.RandomState(1)
+    capacity_paged = 0
+    while True:
+        plen = int(cap_rs.randint(3, max_prompt + 1))
+        new = long_new if capacity_paged % 5 == 4 else short_new
+        need = pool.pages_for(min(plen + new - 1, max_len))
+        if not pool.can_reserve(need):
+            break
+        pool.alloc(need)
+        capacity_paged += 1
+    capacity_ratio = capacity_paged / slots
 
     # greedy decode is deterministic: both schedulers must produce the
     # SAME tokens — a throughput number from divergent outputs is bogus
@@ -330,6 +372,15 @@ def run_generation_bench(args):
         "slots": slots,
         "max_len": max_len,
         "output_mismatches": mismatches,
+        "page_size": page_size,
+        "pages_total": snap["pages_total"],
+        "pages_peak": snap["pages_peak"],
+        "prefill_chunks": snap["prefill_chunks"],
+        "sampled": bool(args.sample),
+        "sampled_tokens": snap["sampled_tokens"],
+        "capacity_dense_slots": slots,
+        "capacity_paged_seqs": capacity_paged,
+        "capacity_paged_vs_dense": round(capacity_ratio, 3),
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -347,13 +398,20 @@ def run_generation_bench(args):
             raise SystemExit(
                 f"generation smoke: {mismatches} request(s) decoded "
                 "different tokens under continuous vs static scheduling — "
-                "greedy decode must be schedule-invariant")
+                "decode (greedy AND seeded sampling) must be "
+                "schedule-invariant")
         if result["continuous_vs_static"] < 1.5:
             raise SystemExit(
                 "generation smoke: continuous batching %.2fx static "
                 "(gate: >= 1.5x on mixed lengths — the scheduling win "
                 "should not depend on core count)"
                 % result["continuous_vs_static"])
+        if result["capacity_paged_vs_dense"] < 2.0:
+            raise SystemExit(
+                "generation smoke: paged KV admits only %.2fx the dense "
+                "concurrent sequences at a fixed KV-byte budget (gate: "
+                ">= 2x on the 4:1 short:long mix)"
+                % result["capacity_paged_vs_dense"])
 
 
 def run_checkpoint_bench(args):
@@ -761,6 +819,15 @@ def _parse_args(argv=None):
                          "on a mixed-length workload")
     ap.add_argument("--serve-slots", type=int, default=8,
                     help="serving --generate: engine slot-table size")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="serving --generate: KV-cache page size (tokens "
+                         "per page in the paged block-table pool)")
+    ap.add_argument("--sample", action="store_true",
+                    help="serving --generate: sample (temperature 0.8, "
+                         "top-k 40, top-p 0.95) instead of greedy — runs "
+                         "inside the jitted step; seeded per request, so "
+                         "the continuous-vs-static mismatch gate still "
+                         "applies")
     ap.add_argument("--ckpt-iters", type=int, default=20,
                     help="checkpoint: timed steps per loop")
     ap.add_argument("--ckpt-save-every", type=int, default=5,
@@ -776,7 +843,9 @@ def _parse_args(argv=None):
                          "unless the JSON parses and end-to-end >= 0.8x "
                          "the achievable stage bound; serving --generate: "
                          "exits nonzero unless continuous batching >= 1.5x "
-                         "static tokens/sec (the CI gates)")
+                         "static tokens/sec AND paged KV admits >= 2x the "
+                         "dense concurrent sequences at a fixed KV budget "
+                         "(the CI gates)")
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--short", type=int, default=4)
     ap.add_argument("--long", type=int, default=20)

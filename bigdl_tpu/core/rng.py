@@ -47,6 +47,31 @@ def element_seed(base_seed: int, index: int, stream: int = 0) -> int:
     return x >> 1  # non-negative, < 2**63
 
 
+def threefry_key_data(seed: int) -> np.ndarray:
+    """Raw ``(2,)`` uint32 threefry key words for ``seed`` — the host-side
+    equivalent of ``jax.random.PRNGKey(seed)`` without a device dispatch.
+    The serving tier keeps one such key PER SLOT in a ``(max_slots, 2)``
+    array that rides through the jitted decode step (split + uniform draw
+    inside the step), so sampling costs no extra host<->device round trip
+    and each request's stream is a pure function of its seed."""
+    seed = int(seed)
+    return np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+                    np.uint32)
+
+
+def request_seed(base_seed: int, payload: bytes, stream: int = 0) -> int:
+    """Deterministic per-request seed from an engine-level ``base_seed``
+    and the request's identifying bytes (e.g. its prompt token ids).
+    Built on :func:`element_seed` with a crc32 of the payload as the
+    element index, so the derived sampling stream depends only on the
+    request CONTENT — never on admission order, slot assignment, or
+    wall-clock — which is what makes sampled generation reproducible
+    across schedulers and submission orderings. Two byte-identical
+    requests share a stream; pass an explicit per-request seed when they
+    must diverge."""
+    return element_seed(base_seed, zlib.crc32(payload), stream)
+
+
 class RandomGenerator:
     """Stateful convenience wrapper over a splittable key.
 

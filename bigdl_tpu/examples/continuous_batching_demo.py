@@ -34,7 +34,7 @@ def build_lm(vocab_size: int = 128):
 
 def main(argv=None):
     from bigdl_tpu.serving import (
-        DecodeKernels, GenerationEngine, ModelRouter, Overloaded,
+        GenerationEngine, ModelRouter, Overloaded, PagedDecodeKernels,
         static_generate,
     )
 
@@ -51,12 +51,17 @@ def main(argv=None):
                     help="short requests' max_new_tokens")
     ap.add_argument("--long", type=int, default=48,
                     help="long requests' max_new_tokens")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature (0 = greedy; sampling runs "
+                         "inside the jitted step, seeded per request)")
     args = ap.parse_args(argv)
 
     vocab = 128
     model = build_lm(vocab)
     params, _ = model.init(jax.random.key(0))
-    kernels = DecodeKernels(model)
+    # paged kernels (PR 6): block-table KV cache + in-step sampling —
+    # KV memory scales with each request's token budget, not max_len
+    kernels = PagedDecodeKernels(model)
 
     rs = np.random.RandomState(0)
     requests = []
@@ -87,7 +92,8 @@ def main(argv=None):
         for i in range(cid, args.requests, args.concurrency):
             prompt, mnt = requests[i]
             try:
-                streams[i] = router.submit("lm", prompt, max_new_tokens=mnt)
+                streams[i] = router.submit("lm", prompt, max_new_tokens=mnt,
+                                           temperature=args.temperature)
             except Overloaded:
                 rejected[cid] += 1
         for i, stream in streams.items():
@@ -111,7 +117,9 @@ def main(argv=None):
     t0 = time.monotonic()
     souts, static_steps = static_generate(
         model, params, requests, max_slots=args.slots, max_len=args.max_len,
-        kernels=kernels, prompt_buckets=engine.prompt_buckets)
+        kernels=kernels, prompt_buckets=engine.prompt_buckets,
+        sampling=[dict(temperature=args.temperature)] * args.requests
+        if args.temperature > 0 else None)
     static_wall = time.monotonic() - t0
     static_tokens = sum(len(o) for o in souts)
 

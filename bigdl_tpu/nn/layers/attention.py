@@ -34,7 +34,9 @@ from bigdl_tpu.nn.module import Context, Module
 from bigdl_tpu.ops.attention import (
     attention_bias_from_padding,
     dot_product_attention,
+    paged_attention,
 )
+from bigdl_tpu.ops.flash_attention import gather_kv_lanes
 
 
 def position_encoding(length: int, hidden_size: int, dtype=jnp.float32) -> jax.Array:
@@ -82,7 +84,8 @@ class Attention(Module):
         return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
     def forward(self, ctx: Context, x, bias: Optional[jax.Array] = None,
-                causal: bool = False, cache=None, cache_index=None):
+                causal: bool = False, cache=None, cache_index=None,
+                paged=None, write_len=None):
         if isinstance(x, (tuple, list)):
             x, y = x
         else:
@@ -92,6 +95,70 @@ class Attention(Module):
         v = self._split_heads(self.run_child(ctx, "v_layer", y))
 
         new_cache = None
+        if paged is not None:
+            # Block-table KV cache (vLLM-style): `paged` is a dict with
+            # pools "k"/"v" of shape (num_pages, H, page_size, D) and
+            # "map", the int32 physical-page ids. New K/V rows are
+            # SCATTERED into the pools, then attention runs over the
+            # gathered logical lanes — the same op sequence as the dense
+            # slot-table path below, so outputs are bit-identical to it
+            # (test-enforced); on TPU the decode step instead streams
+            # pages through the Pallas gather kernel ("use_kernel").
+            pk, pv = paged["k"], paged["v"]
+            page_size = pk.shape[2]
+            if getattr(cache_index, "ndim", 0) == 1:
+                # decode: one token per slot; map is (S, ppn)
+                page_map = paged["map"]
+                pos = cache_index
+                pg = jnp.take_along_axis(
+                    page_map, (pos // page_size)[:, None], axis=1)[:, 0]
+                row = pos % page_size
+                pk = pk.at[pg, :, row].set(k[:, :, 0, :].astype(pk.dtype))
+                pv = pv.at[pg, :, row].set(v[:, :, 0, :].astype(pv.dtype))
+                if bias is not None:
+                    # positions fully define the mask in a paged decode
+                    # step; no caller passes one (keep the contract
+                    # narrow instead of carrying an untested mask-
+                    # composition path)
+                    raise ValueError(
+                        "paged decode attention takes no external bias")
+                out3 = paged_attention(
+                    q[:, :, 0, :], pk, pv, page_map, pos,
+                    use_kernel=paged.get("use_kernel"))
+                out = out3[:, :, None, :]
+            else:
+                # prefill chunk: q rows are positions idx..idx+C-1 of ONE
+                # sequence whose page ids are the (ppn,) "map" row. Rows
+                # past `write_len` are bucket padding: their K/V is
+                # routed to the "trash" page so pad garbage can never
+                # land in a page another slot owns (the dense path writes
+                # pad rows into its own private lane; a shared pool has
+                # no private rows to waste).
+                pages_row = paged["map"]
+                ppn = pages_row.shape[0]
+                idx = cache_index if cache_index is not None else 0
+                n_chunk = q.shape[2]
+                t = jnp.arange(n_chunk)
+                pos = idx + t
+                valid = t < (n_chunk if write_len is None else write_len)
+                pg = jnp.where(
+                    valid,
+                    pages_row[jnp.clip(pos // page_size, 0, ppn - 1)],
+                    paged["trash"])
+                row = pos % page_size
+                pk = pk.at[pg, :, row].set(
+                    k[0].transpose(1, 0, 2).astype(pk.dtype))
+                pv = pv.at[pg, :, row].set(
+                    v[0].transpose(1, 0, 2).astype(pv.dtype))
+                lk = gather_kv_lanes(pk, pages_row)[None]
+                lv = gather_kv_lanes(pv, pages_row)[None]
+                rows = idx + t[:, None]
+                cols = jnp.arange(lk.shape[2])[None, :]
+                validity = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
+                out = dot_product_attention(
+                    q, lk, lv, validity if bias is None else bias + validity)
+            out = self.run_child(ctx, "output_layer", self._join_heads(out))
+            return out, (pk, pv)
         if cache is not None:
             ck, cv = cache
             idx = cache_index if cache_index is not None else 0
@@ -202,10 +269,12 @@ class TransformerLayer(Module):
             hidden_size, residual_dropout)
 
     def forward(self, ctx: Context, x, bias=None, causal=False,
-                encoder_output=None, encoder_bias=None, cache=None, cache_index=None):
+                encoder_output=None, encoder_bias=None, cache=None,
+                cache_index=None, paged=None, write_len=None):
         out = self.self_attention.forward(
             ctx.child("self_attention"), x,
-            bias=bias, causal=causal, cache=cache, cache_index=cache_index)
+            bias=bias, causal=causal, cache=cache, cache_index=cache_index,
+            paged=paged, write_len=write_len)
         new_cache = None
         if isinstance(out, tuple):
             out, new_cache = out
@@ -335,6 +404,90 @@ class Transformer(Module):
         last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
                                             keepdims=False)
         return last[0], new_cache
+
+    # ------------------------------------------------- paged decoding ----
+    # Block-table variant of the slot-table API above (vLLM-style paged
+    # KV): the cache is a shared pool of fixed-size pages per layer and
+    # each sequence owns a row of int32 page ids, so KV memory scales
+    # with ACTUAL token counts instead of max_slots x max_len. The
+    # logical-lane view a page map reconstitutes is bit-identical to a
+    # dense lane, so these produce the same logits as prefill/decode_step
+    # (test-enforced). Prefill takes a `start` offset: long prompts run
+    # as a sequence of chunks interleaved with decode steps (chunked
+    # prefill), each chunk attending to the already-cached prefix.
+
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         dtype=jnp.float32):
+        """Zeroed per-layer KV page pools ``{layer: (K, V)}`` with K/V of
+        shape ``(num_pages, num_heads, page_size, head_dim)``. Page ids
+        are the caller's to manage (the serving tier's ``PagePool``
+        reserves one physical page as the trash page for masked
+        writes)."""
+        if self.transformer_type != LANGUAGE_MODEL:
+            raise ValueError("incremental decoding needs a language_model "
+                             "transformer (decoder-only)")
+        head_dim = self.hidden_size // self.num_heads
+        shape = (num_pages, self.num_heads, page_size, head_dim)
+        return {name: (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for name in self._decoder_names()}
+
+    def prefill_paged(self, params, cache, pages_row, tokens, start,
+                      length, trash, need_logits: bool = True):
+        """Run one prompt chunk ``tokens`` (C,) through the decoder at
+        positions ``start .. start+C-1`` of the sequence whose physical
+        page ids are ``pages_row`` (ppn,). ``length`` is the number of
+        REAL tokens in the chunk (the rest is bucket padding, routed to
+        the ``trash`` page); with ``need_logits`` (the FINAL chunk)
+        returns ``(next-token logits (vocab,), new_cache)`` read at chunk
+        row ``length - 1``, otherwise just ``new_cache``."""
+        ctx = Context(params, {}, False, None)
+        n_chunk = tokens.shape[0]
+        emb = ctx.param("embedding")
+        x = emb[tokens][None] * (self.hidden_size ** 0.5)
+        page_size = jax.tree_util.tree_leaves(cache)[0].shape[2]
+        max_len = pages_row.shape[0] * page_size
+        pe = position_encoding(max_len, self.hidden_size, x.dtype)
+        x = x + pe[jnp.clip(start + jnp.arange(n_chunk), 0, max_len - 1)][None]
+        x = self.run_child(ctx, "embed_drop", x)
+        new_cache = dict(cache)
+        for name in self._decoder_names():
+            pk, pv = cache[name]
+            x, new_cache[name] = self._modules[name].forward(
+                ctx.child(name), x, cache_index=start,
+                paged={"k": pk, "v": pv, "map": pages_row, "trash": trash},
+                write_len=length)
+        if not need_logits:
+            return new_cache
+        h = self.run_child(ctx, "final_norm", x)
+        logits = self._logits(ctx, h)
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                            keepdims=False)
+        return last[0], new_cache
+
+    def decode_step_paged(self, params, cache, tokens, positions, page_map,
+                          use_kernel: Optional[bool] = None):
+        """One decode step for every slot over the paged pools:
+        ``tokens``/``positions`` as in :meth:`decode_step`, ``page_map``
+        (S, ppn) int32 physical pages per slot. Returns
+        ``(logits (S, vocab), new_cache)``; ``use_kernel`` routes the
+        attention through the Pallas paged kernel (TPU) instead of the
+        jnp gather reference."""
+        ctx = Context(params, {}, False, None)
+        emb = ctx.param("embedding")
+        x = emb[tokens][:, None, :] * (self.hidden_size ** 0.5)
+        page_size = jax.tree_util.tree_leaves(cache)[0].shape[2]
+        max_len = page_map.shape[1] * page_size
+        pe = position_encoding(max_len, self.hidden_size, x.dtype)
+        x = x + pe[positions][:, None, :]
+        new_cache = dict(cache)
+        for name in self._decoder_names():
+            pk, pv = cache[name]
+            x, new_cache[name] = self._modules[name].forward(
+                ctx.child(name), x, cache_index=positions,
+                paged={"k": pk, "v": pv, "map": page_map,
+                       "use_kernel": use_kernel})
+        x = self.run_child(ctx, "final_norm", x)
+        return self._logits(ctx, x)[:, 0, :], new_cache
 
     def decode_step(self, params, cache, tokens, positions):
         """One decode step for EVERY slot at once: ``tokens`` (S,) are each

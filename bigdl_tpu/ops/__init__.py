@@ -7,8 +7,18 @@ hand-written Pallas kernels (flash attention); everything else is plain
 jax.numpy and relies on XLA fusion (SURVEY.md §7 design translation table).
 """
 
-from bigdl_tpu.ops.attention import dot_product_attention, attention_bias_from_padding, causal_bias
-from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops.attention import (
+    dot_product_attention,
+    attention_bias_from_padding,
+    causal_bias,
+    paged_attention,
+)
+from bigdl_tpu.ops.flash_attention import (
+    flash_attention,
+    gather_kv_lanes,
+    paged_flash_attention,
+)
+from bigdl_tpu.ops.sampling import numpy_reference_sample, sample_tokens
 from bigdl_tpu.ops import tf_ops
 from bigdl_tpu.ops import control_flow
 from bigdl_tpu.ops.tf_ops import *  # noqa: F401,F403 (tf_ops defines __all__)
@@ -26,6 +36,11 @@ __all__ = [
     "attention_bias_from_padding",
     "causal_bias",
     "flash_attention",
+    "gather_kv_lanes",
+    "numpy_reference_sample",
+    "paged_attention",
+    "paged_flash_attention",
+    "sample_tokens",
     "tf_ops",
     "control_flow",
     "AssignTo",

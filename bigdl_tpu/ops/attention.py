@@ -46,6 +46,39 @@ def _flash_ok(q, k) -> bool:
     return sq % bq == 0 and sk % bk == 0
 
 
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_map: jax.Array,
+    positions: jax.Array,
+    *,
+    sm_scale: Optional[float] = None,
+    use_kernel: Optional[bool] = None,
+) -> jax.Array:
+    """Decode-step attention over a paged (block-table) KV cache.
+
+    ``q``: (S, H, D) one query per slot; ``k_pages``/``v_pages``:
+    (num_pages, H, page_size, D) shared pools; ``page_map``: (S, ppn)
+    int32; ``positions``: (S,) — key column ``j`` valid iff
+    ``j <= positions[s]``. ``use_kernel=None`` auto-selects the Pallas
+    scalar-prefetch kernel on TPU and the pure-jnp gather reference
+    elsewhere; the reference path is bit-identical to dense slot-table
+    attention on the same backend (test-enforced), which is what lets
+    the serving tier swap lanes for pages without changing one token.
+    """
+    platform = jax.devices()[0].platform
+    if use_kernel is None:
+        use_kernel = platform == "tpu" and q.shape[-1] <= 256
+    if use_kernel:
+        return _fa.paged_flash_attention(
+            q, k_pages, v_pages, page_map, positions, sm_scale,
+            interpret=(platform != "tpu"),
+        )
+    return _fa.paged_attention_reference(
+        q, k_pages, v_pages, page_map, positions, sm_scale)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,

@@ -156,6 +156,148 @@ def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
     return out.reshape(b, h, sq, d)
 
 
+# --------------------------------------------------------------------- #
+# Paged (block-table) attention — the decode-side companion of the flash
+# kernel. The serving tier's KV cache is a shared pool of fixed-size
+# pages (vLLM's PagedAttention, SOSP '23 — PAPERS.md): per layer,
+# K/V are (num_pages, heads, page_size, head_dim) and each slot owns a
+# row of int32 page ids. Attention must therefore GATHER a slot's keys
+# through its page map instead of slicing a dense lane. Two paths:
+#
+# - `paged_attention_reference`: pure-jnp `jnp.take` gather that
+#   reconstitutes the logical (S, H, L, D) lanes and reuses the exact
+#   dense attention ops — bit-identical to the dense slot-table path on
+#   the same backend (gathering is data movement; the math that follows
+#   is the same op sequence). This is the CPU/tier-1 path.
+# - `paged_flash_attention`: a Pallas TPU kernel streaming pages through
+#   VMEM with the page map scalar-prefetched, so the physical page id
+#   feeds the K/V BlockSpec index_map directly (no materialised gather)
+#   and pages wholly past a slot's position are skipped.
+
+
+def gather_kv_lanes(pages: jax.Array, page_map: jax.Array) -> jax.Array:
+    """(num_pages, H, page_size, D) pool + (..., ppn) int32 page map ->
+    logical lanes (..., H, ppn * page_size, D). The gather is exact data
+    movement: lane bytes equal the pooled page bytes, which is what the
+    paged == dense bit-identity tests lean on."""
+    h, ps, d = pages.shape[1:]
+    lanes = jnp.take(pages, page_map, axis=0)  # (..., ppn, H, ps, D)
+    perm = tuple(range(page_map.ndim - 1)) + (
+        page_map.ndim, page_map.ndim - 1, page_map.ndim + 1,
+        page_map.ndim + 2)
+    lanes = lanes.transpose(perm)              # (..., H, ppn, ps, D)
+    return lanes.reshape(page_map.shape[:-1] + (h, -1, d))
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_map, positions,
+                              sm_scale: Optional[float] = None):
+    """Decode-shaped paged attention, pure jnp (the XLA/tier-1 path).
+
+    ``q``: (S, H, D) one query per slot; ``k_pages``/``v_pages``:
+    (num_pages, H, page_size, D); ``page_map``: (S, ppn) int32 physical
+    page per logical page; ``positions``: (S,) int32 — key column ``j``
+    is valid for slot ``s`` iff ``j <= positions[s]`` (the row the
+    current token was just written to). Returns (S, H, D)."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    lk = gather_kv_lanes(k_pages, page_map)    # (S, H, L, D)
+    lv = gather_kv_lanes(v_pages, page_map)
+    length = lk.shape[2]
+    rows = positions[:, None]                  # one query row per slot
+    cols = jnp.arange(length)
+    validity = jnp.where(cols[None, None, :] <= rows[:, :, None],
+                         0.0, -1e9)[:, None, :, :]
+    out = _xla_attention(q[:, :, None, :], lk, lv, validity, scale, False)
+    return out[:, :, 0, :]
+
+
+def _paged_kernel(pm_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale, page_size, n_pages):
+    s = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[s]
+
+    @pl.when(pi * page_size <= pos)            # page holds >= 1 valid col
+    def _compute():
+        q = q_ref[...].reshape(1, -1).astype(jnp.float32)    # (1, D)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (ps, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                         # (1, ps)
+        cols = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(cols <= pos, scores, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(scores - m_next)
+        l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(pi == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / l).reshape(o_ref.shape).astype(
+            o_ref.dtype)
+
+
+def paged_flash_attention(q, k_pages, v_pages, page_map, positions,
+                          sm_scale: Optional[float] = None,
+                          interpret: bool = False):
+    """Pallas paged gather-attention: online-softmax over a slot's mapped
+    pages, page ids scalar-prefetched so each K/V block DMA reads the
+    physical page directly. Same signature/semantics as
+    :func:`paged_attention_reference` (q: (S, H, D) -> (S, H, D))."""
+    n_slots, heads, d = q.shape
+    n_phys, _, page_size, _ = k_pages.shape
+    ppn = page_map.shape[1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_slots, heads, ppn),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda s, h, p, pm, pos: (s, h, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda s, h, p, pm, pos: (s, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, _MIN_LANE), jnp.float32),
+            pltpu.VMEM((1, _MIN_LANE), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=scale, page_size=page_size, n_pages=ppn)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_slots, heads, d), q.dtype),
+        interpret=interpret,
+    )(page_map.astype(jnp.int32), positions.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
                     causal: bool = False, block_q: int = 128,

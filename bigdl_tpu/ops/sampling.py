@@ -1,0 +1,141 @@
+"""Token sampling INSIDE the jitted decode step.
+
+The generation engine's PR-5 decode step did greedy argmax on-device so
+only an int32 token vector crossed to the host per iteration. Real
+serving needs temperature / top-k / top-p — but hoisting logits to the
+host for sampling would move a ``(max_slots, vocab)`` float tensor per
+step and put numpy on the critical path. Instead the whole sampler runs
+in-step: per-request parameters are batched as ``(max_slots,)`` arrays
+(so they are TRACED values — changing them never recompiles), and each
+slot carries its own raw threefry key, split once per step inside the
+jit. A slot's stream is therefore a pure function of its request seed:
+the same request produces the same tokens whatever slot it lands in,
+whenever it is admitted, and under any scheduler — the sampled analogue
+of greedy decode's schedule invariance, which the engine tests enforce.
+
+Sampling is inverse-CDF over the sorted nucleus (not Gumbel-max): one
+uniform draw per slot per step, so :func:`numpy_reference_sample` can
+replay a step exactly from ``(logits, params, u)`` — the per-step parity
+oracle the tests run against the jitted path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sample_tokens(logits, temperature, top_k, top_p,
+                  key_data) -> Tuple[jax.Array, jax.Array]:
+    """Sample one token per slot from ``logits`` — jit-friendly, all
+    per-slot parameters dynamic.
+
+    - ``logits``: ``(S, V)`` float.
+    - ``temperature``: ``(S,)`` float32; ``<= 0`` means GREEDY for that
+      slot (bitwise the PR-5 ``argmax`` path — the engine's default).
+    - ``top_k``: ``(S,)`` int32; ``<= 0`` disables the top-k filter.
+    - ``top_p``: ``(S,)`` float32; ``>= 1`` (or ``<= 0``) disables the
+      nucleus filter. The kept set is the smallest prefix of the sorted
+      distribution whose exclusive cumulative probability is ``< p``
+      (the first token is always kept).
+    - ``key_data``: ``(S, 2)`` uint32 raw threefry key words, one stream
+      per slot (see ``core.rng.threefry_key_data``).
+
+    Returns ``(tokens (S,) int32, new_key_data (S, 2) uint32)``. Exactly
+    ONE split is consumed per slot per call — token ``i`` of a stream
+    always draws from split ``i`` of its request key, which is what makes
+    sampled output schedule-invariant. Greedy slots burn their split too
+    (cheaper than a gather around it, and it keeps the key state's
+    evolution independent of the mix of sampling params in the batch).
+    """
+    logits = logits.astype(jnp.float32)
+    n, vocab = logits.shape
+    temperature = temperature.astype(jnp.float32)
+
+    # key evolution is UNCONDITIONAL (cheap, O(S)): both the sampled and
+    # the all-greedy branch below advance every slot's stream by exactly
+    # one split per call, so the mix of sampling params in the batch can
+    # never desynchronise a request's stream
+    pairs = jax.vmap(jax.random.split)(key_data)          # (S, 2, 2)
+    new_keys = pairs[:, 0]
+    u = jax.vmap(lambda k: jax.random.uniform(k, (), jnp.float32))(
+        pairs[:, 1])                                      # (S,) in [0, 1)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        t_safe = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+        scaled = logits / t_safe
+        order = jnp.argsort(-scaled, axis=-1)             # stable, desc
+        sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+
+        ranks = jnp.arange(vocab)[None, :]
+        k_eff = jnp.where(top_k <= 0, vocab,
+                          jnp.clip(top_k, 1, vocab))[:, None]
+        p_eff = jnp.where((top_p <= 0.0) | (top_p >= 1.0), 1.0,
+                          top_p.astype(jnp.float32))[:, None]
+        keep = (ranks < k_eff) & (((csum - probs) < p_eff) | (ranks == 0))
+
+        w = jnp.where(keep, probs, 0.0)
+        wsum = jnp.cumsum(w, axis=-1)
+        total = wsum[:, -1:]
+        # smallest rank whose inclusive kept-mass exceeds u * total. Both
+        # top-k and top-p keep a PREFIX of the sorted ranks, so clamping
+        # to the kept count keeps the pick inside the nucleus even when
+        # the f32 product u * total rounds up to the full mass (u near
+        # 1): without it, that ~2^-24 edge would return the
+        # least-probable token in the whole vocabulary, ignoring the
+        # filters.
+        idx = jnp.sum(wsum <= u[:, None] * total, axis=-1)
+        idx = jnp.clip(idx, 0, jnp.sum(keep, axis=-1) - 1)
+        sampled = jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0]
+        return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+    # the engine's default is all-greedy; the temperatures are traced
+    # values, so without the cond XLA would run the O(S * V log V)
+    # sort/softmax/cumsum machinery every step just to discard it at the
+    # where() — lax.cond skips it whenever no slot is actually sampling
+    toks = jax.lax.cond(jnp.any(temperature > 0.0), _sampled,
+                        lambda _: greedy, None)
+    return toks, new_keys
+
+
+def split_key_data(key_data: np.ndarray):
+    """Host-side replay of the per-step key evolution: returns
+    ``(new_key_data, u)`` exactly as one :func:`sample_tokens` call
+    advances a single slot's ``(2,)`` key and draws its uniform."""
+    pair = jax.random.split(jnp.asarray(key_data, jnp.uint32))
+    u = float(jax.random.uniform(pair[1], (), jnp.float32))
+    return np.asarray(pair[0]), u
+
+
+def numpy_reference_sample(logits, temperature, top_k, top_p, u) -> int:
+    """Pure-numpy single-slot oracle for one :func:`sample_tokens` step,
+    given the SAME uniform draw ``u`` (replay it with
+    :func:`split_key_data`). The tests assert the jitted sampler picks
+    the identical token id per step at fixed seed."""
+    logits = np.asarray(logits, np.float32)
+    vocab = logits.shape[-1]
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    scaled = (logits / np.float32(temperature)).astype(np.float32)
+    order = np.argsort(-scaled, kind="stable")
+    sorted_logits = scaled[order]
+    e = np.exp((sorted_logits - sorted_logits.max()).astype(np.float32))
+    probs = (e / e.sum()).astype(np.float32)
+    csum = np.cumsum(probs, dtype=np.float32)
+    ranks = np.arange(vocab)
+    k_eff = vocab if top_k <= 0 else min(max(int(top_k), 1), vocab)
+    p_eff = 1.0 if (top_p <= 0.0 or top_p >= 1.0) else np.float32(top_p)
+    keep = (ranks < k_eff) & (((csum - probs) < p_eff) | (ranks == 0))
+    w = np.where(keep, probs, np.float32(0.0))
+    wsum = np.cumsum(w, dtype=np.float32)
+    total = wsum[-1]
+    idx = int(np.sum(wsum <= np.float32(u) * total))
+    # keep is a prefix of the sorted ranks: clamp inside it (see the
+    # jitted sampler for the u-near-1 rounding edge this guards)
+    return int(order[min(idx, int(np.sum(keep)) - 1)])

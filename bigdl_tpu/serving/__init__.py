@@ -33,8 +33,10 @@ from bigdl_tpu.serving.engine import (
     DecodeKernels,
     GenerationEngine,
     GenerationStream,
+    PagedDecodeKernels,
     static_generate,
 )
+from bigdl_tpu.serving.paging import PagePool
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -57,6 +59,8 @@ __all__ = [
     "InferenceService",
     "ModelRouter",
     "Overloaded",
+    "PagePool",
+    "PagedDecodeKernels",
     "ServingError",
     "ServingMetrics",
     "StreamCancelled",

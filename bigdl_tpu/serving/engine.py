@@ -32,10 +32,31 @@ jitted kernels — ``bench.py --mode serving --generate`` and the CI smoke
 gate measure continuous vs static tokens/sec with it (the win is
 scheduling, so it shows even on one core).
 
-Sampling is greedy (argmax inside the jitted step): deterministic for a
-fixed model+prompt regardless of admission order or slot assignment,
-which the tests rely on. Swap :class:`DecodeKernels` for a sampling
-variant when temperature is needed.
+PR 6 replaces the dense slot lanes with a **paged KV cache**
+(:class:`PagedDecodeKernels`, the default for paged-capable models):
+per layer the cache is a shared pool of fixed-size pages plus a per-slot
+int32 page map, reserved/released by the host-side
+:class:`~bigdl_tpu.serving.paging.PagePool` as sequences are admitted
+and retire — KV memory scales with each request's actual token budget
+instead of ``max_slots x max_len``, the direct capacity lever on
+concurrent users. Riding on the paged step:
+
+- **in-step sampling** — temperature / top-k / top-p run INSIDE the
+  jitted decode step with per-request params batched as ``(max_slots,)``
+  arrays and one raw threefry key per slot (``core.rng``); a request's
+  stream depends only on its seed, so sampled output is deterministic
+  across runs, admission orderings, and schedulers. Greedy
+  (``temperature=0``, the default) stays bit-identical to the dense
+  PR-5 engine — test-enforced.
+- **chunked prefill** — prompts longer than ``prefill_chunk`` advance
+  one chunk per engine iteration, interleaved with decode steps, so a
+  max-length prompt no longer stalls every neighbour's next token; the
+  ``max_prompt_len < max_len`` admission wall is gone (any prompt up to
+  ``max_len - 1`` is admitted and chunked).
+
+The dense :class:`DecodeKernels` path is kept verbatim as the PR-5
+baseline (and for decode-capable models without the paged API); the
+bit-identity acceptance tests decode the same prompts through both.
 """
 
 from __future__ import annotations
@@ -52,6 +73,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.core.rng import request_seed, threefry_key_data
+from bigdl_tpu.ops.sampling import sample_tokens
 from bigdl_tpu.serving.batcher import bucket_sizes_for
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
@@ -59,6 +82,7 @@ from bigdl_tpu.serving.errors import (
     StreamCancelled,
 )
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.paging import PagePool, pages_per_lane
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -72,11 +96,12 @@ class _TraceCounts:
     it in a cycle through the C++ pjit object, which the GC cannot
     break, leaking model+params on an unclosed engine."""
 
-    __slots__ = ("prefill", "decode")
+    __slots__ = ("prefill", "decode", "chunk")
 
     def __init__(self):
         self.prefill = 0
         self.decode = 0
+        self.chunk = 0
 
 
 class DecodeKernels:
@@ -128,6 +153,111 @@ class DecodeKernels:
         """-> (next token per slot (S,), new cache); donates ``cache``."""
         return self._decode(params, cache, np.asarray(tokens, np.int32),
                             np.asarray(positions, np.int32))
+
+
+class PagedDecodeKernels:
+    """The jitted ``(prefill, chunk, decode)`` triple over a PAGED
+    decode-capable model (one exposing ``init_paged_cache`` /
+    ``prefill_paged`` / ``decode_step_paged``, e.g. ``nn.Transformer``).
+
+    Differences from the dense :class:`DecodeKernels`:
+
+    - the cache is the shared page pool; every call additionally takes
+      int32 page ids (a ``(ppn,)`` row for prefill chunks, the full
+      ``(max_slots, ppn)`` map for decode) — dynamic VALUES with static
+      shapes, so the compile-once guarantee is untouched;
+    - sampling runs inside the step: per-slot ``temperature`` / ``top_k``
+      / ``top_p`` arrays plus one raw threefry key per slot, split once
+      per call (``ops.sampling.sample_tokens``). ``temperature=0`` rows
+      take the bitwise PR-5 greedy-argmax path;
+    - ``chunk`` is prefill WITHOUT logits/sampling — the non-final
+      pieces of a chunked prompt. It always runs at exactly
+      ``prefill_chunk`` tokens, so it traces once.
+
+    The cache is donated on every call; only token/key vectors cross to
+    the host per step. ``use_kernel`` routes decode attention through
+    the Pallas paged kernel (auto: TPU only).
+    """
+
+    def __init__(self, model, *, donate: bool = True,
+                 use_kernel: Optional[bool] = None):
+        self.model = model
+        self.counts = _TraceCounts()
+        counts = self.counts
+
+        def prefill(params, cache, pages, tokens, start, length, trash,
+                    temp, top_k, top_p, key):
+            counts.prefill += 1
+            logits, cache = model.prefill_paged(
+                params, cache, pages, tokens, start, length, trash)
+            toks, new_key = sample_tokens(logits[None], temp, top_k, top_p,
+                                          key)
+            return toks[0], new_key, cache
+
+        def chunk(params, cache, pages, tokens, start, length, trash):
+            counts.chunk += 1
+            return model.prefill_paged(params, cache, pages, tokens, start,
+                                       length, trash, need_logits=False)
+
+        def decode(params, cache, tokens, positions, page_map,
+                   temps, top_ks, top_ps, keys):
+            counts.decode += 1
+            logits, cache = model.decode_step_paged(
+                params, cache, tokens, positions, page_map,
+                use_kernel=use_kernel)
+            toks, new_keys = sample_tokens(logits, temps, top_ks, top_ps,
+                                           keys)
+            return toks, new_keys, cache
+
+        dn = (1,) if donate else ()
+        self._prefill = jax.jit(prefill, donate_argnums=dn)
+        self._chunk = jax.jit(chunk, donate_argnums=dn)
+        self._decode = jax.jit(decode, donate_argnums=dn)
+
+    @property
+    def prefill_traces(self) -> int:
+        return self.counts.prefill
+
+    @property
+    def chunk_traces(self) -> int:
+        return self.counts.chunk
+
+    @property
+    def decode_traces(self) -> int:
+        return self.counts.decode
+
+    def prefill(self, params, cache, pages, tokens, start, length, trash,
+                temperature=0.0, top_k=0, top_p=1.0, key=None):
+        """Final (or only) chunk of one prompt: writes its K/V rows and
+        samples the first generated token. -> ``(token, new_key (1, 2),
+        new cache)``; donates ``cache``."""
+        if key is None:
+            key = np.zeros(2, np.uint32)
+        return self._prefill(
+            params, cache, np.asarray(pages, np.int32),
+            np.asarray(tokens, np.int32), int(start), int(length),
+            int(trash), np.asarray([temperature], np.float32),
+            np.asarray([top_k], np.int32), np.asarray([top_p], np.float32),
+            np.asarray(key, np.uint32).reshape(1, 2))
+
+    def chunk(self, params, cache, pages, tokens, start, length, trash):
+        """Non-final prompt chunk: K/V writes only. -> new cache
+        (donates the old one)."""
+        return self._chunk(
+            params, cache, np.asarray(pages, np.int32),
+            np.asarray(tokens, np.int32), int(start), int(length),
+            int(trash))
+
+    def decode(self, params, cache, tokens, positions, page_map,
+               temps, top_ks, top_ps, keys):
+        """One decode step for every slot. -> ``(next token per slot
+        (S,), new keys (S, 2), new cache)``; donates ``cache``."""
+        return self._decode(
+            params, cache, np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32),
+            np.asarray(page_map, np.int32),
+            np.asarray(temps, np.float32), np.asarray(top_ks, np.int32),
+            np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32))
 
 
 class GenerationStream:
@@ -238,28 +368,49 @@ class GenerationStream:
 
 
 class _GenRequest:
-    __slots__ = ("prompt", "max_new_tokens", "deadline", "stream")
+    __slots__ = ("prompt", "max_new_tokens", "deadline", "stream",
+                 "temperature", "top_k", "top_p", "seed")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
-                 deadline: Optional[float], stream: GenerationStream):
+                 deadline: Optional[float], stream: GenerationStream,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
         self.stream = stream
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0.0
 
 
 class _SlotState:
-    """Host-side bookkeeping for one occupied slot."""
+    """Host-side bookkeeping for one occupied slot. ``phase`` is
+    "decode" for the dense engine always; the paged engine admits into
+    "prefill" and flips to "decode" once the final prompt chunk has run
+    (chunked prefill interleaves with neighbours' decode steps)."""
 
-    __slots__ = ("req", "last_token", "position", "generated", "t_admit")
+    __slots__ = ("req", "last_token", "position", "generated", "t_admit",
+                 "phase", "pages", "page_row", "prefill_pos")
 
     def __init__(self, req: _GenRequest, last_token: int, position: int,
-                 generated: int, t_admit: float):
+                 generated: int, t_admit: float, phase: str = "decode",
+                 pages: Optional[List[int]] = None,
+                 page_row=None, prefill_pos: int = 0):
         self.req = req
         self.last_token = last_token
         self.position = position          # cache row the NEXT token writes
         self.generated = generated
         self.t_admit = t_admit
+        self.phase = phase
+        self.pages = pages                # reserved physical pages (paged)
+        self.page_row = page_row          # (ppn,) int32 map row (paged)
+        self.prefill_pos = prefill_pos    # next prompt index to prefill
 
 
 class _Core:
@@ -279,12 +430,27 @@ class _Core:
         self.drain = True
 
 
-def _fail_streams(core: _Core, error: BaseException) -> None:
+def _fail_streams(core: _Core, error: BaseException,
+                  engine: "Optional[GenerationEngine]" = None) -> None:
+    """Fail every pending/active stream. Pass the engine (when a strong
+    ref is still live) so a PAGED engine's reserved pages return to the
+    pool — close(drain=False) and step-failure must not strand the
+    ``pages_in_use`` gauge non-zero in a shared ServingMetrics. Callers
+    are the loop thread or a post-join close(): never concurrent with a
+    running step, so touching the pool here is safe."""
     with core.cond:
         reqs = list(core.pending) + [s.req for s in core.active.values()]
+        states = list(core.active.items())
         core.pending.clear()
         core.free.extend(core.active.keys())
         core.active.clear()
+    if engine is not None and engine.paged and states:
+        for slot, st in states:
+            engine._pool.release(st.pages or ())
+            st.pages = None
+            engine._page_map[slot] = engine._pool.trash
+        engine.metrics.set_pages(engine._pool.in_use,
+                                 engine._pool.num_pages)
     for r in reqs:
         if not r.stream.done:
             r.stream._finish(error)
@@ -305,7 +471,8 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
             if core.closed:
                 if not core.drain:
                     _fail_streams(core, RuntimeError(
-                        "generation engine closed before request ran"))
+                        "generation engine closed before request ran"),
+                        engine_ref())
                     return
                 if not core.pending and not core.active:
                     return
@@ -322,7 +489,7 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
             # consumed — fail every stream loudly and stop the loop
             engine._failed = e
             log.exception("generation engine step failed; engine stopped")
-            _fail_streams(core, e)
+            _fail_streams(core, e, engine)
             return
         del engine
 
@@ -351,7 +518,12 @@ class GenerationEngine:
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
                  cache_dtype=jnp.float32,
-                 kernels: Optional[DecodeKernels] = None):
+                 kernels=None,
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 seed: int = 0,
+                 use_paged_kernel: Optional[bool] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -359,20 +531,61 @@ class GenerationEngine:
         self.model = model
         self.max_slots = int(max_slots)
         self.max_len = int(max_len)
-        self.max_prompt_len = int(max_prompt_len or max(1, max_len // 2))
-        if not 1 <= self.max_prompt_len < self.max_len:
-            raise ValueError(
-                f"max_prompt_len {self.max_prompt_len} must be in "
-                f"[1, max_len) = [1, {self.max_len})")
         self.eos_id = None if eos_id is None else int(eos_id)
         self.pad_id = int(pad_id)
         self.max_queue = int(max_queue)
         self.metrics = metrics or ServingMetrics()
-        self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
-        self.kernels = kernels or DecodeKernels(model)
+        self.seed = int(seed)
+        # mode: the kernels pick it when given; otherwise paged whenever
+        # the model speaks the paged API (the dense lanes are the PR-5
+        # baseline, kept for bit-identity tests and plain-cache models)
+        if kernels is not None:
+            self.paged = isinstance(kernels, PagedDecodeKernels)
+        else:
+            self.paged = bool(page_size) and hasattr(model,
+                                                    "decode_step_paged")
+        if self.paged:
+            # chunked prefill lifts the prompt-length wall: anything that
+            # leaves room for one generated token is admitted and chunked
+            self.max_prompt_len = int(max_prompt_len or (max_len - 1))
+        else:
+            self.max_prompt_len = int(max_prompt_len or max(1, max_len // 2))
+        if not 1 <= self.max_prompt_len < self.max_len:
+            raise ValueError(
+                f"max_prompt_len {self.max_prompt_len} must be in "
+                f"[1, max_len) = [1, {self.max_len})")
+        if self.paged:
+            self.page_size = int(page_size)
+            self.prefill_chunk = int(
+                prefill_chunk or min(64, self.max_prompt_len))
+            if self.prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1")
+            self.prompt_buckets = bucket_sizes_for(
+                min(self.max_prompt_len, self.prefill_chunk))
+            # dense-equivalent pool by default; shrink num_pages to trade
+            # worst-case capacity for more concurrent typical requests
+            ppn = pages_per_lane(self.max_len, self.page_size)
+            self.num_pages = int(num_pages or self.max_slots * ppn)
+            self._pool = PagePool(self.num_pages, self.page_size,
+                                  self.max_len)
+            self.kernels = kernels or PagedDecodeKernels(
+                model, use_kernel=use_paged_kernel)
+            self._cache = model.init_paged_cache(
+                self.num_pages + 1, self.page_size, cache_dtype)
+            # per-slot step inputs, mutated on admission/retirement only
+            self._page_map = np.full((self.max_slots, ppn),
+                                     self._pool.trash, np.int32)
+            self._temps = np.zeros((self.max_slots,), np.float32)
+            self._top_ks = np.zeros((self.max_slots,), np.int32)
+            self._top_ps = np.ones((self.max_slots,), np.float32)
+            self._keys = np.zeros((self.max_slots, 2), np.uint32)
+            self.metrics.set_pages(0, self.num_pages)
+        else:
+            self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
+            self.kernels = kernels or DecodeKernels(model)
+            self._cache = model.init_cache(self.max_slots, self.max_len,
+                                           cache_dtype)
         self._params = params
-        self._cache = model.init_cache(self.max_slots, self.max_len,
-                                       cache_dtype)
         self._failed: Optional[BaseException] = None
         self._core = _Core(self.max_slots)
         self._thread = threading.Thread(
@@ -384,12 +597,23 @@ class GenerationEngine:
 
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: Optional[int] = None,
-               deadline: Optional[float] = None) -> GenerationStream:
+               deadline: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0,
+               seed: Optional[int] = None) -> GenerationStream:
         """Enqueue one prompt (sequence of token ids). ``max_new_tokens``
         caps generation (default: whatever fits in ``max_len``);
         ``deadline`` is seconds from now — an expired request retires
         mid-flight with :class:`DeadlineExceeded` on its stream. Raises
-        :class:`Overloaded` when the pending queue is at its bound."""
+        :class:`Overloaded` when the pending queue is at its bound.
+
+        Sampling (paged engine only): ``temperature > 0`` samples inside
+        the jitted step, optionally filtered by ``top_k`` / nucleus
+        ``top_p``; ``temperature=0`` (default) is greedy argmax. The
+        stream's PRNG seed defaults to a pure function of the engine
+        seed and the prompt bytes, so sampled output — like greedy — is
+        identical across runs and admission orderings; pass ``seed`` to
+        give byte-identical prompts distinct streams."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -397,15 +621,35 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds max_prompt_len "
                 f"{self.max_prompt_len}")
+        temperature = float(temperature)
+        if temperature > 0.0 and not self.paged:
+            raise ValueError(
+                "sampling (temperature > 0) needs the paged engine — the "
+                "dense DecodeKernels path is the greedy PR-5 baseline")
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
         room = self.max_len - len(prompt)
         mnt = room if max_new_tokens is None else min(int(max_new_tokens), room)
         if mnt < 1:
             raise ValueError("no room to generate even one token")
+        if self.paged:
+            need = self._pool.pages_for(
+                min(len(prompt) + mnt - 1, self.max_len))
+            if need > self.num_pages:
+                # a reservation the pool can NEVER satisfy would block the
+                # FIFO head forever (page pressure is allowed to delay, not
+                # to deadlock) — reject it on the caller's thread instead
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool holds "
+                    f"{self.num_pages}; shrink the prompt/max_new_tokens "
+                    f"or grow num_pages")
         stream = GenerationStream()
         now = stream.t_submit
         req = _GenRequest(prompt, mnt,
                           None if deadline is None else now + float(deadline),
-                          stream)
+                          stream, temperature=temperature, top_k=int(top_k),
+                          top_p=float(top_p),
+                          seed=None if seed is None else int(seed))
         core = self._core
         with core.cond:
             if self._failed is not None:
@@ -426,31 +670,176 @@ class GenerationEngine:
     def generate(self, prompt: Sequence[int], *,
                  max_new_tokens: Optional[int] = None,
                  deadline: Optional[float] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None,
                  timeout: Optional[float] = None) -> List[int]:
         """Blocking convenience: ``submit(...).result(timeout)``."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           deadline=deadline).result(timeout)
+                           deadline=deadline, temperature=temperature,
+                           top_k=top_k, top_p=top_p,
+                           seed=seed).result(timeout)
 
     # ------------------------------------------------- loop internals ----
     # Everything below here runs on the loop thread only (except warmup,
     # which the caller must run before traffic).
 
     def _step(self) -> None:
-        """One scheduler iteration: admit pending prompts into free slots,
-        then one decode step over every active slot."""
+        """One scheduler iteration: admit pending prompts into free slots
+        (paged: only while the pool can cover the head request's full
+        reservation — FIFO, so page pressure delays rather than reorders),
+        advance one prefill chunk per prefilling slot, then one decode
+        step over every decoding slot."""
         core = self._core
         while True:
             with core.cond:
                 if not core.pending or not core.free:
                     break
+                if self.paged and not self._pool.can_reserve(
+                        self._pages_needed(core.pending[0])):
+                    break
                 req = core.pending.popleft()
                 depth = len(core.pending)
             self.metrics.set_queue_depth(depth)
-            self._admit(req)
+            if self.paged:
+                self._admit_paged(req)
+            else:
+                self._admit(req)
+        if self.paged:
+            with core.cond:
+                prefilling = sorted((s, st) for s, st in core.active.items()
+                                    if st.phase == "prefill")
+            for slot, st in prefilling:
+                self._prefill_chunk_once(slot, st)
         with core.cond:
-            active = sorted(core.active.items())
+            active = sorted((s, st) for s, st in core.active.items()
+                            if st.phase == "decode")
         if active:
             self._decode_once(active)
+
+    def _pages_needed(self, req: _GenRequest) -> int:
+        # rows written = prompt + generated - 1 (the final token is
+        # returned but never written back before the slot retires)
+        return self._pool.pages_for(
+            min(len(req.prompt) + req.max_new_tokens - 1, self.max_len))
+
+    def _request_key(self, req: _GenRequest) -> np.ndarray:
+        seed = req.seed
+        if seed is None:
+            seed = request_seed(
+                self.seed, np.asarray(req.prompt, np.int32).tobytes(),
+                len(req.prompt))
+        return threefry_key_data(seed)
+
+    def _admit_paged(self, req: _GenRequest) -> None:
+        """Paged admission is bookkeeping only: reserve the slot and its
+        full page budget. The prompt itself runs as chunks inside the
+        iteration loop so a long prompt interleaves with neighbours'
+        decode steps.
+
+        CRITICAL ordering: the slot's row of ``self._page_map`` stays
+        parked on the trash page (and its sampling params/key stay
+        disarmed) until the FINAL chunk completes — interleaved decode
+        steps scatter a pad-token K/V row for every slot in the batch,
+        prefilling ones included, and split every slot's PRNG key. Expose
+        the real pages or the request key early and those decode steps
+        would corrupt the prompt's first page and make the sampled
+        stream depend on neighbour traffic. The chunk/prefill kernels
+        take the page row as an explicit argument instead."""
+        now = time.monotonic()
+        why = self._retire_why(None, req, now)
+        if why is not None:
+            self._finish_request(req, why, now, queue_wait=None)
+            return
+        core = self._core
+        with core.cond:
+            core.free.sort()
+            slot = core.free.pop(0)
+        pages = self._pool.alloc(self._pages_needed(req))
+        row = np.full((self._pool.pages_per_slot,), self._pool.trash,
+                      np.int32)
+        row[:len(pages)] = pages
+        st = _SlotState(req, self.pad_id, 0, 0, now, phase="prefill",
+                        pages=pages, page_row=row, prefill_pos=0)
+        with core.cond:
+            core.active[slot] = st
+        self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
+
+    def _prefill_chunk_once(self, slot: int, st: _SlotState) -> None:
+        """Advance one prompt chunk for a prefilling slot. Non-final
+        chunks are always exactly ``prefill_chunk`` tokens (one compiled
+        shape); the final chunk is bucket-padded and samples the first
+        generated token."""
+        req = st.req
+        now = time.monotonic()
+        why = self._retire_why(None, req, now)
+        if why is not None:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
+            return
+        prompt = req.prompt
+        start = st.prefill_pos
+        remaining = len(prompt) - start
+        pages_row = st.page_row  # NOT self._page_map: see _admit_paged
+        if remaining > self.prefill_chunk:
+            tokens = np.asarray(prompt[start:start + self.prefill_chunk],
+                                np.int32)
+            self._cache = self.kernels.chunk(
+                self._params, self._cache, pages_row, tokens, start,
+                self.prefill_chunk, self._pool.trash)
+            st.prefill_pos += self.prefill_chunk
+            st.position = st.prefill_pos
+            self.metrics.record_chunk(self.prefill_chunk, self.prefill_chunk)
+            return
+        bucket = next(b for b in self.prompt_buckets if b >= remaining)
+        padded = np.full((bucket,), self.pad_id, np.int32)
+        padded[:remaining] = prompt[start:]
+        # the final chunk arms the slot's step inputs: sampling params,
+        # the request's PRNG key (fresh HERE, so token i always draws
+        # from split i whatever decode traffic ran during the prefill),
+        # and — after the K/V writes land — the live page-map row
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+        tok_dev, key_dev, self._cache = self.kernels.prefill(
+            self._params, self._cache, pages_row, padded, start, remaining,
+            self._pool.trash, self._temps[slot], self._top_ks[slot],
+            self._top_ps[slot], self._request_key(req))
+        tok = int(np.asarray(tok_dev))
+        self._keys[slot] = np.asarray(key_dev)[0]
+        self._page_map[slot] = pages_row
+        now = time.monotonic()
+        self.metrics.record_prefill(remaining, bucket,
+                                    now - req.stream.t_submit)
+        if req.sampled:
+            self.metrics.record_sampled(1)
+        req.stream._push(tok, now)
+        st.phase = "decode"
+        st.last_token = tok
+        st.position = len(prompt)
+        st.generated = 1
+        why = self._retire_why(st, req, now)
+        if why is not None:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
+
+    def _release_slot(self, slot: int, st: _SlotState) -> None:
+        """Return a slot (and, paged, its pages + step-input rows) to the
+        free state. The page-map row parks on the trash page so the
+        still-running decode step can neither read nor clobber a page the
+        next owner gets."""
+        core = self._core
+        with core.cond:
+            core.active.pop(slot, None)
+            core.free.append(slot)
+        if self.paged:
+            self._pool.release(st.pages or ())
+            st.pages = None
+            self._page_map[slot] = self._pool.trash
+            self._temps[slot] = 0.0
+            self._top_ks[slot] = 0
+            self._top_ps[slot] = 1.0
+            self._keys[slot] = 0
+            self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
 
     def _admit(self, req: _GenRequest) -> None:
         now = time.monotonic()
@@ -488,29 +877,35 @@ class GenerationEngine:
         for slot, st in active:
             tokens[slot] = st.last_token
             positions[slot] = st.position
-        toks_dev, self._cache = self.kernels.decode(
-            self._params, self._cache, tokens, positions)
+        if self.paged:
+            toks_dev, keys_dev, self._cache = self.kernels.decode(
+                self._params, self._cache, tokens, positions,
+                self._page_map, self._temps, self._top_ks, self._top_ps,
+                self._keys)
+            self._keys = np.array(keys_dev)  # writable copy (host-mutated)
+        else:
+            toks_dev, self._cache = self.kernels.decode(
+                self._params, self._cache, tokens, positions)
         toks = np.asarray(toks_dev)
         now = time.monotonic()
         self.metrics.record_decode_step(len(active), self.max_slots)
+        sampled = 0
         retired = []
         for slot, st in active:
             tok = int(toks[slot])
             st.last_token = tok
             st.position += 1
             st.generated += 1
+            sampled += st.req.sampled
             st.req.stream._push(tok, now)
             why = self._retire_why(st, st.req, now)
             if why is not None:
                 retired.append((slot, st, why))
-        if retired:
-            core = self._core
-            with core.cond:
-                for slot, _, _ in retired:
-                    core.active.pop(slot, None)
-                    core.free.append(slot)
-            for _, st, why in retired:
-                self._finish_slot(st, why, now)
+        if sampled:
+            self.metrics.record_sampled(sampled)
+        for slot, st, why in retired:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
 
     def _retire_why(self, st: Optional[_SlotState], req: _GenRequest,
                     now: float) -> Optional[str]:
@@ -563,14 +958,37 @@ class GenerationEngine:
         with core.cond:
             if core.pending or core.active:
                 raise RuntimeError("warmup() must run before traffic")
-        _, self._cache = self.kernels.decode(
-            self._params, self._cache,
-            np.zeros((self.max_slots,), np.int32),
-            np.zeros((self.max_slots,), np.int32))
-        for bucket in self.prompt_buckets:
-            _, self._cache = self.kernels.prefill(
-                self._params, self._cache, 0,
-                np.full((bucket,), self.pad_id, np.int32), bucket)
+        zeros = np.zeros((self.max_slots,), np.int32)
+        if self.paged:
+            # every write below routes to the trash page (the map rows
+            # are parked there), so warmup garbage can never surface
+            trash_row = np.full((self._pool.pages_per_slot,),
+                                self._pool.trash, np.int32)
+            _, self._keys, self._cache = self.kernels.decode(
+                self._params, self._cache, zeros, zeros, self._page_map,
+                self._temps, self._top_ks, self._top_ps, self._keys)
+            self._keys = np.asarray(self._keys)
+            if self.max_prompt_len > self.prefill_chunk:
+                self._cache = self.kernels.chunk(
+                    self._params, self._cache, trash_row,
+                    np.full((self.prefill_chunk,), self.pad_id, np.int32),
+                    0, self.prefill_chunk, self._pool.trash)
+            for bucket in self.prompt_buckets:
+                _, _, self._cache = self.kernels.prefill(
+                    self._params, self._cache, trash_row,
+                    np.full((bucket,), self.pad_id, np.int32), 0, bucket,
+                    self._pool.trash)
+            # warmup consumed one split per slot key: re-arm the zeros so
+            # the first real admission starts from its request seed (it
+            # overwrites the row anyway; this keeps the invariant obvious)
+            self._keys = np.zeros((self.max_slots, 2), np.uint32)
+        else:
+            _, self._cache = self.kernels.decode(
+                self._params, self._cache, zeros, zeros)
+            for bucket in self.prompt_buckets:
+                _, self._cache = self.kernels.prefill(
+                    self._params, self._cache, 0,
+                    np.full((bucket,), self.pad_id, np.int32), bucket)
         jax.block_until_ready(self._cache)
 
     def reload(self, params, state: Any = None) -> None:
@@ -610,7 +1028,7 @@ class GenerationEngine:
             # streams the loop is still legitimately serving and
             # double-free their slots mid-step.
             _fail_streams(core, RuntimeError(
-                "generation engine closed before request ran"))
+                "generation engine closed before request ran"), self)
 
     def __enter__(self) -> "GenerationEngine":
         return self
@@ -643,12 +1061,27 @@ class GenerationEngine:
     def prefill_compilations(self) -> int:
         return self.kernels.prefill_traces
 
+    @property
+    def chunk_compilations(self) -> int:
+        return getattr(self.kernels, "chunk_traces", 0)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self._pool.in_use if self.paged else 0
+
+    @property
+    def free_pages(self) -> int:
+        return self._pool.free_pages if self.paged else 0
+
 
 def static_generate(model, params, requests, *, max_slots: int,
                     max_len: int, eos_id: Optional[int] = None,
                     pad_id: int = 0, cache_dtype=jnp.float32,
-                    kernels: Optional[DecodeKernels] = None,
-                    prompt_buckets: Optional[Sequence[int]] = None):
+                    kernels=None,
+                    prompt_buckets: Optional[Sequence[int]] = None,
+                    page_size: int = 16, num_pages: Optional[int] = None,
+                    prefill_chunk: Optional[int] = None, seed: int = 0,
+                    sampling: Optional[Sequence[dict]] = None):
     """Run-to-completion static batching BASELINE over the same jitted
     kernels the engine uses: admit ``max_slots`` requests, decode until
     EVERY one finishes (the longest sequence holds the whole batch
@@ -656,9 +1089,28 @@ def static_generate(model, params, requests, *, max_slots: int,
     of ``(prompt, max_new_tokens)``; returns ``(token lists, decode
     steps executed)``. This is the comparison the bench/CI smoke gate
     runs — continuous batching must beat it on mixed lengths because it
-    retires short sequences mid-flight instead of idling their slots."""
-    kernels = kernels or DecodeKernels(model)
+    retires short sequences mid-flight instead of idling their slots.
+
+    With :class:`PagedDecodeKernels` (the default for paged-capable
+    models) the baseline runs over the SAME paged + sampling kernels as
+    the engine — apples to apples stays apples. ``sampling`` is an
+    optional per-request list of dicts (``temperature`` / ``top_k`` /
+    ``top_p`` / ``seed``); seeds derive exactly like the engine's, so a
+    sampled run produces IDENTICAL streams under either scheduler."""
+    if kernels is None:
+        kernels = (PagedDecodeKernels(model)
+                   if page_size and hasattr(model, "decode_step_paged")
+                   else DecodeKernels(model))
     requests = [([int(t) for t in p], int(m)) for p, m in requests]
+    if isinstance(kernels, PagedDecodeKernels):
+        return _static_generate_paged(
+            model, params, requests, kernels, max_slots=max_slots,
+            max_len=max_len, eos_id=eos_id, pad_id=pad_id,
+            cache_dtype=cache_dtype, prompt_buckets=prompt_buckets,
+            page_size=page_size, num_pages=num_pages,
+            prefill_chunk=prefill_chunk, seed=seed, sampling=sampling)
+    if sampling is not None:
+        raise ValueError("sampling needs PagedDecodeKernels")
     buckets = list(prompt_buckets
                    or bucket_sizes_for(max(len(p) for p, _ in requests)))
     cache = model.init_cache(max_slots, max_len, cache_dtype)
@@ -702,4 +1154,109 @@ def static_generate(model, params, requests, *, max_slots: int,
                     s["done"] = True
         for i, s in enumerate(states):
             outputs[base + i] = s["tokens"]
+    return outputs, total_steps
+
+
+def _static_generate_paged(model, params, requests, kernels, *, max_slots,
+                           max_len, eos_id, pad_id, cache_dtype,
+                           prompt_buckets, page_size, num_pages,
+                           prefill_chunk, seed, sampling):
+    """Paged body of :func:`static_generate`: same group-at-a-time
+    run-to-completion schedule, over the paged + sampling kernels. Each
+    group reserves its pages up front and releases them when the whole
+    group finishes — which is exactly the capacity pathology the paged
+    ENGINE fixes by releasing per sequence."""
+    chunk = int(prefill_chunk or min(64, max_len - 1))
+    longest = max(len(p) for p, _ in requests)
+    buckets = list(prompt_buckets or bucket_sizes_for(min(longest, chunk)))
+    num_pages = int(num_pages
+                    or max_slots * pages_per_lane(max_len, page_size))
+    pool = PagePool(num_pages, page_size, max_len)
+    cache = model.init_paged_cache(num_pages + 1, page_size, cache_dtype)
+    page_map = np.full((max_slots, pool.pages_per_slot), pool.trash,
+                       np.int32)
+    temps = np.zeros((max_slots,), np.float32)
+    top_ks = np.zeros((max_slots,), np.int32)
+    top_ps = np.ones((max_slots,), np.float32)
+    keys = np.zeros((max_slots, 2), np.uint32)
+
+    outputs: List[Optional[List[int]]] = [None] * len(requests)
+    total_steps = 0
+    for base in range(0, len(requests), max_slots):
+        group = requests[base:base + max_slots]
+        states = []
+        for slot, (prompt, mnt) in enumerate(group):
+            n = len(prompt)
+            target = min(mnt, max_len - n)
+            spec = dict(sampling[base + slot] or {}) if sampling else {}
+            req_seed = spec.get("seed")
+            if req_seed is None:
+                req_seed = request_seed(
+                    seed, np.asarray(prompt, np.int32).tobytes(), n)
+            temps[slot] = float(spec.get("temperature", 0.0))
+            top_ks[slot] = int(spec.get("top_k", 0))
+            top_ps[slot] = float(spec.get("top_p", 1.0))
+            keys[slot] = threefry_key_data(req_seed)
+            need = pool.pages_for(min(n + target - 1, max_len))
+            if not pool.can_reserve(need):
+                raise ValueError(
+                    f"num_pages={num_pages} cannot hold a static group "
+                    f"(needs {need} more pages) — grow the pool or "
+                    f"shrink max_slots")
+            pages = pool.alloc(need)
+            page_map[slot, :] = pool.trash
+            page_map[slot, :len(pages)] = pages
+            start = 0
+            while n - start > chunk:
+                cache = kernels.chunk(
+                    params, cache, page_map[slot],
+                    np.asarray(prompt[start:start + chunk], np.int32),
+                    start, chunk, pool.trash)
+                start += chunk
+            remaining = n - start
+            bucket = next(b for b in buckets if b >= remaining)
+            padded = np.full((bucket,), pad_id, np.int32)
+            padded[:remaining] = prompt[start:]
+            tok_dev, key_dev, cache = kernels.prefill(
+                params, cache, page_map[slot], padded, start, remaining,
+                pool.trash, temps[slot], top_ks[slot], top_ps[slot],
+                keys[slot])
+            tok = int(np.asarray(tok_dev))
+            keys[slot] = np.asarray(key_dev)[0]
+            states.append({
+                "tokens": [tok], "last": tok, "pos": n,
+                "target": target, "pages": pages,
+                "done": (eos_id is not None and tok == eos_id) or target <= 1,
+            })
+        while not all(s["done"] for s in states):
+            tokens = np.zeros((max_slots,), np.int32)
+            positions = np.zeros((max_slots,), np.int32)
+            for slot, s in enumerate(states):
+                tokens[slot] = s["last"]
+                positions[slot] = s["pos"]
+            toks_dev, keys_dev, cache = kernels.decode(
+                params, cache, tokens, positions, page_map, temps, top_ks,
+                top_ps, keys)
+            toks = np.asarray(toks_dev)
+            keys = np.array(keys_dev)
+            total_steps += 1
+            for slot, s in enumerate(states):
+                if s["done"]:
+                    continue
+                tok = int(toks[slot])
+                s["tokens"].append(tok)
+                s["last"] = tok
+                s["pos"] += 1
+                if ((eos_id is not None and tok == eos_id)
+                        or len(s["tokens"]) >= s["target"]
+                        or s["pos"] >= max_len):
+                    s["done"] = True
+        for i, s in enumerate(states):
+            outputs[base + i] = s["tokens"]
+            pool.release(s["pages"])
+        page_map[:] = pool.trash
+        temps[:] = 0.0
+        top_ks[:] = 0
+        top_ps[:] = 1.0
+        keys[:] = 0
     return outputs, total_steps

@@ -77,6 +77,14 @@ class ServingMetrics:
         self.reloads = 0         # hot param swaps (reload/watch_checkpoints)
         self._ttft = _Reservoir(reservoir_size)         # submit -> 1st token
         self._stream_rate = _Reservoir(reservoir_size)  # per-stream tokens/s
+        # paged-KV / sampling / chunked-prefill counters (PR 6); zero for
+        # a dense engine or plain InferenceService — their snapshot/table
+        # keep the earlier shapes (append-only, golden-order-enforced)
+        self.prefill_chunks = 0  # non-final chunk forwards (chunked prefill)
+        self.sampled_tokens = 0  # tokens produced by temperature > 0 slots
+        self.pages_in_use = 0    # KV pool pages currently reserved (gauge)
+        self.pages_total = 0     # KV pool size (gauge; 0 = not paged)
+        self.pages_peak = 0      # high-water reserved pages
 
     # ------------------------------------------------------- mutators ----
 
@@ -143,6 +151,28 @@ class ServingMetrics:
         with self._lock:
             self.reloads += 1
 
+    def record_chunk(self, n_real: int, n_padded: int) -> None:
+        """One NON-final prompt chunk forward (chunked prefill); its
+        tokens count toward the prompt totals, the admission itself is
+        recorded by ``record_prefill`` when the final chunk runs."""
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_tokens += n_real
+            self.prefill_padded += n_padded - n_real
+
+    def record_sampled(self, n: int) -> None:
+        """``n`` tokens this step came from temperature-sampled slots
+        (the rest of ``tokens_out`` is greedy)."""
+        with self._lock:
+            self.sampled_tokens += n
+
+    def set_pages(self, in_use: int, total: int) -> None:
+        """KV page-pool occupancy gauge (paged engine only)."""
+        with self._lock:
+            self.pages_in_use = in_use
+            self.pages_total = total
+            self.pages_peak = max(self.pages_peak, in_use)
+
     # -------------------------------------------------------- readers ----
 
     def snapshot(self) -> dict:
@@ -190,6 +220,15 @@ class ServingMetrics:
                 "stream_tokens_per_sec": None if (r := self._stream_rate.
                                                   percentiles((50,))) is None
                 else round(r[0], 2),
+                # paged-KV / sampling / chunked-prefill fields (PR 6):
+                # appended after every earlier key, never reordered
+                "prefill_chunks": self.prefill_chunks,
+                "sampled_tokens": self.sampled_tokens,
+                "pages_in_use": self.pages_in_use,
+                "pages_total": self.pages_total,
+                "pages_peak": self.pages_peak,
+                "page_occupancy": (self.pages_in_use / self.pages_total
+                                   if self.pages_total else 0.0),
             }
 
     def format_table(self) -> str:
@@ -226,6 +265,17 @@ class ServingMetrics:
                     row(f"ttft_{q}(ms)", f"{v:.3f}")
             if s["stream_tokens_per_sec"] is not None:
                 row("stream_tokens/s_p50", f"{s['stream_tokens_per_sec']:.2f}")
+        # paged-KV rows: appended strictly after the generation block and
+        # only when a paged engine actually ran (same append-only golden
+        # contract as above — a dense engine's table is byte-identical
+        # to its PR-5 output)
+        if s["pages_total"] or s["prefill_chunks"] or s["sampled_tokens"]:
+            row("pages_in_use", s["pages_in_use"])
+            row("pages_total", s["pages_total"])
+            row("pages_peak", s["pages_peak"])
+            row("page_occupancy", f"{s['page_occupancy'] * 100:.1f}%")
+            row("prefill_chunks", s["prefill_chunks"])
+            row("sampled_tokens", s["sampled_tokens"])
         if s["reloads"]:
             row("reloads", s["reloads"])
         return "\n".join(lines)

@@ -1,0 +1,91 @@
+"""Host-side page accounting for the paged KV cache.
+
+The device side is dumb on purpose — per layer, K/V pools of shape
+``(num_pages + 1, heads, page_size, head_dim)`` and int32 page-id arrays
+(see ``nn.Transformer.init_paged_cache``). ALL allocation policy lives
+here, on the host, between decode steps: which physical pages a sequence
+owns, when they are reserved, when they return to the free list. That
+split keeps the jitted kernels shape-stable (compile-once survives any
+allocation pattern) and makes the allocator trivially testable.
+
+Policy notes:
+
+- **full reservation at admission.** A request needs pages for
+  ``prompt_len + max_new_tokens - 1`` rows (the last generated token is
+  never written back); all of them are reserved up front. Memory still
+  scales with the request's ACTUAL budget instead of ``max_len`` — the
+  capacity lever — while mid-flight page exhaustion (which would force
+  vLLM-style preemption/recompute) becomes impossible by construction.
+  Early retirement (EOS, deadline, cancel) returns the unused tail.
+- **smallest-id-first.** Frees push onto a heap, allocations pop the
+  smallest ids: the allocation sequence is a pure function of the
+  admission/retirement sequence, which the determinism tests lean on
+  (and fragmented maps stay cheap to eyeball in a debugger).
+- **one trash page.** Physical page ``num_pages`` exists in the pools
+  but never in the free list: bucket-padding writes and freed slots'
+  map rows point there, so garbage can never land in a page another
+  sequence owns. Its contents are arbitrary and always masked.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Sequence
+
+
+def pages_per_lane(max_len: int, page_size: int) -> int:
+    """Logical pages covering one full-length lane (ceil division). The
+    ONE place this rounding lives — the engine, static baseline, and
+    bench capacity math all read it from here (or from a pool's
+    ``pages_per_slot``), so the allocator and its accountants can never
+    disagree."""
+    if page_size < 1:
+        raise ValueError("page_size must be >= 1")
+    return -(-int(max_len) // int(page_size))
+
+
+class PagePool:
+    """Free-list allocator over ``num_pages`` usable KV pages."""
+
+    def __init__(self, num_pages: int, page_size: int, max_len: int):
+        if num_pages < 1:
+            raise ValueError("num_pages must be >= 1")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # logical pages per slot: every page map row has this many ids
+        self.pages_per_slot = pages_per_lane(max_len, self.page_size)
+        # the extra physical page all masked writes are routed to
+        self.trash = self.num_pages
+        self._free: List[int] = list(range(self.num_pages))
+        heapq.heapify(self._free)
+        self.in_use = 0  # peak tracking lives in ServingMetrics.set_pages
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` KV rows (>= 1)."""
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    def can_reserve(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> List[int]:
+        """Reserve ``n`` pages (smallest ids first). Raises if the pool
+        cannot satisfy the request — callers gate on :meth:`can_reserve`
+        at admission, so this firing means an accounting bug."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: want {n}, have {len(self._free)} "
+                f"of {self.num_pages}")
+        pages = [heapq.heappop(self._free) for _ in range(n)]
+        self.in_use += n
+        return pages
+
+    def release(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            heapq.heappush(self._free, int(p))
+        self.in_use -= len(pages)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
