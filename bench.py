@@ -232,6 +232,45 @@ def run_serving_bench(args):
     }))
 
 
+class _FixedCostKernels:
+    """Paged-kernels wrapper adding a fixed per-call cost — stands in
+    for a real chip's step time on CPU smoke runs, exactly like the test
+    suite's slow-kernels shim: the replicated-vs-single gate measures the
+    SCHEDULING/PLACEMENT win (replica loops step concurrently), which a
+    microsecond-fast CPU step would drown in Python bookkeeping and a
+    1-core runner could not otherwise show. Both sides of the comparison
+    run the same cost, so the ratio is honest."""
+
+    def __init__(self, inner, step_sleep_s):
+        self.inner = inner
+        self.step_sleep_s = float(step_sleep_s)
+        self.cache_sharding = getattr(inner, "cache_sharding", None)
+
+    def prefill(self, *a, **kw):
+        time.sleep(self.step_sleep_s)
+        return self.inner.prefill(*a, **kw)
+
+    def chunk(self, *a, **kw):
+        time.sleep(self.step_sleep_s)
+        return self.inner.chunk(*a, **kw)
+
+    def decode(self, *a, **kw):
+        time.sleep(self.step_sleep_s)
+        return self.inner.decode(*a, **kw)
+
+    @property
+    def prefill_traces(self):
+        return self.inner.prefill_traces
+
+    @property
+    def chunk_traces(self):
+        return self.inner.chunk_traces
+
+    @property
+    def decode_traces(self):
+        return self.inner.decode_traces
+
+
 def run_generation_bench(args):
     """Generation serving benchmark: continuous batching
     (``serving.GenerationEngine``) vs run-to-completion static batching
@@ -256,12 +295,33 @@ def run_generation_bench(args):
     >= 2x) — and ``--sample``, which runs the whole workload with
     temperature/top-k/top-p per request. Sampled streams derive their
     seed from the request, so continuous and static MUST still produce
-    identical tokens (the mismatch gate covers sampling too)."""
+    identical tokens (the mismatch gate covers sampling too).
+
+    PR 7 — sharded + replicated columns:
+
+    - ``--tp K`` runs the ENGINE tensor-parallel over a K-device mesh
+      (Megatron pspecs from ``parallel.tp``, KV pools sharded on heads)
+      while the timed static baseline stays single-device, so the
+      existing mismatch gate becomes the sharded-vs-single-device
+      bit-identity check (the 1.5x scheduling gate then applies only at
+      tp=1 — sharded and unsharded step times are not comparable on CPU);
+    - ``--replicas R`` adds the scale-out column: R engines on disjoint
+      device groups behind a ``ReplicaSet`` vs ONE engine fed the same
+      total traffic at the same per-step cost (``--step-cost-ms``,
+      default 8 ms under ``--smoke`` — see ``_FixedCostKernels``). The
+      smoke gate requires replicated tokens/sec >= 1.5x single-replica,
+      plus per-replica occupancy rows from each replica's own
+      ``ServingMetrics``."""
+    from jax.sharding import NamedSharding
+
     from bigdl_tpu.nn.layers.attention import Transformer
+    from bigdl_tpu.parallel import kv_cache_pspec, serving_meshes
     from bigdl_tpu.serving import (
         GenerationEngine,
         PagePool,
         PagedDecodeKernels,
+        ReplicaSet,
+        ServingMetrics,
         static_generate,
     )
 
@@ -282,7 +342,20 @@ def run_generation_bench(args):
         max_len, short_new, long_new = 104, 3, 72
     max_prompt = 16
     params, _ = model.init(jax.random.key(0))
-    kernels = PagedDecodeKernels(model)
+    kernels = PagedDecodeKernels(model)  # single-device triple: the
+    # static baseline AND the identity reference for sharded runs
+    mesh = None
+    engine_kernels = kernels
+    if args.tp > 1:
+        if args.tp * max(1, args.replicas) > len(jax.devices()):
+            raise SystemExit(
+                f"--tp {args.tp} x --replicas {max(1, args.replicas)} needs "
+                f"{args.tp * max(1, args.replicas)} devices, have "
+                f"{len(jax.devices())} (CPU: set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N)")
+        mesh = serving_meshes(1, args.tp)[0]
+        engine_kernels = PagedDecodeKernels(
+            model, cache_sharding=NamedSharding(mesh, kv_cache_pspec()))
 
     rs = np.random.RandomState(0)
     n_requests = args.requests or 4 * slots
@@ -295,15 +368,21 @@ def run_generation_bench(args):
         # long and idles its short slots for the whole tail, so the
         # deterministic step-count gap is ~3x and the 1.5x wall-clock
         # gate keeps a wide margin against scheduler jitter on shared
-        # CI runners (a 50/50 mix measured 1.44-1.62x — too close)
-        requests.append((prompt, long_new if i % 4 == 3 else short_new))
+        # CI runners (a 50/50 mix measured 1.44-1.62x — too close).
+        # Long positions alternate parity (3 then 6 per 8) so they do not
+        # alias with 2-replica least-loaded placement — i % 4 == 3 put
+        # every long at an odd submit index, i.e. ALL of them on one of
+        # two replicas, and the replicated column measured placement skew
+        # instead of throughput
+        requests.append((prompt,
+                         long_new if i % 8 in (3, 6) else short_new))
     sample_spec = (dict(temperature=0.8, top_k=40, top_p=0.95)
                    if args.sample else {})
 
     engine = GenerationEngine(
         model, params, max_slots=slots, max_len=max_len,
         max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
-        kernels=kernels, page_size=page_size, seed=0)
+        kernels=engine_kernels, page_size=page_size, seed=0, mesh=mesh)
     engine.warmup()
 
     # continuous: submit everything, the engine packs slots between steps
@@ -349,8 +428,88 @@ def run_generation_bench(args):
     capacity_ratio = capacity_paged / slots
 
     # greedy decode is deterministic: both schedulers must produce the
-    # SAME tokens — a throughput number from divergent outputs is bogus
+    # SAME tokens — a throughput number from divergent outputs is bogus.
+    # With --tp this is ALSO the sharded-vs-single-device identity check:
+    # the engine ran tensor-parallel, the static baseline on one device.
     mismatches = sum(1 for a, b in zip(outs, souts) if a != b)
+
+    # scale-out column: R replicas on disjoint device groups behind a
+    # ReplicaSet vs ONE engine fed the same total traffic at the same
+    # fixed per-step cost (sleeps overlap across replica loop threads;
+    # compute overlaps across cores on multicore hosts)
+    # default 8 ms: must comfortably dominate the ~1.5 ms CPU step +
+    # Python bookkeeping, which CANNOT overlap on a 1-core runner — the
+    # 2-replica wall-clock ceiling there is 2(s+c)/(s+2c), i.e. ~1.5x at
+    # s=3ms but ~1.7x at s=8ms (multicore runners overlap c too and land
+    # higher)
+    step_cost_ms = args.step_cost_ms
+    if step_cost_ms is None:
+        step_cost_ms = 8.0 if (smoke and args.replicas > 1) else 0.0
+    rep_fields = {}
+    if args.replicas > 1:
+        if args.tp > 1:
+            rep_meshes = serving_meshes(args.replicas, args.tp)
+        else:
+            rep_meshes = [None] * args.replicas
+        # the replicated column runs every engine at HALF the slots: a
+        # replica only pays off once a single engine is capacity-bound
+        # (that is why production adds replicas), and at `slots` lanes one
+        # engine already fits every long generation of a wave concurrently
+        # — the sequential decode critical path would cap the ratio at
+        # ~1.3x however many replicas overlap. Same slots on both sides,
+        # same total traffic, same per-step cost: the ratio isolates
+        # placement + loop overlap.
+        rep_slots = max(2, slots // 2)
+
+        def build_replica(mesh_i):
+            if mesh_i is None:
+                kern = kernels  # share the compiled single-device triple
+            else:
+                kern = PagedDecodeKernels(model, cache_sharding=NamedSharding(
+                    mesh_i, kv_cache_pspec()))
+            if step_cost_ms > 0:
+                kern = _FixedCostKernels(kern, step_cost_ms / 1e3)
+            eng = GenerationEngine(
+                model, params, max_slots=rep_slots, max_len=max_len,
+                max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
+                kernels=kern, page_size=page_size, seed=0, mesh=mesh_i,
+                metrics=ServingMetrics())
+            eng.warmup()
+            return eng
+
+        single = build_replica(rep_meshes[0])
+        t0 = time.perf_counter()
+        ss = [single.submit(p, max_new_tokens=m, **sample_spec)
+              for p, m in requests]
+        single_tokens = sum(len(s.result(timeout=600)) for s in ss)
+        single_wall = time.perf_counter() - t0
+        single.close()
+
+        replicas = [build_replica(m_) for m_ in rep_meshes]
+        rset = ReplicaSet(replicas, metrics=ServingMetrics(), name="bench")
+        t0 = time.perf_counter()
+        rstreams = [rset.submit(p, max_new_tokens=m, **sample_spec)
+                    for p, m in requests]
+        rep_tokens = sum(len(s.result(timeout=600)) for s in rstreams)
+        rep_wall = time.perf_counter() - t0
+        per_replica = {}
+        for i, e in enumerate(replicas):
+            rsnap = e.metrics.snapshot()
+            per_replica[f"r{i}"] = {
+                "served": rsnap["served"],
+                "tokens_out": rsnap["tokens_out"],
+                "slot_occupancy": round(rsnap["slot_occupancy"], 4),
+            }
+        rset.close()
+        rep_tps = rep_tokens / rep_wall
+        single_tps_c = single_tokens / single_wall
+        rep_fields = {
+            "replica_slots": rep_slots,
+            "replicated_tokens_per_sec": round(rep_tps, 2),
+            "single_replica_tokens_per_sec": round(single_tps_c, 2),
+            "replicated_vs_single": round(rep_tps / single_tps_c, 3),
+            "per_replica": per_replica,
+        }
 
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
@@ -381,6 +540,10 @@ def run_generation_bench(args):
         "capacity_dense_slots": slots,
         "capacity_paged_seqs": capacity_paged,
         "capacity_paged_vs_dense": round(capacity_ratio, 3),
+        "tp": args.tp,
+        "replicas": args.replicas,
+        "step_cost_ms": step_cost_ms,
+        **rep_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -397,15 +560,27 @@ def run_generation_bench(args):
         if mismatches:
             raise SystemExit(
                 f"generation smoke: {mismatches} request(s) decoded "
-                "different tokens under continuous vs static scheduling — "
-                "decode (greedy AND seeded sampling) must be "
+                "different tokens under continuous vs static scheduling"
+                + (" (tp>1: the continuous side ran SHARDED — sharded "
+                   "decode must be bit-identical to single-device)"
+                   if args.tp > 1 else "")
+                + " — decode (greedy AND seeded sampling) must be "
                 "schedule-invariant")
-        if result["continuous_vs_static"] < 1.5:
+        if args.tp == 1 and result["continuous_vs_static"] < 1.5:
+            # tp>1 pits a sharded engine against a single-device static
+            # baseline: wall-clocks are not comparable there (CPU emulates
+            # the collectives); the identity gate above covers tp>1
             raise SystemExit(
                 "generation smoke: continuous batching %.2fx static "
                 "(gate: >= 1.5x on mixed lengths — the scheduling win "
                 "should not depend on core count)"
                 % result["continuous_vs_static"])
+        if args.replicas > 1 and result["replicated_vs_single"] < 1.5:
+            raise SystemExit(
+                "generation smoke: %d replicas sustain only %.2fx a single "
+                "replica's tokens/sec on the same total traffic at the "
+                "same per-step cost (gate: >= 1.5x — replica loops must "
+                "overlap)" % (args.replicas, result["replicated_vs_single"]))
         if result["capacity_paged_vs_dense"] < 2.0:
             raise SystemExit(
                 "generation smoke: paged KV admits only %.2fx the dense "
@@ -822,6 +997,22 @@ def _parse_args(argv=None):
     ap.add_argument("--page-size", type=int, default=16,
                     help="serving --generate: KV-cache page size (tokens "
                          "per page in the paged block-table pool)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="serving --generate: tensor-parallel degree — the "
+                         "engine runs sharded over a tp-device mesh "
+                         "(Megatron pspecs, KV pools sharded on heads); "
+                         "the static baseline stays single-device, so the "
+                         "mismatch gate checks sharded bit-identity")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serving --generate: replica count — R engines on "
+                         "disjoint device groups behind a ReplicaSet vs one "
+                         "engine at the same per-step cost; --smoke gates "
+                         "replicated tokens/sec >= 1.5x single-replica")
+    ap.add_argument("--step-cost-ms", type=float, default=None,
+                    help="serving --generate --replicas: fixed per-kernel-"
+                         "call cost standing in for a chip's step time "
+                         "(default: 8 ms under --smoke with replicas > 1, "
+                         "else 0 — raw wall clock)")
     ap.add_argument("--sample", action="store_true",
                     help="serving --generate: sample (temperature 0.8, "
                          "top-k 40, top-p 0.95) instead of greedy — runs "

@@ -52,6 +52,8 @@ from bigdl_tpu.ckpt.manifest import (
     fsync_dir,
     load_manifest,
     sha256_bytes,
+    shard_files,
+    verify_shards,
     write_manifest,
 )
 from bigdl_tpu.utils.checkpoint import (
@@ -227,7 +229,9 @@ class CheckpointManager:
         kept = apply_retention(entries, self.keep_last_n,
                                self.keep_every_k_steps)
         write_manifest(self.directory, kept, fsync=self.fsync)
-        self._gc(referenced={k.file for k in kept})
+        # per-shard blobs (multi-host entries) are live data: reference
+        # them so the orphan sweep can never eat another host's shard
+        self._gc(referenced={k.file for k in kept} | shard_files(kept))
         return entry
 
     def _adopt_legacy_entries(self, exclude: str) -> List[ManifestEntry]:
@@ -305,6 +309,14 @@ class CheckpointManager:
                     "checkpoint '%s' failed verification (missing, "
                     "truncated, or checksum mismatch); falling back to the "
                     "previous manifest entry", entry.tag)
+                continue
+            if not verify_shards(self.directory, entry):
+                # a sharded entry restores only when EVERY host shard
+                # verifies — one torn shard fails the whole entry over
+                log.warning(
+                    "checkpoint '%s' has a missing or corrupt per-host "
+                    "shard; falling back to the previous manifest entry",
+                    entry.tag)
                 continue
             try:
                 payload = deserialize_payload(blob, template)

@@ -32,7 +32,15 @@ _VERSION = 1
 
 @dataclasses.dataclass
 class ManifestEntry:
-    """One committed checkpoint."""
+    """One committed checkpoint.
+
+    ``shards`` is the multi-host groundwork: when a checkpoint's leaves
+    are written as per-host blobs (each host owning its mesh shard), the
+    entry lists every shard as ``{"path", "size", "sha256"}`` relative to
+    the directory, verified alongside the main blob at restore/hot-reload
+    time. Single-writer saves leave it empty — the schema is the
+    format-level prerequisite for sharded hot-reload, not a writer
+    change."""
 
     tag: str
     file: str                     # blob basename, relative to the directory
@@ -42,6 +50,7 @@ class ManifestEntry:
     wall_time: float
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
     preempted: bool = False
+    shards: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -130,6 +139,50 @@ def verify_entry(directory: str, entry: ManifestEntry) -> Optional[bytes]:
     if len(blob) != entry.size or sha256_bytes(blob) != entry.sha256:
         return None
     return blob
+
+
+def verify_shards(directory: str, entry: ManifestEntry) -> bool:
+    """True iff EVERY per-shard blob the entry lists matches its recorded
+    size and sha256 (vacuously true for shard-less entries). A sharded
+    checkpoint is only as restorable as its worst shard, so restore and
+    hot-reload gate on this alongside :func:`verify_entry` — one torn
+    host shard fails the whole entry over to the previous commit."""
+    for sh in entry.shards or []:
+        try:
+            path = os.path.join(directory, str(sh.get("path", "")))
+            want_size = int(sh.get("size", -1))
+            want_sha = sh.get("sha256")
+            digest = hashlib.sha256()
+            size = 0
+            # hash in chunks: shards are the GB-scale objects here and
+            # this runs on every restore and hot-reload poll — a full
+            # read would spike RAM by the shard size just to discard it
+            with open(path, "rb") as fh:
+                while chunk := fh.read(1 << 20):
+                    digest.update(chunk)
+                    size += len(chunk)
+        except (OSError, AttributeError, TypeError, ValueError):
+            # unreadable blob OR malformed metadata (a corrupt/future
+            # writer): both mean "this entry does not verify", never an
+            # exception — callers use the bool to fall back an entry
+            return False
+        if size != want_size or digest.hexdigest() != want_sha:
+            return False
+    return True
+
+
+def shard_files(entries: List[ManifestEntry]) -> set:
+    """Every shard path referenced by ``entries`` (for the GC's
+    referenced set — shards are live data, not orphans)."""
+    out = set()
+    for e in entries:
+        for sh in e.shards or []:
+            if not isinstance(sh, dict):
+                continue  # malformed metadata: nothing referencable
+            p = str(sh.get("path", ""))
+            if p:
+                out.add(p)
+    return out
 
 
 def apply_retention(

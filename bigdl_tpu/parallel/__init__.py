@@ -20,9 +20,13 @@ Axis-name conventions used across the framework:
 
 from bigdl_tpu.parallel.mesh import (
     MeshSpec,
+    axis_size,
     constrain,
     current_mesh,
     make_mesh,
+    serving_meshes,
+    shard_tree,
+    tree_shardings,
     use_mesh,
 )
 from bigdl_tpu.parallel.tp import (
@@ -30,6 +34,8 @@ from bigdl_tpu.parallel.tp import (
     RowParallelLinear,
     TensorParallelAttention,
     TensorParallelFFN,
+    kv_cache_pspec,
+    transformer_tp_pspecs,
 )
 from bigdl_tpu.parallel.ring_attention import ring_attention
 from bigdl_tpu.parallel.ulysses import ulysses_attention
@@ -52,8 +58,10 @@ from bigdl_tpu.parallel.overlap import (
 
 __all__ = [
     "MeshSpec", "make_mesh", "use_mesh", "current_mesh", "constrain",
+    "axis_size", "serving_meshes", "shard_tree", "tree_shardings",
     "ColumnParallelLinear", "RowParallelLinear",
     "TensorParallelAttention", "TensorParallelFFN",
+    "kv_cache_pspec", "transformer_tp_pspecs",
     "ring_attention", "ulysses_attention",
     "Pipeline", "pipeline_apply", "HeteroPipeline", "make_pp_train_step",
     "MoE", "SwitchFFN",

@@ -130,6 +130,94 @@ def constrain(x, *spec_parts):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*cleaned)))
 
 
+def tree_shardings(mesh: Mesh, tree, pspecs=None):
+    """Expand a SPARSE PartitionSpec tree into a full ``NamedSharding``
+    tree mirroring ``tree``.
+
+    ``pspecs`` follows ``Module.param_pspecs()`` conventions: nested dicts
+    holding ``PartitionSpec`` leaves for the annotated parameters only.
+    Every leaf of ``tree`` with no spec (missing key, or ``pspecs=None``)
+    gets ``P()`` — explicitly REPLICATED over the whole mesh, the safe
+    default for embeddings / norms / biases. The result is what
+    ``jax.device_put(tree, tree_shardings(...))`` and a reload both need:
+    one sharding per leaf, structurally identical to the value tree.
+    """
+    def walk(node, spec, path):
+        if isinstance(node, dict):
+            if spec is not None and not isinstance(spec, dict):
+                # a P() attached to a SUBTREE would otherwise silently
+                # replicate every leaf under it — a memory/perf regression
+                # with no symptom; specs apply to leaves (or tuple nodes)
+                raise ValueError(
+                    f"pspec at {'/'.join(path) or '<root>'} is "
+                    f"{spec!r} but the params tree has a dict there; "
+                    f"attach PartitionSpecs to leaves")
+            sub = spec or {}
+            extra = set(sub) - set(node)
+            if extra:
+                raise ValueError(
+                    f"pspec keys {sorted(extra)} at "
+                    f"{'/'.join(path) or '<root>'} match no parameter")
+            return {k: walk(v, sub.get(k), path + (k,))
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)) and not isinstance(node, P):
+            if isinstance(spec, P) or spec is None:
+                sub = [spec] * len(node)  # one spec covers homogeneous kids
+            else:
+                sub = list(spec)
+                if len(sub) != len(node):
+                    raise ValueError(
+                        f"pspec list at {'/'.join(path) or '<root>'} has "
+                        f"{len(sub)} entries for {len(node)} children")
+            out = [walk(v, s, path + (str(i),))
+                   for i, (v, s) in enumerate(zip(node, sub))]
+            return type(node)(out)
+        return NamedSharding(mesh, spec if spec is not None else P())
+
+    return walk(tree, pspecs, ())
+
+
+def shard_tree(mesh: Mesh, tree, pspecs=None):
+    """``(sharded tree, sharding tree)``: place every leaf of ``tree``
+    per the sparse ``pspecs`` (unannotated leaves replicated). The
+    returned sharding tree is the reload contract — hot-swapped weights
+    must be ``device_put`` with exactly these shardings or the jitted
+    step would miss its executable cache."""
+    shardings = tree_shardings(mesh, tree, pspecs)
+    return jax.device_put(tree, shardings), shardings
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    """Size of named ``axis`` in ``mesh`` (1 when absent — the degraded
+    single-chip case every tp layer must tolerate)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(sizes.get(axis, 1))
+
+
+def serving_meshes(n_replicas: int, tp: int = 1, *, axis: str = "tp",
+                   devices=None):
+    """``n_replicas`` disjoint single-axis meshes of ``tp`` devices each —
+    the replica-group topology for sharded + replicated serving: every
+    replica runs its tensor-parallel engine on its own device set, so one
+    replica's death or reload never touches a sibling's chips.
+
+    Raises when ``n_replicas * tp`` exceeds the available devices
+    (serving replicas must not share chips; for CPU tests use
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+    """
+    if n_replicas < 1 or tp < 1:
+        raise ValueError("n_replicas and tp must be >= 1")
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_replicas * tp
+    if need > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas x tp={tp} needs {need} devices, have "
+            f"{len(devices)} (CPU: set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")
+    return [make_mesh(MeshSpec(**{axis: tp}), devices[i * tp:(i + 1) * tp])
+            for i in range(n_replicas)]
+
+
 def mark_varying(t, axis_name):
     """Cast ``t`` to device-varying over ``axis_name`` (shard_map type
     system). ``pcast`` is the current API; ``pvary`` its deprecated

@@ -26,11 +26,18 @@ from typing import Optional
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from jax.sharding import Mesh
+
 from bigdl_tpu.nn.init import Xavier, Zeros
 from bigdl_tpu.nn.layers.linear import Linear
 from bigdl_tpu.nn.module import Context, Module
 from bigdl_tpu.ops.attention import dot_product_attention
-from bigdl_tpu.parallel.mesh import UNCONSTRAINED, constrain, current_mesh
+from bigdl_tpu.parallel.mesh import (
+    UNCONSTRAINED,
+    axis_size,
+    constrain,
+    current_mesh,
+)
 
 
 class ColumnParallelLinear(Linear):
@@ -157,3 +164,68 @@ class TensorParallelAttention(Module):
         b, h, s, d = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         return self.run_child(ctx, "out", o)
+
+
+# --------------------------------------------------------------------------
+# Serving-side tensor parallelism: Megatron pspecs for an ``nn.Transformer``.
+#
+# The serving tier decodes through ``nn.Transformer``'s incremental API
+# (prefill / decode_step and their paged twins), whose layers are plain
+# ``Linear``s with no sharding annotations. These helpers map that exact
+# parameter tree onto the column->row pattern the classes above implement
+# for training, so an ``InferenceService``/``GenerationEngine`` can pjit
+# the SAME kernels over tensor-parallel weights: q/k/v projections shard
+# like :class:`ColumnParallelLinear` (each tp shard owns
+# ``num_heads / tp`` heads end to end, which is also how the KV cache
+# shards), attention output + FFN down projection like
+# :class:`RowParallelLinear` (the two psums per block), embeddings and
+# norms replicated. GSPMD derives every collective from the weight
+# shardings alone — the serving model source is untouched.
+
+
+def kv_cache_pspec(axis: str = "tp") -> P:
+    """PartitionSpec for serving KV caches, dense or paged: both are
+    ``(slots|pages, heads, rows, head_dim)`` per layer, sharded on the
+    HEADS axis — the same per-head ownership the column-parallel q/k/v
+    projections produce, so cache reads/writes need no collective."""
+    return P(None, axis)
+
+
+def transformer_tp_pspecs(model, mesh: Optional[Mesh] = None,
+                          axis: str = "tp"):
+    """Sparse Megatron PartitionSpec tree for an ``nn.Transformer``'s
+    params (LANGUAGE_MODEL mode — the serving decode surface).
+
+    Returns only the sharded leaves (``parallel.mesh.tree_shardings``
+    replicates everything else: embedding, norms, output biases). With a
+    ``mesh``, validates that the ``axis`` size divides ``num_heads`` —
+    attention is parallel over whole heads, never head fractions.
+    """
+    from bigdl_tpu.nn.layers.attention import LANGUAGE_MODEL, Transformer
+
+    if not isinstance(model, Transformer):
+        raise TypeError(
+            f"transformer_tp_pspecs needs an nn.Transformer, got "
+            f"{type(model).__name__}; pass explicit param_pspecs for "
+            f"other model families")
+    if model.transformer_type != LANGUAGE_MODEL:
+        raise ValueError("serving tensor parallelism covers language_model "
+                         "(decoder-only) transformers")
+    if mesh is not None:
+        tp = axis_size(mesh, axis)
+        if model.num_heads % tp:
+            raise ValueError(
+                f"mesh axis '{axis}' size {tp} must divide num_heads "
+                f"{model.num_heads} (heads shard whole, like "
+                f"TensorParallelAttention)")
+    col = {"weight": P(axis, None)}       # ColumnParallelLinear pattern
+    row = {"weight": P(None, axis)}       # RowParallelLinear pattern
+    attn = {"inner": {"q_layer": col, "k_layer": col, "v_layer": col,
+                      "output_layer": row}}
+    ffn = {"inner": {"filter_layer": {"weight": P(axis, None),
+                                      "bias": P(axis)},
+                     "output_layer": {"weight": P(None, axis),
+                                      "bias": P()}}}
+    layer = {"self_attention": attn, "ffn": ffn}
+    return {name: layer for name in model.modules
+            if name.startswith("decoder_")}

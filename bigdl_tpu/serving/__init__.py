@@ -18,6 +18,12 @@ package supplies the TPU-native translation:
   :class:`GenerationStream` iterator-futures;
 - :class:`ModelRouter` — one ``submit(model, x)`` front door over N
   registered backends with per-model quotas;
+- :class:`ReplicaSet` — N replicas of one model on disjoint device sets
+  behind one ``submit``: least-loaded placement, consecutive-failure
+  eviction with probe-driven rejoin, and draining rolling reloads (a
+  model name registered with a LIST of backends resolves to one
+  transparently); pair with ``parallel.serving_meshes`` /
+  ``parallel.tp.transformer_tp_pspecs`` for tensor-parallel replicas;
 - :func:`watch_checkpoints` — poll a ckpt-tier ``MANIFEST.json`` and
   hot-reload a running service on each new committed entry;
 - :class:`ServingMetrics` — served/rejected/expired counters, batch and
@@ -40,12 +46,14 @@ from bigdl_tpu.serving.paging import PagePool
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
+    ReplicaUnavailable,
     ServingError,
     StreamCancelled,
     UnknownModel,
 )
 from bigdl_tpu.serving.hot_reload import CheckpointWatcher, watch_checkpoints
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.replica import ReplicaSet
 from bigdl_tpu.serving.router import ModelRouter
 from bigdl_tpu.serving.service import InferenceService
 
@@ -61,6 +69,8 @@ __all__ = [
     "Overloaded",
     "PagePool",
     "PagedDecodeKernels",
+    "ReplicaSet",
+    "ReplicaUnavailable",
     "ServingError",
     "ServingMetrics",
     "StreamCancelled",

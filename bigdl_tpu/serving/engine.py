@@ -104,6 +104,25 @@ class _TraceCounts:
         self.chunk = 0
 
 
+def _cache_pinner(cache_sharding):
+    """Constraint applied to the new cache INSIDE every jitted kernel
+    when the engine runs sharded: pins the output cache to the exact
+    NamedSharding the input cache carries, so (a) donation of the sharded
+    cache holds call after call (donor and result layouts match) and
+    (b) GSPMD can never drift the cache layout between steps, which would
+    miss the executable cache and break compile-once. ``None`` (the
+    single-device engine) is the identity."""
+    if cache_sharding is None:
+        return lambda cache: cache
+
+    def pin(cache):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, cache_sharding),
+            cache)
+
+    return pin
+
+
 class DecodeKernels:
     """The jitted ``(prefill, decode)`` pair over a decode-capable model
     (one exposing ``init_cache`` / ``prefill`` / ``decode_step``, e.g.
@@ -115,22 +134,29 @@ class DecodeKernels:
     actually traces (= compiles) — the compile-count assertions in the
     tests read them. The cache argument is donated: the steady-state
     loop never reallocates cache buffers.
+
+    ``cache_sharding`` (a ``NamedSharding``, typically
+    ``parallel.tp.kv_cache_pspec`` over a serving mesh) turns the pair
+    into pjit over tensor-parallel params: the returned cache is pinned
+    to that sharding so donation and compile-once survive sharding.
     """
 
-    def __init__(self, model, *, donate: bool = True):
+    def __init__(self, model, *, donate: bool = True, cache_sharding=None):
         self.model = model
+        self.cache_sharding = cache_sharding
         self.counts = _TraceCounts()
         counts = self.counts
+        pin = _cache_pinner(cache_sharding)
 
         def prefill(params, cache, slot, tokens, length):
             counts.prefill += 1
             logits, cache = model.prefill(params, cache, slot, tokens, length)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pin(cache)
 
         def decode(params, cache, tokens, positions):
             counts.decode += 1
             logits, cache = model.decode_step(params, cache, tokens, positions)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pin(cache)
 
         dn = (1,) if donate else ()
         self._prefill = jax.jit(prefill, donate_argnums=dn)
@@ -176,14 +202,17 @@ class PagedDecodeKernels:
 
     The cache is donated on every call; only token/key vectors cross to
     the host per step. ``use_kernel`` routes decode attention through
-    the Pallas paged kernel (auto: TPU only).
+    the Pallas paged kernel (auto: TPU only). ``cache_sharding`` shards
+    the page pools (heads axis) exactly like :class:`DecodeKernels`.
     """
 
     def __init__(self, model, *, donate: bool = True,
-                 use_kernel: Optional[bool] = None):
+                 use_kernel: Optional[bool] = None, cache_sharding=None):
         self.model = model
+        self.cache_sharding = cache_sharding
         self.counts = _TraceCounts()
         counts = self.counts
+        pin = _cache_pinner(cache_sharding)
 
         def prefill(params, cache, pages, tokens, start, length, trash,
                     temp, top_k, top_p, key):
@@ -192,12 +221,13 @@ class PagedDecodeKernels:
                 params, cache, pages, tokens, start, length, trash)
             toks, new_key = sample_tokens(logits[None], temp, top_k, top_p,
                                           key)
-            return toks[0], new_key, cache
+            return toks[0], new_key, pin(cache)
 
         def chunk(params, cache, pages, tokens, start, length, trash):
             counts.chunk += 1
-            return model.prefill_paged(params, cache, pages, tokens, start,
-                                       length, trash, need_logits=False)
+            return pin(model.prefill_paged(params, cache, pages, tokens,
+                                           start, length, trash,
+                                           need_logits=False))
 
         def decode(params, cache, tokens, positions, page_map,
                    temps, top_ks, top_ps, keys):
@@ -207,7 +237,7 @@ class PagedDecodeKernels:
                 use_kernel=use_kernel)
             toks, new_keys = sample_tokens(logits, temps, top_ks, top_ps,
                                            keys)
-            return toks, new_keys, cache
+            return toks, new_keys, pin(cache)
 
         dn = (1,) if donate else ()
         self._prefill = jax.jit(prefill, donate_argnums=dn)
@@ -523,7 +553,10 @@ class GenerationEngine:
                  num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  seed: int = 0,
-                 use_paged_kernel: Optional[bool] = None):
+                 use_paged_kernel: Optional[bool] = None,
+                 mesh=None,
+                 param_pspecs=None,
+                 shard_axis: str = "tp"):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -536,11 +569,50 @@ class GenerationEngine:
         self.max_queue = int(max_queue)
         self.metrics = metrics or ServingMetrics()
         self.seed = int(seed)
+        # sharded (tensor-parallel) mode: params placed per the Megatron
+        # pspecs (parallel.tp), the KV cache — dense lanes or paged pools
+        # — sharded on the HEADS axis; the jitted kernels become pjit and
+        # GSPMD derives the collectives. Greedy decode stays bit-identical
+        # to the single-device engine and compile-once survives because
+        # every call sees the same input shardings (test-enforced).
+        self.mesh = mesh
+        self._param_shardings = None
+        self._cache_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from bigdl_tpu.parallel.mesh import tree_shardings
+            from bigdl_tpu.parallel.tp import (
+                kv_cache_pspec,
+                transformer_tp_pspecs,
+            )
+
+            if param_pspecs is None:
+                param_pspecs = transformer_tp_pspecs(model, mesh,
+                                                     axis=shard_axis)
+            self._param_shardings = tree_shardings(mesh, params, param_pspecs)
+            params = jax.device_put(params, self._param_shardings)
+            self._cache_sharding = NamedSharding(mesh,
+                                                 kv_cache_pspec(shard_axis))
+            if kernels is not None and getattr(
+                    kernels, "cache_sharding",
+                    None) != self._cache_sharding:
+                # not just non-None: kernels pinned to a DIFFERENT mesh or
+                # spec would return caches whose layout disagrees with the
+                # engine's placement every step — donation mismatch and a
+                # silent compile-once violation
+                raise ValueError(
+                    "a sharded engine needs kernels built with the engine's "
+                    "exact cache_sharding (NamedSharding of this mesh + "
+                    f"{kv_cache_pspec(shard_axis)}); pass kernels=None to "
+                    "build matching ones")
         # mode: the kernels pick it when given; otherwise paged whenever
         # the model speaks the paged API (the dense lanes are the PR-5
-        # baseline, kept for bit-identity tests and plain-cache models)
+        # baseline, kept for bit-identity tests and plain-cache models).
+        # `chunk` is the paged-triple discriminator so wrappers (fixed
+        # step-cost shims, failure injectors) duck-type either flavour.
         if kernels is not None:
-            self.paged = isinstance(kernels, PagedDecodeKernels)
+            self.paged = hasattr(kernels, "chunk")
         else:
             self.paged = bool(page_size) and hasattr(model,
                                                     "decode_step_paged")
@@ -569,7 +641,8 @@ class GenerationEngine:
             self._pool = PagePool(self.num_pages, self.page_size,
                                   self.max_len)
             self.kernels = kernels or PagedDecodeKernels(
-                model, use_kernel=use_paged_kernel)
+                model, use_kernel=use_paged_kernel,
+                cache_sharding=self._cache_sharding)
             self._cache = model.init_paged_cache(
                 self.num_pages + 1, self.page_size, cache_dtype)
             # per-slot step inputs, mutated on admission/retirement only
@@ -582,9 +655,14 @@ class GenerationEngine:
             self.metrics.set_pages(0, self.num_pages)
         else:
             self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
-            self.kernels = kernels or DecodeKernels(model)
+            self.kernels = kernels or DecodeKernels(
+                model, cache_sharding=self._cache_sharding)
             self._cache = model.init_cache(self.max_slots, self.max_len,
                                            cache_dtype)
+        if self._cache_sharding is not None:
+            # heads-axis placement from step zero: the kernels' in-step
+            # constraint then keeps every successive donated cache here
+            self._cache = jax.device_put(self._cache, self._cache_sharding)
         self._params = params
         self._failed: Optional[BaseException] = None
         self._core = _Core(self.max_slots)
@@ -1006,8 +1084,13 @@ class GenerationEngine:
                 "decode runs stateless (no BN-style buffers)")
         require_matching_signature("params", self._params, params)
         # device_put once: host arrays would re-transfer every step and
-        # miss the jit cache (uncommitted args key a different executable)
-        self._params = jax.device_put(params)
+        # miss the jit cache (uncommitted args key a different executable).
+        # A sharded engine re-places with the ORIGINAL shardings for the
+        # same reason: differently-placed params key a fresh executable.
+        if self._param_shardings is not None:
+            self._params = jax.device_put(params, self._param_shardings)
+        else:
+            self._params = jax.device_put(params)
         self.metrics.record_reload()
 
     def close(self, drain: bool = True,
@@ -1102,7 +1185,7 @@ def static_generate(model, params, requests, *, max_slots: int,
                    if page_size and hasattr(model, "decode_step_paged")
                    else DecodeKernels(model))
     requests = [([int(t) for t in p], int(m)) for p, m in requests]
-    if isinstance(kernels, PagedDecodeKernels):
+    if hasattr(kernels, "chunk"):  # paged triple (or a wrapper around one)
         return _static_generate_paged(
             model, params, requests, kernels, max_slots=max_slots,
             max_len=max_len, eos_id=eos_id, pad_id=pad_id,

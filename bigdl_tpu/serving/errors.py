@@ -42,6 +42,22 @@ class UnknownModel(ServingError):
         self.available = sorted(available)
 
 
+class ReplicaUnavailable(ServingError):
+    """Every replica in a :class:`~bigdl_tpu.serving.replica.ReplicaSet`
+    is quarantined (or closed): there is no healthy backend to place the
+    request on. Distinct from :class:`Overloaded` — overload is healthy
+    backpressure, this is an availability failure the operator should
+    page on."""
+
+    def __init__(self, name: str, replicas):
+        replicas = list(replicas)
+        super().__init__(
+            f"no healthy replica available for '{name}' "
+            f"({len(replicas)} registered: {', '.join(replicas) or '<none>'})")
+        self.name = name
+        self.replicas = replicas
+
+
 class StreamCancelled(ServingError):
     """The generation stream was cancelled by its consumer; the slot was
     retired at the next decode-step boundary. Tokens produced before the

@@ -21,7 +21,7 @@ import logging
 import threading
 from typing import Any, Callable, Optional
 
-from bigdl_tpu.ckpt.manifest import load_manifest, verify_entry
+from bigdl_tpu.ckpt.manifest import load_manifest, verify_entry, verify_shards
 from bigdl_tpu.utils.checkpoint import deserialize_payload
 
 log = logging.getLogger("bigdl_tpu.serving")
@@ -77,6 +77,16 @@ class CheckpointWatcher:
             return False
         if entry.tag == self._skip_tag:
             return False  # known-bad tip: wait for a NEW commit
+        # shards first: they fail cheap (per-shard chunked hash) and a
+        # torn-shard tip is retried every poll until repaired — checking
+        # them before verify_entry spares re-reading and re-hashing the
+        # full main blob on each of those failing polls
+        if not verify_shards(self.directory, entry):
+            log.warning(
+                "checkpoint '%s' has a missing or corrupt per-host shard; "
+                "keeping the serving weights and waiting for the next "
+                "commit (or the shard's repair)", entry.tag)
+            return False
         blob = verify_entry(self.directory, entry)
         if blob is None:
             log.warning(
@@ -88,17 +98,29 @@ class CheckpointWatcher:
             payload = deserialize_payload(blob, self._template)
             self.service.reload(payload["params"],
                                 payload.get("module_state") or None)
-        except Exception as e:
-            # deterministic failure (structure/signature mismatch — e.g. a
-            # retrained model with a different config): memo the tag so we
-            # do not re-read + re-deserialize a multi-GB blob every poll
-            # forever; a NEW commit clears the memo by changing the tip
+        except (ValueError, TypeError) as e:
+            # deterministic rejection (structure/signature mismatch — e.g.
+            # a retrained model with a different config): memo the tag so
+            # we do not re-read + re-deserialize a multi-GB blob every
+            # poll forever; a NEW commit clears the memo by changing the
+            # tip
             self._skip_tag = entry.tag
             self.last_error = e
             log.exception(
                 "checkpoint '%s' cannot be hot-reloaded; the serving "
                 "weights are unchanged and this entry will be skipped "
                 "until a new commit lands", entry.tag)
+            return False
+        except Exception as e:
+            # anything else may be TRANSIENT — a device_put hiccup, or a
+            # ReplicaSet roll aborted by one replica mid-sweep (siblings
+            # already swapped; only a RETRY of this same tip can converge
+            # the fleet back to one version) — so do NOT memoize: the
+            # next poll tries the same entry again
+            self.last_error = e
+            log.exception(
+                "checkpoint '%s' reload failed (possibly transient); "
+                "will retry on the next poll", entry.tag)
             return False
         self._skip_tag = None
         self.last_error = None

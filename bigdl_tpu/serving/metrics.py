@@ -85,6 +85,14 @@ class ServingMetrics:
         self.pages_in_use = 0    # KV pool pages currently reserved (gauge)
         self.pages_total = 0     # KV pool size (gauge; 0 = not paged)
         self.pages_peak = 0      # high-water reserved pages
+        # replica-group counters (ReplicaSet); zero for a single backend —
+        # its snapshot/table keep the earlier shapes (append-only contract)
+        self.replicas_total = 0      # registered replicas (gauge)
+        self.replicas_healthy = 0    # replicas currently placeable (gauge)
+        self.replica_evictions = 0   # consecutive-failure quarantines
+        self.replica_rejoins = 0     # probe-verified returns to service
+        self.rolling_reloads = 0     # completed rolling reload sweeps
+        self._replica_inflight: Dict[str, int] = {}  # per-replica gauge
 
     # ------------------------------------------------------- mutators ----
 
@@ -173,6 +181,34 @@ class ServingMetrics:
             self.pages_total = total
             self.pages_peak = max(self.pages_peak, in_use)
 
+    # --------------------------------------------- replica mutators ----
+
+    def set_replicas(self, healthy: int, total: int,
+                     inflight: Optional[Dict[str, int]] = None) -> None:
+        """Replica-group occupancy gauges (ReplicaSet only): how many
+        replicas are placeable and each replica's in-flight depth."""
+        with self._lock:
+            self.replicas_healthy = int(healthy)
+            self.replicas_total = int(total)
+            if inflight is not None:
+                self._replica_inflight = dict(inflight)
+
+    def record_eviction(self) -> None:
+        """One replica quarantined after consecutive failures."""
+        with self._lock:
+            self.replica_evictions += 1
+
+    def record_rejoin(self) -> None:
+        """One quarantined replica returned to service after a probe."""
+        with self._lock:
+            self.replica_rejoins += 1
+
+    def record_rolling_reload(self) -> None:
+        """One completed rolling reload sweep (every replica drained and
+        swapped in turn; individual swaps also count in ``reloads``)."""
+        with self._lock:
+            self.rolling_reloads += 1
+
     # -------------------------------------------------------- readers ----
 
     def snapshot(self) -> dict:
@@ -229,6 +265,14 @@ class ServingMetrics:
                 "pages_peak": self.pages_peak,
                 "page_occupancy": (self.pages_in_use / self.pages_total
                                    if self.pages_total else 0.0),
+                # replica-group fields (PR 7): appended after every
+                # earlier key, never reordered
+                "replicas_total": self.replicas_total,
+                "replicas_healthy": self.replicas_healthy,
+                "replica_evictions": self.replica_evictions,
+                "replica_rejoins": self.replica_rejoins,
+                "rolling_reloads": self.rolling_reloads,
+                "replica_inflight": dict(self._replica_inflight),
             }
 
     def format_table(self) -> str:
@@ -278,4 +322,17 @@ class ServingMetrics:
             row("sampled_tokens", s["sampled_tokens"])
         if s["reloads"]:
             row("reloads", s["reloads"])
+        # replica-group rows: appended strictly LAST (after the reloads
+        # row) and only when a ReplicaSet is actually reporting — every
+        # earlier table stays a byte-identical strict prefix of this one
+        # (the append-only golden contract, test-enforced)
+        if s["replicas_total"]:
+            row("replicas_healthy", f"{s['replicas_healthy']}"
+                                    f"/{s['replicas_total']}")
+            row("replica_evictions", s["replica_evictions"])
+            row("replica_rejoins", s["replica_rejoins"])
+            row("rolling_reloads", s["rolling_reloads"])
+            dist = " ".join(f"{k}:{v}" for k, v in
+                            sorted(s["replica_inflight"].items()))
+            row("replica_inflight", dist or "-")
         return "\n".join(lines)

@@ -1,0 +1,481 @@
+"""ReplicaSet — N serving backends for one model behind one front door.
+
+The scale-OUT half of sharded + replicated serving: one model, N engine
+replicas on disjoint device sets (build the meshes with
+``parallel.mesh.serving_meshes``; each replica may itself be
+tensor-parallel over ``tp`` chips). ``submit()`` keeps the exact backend
+signature — a :class:`~bigdl_tpu.serving.router.ModelRouter` resolves a
+model name to a ReplicaSet transparently (``register`` even auto-wraps a
+list of backends) — and the set adds the cross-replica concerns:
+
+- **least-loaded placement** — each request goes to the placeable
+  replica with the fewest set-tracked in-flight requests (ties break by
+  replica index, so placement is a pure function of the request/
+  completion sequence — the skew test leans on this). A replica that
+  rejects with :class:`Overloaded` is skipped for that request; only
+  when EVERY placeable replica is saturated does the front door raise.
+- **health / eviction / rejoin** — a replica whose submissions or
+  streams fail with an engine error (not a client error: deadlines,
+  cancels, overload and malformed requests never count) accrues
+  consecutive failures; at ``max_failures`` it is quarantined and
+  traffic fails over to its siblings instead of failing the front door.
+  A quarantined replica rejoins only after a ``probe`` succeeds against
+  it (a background prober polls every ``probe_interval`` seconds;
+  ``probe_once()`` is the synchronous handle for tests and operators).
+- **draining rolling reloads** — ``reload(params)`` sweeps the replicas
+  ONE at a time: mark draining (no new placements), wait for in-flight
+  work to finish, swap weights via the backend's atomic ``reload``,
+  return it to service, move on. At most one replica is ever out of
+  rotation, so a set of N never drops below N-1 serving replicas — and
+  ``watch_checkpoints`` drives the whole roll from a training job's
+  checkpoint manifest, because the set duck-types the ``reload``
+  contract its members implement.
+
+Backends are anything speaking the serving trio (``submit`` returning a
+future/stream with ``add_done_callback``, ``metrics``, ``close``):
+:class:`~bigdl_tpu.serving.engine.GenerationEngine`,
+:class:`~bigdl_tpu.serving.service.InferenceService`, or stubs. When all
+replicas share ONE :class:`ServingMetrics` (the recommended wiring — the
+engines accept ``metrics=``), the set adopts it, so aggregate counters
+and the replica gauges land in a single table.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import CancelledError
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    ReplicaUnavailable,
+    StreamCancelled,
+    UnknownModel,
+)
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+log = logging.getLogger("bigdl_tpu.serving")
+
+# errors that indict the REQUEST (or its consumer), never the replica:
+# a deadline miss, a cancel, healthy backpressure, or a malformed input
+# would fail identically on every sibling
+_CLIENT_ERRORS = (Overloaded, DeadlineExceeded, StreamCancelled,
+                  UnknownModel, ValueError, TypeError, CancelledError)
+
+
+class _Replica:
+    """Host-side bookkeeping for one backend."""
+
+    __slots__ = ("backend", "name", "index", "inflight", "healthy",
+                 "draining", "failures", "served", "failed",
+                 "weights_version")
+
+    def __init__(self, backend, index: int):
+        self.backend = backend
+        self.name = f"r{index}"
+        self.index = index
+        self.inflight = 0       # set-tracked depth (the placement key)
+        self.healthy = True
+        self.draining = False   # rolling reload: excluded from placement
+        self.failures = 0       # CONSECUTIVE failures (reset on success)
+        self.served = 0
+        self.failed = 0
+        self.weights_version = 0  # last rolling-reload sweep applied
+
+
+class ReplicaSet:
+    """N serving backends for one model behind one ``submit`` door.
+
+    ``replicas`` is a non-empty sequence of backends (engines/services
+    the set now OWNS — ``close()`` closes them). ``max_failures``
+    consecutive engine failures quarantine a replica; ``probe(backend)``
+    (raises on failure) lets it rejoin, polled every ``probe_interval``
+    seconds when set. ``metrics`` defaults to the replicas' shared
+    :class:`ServingMetrics` when they share one, else a fresh set-level
+    instance; the replica gauges land there either way.
+    """
+
+    def __init__(self, replicas: Sequence[Any], *,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_failures: int = 2,
+                 probe: Optional[Callable[[Any], Any]] = None,
+                 probe_interval: float = 2.0,
+                 name: str = "replicas"):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        if max_failures < 1:
+            raise ValueError("max_failures must be >= 1")
+        self.name = name
+        self.max_failures = int(max_failures)
+        self._cond = threading.Condition()
+        self._replicas = [_Replica(b, i) for i, b in enumerate(replicas)]
+        if metrics is None:
+            first = getattr(replicas[0], "metrics", None)
+            shared = first is not None and all(
+                getattr(b, "metrics", None) is first for b in replicas)
+            metrics = first if shared else ServingMetrics()
+        self.metrics = metrics
+        self._probe_fn = probe
+        self.probe_interval = float(probe_interval)
+        self._closed = False
+        self._roll_lock = threading.Lock()  # one rolling reload at a time
+        self._weights_version = 0           # bumped per reload() sweep
+        self._latest_weights = None         # (params, state) of last sweep
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._update_gauges()
+        if probe is not None and self.probe_interval > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="bigdl-serving-replica-probe",
+                daemon=True)
+            self._prober.start()
+
+    # ------------------------------------------------------ placement ----
+
+    def _pick(self, tried: List[_Replica]) -> Optional[_Replica]:
+        """Least-loaded placeable replica not yet tried for this request.
+        Falls back to a DRAINING replica only when NO healthy replica is
+        in rotation at all (a 1-replica set mid-reload keeps its door
+        open — backend reloads are atomic between steps, so this is
+        safe; the drain wait then relies on its timeout). When serving
+        siblings exist but were tried (Overloaded), the answer is
+        backpressure, NOT the draining replica — dumping overflow there
+        would keep its in-flight count pinned and turn every swap of a
+        loaded roll into a full drain_timeout wait."""
+        with self._cond:
+            serving = [r for r in self._replicas
+                       if r.healthy and not r.draining]
+            pool = [r for r in serving if r not in tried]
+            if not serving:
+                pool = [r for r in self._replicas
+                        if r.healthy and r not in tried]
+            if pool:
+                return min(pool, key=lambda r: (r.inflight, r.index))
+            return None
+
+    def submit(self, x, **kwargs):
+        """Place one request on the least-loaded healthy replica and
+        return its handle (stream/future — exactly what the backend's
+        ``submit`` returns). An :class:`Overloaded` replica is skipped; a
+        replica that fails at submission is marked and skipped; raises
+        :class:`Overloaded` only when every placeable replica is
+        saturated, :class:`ReplicaUnavailable` when none is healthy."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("replica set is closed")
+        tried: List[_Replica] = []
+        overload: Optional[Overloaded] = None
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                if overload is not None:
+                    raise overload
+                raise ReplicaUnavailable(
+                    self.name, [rr.name for rr in self._replicas])
+            try:
+                handle = r.backend.submit(x, **kwargs)
+            except Overloaded as e:
+                overload = e  # healthy backpressure, not a health event
+                tried.append(r)
+                continue
+            except _CLIENT_ERRORS:
+                raise  # would fail identically on every sibling
+            except Exception as e:
+                self._note_failure(r, e, where="submit")
+                tried.append(r)
+                continue
+            self._track(r, handle)
+            return handle
+
+    def predict(self, x, timeout: Optional[float] = None, **kwargs):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(x, **kwargs).result(timeout)
+
+    def _track(self, r: _Replica, handle) -> None:
+        with self._cond:
+            r.inflight += 1
+        self._update_gauges()
+        released = [False]
+
+        def done(h):
+            # idempotent by construction: a handle whose callbacks fire
+            # twice (or a close() racing a completion) releases the
+            # in-flight slot exactly once
+            with self._cond:
+                if released[0]:
+                    return
+                released[0] = True
+                r.inflight -= 1
+                self._cond.notify_all()
+            err = self._handle_error(h)
+            if err is None:
+                self._note_success(r)
+            elif not isinstance(err, _CLIENT_ERRORS):
+                self._note_failure(r, err, where="stream")
+            # client outcomes (deadline, cancel, ...) are NEUTRAL: they
+            # neither count as served nor reset the consecutive-failure
+            # streak — otherwise interleaved deadline traffic could keep
+            # an every-other-stream-failing replica below max_failures
+            # forever
+            self._update_gauges()
+
+        try:
+            handle.add_done_callback(done)
+        except BaseException:
+            done(handle)  # never strand the in-flight count
+            raise
+
+    @staticmethod
+    def _handle_error(handle) -> Optional[BaseException]:
+        err = getattr(handle, "error", None)
+        if err is None and hasattr(handle, "exception"):
+            try:
+                err = handle.exception(timeout=0)
+            except TypeError:
+                err = handle.exception()
+            except BaseException as e:  # CancelledError et al.
+                err = e
+        return err
+
+    # --------------------------------------------------------- health ----
+
+    def _note_failure(self, r: _Replica, error: BaseException,
+                      where: str) -> None:
+        with self._cond:
+            r.failures += 1
+            r.failed += 1
+            evict = r.healthy and r.failures >= self.max_failures
+            if evict:
+                r.healthy = False
+        if evict:
+            self.metrics.record_eviction()
+            log.warning(
+                "replica %s/%s quarantined after %d consecutive failures "
+                "(last, at %s: %s); traffic fails over to siblings",
+                self.name, r.name, r.failures, where, error)
+        else:
+            log.info("replica %s/%s failure at %s (%d/%d before eviction): "
+                     "%s", self.name, r.name, where, r.failures,
+                     self.max_failures, error)
+        self._update_gauges()
+
+    def _note_success(self, r: _Replica) -> None:
+        with self._cond:
+            r.served += 1
+            r.failures = 0
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            try:
+                self.probe_once()
+            except Exception:
+                log.exception("replica probe pass failed; will retry")
+
+    def probe_once(self) -> int:
+        """Probe every quarantined replica once; rejoin the ones whose
+        probe succeeds. Returns how many rejoined. (The background prober
+        calls this every ``probe_interval``; tests and operators can call
+        it synchronously.)"""
+        if self._probe_fn is None:
+            return 0
+        rejoined = 0
+        for r in self._replicas:
+            with self._cond:
+                if r.healthy or self._closed:
+                    continue
+            try:
+                self._probe_fn(r.backend)
+            except Exception as e:
+                log.info("replica %s/%s probe failed (stays quarantined): "
+                         "%s", self.name, r.name, e)
+                continue
+            # a replica that missed a rolling reload while quarantined
+            # must catch up BEFORE it rejoins — re-entering rotation on
+            # the old checkpoint would serve mixed model versions forever
+            # (the watcher's tip has already advanced, so nothing else
+            # would ever retry the swap)
+            with self._roll_lock:
+                stale = r.weights_version != self._weights_version
+                weights = self._latest_weights
+                if stale and weights is not None:
+                    params, state = weights
+                    try:
+                        if state is None:
+                            r.backend.reload(params)
+                        else:
+                            r.backend.reload(params, state)
+                    except Exception as e:
+                        log.warning(
+                            "replica %s/%s probe succeeded but the "
+                            "missed-reload catch-up failed (stays "
+                            "quarantined): %s", self.name, r.name, e)
+                        continue
+                    r.weights_version = self._weights_version
+            with self._cond:
+                r.healthy = True
+                r.failures = 0
+            rejoined += 1
+            self.metrics.record_rejoin()
+            log.info("replica %s/%s rejoined after a successful probe",
+                     self.name, r.name)
+        if rejoined:
+            self._update_gauges()
+        return rejoined
+
+    # --------------------------------------------------- rolling reload ----
+
+    def reload(self, params, state: Any = None, *,
+               drain_timeout: float = 30.0) -> None:
+        """Rolling reload: drain and swap each replica IN TURN via its
+        atomic ``reload``, so the set never drops below N-1 serving
+        replicas (``watch_checkpoints`` on a ReplicaSet drives exactly
+        this). A replica still busy after ``drain_timeout`` is reloaded
+        anyway — backend reloads swap between steps/batches, so this
+        trades per-stream params consistency for bounded roll time, with
+        a warning. A HEALTHY replica rejecting the weights (signature
+        mismatch = config error) aborts the roll loudly; already-swapped
+        siblings keep the new weights. Quarantined replicas are still
+        attempted (so a later rejoin serves fresh weights) but their
+        failures only log."""
+        with self._roll_lock:
+            # remember the sweep: a quarantined replica that misses it
+            # must catch up at probe-rejoin time, or it would re-enter
+            # rotation serving the previous checkpoint
+            self._weights_version += 1
+            self._latest_weights = (params, state)
+            version = self._weights_version
+            for r in self._replicas:
+                with self._cond:
+                    if self._closed:
+                        raise RuntimeError("replica set is closed")
+                    healthy = r.healthy
+                    r.draining = True
+                    deadline = time.monotonic() + float(drain_timeout)
+                    while r.inflight > 0:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(timeout=min(0.1, left))
+                    drained = r.inflight == 0
+                if not drained:
+                    log.warning(
+                        "replica %s/%s still has %d request(s) in flight "
+                        "after %.1fs drain; reloading anyway (the backend "
+                        "swap is atomic between steps)",
+                        self.name, r.name, r.inflight, drain_timeout)
+                try:
+                    if state is None:
+                        r.backend.reload(params)
+                    else:
+                        r.backend.reload(params, state)
+                except Exception as e:
+                    with self._cond:
+                        r.draining = False
+                    self._update_gauges()
+                    if healthy:
+                        raise
+                    log.warning("quarantined replica %s/%s reload failed "
+                                "(retried at probe-rejoin): %s",
+                                self.name, r.name, e)
+                    continue
+                r.weights_version = version
+                with self._cond:
+                    r.draining = False
+                self._update_gauges()
+            self.metrics.record_rolling_reload()
+
+    # ------------------------------------------------------ lifecycle ----
+
+    def warmup(self, *args, **kwargs) -> None:
+        """Forward ``warmup`` to every replica (compile before traffic)."""
+        for r in self._replicas:
+            r.backend.warmup(*args, **kwargs)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop the prober, refuse new traffic, close every replica
+        (drained by default — the set owns its members)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout)
+        for r in self._replicas:
+            try:
+                r.backend.close(drain=drain, timeout=timeout)
+            except TypeError:
+                r.backend.close(drain=drain)
+            except Exception:
+                log.exception("closing replica %s/%s failed",
+                              self.name, r.name)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------- queries ----
+
+    def _update_gauges(self) -> None:
+        with self._cond:
+            healthy = sum(r.healthy for r in self._replicas)
+            inflight = {r.name: r.inflight for r in self._replicas}
+        self.metrics.set_replicas(healthy, len(self._replicas), inflight)
+
+    @property
+    def replicas(self) -> List[Any]:
+        return [r.backend for r in self._replicas]
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def healthy_replicas(self) -> List[str]:
+        with self._cond:
+            return [r.name for r in self._replicas if r.healthy]
+
+    def inflight(self, index: int) -> int:
+        with self._cond:
+            return self._replicas[index].inflight
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Set-level view: health/placement per replica plus each
+        replica's own metrics snapshot (``set`` holds the set-level
+        :class:`ServingMetrics` — the one the router reads)."""
+        out: Dict[str, Any] = {"set": self.metrics.snapshot(),
+                               "replicas": {}}
+        with self._cond:
+            states = [(r.name, r.healthy, r.draining, r.inflight, r.served,
+                       r.failed, r.failures, r.backend)
+                      for r in self._replicas]
+        for name, healthy, draining, inflight, served, failed, fails, b in \
+                states:
+            entry = {"healthy": healthy, "draining": draining,
+                     "inflight": inflight, "served": served,
+                     "failed": failed, "consecutive_failures": fails}
+            m = getattr(b, "metrics", None)
+            if m is not None and m is not self.metrics:
+                entry["metrics"] = m.snapshot()
+            out["replicas"][name] = entry
+        return out
+
+    def format_table(self) -> str:
+        """One row per replica, in the style of the metrics tables."""
+        snap = self.snapshot()
+        lines = [f"{'replica':<10} {'state':<12} {'inflight':>8} "
+                 f"{'served':>8} {'failed':>8}"]
+        for name in sorted(snap["replicas"]):
+            r = snap["replicas"][name]
+            state = ("draining" if r["draining"]
+                     else "healthy" if r["healthy"] else "quarantined")
+            lines.append(f"{name:<10} {state:<12} {r['inflight']:>8} "
+                         f"{r['served']:>8} {r['failed']:>8}")
+        return "\n".join(lines)

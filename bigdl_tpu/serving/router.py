@@ -28,6 +28,7 @@ import threading
 from typing import Dict, List, Optional
 
 from bigdl_tpu.serving.errors import Overloaded, UnknownModel
+from bigdl_tpu.serving.replica import ReplicaSet
 
 _SNAP_COLS = ("served", "rejected", "expired", "failed", "tokens_out")
 
@@ -60,13 +61,31 @@ class ModelRouter:
 
     def register(self, name: str, backend, *,
                  max_inflight: Optional[int] = None,
-                 owned: bool = True) -> "ModelRouter":
+                 owned: bool = True, **replica_kw) -> "ModelRouter":
         """Add a backend under ``name``. ``max_inflight`` bounds
         concurrently outstanding requests for THIS model (None =
         unbounded at the router; the backend's own queue still applies).
-        Returns self for chaining."""
+        A LIST of backends registers as one
+        :class:`~bigdl_tpu.serving.replica.ReplicaSet` transparently —
+        the model name then resolves to N replicas behind the same
+        ``submit`` signature (extra keyword args configure the set, e.g.
+        ``max_failures`` / ``probe``). Returns self for chaining."""
         if max_inflight is not None and max_inflight < 1:
             raise ValueError("max_inflight must be >= 1 (or None)")
+        if isinstance(backend, (list, tuple)):
+            if not owned:
+                # the router builds the set right here, so "someone else
+                # manages its lifecycle" can't be true: an unowned set
+                # would leak its prober thread and member engines forever
+                raise ValueError(
+                    "a list of backends registers as a router-owned "
+                    "ReplicaSet; construct the ReplicaSet yourself to "
+                    "manage its lifecycle (owned=False)")
+            backend = ReplicaSet(list(backend), name=name, **replica_kw)
+        elif replica_kw:
+            raise TypeError(
+                f"unexpected arguments {sorted(replica_kw)}: replica "
+                f"options apply only when registering a list of backends")
         with self._lock:
             if self._closed:
                 raise RuntimeError("router is closed")
@@ -125,18 +144,34 @@ class ModelRouter:
             # slip under the quota, and the done-callback may fire on
             # another thread the instant submit returns
             b.inflight += 1
+
+        # idempotent, exception-safe release: exactly one decrement per
+        # submission, whoever fires it and however often. A backend whose
+        # close(drain=False) races a completion (replica eviction fails
+        # the same futures the loop is finishing) may invoke done
+        # callbacks more than once, and a broken handle may reject the
+        # callback outright — neither may leak or double-release the
+        # quota slot, or the model jams shut / overshoots its bound.
+        released = [False]
+
+        def release_once(_h=None):
+            with self._lock:
+                if released[0]:
+                    return
+                released[0] = True
+                b.inflight -= 1
+
         try:
             handle = b.backend.submit(x, **kwargs)
         except BaseException:
-            with self._lock:
-                b.inflight -= 1
+            release_once()
             raise
-        handle.add_done_callback(lambda _h: self._release(b))
+        try:
+            handle.add_done_callback(release_once)
+        except BaseException:
+            release_once()
+            raise
         return handle
-
-    def _release(self, b: _Backend) -> None:
-        with self._lock:
-            b.inflight -= 1
 
     def predict(self, model_name: str, x,
                 timeout: Optional[float] = None, **kwargs):

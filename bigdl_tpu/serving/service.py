@@ -76,12 +76,33 @@ class InferenceService:
                  max_batch_size: int = 8, max_wait_ms: float = 2.0,
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 forward_fn=None):
+                 forward_fn=None, mesh=None, param_pspecs=None):
         self.model = model
+        state = state or {}
+        # sharded (tensor-parallel) mode: with a mesh, params are placed
+        # per their PartitionSpecs (``param_pspecs`` overrides the
+        # model's own ``param_pspecs()`` annotations; unannotated leaves
+        # replicate) and the jitted forward becomes pjit — GSPMD derives
+        # the collectives from the weight shardings. State (BN stats
+        # etc.) replicates: it is elementwise per-feature and tiny.
+        self.mesh = mesh
+        self._param_shardings = None
+        self._state_shardings = None
+        if mesh is not None:
+            from bigdl_tpu.parallel.mesh import tree_shardings
+
+            if param_pspecs is None:
+                param_pspecs = (model.param_pspecs()
+                                if hasattr(model, "param_pspecs") else {})
+            self._param_shardings = tree_shardings(mesh, params, param_pspecs)
+            params = jax.device_put(params, self._param_shardings)
+            if state:
+                self._state_shardings = tree_shardings(mesh, state, None)
+                state = jax.device_put(state, self._state_shardings)
         # params+state live in ONE tuple so a reload is a single atomic
         # reference swap: a batch reads the tuple once and always sees a
         # matched pair, never one new half and one old (test-enforced)
-        self._weights = (params, state or {})
+        self._weights = (params, state)
         self.metrics = metrics or ServingMetrics()
         # jit a closure over the MODEL, never a bound method: a jitted
         # bound method puts the service in a cycle through the C++ pjit
@@ -123,9 +144,18 @@ class InferenceService:
             require_matching_signature("state", old_state, state)
         # device_put once at reload: host arrays (e.g. a deserialized
         # checkpoint) would otherwise re-transfer per batch AND miss the
-        # jit cache (an uncommitted arg keys a different executable)
-        params = jax.device_put(params)
-        state = old_state if state is None else jax.device_put(state)
+        # jit cache (an uncommitted arg keys a different executable). A
+        # sharded service re-places with the ORIGINAL shardings so the
+        # pjit executable is reused, not recompiled.
+        params = (jax.device_put(params, self._param_shardings)
+                  if self._param_shardings is not None
+                  else jax.device_put(params))
+        if state is None:
+            state = old_state
+        elif self._state_shardings is not None:
+            state = jax.device_put(state, self._state_shardings)
+        else:
+            state = jax.device_put(state)
         self._weights = (params, state)
         self.metrics.record_reload()
 

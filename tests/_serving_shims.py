@@ -1,0 +1,52 @@
+"""Shared test shims for the serving tier.
+
+``SlowKernels`` wraps a (Paged)DecodeKernels pair/triple with a fixed
+per-call cost — standing in for a real chip's step time so
+timing-sensitive tests (deadlines, cancels, mid-flight admission,
+scheduling/placement throughput, drains) are deterministic instead of
+racing a microsecond-fast CPU step. One copy, duck-typing BOTH kernel
+flavours (``chunk`` delegates only when the inner kernels have it, so
+the engine's paged-mode detection sees the right surface), so a future
+kernels-surface change has one shim to update. ``bench.py`` keeps its
+own ``_FixedCostKernels`` — same idea, but it is part of the measured
+methodology and documented there.
+"""
+
+import time
+
+
+class SlowKernels:
+    """Fixed per-call cost around a dense or paged kernels object."""
+
+    def __init__(self, inner, step_sleep=0.002):
+        self.inner = inner
+        self.step_sleep = step_sleep
+        self.cache_sharding = getattr(inner, "cache_sharding", None)
+        if hasattr(inner, "chunk"):
+            # defined per-instance so `hasattr(kernels, "chunk")` stays a
+            # faithful paged-vs-dense discriminator through the wrapper
+            def chunk(*a, **kw):
+                time.sleep(self.step_sleep)
+                return self.inner.chunk(*a, **kw)
+
+            self.chunk = chunk
+
+    def prefill(self, *a, **kw):
+        time.sleep(self.step_sleep)
+        return self.inner.prefill(*a, **kw)
+
+    def decode(self, *a, **kw):
+        time.sleep(self.step_sleep)
+        return self.inner.decode(*a, **kw)
+
+    @property
+    def prefill_traces(self):
+        return self.inner.prefill_traces
+
+    @property
+    def chunk_traces(self):
+        return self.inner.chunk_traces
+
+    @property
+    def decode_traces(self):
+        return self.inner.decode_traces

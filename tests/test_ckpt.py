@@ -452,3 +452,106 @@ def test_template_mismatch_raises_instead_of_silent_restart(tmp_path):
     with pytest.raises(ValueError, match="structure/config mismatch"):
         mgr.restore_latest(wrong_template)
     mgr.close()
+
+
+# ------------------------------------------------------- sharded entries --
+# Multi-host groundwork (schema only — single-writer saves unchanged):
+# entries may list per-shard blobs {path,size,sha256}; restore and GC
+# must treat them as first-class checkpoint data.
+
+def _attach_shards(directory, shard_specs):
+    """Write shard blobs and record them on the NEWEST manifest entry
+    (what a future multi-host writer will do per host)."""
+    from bigdl_tpu.ckpt.manifest import sha256_bytes, write_manifest
+
+    entries = load_manifest(directory)
+    shards = []
+    for name, payload in shard_specs:
+        with open(os.path.join(directory, name), "wb") as fh:
+            fh.write(payload)
+        shards.append({"path": name, "size": len(payload),
+                       "sha256": sha256_bytes(payload)})
+    entries[-1].shards = shards
+    write_manifest(directory, entries)
+    return entries[-1]
+
+
+def test_shard_entries_roundtrip_and_verify(tmp_path):
+    from bigdl_tpu.ckpt.manifest import verify_shards
+
+    mgr = CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [1])
+    entry = _attach_shards(str(tmp_path), [("model.iter1.shard0", b"aaaa"),
+                                           ("model.iter1.shard1", b"bb")])
+    # the schema roundtrips through the JSON manifest
+    loaded = load_manifest(str(tmp_path))[-1]
+    assert loaded.shards == entry.shards and len(loaded.shards) == 2
+    assert verify_shards(str(tmp_path), loaded)
+    # restore still verifies and returns the (main-blob) payload
+    payload, got = mgr.restore_latest(_tmpl())
+    assert got.step == 1 and got.shards == entry.shards
+    mgr.close()
+
+
+def test_restore_falls_back_when_a_shard_is_corrupt_or_missing(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [1, 2])
+    _attach_shards(str(tmp_path), [("model.iter2.shard0", b"shard-bytes")])
+    # corrupt the shard (size preserved, bytes flipped): the WHOLE entry
+    # fails over to the previous commit — a sharded checkpoint is only as
+    # restorable as its worst shard
+    with open(tmp_path / "model.iter2.shard0", "r+b") as fh:
+        fh.write(b"SHARD-BYTES")
+    payload, entry = mgr.restore_latest(_tmpl())
+    assert entry.step == 1
+    np.testing.assert_array_equal(payload["params"]["dense"]["weight"],
+                                  _params(1)["dense"]["weight"])
+    # missing entirely: same fallback
+    os.remove(tmp_path / "model.iter2.shard0")
+    payload, entry = mgr.restore_latest(_tmpl())
+    assert entry.step == 1
+    mgr.close()
+
+
+def test_gc_never_collects_referenced_shard_blobs(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [1])
+    _attach_shards(str(tmp_path), [("model.iter1.shard0.ckpt", b"ckpt-suffixed"),
+                                   ("model.iter1.shard1", b"plain")])
+    # the next commit runs retention + the orphan sweep: referenced
+    # shards survive it, even .ckpt-suffixed ones the sweep would
+    # otherwise treat as unreferenced blobs
+    _save_steps(mgr, [2])
+    assert (tmp_path / "model.iter1.shard0.ckpt").exists()
+    assert (tmp_path / "model.iter1.shard1").exists()
+    entries = load_manifest(str(tmp_path))
+    assert entries[0].shards and entries[0].shards[0]["path"] \
+        == "model.iter1.shard0.ckpt"
+    mgr.close()
+
+
+def test_malformed_shard_metadata_fails_verification_not_restore(tmp_path):
+    """A corrupt/future-writer shards list (non-dict item, non-numeric
+    size) must read as 'does not verify' and fall back — never escape
+    restore_latest as an exception."""
+    from bigdl_tpu.ckpt.manifest import verify_shards, write_manifest
+
+    mgr = CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [1, 2])
+    entries = load_manifest(str(tmp_path))
+    (tmp_path / "model.iter2.shard0").write_bytes(b"data")
+    entries[-1].shards = [{"path": "model.iter2.shard0", "size": "abc",
+                           "sha256": "x"}]
+    write_manifest(str(tmp_path), entries)
+    assert not verify_shards(str(tmp_path), load_manifest(str(tmp_path))[-1])
+    payload, entry = mgr.restore_latest(_tmpl())
+    assert entry.step == 1  # fell back, did not raise
+    entries = load_manifest(str(tmp_path))
+    entries[-1].shards = ["not-a-dict"]
+    write_manifest(str(tmp_path), entries)
+    assert not verify_shards(str(tmp_path), load_manifest(str(tmp_path))[-1])
+    payload, entry = mgr.restore_latest(_tmpl())
+    assert entry.step == 1
+    _save_steps(mgr, [3])  # GC over the malformed entry must not raise
+    assert mgr.restore_latest(_tmpl())[1].step == 3
+    mgr.close()

@@ -10,6 +10,7 @@ The load-bearing properties, per the subsystem contract:
 - expired deadlines fail fast without occupying a forward slot.
 """
 
+import os
 import threading
 import time
 
@@ -497,3 +498,48 @@ def test_unclosed_service_is_garbage_collectable(setup):
     assert sref() is None, "unclosed InferenceService leaked"
     worker.join(timeout=10)
     assert not worker.is_alive()
+
+
+def test_watch_checkpoints_skips_entry_with_bad_shard(setup, tmp_path):
+    """An entry whose per-host shard blob fails verification is never
+    hot-reloaded (old weights keep serving); repairing the shard lets
+    the same tip load on a later poll."""
+    from bigdl_tpu.ckpt import CheckpointManager
+    from bigdl_tpu.ckpt.manifest import (
+        load_manifest,
+        sha256_bytes,
+        write_manifest,
+    )
+    from bigdl_tpu.serving import watch_checkpoints
+
+    model, params, state, x = setup
+    ckdir = str(tmp_path / "ck")
+    scaled = jax.tree_util.tree_map(lambda a: np.asarray(a) * 3.0, params)
+    with CheckpointManager(ckdir, fsync=False) as mgr:
+        mgr.save("model.iter1", scaled, state, {},
+                 meta={"iteration": 1}, blocking=True)
+    good = b"per-host shard payload"
+    entries = load_manifest(ckdir)
+    entries[-1].shards = [{"path": "model.iter1.shard0", "size": len(good),
+                           "sha256": sha256_bytes(good)}]
+    write_manifest(ckdir, entries)
+    with open(os.path.join(ckdir, "model.iter1.shard0"), "wb") as fh:
+        fh.write(b"torn half-written shard")
+
+    svc = InferenceService(model, params, state, max_wait_ms=1.0)
+    watcher = watch_checkpoints(svc, ckdir, poll_interval=0.01)
+    time.sleep(0.15)  # several polls over the bad-shard tip
+    assert watcher.reloads == 0  # old weights kept serving
+
+    with open(os.path.join(ckdir, "model.iter1.shard0"), "wb") as fh:
+        fh.write(good)  # shard repaired (e.g. re-pushed by its host)
+    deadline = time.monotonic() + 10
+    while watcher.reloads < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert watcher.reloads == 1
+    expected, _ = model.apply(scaled, x[:1], state=state)
+    np.testing.assert_allclose(
+        np.asarray(svc.predict(x[0], timeout=30)),
+        np.asarray(expected)[0], rtol=1e-5)
+    watcher.stop(timeout=10)
+    svc.close()

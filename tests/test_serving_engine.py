@@ -51,31 +51,11 @@ def lm():
     return model, params, kernels
 
 
-class _SlowKernels:
-    """Kernels wrapper adding a fixed per-call cost — stands in for a
-    real chip's step time so timing-sensitive tests (deadlines, cancel,
-    mid-flight admission, scheduling throughput) are deterministic
-    instead of racing a microsecond-fast CPU step."""
-
-    def __init__(self, inner, step_sleep=0.002):
-        self.inner = inner
-        self.step_sleep = step_sleep
-
-    def prefill(self, *a):
-        time.sleep(self.step_sleep)
-        return self.inner.prefill(*a)
-
-    def decode(self, *a):
-        time.sleep(self.step_sleep)
-        return self.inner.decode(*a)
-
-    @property
-    def prefill_traces(self):
-        return self.inner.prefill_traces
-
-    @property
-    def decode_traces(self):
-        return self.inner.decode_traces
+# fixed per-call cost: stands in for a real chip's step time so
+# timing-sensitive tests (deadlines, cancel, mid-flight admission,
+# scheduling throughput) are deterministic instead of racing a
+# microsecond-fast CPU step
+from _serving_shims import SlowKernels as _SlowKernels  # noqa: E402
 
 
 def make_engine(lm, **kw):
@@ -581,6 +561,75 @@ def test_router_quota_applies_to_generation_streams(lm):
         time.sleep(0.005)
     assert len(router.predict("lm", [5, 6], timeout=30,
                               max_new_tokens=2)) == 2
+    router.close()
+
+
+class _ManualHandle:
+    """Duck-typed future whose done callbacks the TEST fires — including
+    twice, which a real backend can do when ``close(drain=False)`` races
+    a completion during replica eviction."""
+
+    def __init__(self, break_add=False):
+        self._cbs = []
+        self.error = None
+        self.break_add = break_add
+
+    def add_done_callback(self, fn):
+        if self.break_add:
+            raise RuntimeError("injected broken handle")
+        self._cbs.append(fn)
+
+    def fire(self, times=1):
+        for _ in range(times):
+            for fn in list(self._cbs):
+                fn(self)
+
+    def result(self, timeout=None):
+        return None
+
+
+class _ManualBackend:
+    def __init__(self, break_add=False):
+        from bigdl_tpu.serving import ServingMetrics
+
+        self.metrics = ServingMetrics()
+        self.break_add = break_add
+        self.handles = []
+
+    def submit(self, x, **kw):
+        h = _ManualHandle(self.break_add)
+        self.handles.append(h)
+        return h
+
+    def close(self, drain=True, timeout=None):
+        pass
+
+
+def test_router_quota_release_idempotent_and_exception_safe():
+    """Regression (replica-eviction race): a backend future failed by
+    ``close(drain=False)`` WHILE the worker completes it can run its done
+    callbacks twice — the quota slot must release exactly once (never
+    leak, never double-release); and a handle whose ``add_done_callback``
+    raises must not leak the slot either."""
+    router = ModelRouter()
+    good = _ManualBackend()
+    router.register("m", good, max_inflight=1)
+    h = router.submit("m", 1)
+    assert router.inflight("m") == 1
+    h.fire(times=2)  # double-fired completion: released ONCE, not twice
+    assert router.inflight("m") == 0
+    h2 = router.submit("m", 1)  # a double-release would have gone to -1
+    with pytest.raises(Overloaded):
+        router.submit("m", 1)   # quota still bounds at exactly 1
+    h2.fire()
+    assert router.inflight("m") == 0
+
+    bad = _ManualBackend(break_add=True)
+    router.register("b", bad, max_inflight=1)
+    for _ in range(2):  # a leak would jam the quota shut on try 2
+        with pytest.raises(RuntimeError, match="broken handle"):
+            router.submit("b", 1)
+        assert router.inflight("b") == 0
     router.close()
 
 
