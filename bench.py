@@ -966,10 +966,313 @@ def run_pipeline_bench(args):
                    result["achievable_bound"], result["pool_e2e_speedup"]))
 
 
+def run_chaos_bench(args):
+    """Chaos soak (``--mode chaos``): a short train-with-checkpoints +
+    serve-with-replicas workload under a FIXED-SEED randomized fault
+    schedule, asserting the invariants the stack promises individually:
+
+    - **train**: with worker crashes injected into the parallel input
+      pipeline (supervised restarts) and transient OSErrors injected
+      into the checkpoint blob/manifest writes (RetryPolicy healing),
+      training completes and BOTH the live final params and the
+      restored newest checkpoint are bit-identical to a fault-free run
+      of the same seed;
+    - **serve**: with one replica killed mid-soak (``engine.decode``
+      site), transient submit faults (``replica.submit`` site), and
+      deadline-bearing requests, the ReplicaSet front door raises only
+      API-typed errors (Overloaded/ReplicaUnavailable at submit;
+      DeadlineExceeded/StreamCancelled/the injected fault on streams),
+      and after the schedule exhausts a clean final wave is served
+      entirely by the surviving replica;
+    - **watchdog**: a wedged decode step (armed latency) fails its
+      streams with a StallError diagnostic instead of hanging;
+    - **drain**: KV pages return to zero on every engine, no
+      /dev/shm segment leaks, and every bigdl-owned thread retires.
+
+    All schedules derive from ``--chaos-seed`` via the splitmix64 plans
+    in ``bigdl_tpu.faults`` — the soak replays exactly. ``--smoke``
+    shrinks the run for the CI gate (<60 s on one core); the invariant
+    checks run in every mode and exit nonzero on violation."""
+    import glob
+    import shutil
+    import tempfile
+    import threading
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import faults, optim
+    from bigdl_tpu.core.rng import RandomGenerator
+    from bigdl_tpu.dataset import DataSet, FunctionTransformer, \
+        SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.faults import InjectedFault, StallError
+    from bigdl_tpu.nn.layers.attention import Transformer
+    from bigdl_tpu.serving import (
+        DeadlineExceeded,
+        GenerationEngine,
+        Overloaded,
+        PagedDecodeKernels,
+        ReplicaSet,
+        ReplicaUnavailable,
+        ServingMetrics,
+        StreamCancelled,
+    )
+
+    t_start = time.perf_counter()
+    seed = args.chaos_seed
+    smoke = args.smoke
+    train_iters = args.chaos_iters or (12 if smoke else 24)
+    n_requests = args.chaos_requests or (24 if smoke else 64)
+    violations = []
+
+    def own_threads():
+        prefixes = ("bigdl-", "ckpt-writer", "pipeline-")
+        return sorted(t.name for t in threading.enumerate()
+                      if t.name.startswith(prefixes) and t.is_alive())
+
+    shm_dir = "/dev/shm"
+    shm_before = set(glob.glob(os.path.join(shm_dir, "*"))) \
+        if os.path.isdir(shm_dir) else None
+
+    # ---------------------------------------------------------- train ----
+    def train_once(workdir, data_seed=5):
+        def to_sample(t):
+            return Sample(t[0], np.int32(t[1]))
+
+        rs = np.random.RandomState(3)
+        xs = rs.randn(128, 8).astype(np.float32)
+        w = rs.randn(1, 8).astype(np.float32)
+        ys = (xs @ w.T > 0).astype(np.int32)[:, 0]
+        elems = [(xs[i], ys[i]) for i in range(len(xs))]
+        # explicit rng: the default RandomGenerator is process-global
+        # and its epoch shuffles would diverge between the two runs
+        ds = DataSet.array(elems, rng=RandomGenerator(data_seed)) \
+            >> (FunctionTransformer(to_sample) >> SampleToMiniBatch(16))
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 2), nn.LogSoftMax())
+        opt = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                   batch_size=16)
+        opt.set_optim_method(optim.SGD(learning_rate=0.5, momentum=0.9))
+        opt.set_end_when(optim.Trigger.max_iteration(train_iters))
+        opt.set_checkpoint(workdir, optim.Trigger.several_iteration(3),
+                           keep_last_n=3)
+        opt.set_data_pipeline(2, ordered=True, max_worker_restarts=16)
+        opt.set_watchdog(120.0)  # only a genuine hang fires
+        params, _ = opt.optimize()
+        mgr = opt.checkpoint_manager
+        mgr.wait()
+        restored = mgr.restore_latest()
+        mgr.close()
+        host = jax.tree_util.tree_map(np.asarray, params)
+        return host, restored
+
+    root = tempfile.mkdtemp(prefix="bigdl_chaos_")
+    try:
+        ref_params, ref_restored = train_once(os.path.join(root, "ref"))
+
+        faults.arm("pipeline.worker", rate=0.05, seed=seed, times=6)
+        faults.arm("ckpt.blob_write", nth=1, exc=OSError)
+        faults.arm("ckpt.manifest_write", rate=0.5, seed=seed + 1,
+                   times=2, exc=OSError)
+        chaos_params, chaos_restored = train_once(os.path.join(root, "chaos"))
+        train_fired = {s: v["fired"] for s, v in faults.snapshot().items()}
+        faults.reset()
+
+        ref_leaves = jax.tree_util.tree_leaves(ref_params)
+        chaos_leaves = jax.tree_util.tree_leaves(chaos_params)
+        params_match = len(ref_leaves) == len(chaos_leaves) and all(
+            np.array_equal(a, b) for a, b in zip(ref_leaves, chaos_leaves))
+        restored_match = (
+            ref_restored is not None and chaos_restored is not None
+            and ref_restored[1].step == chaos_restored[1].step
+            and all(np.array_equal(a, b) for a, b in zip(
+                jax.tree_util.tree_leaves(ref_restored[0]),
+                jax.tree_util.tree_leaves(chaos_restored[0]))))
+        if not params_match:
+            violations.append("train: faulted final params diverge from "
+                              "the fault-free run")
+        if not restored_match:
+            violations.append("train: restored checkpoint diverges from "
+                              "the fault-free run")
+        if train_fired.get("pipeline.worker", 0) < 1 \
+                or train_fired.get("ckpt.blob_write", 0) < 1:
+            violations.append(f"train: fault schedule never fired "
+                              f"({train_fired}) — the soak proved nothing")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # ---------------------------------------------------------- serve ----
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        filter_size=64, num_hidden_layers=1)
+    params, _ = model.init(jax.random.key(0))
+    max_len, max_prompt, slots = 48, 8, 4
+    kernels = PagedDecodeKernels(model)  # ONE compiled triple, shared
+
+    def build_engine(step_cost_ms=2.0, stall_timeout=None):
+        kern = _FixedCostKernels(kernels, step_cost_ms / 1e3) \
+            if step_cost_ms else kernels
+        eng = GenerationEngine(
+            model, params, max_slots=slots, max_len=max_len,
+            max_prompt_len=max_prompt, max_queue=4 * n_requests,
+            kernels=kern, page_size=8, seed=seed,
+            metrics=ServingMetrics(), stall_timeout=stall_timeout)
+        eng.warmup()
+        return eng
+
+    replicas = [build_engine(), build_engine()]
+    rset = ReplicaSet(replicas, max_failures=2,
+                      probe=lambda e: e.generate([1], max_new_tokens=1,
+                                                 timeout=5),
+                      probe_interval=0.05, name="chaos")
+    # schedule: replica 0 dies on its 7th decode step; three transient
+    # submit faults land anywhere (failover absorbs them)
+    death = faults.arm("engine.decode", after=6, times=1,
+                       only=lambda engine=None, **_: engine is replicas[0])
+    flaky_submit = faults.arm("replica.submit", rate=0.25, seed=seed + 2,
+                              times=3)
+
+    rs = np.random.RandomState(seed)
+    outcomes = {"ok": 0, "overloaded": 0, "unavailable": 0, "deadline": 0,
+                "cancelled": 0, "injected": 0}
+    bad_front_door = []
+    bad_stream = []
+
+    def run_wave(n, deadlines=True):
+        streams = []
+        for i in range(n):
+            plen = int(rs.randint(1, max_prompt + 1))
+            prompt = rs.randint(1, 60, (plen,)).tolist()
+            kw = dict(max_new_tokens=int(rs.randint(2, 12)))
+            if deadlines and i % 7 == 3:
+                kw["deadline"] = 0.004  # tight: expiry is an API error
+            try:
+                streams.append(rset.submit(prompt, **kw))
+            except Overloaded:
+                outcomes["overloaded"] += 1
+            except ReplicaUnavailable:
+                outcomes["unavailable"] += 1
+            except Exception as e:  # non-API escape = violation
+                bad_front_door.append(repr(e))
+        for s in streams:
+            try:
+                s.result(timeout=120)
+                outcomes["ok"] += 1
+            except DeadlineExceeded:
+                outcomes["deadline"] += 1
+            except StreamCancelled:
+                outcomes["cancelled"] += 1
+            except InjectedFault:
+                outcomes["injected"] += 1  # the scheduled replica death
+            except Exception as e:
+                bad_stream.append(repr(e))
+
+    run_wave(n_requests)
+    healthy_after_soak = list(rset.healthy_replicas)
+    faults.disarm("engine.decode")
+    faults.disarm("replica.submit")
+    # self-healing moment: the schedule is exhausted; transiently-evicted
+    # replicas rejoin via the backoff-paced prober (the permanently dead
+    # one keeps failing its probe and stays quarantined)
+    heal_deadline = time.monotonic() + 20
+    while not rset.healthy_replicas and time.monotonic() < heal_deadline:
+        time.sleep(0.05)
+    healthy_after_heal = list(rset.healthy_replicas)
+    if not healthy_after_heal:
+        violations.append("serve: no replica rejoined after the fault "
+                          "schedule exhausted (prober never healed the set)")
+    pre_final_ok = outcomes["ok"]
+    run_wave(max(8, n_requests // 4), deadlines=False)
+    final_ok = outcomes["ok"] - pre_final_ok
+
+    if bad_front_door:
+        violations.append(f"serve: non-API front-door errors: "
+                          f"{bad_front_door[:3]}")
+    if bad_stream:
+        violations.append(f"serve: non-API stream errors: {bad_stream[:3]}")
+    if death.fired < 1:
+        violations.append("serve: the replica-death fault never fired")
+    if final_ok < max(8, n_requests // 4):
+        violations.append(
+            f"serve: only {final_ok} of the post-fault wave succeeded — "
+            "the set did not heal around the dead replica")
+    if outcomes["ok"] == 0:
+        violations.append("serve: nothing succeeded during the soak")
+
+    rset.close()
+    pages_leaked = {f"r{i}": e.pages_in_use for i, e in enumerate(replicas)
+                    if e.pages_in_use}
+    if pages_leaked:
+        violations.append(f"serve: leaked KV pages after close: "
+                          f"{pages_leaked}")
+
+    # -------------------------------------------------------- watchdog ----
+    wd_engine = build_engine(step_cost_ms=0.0, stall_timeout=0.2)
+    faults.arm("engine.decode", latency=1.0, times=1,
+               only=lambda engine=None, **_: engine is wd_engine)
+    stalled = wd_engine.submit([1, 2, 3], max_new_tokens=8)
+    try:
+        stalled.result(timeout=60)
+        violations.append("watchdog: a wedged step completed a stream "
+                          "instead of stalling it")
+    except StallError:
+        pass
+    except Exception as e:
+        violations.append(f"watchdog: wrong stall error {e!r}")
+    faults.reset()
+    wd_engine.close(timeout=30)
+    if wd_engine.pages_in_use:
+        violations.append("watchdog: stalled engine leaked KV pages")
+
+    # ----------------------------------------------------------- drain ----
+    deadline = time.monotonic() + 15
+    leftover = own_threads()
+    while leftover and time.monotonic() < deadline:
+        time.sleep(0.1)
+        leftover = own_threads()
+    if leftover:
+        violations.append(f"drain: bigdl threads still alive: {leftover}")
+    shm_leaked = []
+    if shm_before is not None:
+        shm_leaked = sorted(set(glob.glob(os.path.join(shm_dir, "*")))
+                            - shm_before)
+        if shm_leaked:
+            violations.append(f"drain: leaked shm segments: {shm_leaked}")
+
+    result = {
+        "metric": "chaos_soak_pass",
+        "value": 0.0 if violations else 1.0,
+        "unit": "bool",
+        "vs_baseline": None,
+        "train_iters": train_iters,
+        "train_params_bitwise_match": params_match,
+        "train_restored_bitwise_match": restored_match,
+        "train_faults_fired": train_fired,
+        "serve_requests": n_requests,
+        "serve_outcomes": outcomes,
+        "serve_healthy_after_soak": healthy_after_soak,
+        "serve_healthy_after_heal": healthy_after_heal,
+        "serve_final_wave_ok": final_ok,
+        "replica_death_fired": death.fired,
+        "submit_faults_fired": flaky_submit.fired,
+        "threads_leftover": leftover,
+        "shm_leaked": shm_leaked,
+        "violations": violations,
+        "seed": seed,
+        "smoke": smoke,
+        "duration_s": round(time.perf_counter() - t_start, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timing": "invariant soak, not a throughput measurement; all "
+                  "fault schedules are pure functions of --chaos-seed",
+    }
+    print(json.dumps(result))
+    if violations:
+        raise SystemExit("chaos soak FAILED:\n  - " + "\n  - ".join(violations))
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("train", "serving", "checkpoint",
-                                       "pipeline"),
+                                       "pipeline", "chaos"),
                     default="train",
                     help="train = supervised ResNet-50 throughput (default); "
                          "serving = dynamic-batching requests/sec + latency "
@@ -978,7 +1281,11 @@ def _parse_args(argv=None):
                          "save overhead per step + restore latency; "
                          "pipeline = per-stage host input-pipeline img/s "
                          "(produce / augment xN / stage / transfer) + "
-                         "overlapped end-to-end ratio vs min stage rate")
+                         "overlapped end-to-end ratio vs min stage rate; "
+                         "chaos = deterministic fault-injection soak over "
+                         "train-with-checkpoints + serve-with-replicas "
+                         "(bit-identical recovery, API-only front-door "
+                         "errors, zero resource leaks)")
     ap.add_argument("--concurrency", type=int, default=32,
                     help="serving: concurrent client threads")
     ap.add_argument("--requests", type=int, default=0,
@@ -1019,6 +1326,14 @@ def _parse_args(argv=None):
                          "inside the jitted step; seeded per request, so "
                          "the continuous-vs-static mismatch gate still "
                          "applies")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="chaos: root seed of every fault schedule (the "
+                         "soak replays exactly for a given seed)")
+    ap.add_argument("--chaos-iters", type=int, default=0,
+                    help="chaos: training iterations per leg (0 = auto)")
+    ap.add_argument("--chaos-requests", type=int, default=0,
+                    help="chaos: serving requests in the fault wave "
+                         "(0 = auto)")
     ap.add_argument("--ckpt-iters", type=int, default=20,
                     help="checkpoint: timed steps per loop")
     ap.add_argument("--ckpt-save-every", type=int, default=5,
@@ -1404,6 +1719,9 @@ def main():
     elif args.mode == "pipeline":
         # host-side wall-clock rates; nothing differential to supervise
         run_pipeline_bench(args)
+    elif args.mode == "chaos":
+        # invariant soak (pass/fail), not a measurement; runs in-process
+        run_chaos_bench(args)
     elif args.worker:
         run_bench(args)
     else:

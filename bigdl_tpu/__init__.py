@@ -18,6 +18,10 @@ A ground-up JAX/XLA/pjit/Pallas rebuild of the capabilities of BigDL
   ``InferenceService`` with admission control, deadlines, and SLO
   metrics (replacing the reference's one-request-per-forward
   ``PredictionService.scala`` model pool).
+- Robustness tier (``bigdl_tpu.faults``): deterministic seeded fault
+  injection at named sites across the stack, plus the shared
+  ``RetryPolicy`` backoff and stall ``Watchdog`` machinery that heals
+  them (replacing the reference's reliance on Spark task retry).
 
 Compute is JAX on TPU: MXU-friendly matmuls/convs in bfloat16 with fp32
 masters, XLA fusion instead of hand-scheduled MKL-DNN primitives, and

@@ -46,6 +46,7 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from bigdl_tpu import faults
 from bigdl_tpu.ckpt.manifest import (
     ManifestEntry,
     apply_retention,
@@ -56,6 +57,7 @@ from bigdl_tpu.ckpt.manifest import (
     verify_shards,
     write_manifest,
 )
+from bigdl_tpu.faults import RetryPolicy
 from bigdl_tpu.utils.checkpoint import (
     deserialize_payload,
     latest_checkpoint,
@@ -114,12 +116,23 @@ class CheckpointManager:
         async_save: bool = True,
         fsync: bool = True,
         max_pending: int = 2,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.directory = str(directory)
         self.keep_last_n = keep_last_n
         self.keep_every_k_steps = keep_every_k_steps
         self.async_save = async_save
         self.fsync = fsync
+        # transient-IO healing: checkpoint directories live on network
+        # filesystems where EIO-class hiccups are routine, and a dropped
+        # save silently shortens the fallback chain. Blob and manifest
+        # writes retry OSError-class failures on this policy (bounded,
+        # capped backoff, deterministic jitter); exhaustion still fails
+        # the save LOUDLY — the existing verified-fallback chain and the
+        # wait()/close() error surfacing are untouched.
+        self.retry = retry or RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=2.0,
+            transient=(OSError,))
         # backpressure bound: each queued save holds a full host snapshot
         # of params+state, so an unbounded queue on a slow disk would eat
         # host memory one model-copy per trigger until OOM; past the bound
@@ -193,23 +206,32 @@ class CheckpointManager:
         meta.setdefault("wall_time", time.time())
         final = os.path.join(self.directory, f"{tag}.ckpt")
         tmp = final + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(blob)
-            fh.flush()
+
+        def write_blob():
+            # retried as ONE unit: the sequence is idempotent (same
+            # bytes, staged then atomically replaced), so a transient
+            # EIO on any line restarts it cleanly
+            faults.fire("ckpt.blob_write", tag=tag)
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                if self.fsync:
+                    os.fsync(fh.fileno())
+            os.replace(tmp, final)
+            # legacy sidecar: keeps latest_checkpoint()/load_checkpoint()
+            # able to read a manager directory without the manifest
+            side_tmp = final[: -len(".ckpt")] + ".meta.json.tmp"
+            with open(side_tmp, "w") as fh:
+                json.dump(meta, fh)
+                fh.flush()
+                if self.fsync:
+                    os.fsync(fh.fileno())
+            os.replace(side_tmp, final[: -len(".ckpt")] + ".meta.json")
             if self.fsync:
-                os.fsync(fh.fileno())
-        os.replace(tmp, final)
-        # legacy sidecar: keeps latest_checkpoint()/load_checkpoint() able
-        # to read a manager directory without the manifest
-        side_tmp = final[: -len(".ckpt")] + ".meta.json.tmp"
-        with open(side_tmp, "w") as fh:
-            json.dump(meta, fh)
-            fh.flush()
-            if self.fsync:
-                os.fsync(fh.fileno())
-        os.replace(side_tmp, final[: -len(".ckpt")] + ".meta.json")
-        if self.fsync:
-            fsync_dir(self.directory)
+                fsync_dir(self.directory)
+
+        self.retry.call(write_blob,
+                        describe=f"checkpoint '{tag}' blob write")
 
         entry = ManifestEntry(
             tag=tag, file=os.path.basename(final), step=int(step),
@@ -228,7 +250,15 @@ class CheckpointManager:
         entries.append(entry)
         kept = apply_retention(entries, self.keep_last_n,
                                self.keep_every_k_steps)
-        write_manifest(self.directory, kept, fsync=self.fsync)
+
+        def write_mf():
+            faults.fire("ckpt.manifest_write", tag=tag)
+            write_manifest(self.directory, kept, fsync=self.fsync)
+
+        # the write stages then os.replace()s, so a transient failure on
+        # any attempt leaves the OLD manifest intact — retrying is safe
+        self.retry.call(write_mf,
+                        describe=f"checkpoint '{tag}' manifest write")
         # per-shard blobs (multi-host entries) are live data: reference
         # them so the orphan sweep can never eat another host's shard
         self._gc(referenced={k.file for k in kept} | shard_files(kept))
@@ -410,7 +440,12 @@ class CheckpointManager:
             for e in entries:
                 if e.tag == tag:
                     e.preempted = True
-            write_manifest(self.directory, entries, fsync=self.fsync)
+            # eviction-window write: transient-IO healing matters MOST
+            # here (no second chance after the grace period)
+            self.retry.call(
+                lambda: write_manifest(self.directory, entries,
+                                       fsync=self.fsync),
+                describe=f"preemption mark for '{tag}'")
 
         self._pool.submit(_mark).result()
 

@@ -47,6 +47,14 @@ def element_seed(base_seed: int, index: int, stream: int = 0) -> int:
     return x >> 1  # non-negative, < 2**63
 
 
+def uniform01(seed: int, index: int, stream: int = 0) -> float:
+    """Deterministic uniform draw in [0, 1) from the splitmix64 stream
+    keyed on ``(seed, index, stream)`` — :func:`element_seed` scaled to
+    the unit interval. The faults tier draws rate-plan decisions and
+    backoff jitter from this, so its schedules replay exactly."""
+    return element_seed(seed, index, stream) / float(1 << 63)
+
+
 def threefry_key_data(seed: int) -> np.ndarray:
     """Raw ``(2,)`` uint32 threefry key words for ``seed`` — the host-side
     equivalent of ``jax.random.PRNGKey(seed)`` without a device dispatch.

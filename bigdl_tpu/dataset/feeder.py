@@ -33,6 +33,7 @@ from typing import Any, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bigdl_tpu import faults
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch
 
@@ -162,7 +163,14 @@ class SocketFeedDataSet(AbstractDataSet):
             magic = _recv_exact(conn, len(_MAGIC))
             if magic != _MAGIC:
                 raise IOError(f"bad feed handshake {magic!r}")
+            frame = 0
             while True:
+                # fault site, once per frame: an armed exception IS a
+                # producer dying mid-frame — it rides the existing error
+                # path (sticky failure, consumer raises, never a clean
+                # EOF) with the site name in the chained message
+                faults.fire("feed.producer", key=frame)
+                frame += 1
                 hdr = _recv_exact(conn, 4)
                 if hdr is None:
                     # EOF between frames = producer closed without the

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import logging
 import pickle
 import threading
 import time
@@ -47,8 +48,11 @@ from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu import faults
 from bigdl_tpu.core.rng import RandomGenerator, element_seed
 from bigdl_tpu.dataset.transformer import ChainedTransformer, Transformer
+
+log = logging.getLogger("bigdl_tpu.dataset")
 
 
 # --------------------------------------------------------------------------
@@ -156,6 +160,7 @@ class StageStats:
         self._lock = threading.Lock()
         self.items = 0
         self.bytes = 0
+        self.restarts = 0    # supervised worker restarts (pool stages)
         self.stall_s = 0.0   # producer blocked on a full downstream queue
         self.starve_s = 0.0  # consumer blocked on an empty upstream queue
         self.queue_cap = 0
@@ -173,6 +178,10 @@ class StageStats:
             self._t_last = now
             self.items += items
             self.bytes += nbytes
+
+    def record_restart(self) -> None:
+        with self._lock:
+            self.restarts += 1
 
     def record_stall(self, dt: float) -> None:
         if dt > 0:
@@ -202,6 +211,7 @@ class StageStats:
             return {
                 "items": self.items,
                 "mb": self.bytes / 1e6,
+                "restarts": self.restarts,
                 "items_per_sec": rate,
                 "stall_s": self.stall_s,
                 "starve_s": self.starve_s,
@@ -302,6 +312,10 @@ def _apply_chunk(inner, rng_nodes, base_seed, start_idx, elems) -> list:
     queue item per dispatch."""
     def seeded():
         for j, elem in enumerate(elems):
+            # fault site, keyed on the ELEMENT index: an armed rate plan
+            # faults the same elements whatever the worker count or
+            # chunking, so supervised replays stay bit-identical
+            faults.fire("pipeline.worker", key=start_idx + j)
             for k, node in enumerate(rng_nodes):
                 node.rng.reseed(
                     element_seed(base_seed, start_idx + j, stream=k))
@@ -328,6 +342,15 @@ class ParallelTransformer(Transformer):
     ``processes=True`` ships the wrapped chain to spawned workers by
     pickle — transformers must be picklable (module-level functions, not
     lambdas, inside ``FunctionTransformer``).
+
+    **Supervision**: a worker whose chunk fails with a transient error is
+    restarted — a fresh copy of the chain replays the dispatched chunk;
+    the per-element reseed makes the replay bit-exact, so ordered-mode
+    output is identical whether or not a restart happened. Each worker
+    restarts at most ``max_worker_restarts`` times; a poison element
+    that kills the replacement too (or an exhausted budget) fails the
+    consumer with the ORIGINAL exception and traceback. ``BaseException``
+    escapes (KeyboardInterrupt, SystemExit) are never retried.
     """
 
     elementwise = True  # the pool itself is 1:k per element, poolable-safe
@@ -345,9 +368,12 @@ class ParallelTransformer(Transformer):
         stats: Optional[PipelineStats] = None,
         stage: Optional[str] = None,
         join_timeout: float = 5.0,
+        max_worker_restarts: int = 2,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        if max_worker_restarts < 0:
+            raise ValueError("max_worker_restarts must be >= 0")
         self.inner = inner
         self.n_workers = int(n_workers)
         self.ordered = ordered
@@ -360,6 +386,7 @@ class ParallelTransformer(Transformer):
         self.stage_name = stage or (
             f"augment x{self.n_workers}" + ("p" if processes else ""))
         self.join_timeout = join_timeout
+        self.max_worker_restarts = int(max_worker_restarts)
 
     def apply(self, it: Iterator[Any]) -> Iterator[Any]:
         if self.processes:
@@ -412,8 +439,9 @@ class ParallelTransformer(Transformer):
                     q.close()
 
         def work(wid: int):
-            inner = copy.deepcopy(self.inner)
-            rng_nodes = _collect_rng_nodes(inner)
+            state = [copy.deepcopy(self.inner)]
+            state.append(_collect_rng_nodes(state[0]))
+            budget = [self.max_worker_restarts]
             inq = inqs[wid % len(inqs)]
             outq = outqs[wid % len(outqs)]
             try:
@@ -422,12 +450,12 @@ class ParallelTransformer(Transformer):
                         start_idx, elems = inq.get()[0]
                     except Closed:
                         break
-                    try:
-                        outs = _apply_chunk(inner, rng_nodes, self.base_seed,
-                                            start_idx, elems)
-                    except BaseException as e:
+                    outs, failure = _supervised_chunk(
+                        self.inner, state, self.base_seed, start_idx,
+                        elems, budget, st, f"worker {wid}")
+                    if failure is not None:
                         try:
-                            outq.put(_Failure(e, traceback.format_exc()))
+                            outq.put(failure)
                         except Closed:
                             pass
                         break
@@ -507,7 +535,8 @@ class ParallelTransformer(Transformer):
             ctx.Process(
                 target=_process_worker_main,
                 args=(self.inner, self.base_seed, inqs[w % len(inqs)],
-                      outqs[w % len(outqs)], not self.ordered),
+                      outqs[w % len(outqs)], not self.ordered,
+                      self.max_worker_restarts),
                 daemon=True,
             )
             for w in range(n)
@@ -598,6 +627,15 @@ class ParallelTransformer(Transformer):
                     if msg is _PIPELINE_END:
                         ended += 1
                         continue
+                    if isinstance(msg, tuple) and len(msg) == 1 \
+                            and msg[0] == "restart-stat":
+                        # a child-process supervised restart: the child
+                        # cannot reach the parent's StageStats, so it
+                        # forwards each restart as a marker (same queue,
+                        # so it precedes the healed chunk's result)
+                        if st is not None:
+                            st.record_restart()
+                        continue
                     w += 1
                     item = _unpack_result(msg)
                     if st is not None:
@@ -638,7 +676,56 @@ class ParallelTransformer(Transformer):
         return consume()
 
 
+def _supervised_chunk(template, state, base_seed, start_idx, elems,
+                      budget, st, who):
+    """Run one dispatched chunk under worker supervision. On a transient
+    (``Exception``-class) failure the worker is "restarted": a fresh
+    deep copy of the ``template`` chain replaces its state and the chunk
+    replays — bit-exact, because every element reseeds its rng nodes
+    from ``(base_seed, element_index)``. ``budget`` is the worker's
+    remaining restart allowance (mutated in place); once it is exhausted
+    — or the same poison element kills the replacement — the failure
+    reported to the consumer carries the ORIGINAL exception and
+    traceback, not the last retry's. Returns ``(outs, failure)``,
+    exactly one non-None."""
+    failure = None
+    while True:
+        try:
+            return _apply_chunk(state[0], state[1], base_seed, start_idx,
+                                elems), None
+        except BaseException as e:
+            if failure is None:
+                failure = _Failure(e, traceback.format_exc())
+            if budget[0] <= 0 or not isinstance(e, Exception):
+                return None, failure
+            budget[0] -= 1
+            if st is not None:
+                st.record_restart()
+            log.warning(
+                "pipeline %s failed on chunk @%d (%s: %s); restarting the "
+                "worker with a fresh chain and re-dispatching (%d "
+                "restart(s) left)", who, start_idx, type(e).__name__, e,
+                budget[0])
+            state[0] = copy.deepcopy(template)
+            state[1] = _collect_rng_nodes(state[0])
+
+
 # ---- process-mode helpers (module level: must be importable by spawn) ----
+
+
+class _QueueRestartStat:
+    """Process-worker stand-in for :class:`StageStats`: restarts happen
+    in the child, the stats registry lives in the parent, so each
+    restart is forwarded as a one-element queue marker the consumer
+    folds into the real ``StageStats``."""
+
+    __slots__ = ("outq",)
+
+    def __init__(self, outq):
+        self.outq = outq
+
+    def record_restart(self) -> None:
+        self.outq.put(("restart-stat",))
 
 
 def _pack_result(outs: list, name_out: Optional[list] = None):
@@ -737,10 +824,13 @@ def _unlink_msg_shm(msg) -> None:
             pass
 
 
-def _process_worker_main(inner, base_seed, inq, outq, shared_input):
+def _process_worker_main(inner, base_seed, inq, outq, shared_input,
+                         max_restarts=0):
     """Spawned worker process: pull chunks, transform, push packed results.
     ``shared_input``: unordered mode — re-queue the end sentinel so every
-    sibling worker also sees it."""
+    sibling worker also sees it. ``max_restarts`` is this worker's own
+    supervision budget (each process supervises itself; a process KILLED
+    outright still surfaces through the consumer's liveness check)."""
     import signal
 
     def sigterm_to_exit(signum, frame):
@@ -752,7 +842,11 @@ def _process_worker_main(inner, base_seed, inq, outq, shared_input):
     # reach the parent (which unlinks them) instead of leaking
     signal.signal(signal.SIGTERM, sigterm_to_exit)
 
-    rng_nodes = _collect_rng_nodes(inner)
+    # `inner` stays the pristine template (as shipped); the working copy
+    # is what restarts replace — matching the thread pool exactly
+    state = [copy.deepcopy(inner)]
+    state.append(_collect_rng_nodes(state[0]))
+    budget = [int(max_restarts)]
     while True:
         task = inq.get()
         if task is _PIPELINE_END:
@@ -761,15 +855,16 @@ def _process_worker_main(inner, base_seed, inq, outq, shared_input):
             outq.put(_PIPELINE_END)
             return
         start_idx, elems = task
-        try:
-            outs = _apply_chunk(inner, rng_nodes, base_seed, start_idx, elems)
-        except BaseException as e:
-            tb_text = traceback.format_exc()
+        outs, failure = _supervised_chunk(inner, state, base_seed,
+                                          start_idx, elems, budget,
+                                          _QueueRestartStat(outq),
+                                          "process worker")
+        if failure is not None:
+            exc, tb_text = failure.exc, failure.tb_text
             try:
-                pickle.dumps(e)
-                exc = e
+                pickle.dumps(exc)
             except Exception:
-                exc = RuntimeError(f"{type(e).__name__}: {e}")
+                exc = RuntimeError(f"{type(exc).__name__}: {exc}")
             # the traceback object cannot cross the process boundary;
             # _Failure.reraise() re-chains its text on the consumer side
             outq.put(("inline", pickle.dumps(_Failure(exc, tb_text)),

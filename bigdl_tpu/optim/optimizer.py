@@ -103,7 +103,13 @@ class Optimizer:
         self.pipeline_ordered = True
         self.pipeline_processes = False
         self.pipeline_chunk = 1
+        self.pipeline_max_restarts = 2
         self.pipeline_stats = None
+        # step watchdog (set_watchdog): seconds without a completed
+        # iteration before the stall handler fires; None = disabled
+        self.watchdog_timeout: Optional[float] = None
+        self._watchdog_on_stall: Optional[Callable] = None
+        self.watchdog_error = None
         self._rng = jax.random.key(self.config.seed)
 
     # ------------------------------------------------ builder setters ----
@@ -171,6 +177,7 @@ class Optimizer:
         chunk: int = 1,
         host_depth: Optional[int] = None,
         stats=None,
+        max_worker_restarts: int = 2,
     ) -> "Optimizer":
         """Configure the parallel host input pipeline (reference analogue:
         ``MTLabeledBGRImgToBatch``'s thread pool). With ``n_workers > 0``
@@ -189,10 +196,48 @@ class Optimizer:
         self.pipeline_ordered = ordered
         self.pipeline_processes = processes
         self.pipeline_chunk = chunk
+        self.pipeline_max_restarts = int(max_worker_restarts)
         if host_depth is not None:
             self.host_prefetch_depth = host_depth
         self.pipeline_stats = stats or PipelineStats()
         return self
+
+    def set_watchdog(self, timeout: float,
+                     on_stall: Optional[Callable] = None) -> "Optimizer":
+        """Arm a training-step watchdog: if NO iteration completes for
+        ``timeout`` seconds, ``on_stall(err)`` fires from the watchdog
+        thread with a :class:`~bigdl_tpu.faults.StallError` diagnostic.
+        The default handler records the error on ``watchdog_error`` and
+        poisons the dataset through its ``fail()`` hook when it has one
+        (``SocketFeedDataSet`` does) — so a loop blocked on a feed whose
+        producers silently died surfaces the stall instead of waiting
+        forever. A wedged XLA dispatch cannot be unwound from Python;
+        there the watchdog still leaves a loud diagnostic in the log."""
+        if timeout <= 0:
+            # validate HERE, not when Watchdog is built mid-optimize():
+            # 0.0 would silently disable the guard, negatives would
+            # crash far from the misuse site
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
+        self.watchdog_timeout = float(timeout)
+        self._watchdog_on_stall = on_stall
+        return self
+
+    def _watchdog_stalled(self, err) -> None:
+        self.watchdog_error = err
+        if self._watchdog_on_stall is not None:
+            self._watchdog_on_stall(err)
+            return
+        log.error("training stalled: %s", err)
+        # the fail() hook usually lives on the BASE dataset (a
+        # SocketFeedDataSet wrapped by `>> transformer` layers exposes it
+        # only there), so walk the wrapper chain
+        ds = self.dataset
+        while ds is not None:
+            fail = getattr(ds, "fail", None)
+            if callable(fail):
+                fail(err)
+                return
+            ds = getattr(ds, "base", None)
 
     def set_train_summary(self, summary) -> "Optimizer":
         self.train_summary = summary
@@ -422,6 +467,7 @@ class Optimizer:
                 chunk=self.pipeline_chunk,
                 base_seed=self.config.seed,
                 stats=self.pipeline_stats,
+                max_worker_restarts=self.pipeline_max_restarts,
             )
             return chain.apply(self.dataset.base.data(train=True))
         return self.dataset.data(train=True)
@@ -435,6 +481,28 @@ class Optimizer:
         batches = self._train_batches()
         state = self.state
 
+        watchdog = None
+        if self.watchdog_timeout:
+            from bigdl_tpu.faults import Watchdog
+
+            watchdog = Watchdog("optimizer", self.watchdog_timeout,
+                                self._watchdog_stalled)
+            watchdog.arm("training step (batch wait + compute)")
+        try:
+            self._train_loop(state, step_fn, data_sharding, batches,
+                             train_size, watchdog)
+        finally:
+            if watchdog is not None:
+                watchdog.close()
+        if self.checkpoint_manager is not None:
+            # drain in-flight async saves: once optimize() returns, every
+            # triggered checkpoint is committed (and write errors surface
+            # here rather than vanishing with the worker thread)
+            self.checkpoint_manager.wait()
+        return self._params, self._module_state
+
+    def _train_loop(self, state, step_fn, data_sharding, batches,
+                    train_size, watchdog):
         for x, y in device_prefetch(batches, data_sharding,
                                     host_depth=self.host_prefetch_depth,
                                     stats=self.pipeline_stats):
@@ -518,12 +586,10 @@ class Optimizer:
                 if self.end_when(state):
                     break
                 state.epoch_finished = False
-        if self.checkpoint_manager is not None:
-            # drain in-flight async saves: once optimize() returns, every
-            # triggered checkpoint is committed (and write errors surface
-            # here rather than vanishing with the worker thread)
-            self.checkpoint_manager.wait()
-        return self._params, self._module_state
+            if watchdog is not None:
+                # an iteration completed end to end — validation and
+                # checkpoint triggers included — so the deadline resets
+                watchdog.beat()
 
     # ------------------------------------------------ validation ---------
     def _run_validation(self):

@@ -73,7 +73,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import faults
 from bigdl_tpu.core.rng import request_seed, threefry_key_data
+from bigdl_tpu.faults import StallError, Watchdog
 from bigdl_tpu.ops.sampling import sample_tokens
 from bigdl_tpu.serving.batcher import bucket_sizes_for
 from bigdl_tpu.serving.errors import (
@@ -492,6 +494,21 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
     (same discipline as the batcher worker): an engine whose owner
     forgot ``close()`` becomes collectable and the loop exits, failing
     any stranded streams, instead of pinning params + KV cache forever."""
+    try:
+        _engine_loop_body(engine_ref, core)
+    finally:
+        # the LOOP owns watchdog retirement: close() skips it while the
+        # loop is still alive (a wedged step outliving the join
+        # timeout), so when the stuck step finally returns and the loop
+        # exits, the watchdog thread — and its strong engine ref — must
+        # be released here or they leak for the process lifetime
+        engine = engine_ref()
+        if engine is not None and engine._watchdog is not None:
+            engine._watchdog.close(timeout=0)
+
+
+def _engine_loop_body(engine_ref: "weakref.ref[GenerationEngine]",
+                      core: _Core) -> None:
     while True:
         with core.cond:
             while not core.pending and not core.active and not core.closed:
@@ -512,6 +529,16 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
                 "generation engine was garbage-collected with requests "
                 "in flight"))
             return
+        if engine._failed is not None:
+            # the watchdog fired while a step was stuck; the streams are
+            # already failed — now that the loop has control again, do
+            # the slot/page reconciliation HERE (the only thread allowed
+            # to touch them) and stop
+            _fail_streams(core, engine._failed, engine)
+            return
+        wd = engine._watchdog
+        if wd is not None:
+            wd.arm("decode step")
         try:
             engine._step()
         except Exception as e:
@@ -521,6 +548,9 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
             log.exception("generation engine step failed; engine stopped")
             _fail_streams(core, e, engine)
             return
+        finally:
+            if wd is not None:
+                wd.disarm()
         del engine
 
 
@@ -556,7 +586,8 @@ class GenerationEngine:
                  use_paged_kernel: Optional[bool] = None,
                  mesh=None,
                  param_pspecs=None,
-                 shard_axis: str = "tp"):
+                 shard_axis: str = "tp",
+                 stall_timeout: Optional[float] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -666,6 +697,18 @@ class GenerationEngine:
         self._params = params
         self._failed: Optional[BaseException] = None
         self._core = _Core(self.max_slots)
+        # stall watchdog: a decode/prefill call that makes no progress
+        # past `stall_timeout` seconds (wedged device, hung collective)
+        # fails every pending/active STREAM with a StallError diagnostic
+        # instead of hanging their consumers forever; the loop thread
+        # reconciles slots/pages when (if) the stuck step returns. NOTE:
+        # a watchdog-armed engine must be close()d — the watchdog holds
+        # a strong ref, so the forgot-to-close GC path applies only to
+        # unwatched engines.
+        self._watchdog = None
+        if stall_timeout is not None:
+            self._watchdog = Watchdog(
+                f"engine@{id(self):x}", stall_timeout, self._on_stall)
         self._thread = threading.Thread(
             target=_engine_loop, args=(weakref.ref(self), self._core),
             name="bigdl-serving-engine", daemon=True)
@@ -756,6 +799,29 @@ class GenerationEngine:
                            deadline=deadline, temperature=temperature,
                            top_k=top_k, top_p=top_p,
                            seed=seed).result(timeout)
+
+    def _on_stall(self, err: StallError) -> None:
+        """Watchdog callback (runs on the WATCHDOG thread): the loop is
+        stuck inside a step past the deadline. Mark the engine failed so
+        new submits are refused, and finish every pending/active stream
+        with the diagnostic so their consumers unblock. Slot and page
+        bookkeeping is deliberately NOT touched here — only the loop
+        thread may mutate it, and it reconciles via ``_fail_streams``
+        the moment the stuck step returns (see ``_engine_loop``)."""
+        core = self._core
+        with core.cond:
+            if self._failed is not None:
+                return
+            self._failed = err
+            reqs = list(core.pending)
+            streams = [st.req.stream for st in core.active.values()]
+            core.pending.clear()
+            core.cond.notify_all()
+        log.error("generation engine stalled: %s", err)
+        for r in reqs:
+            r.stream._finish(err)
+        for s in streams:
+            s._finish(err)
 
     # ------------------------------------------------- loop internals ----
     # Everything below here runs on the loop thread only (except warmup,
@@ -854,6 +920,7 @@ class GenerationEngine:
             self._release_slot(slot, st)
             self._finish_slot(st, why, now)
             return
+        faults.fire("engine.prefill", engine=self)
         prompt = req.prompt
         start = st.prefill_pos
         remaining = len(prompt) - start
@@ -925,6 +992,7 @@ class GenerationEngine:
         if why is not None:
             self._finish_request(req, why, now, queue_wait=None)
             return
+        faults.fire("engine.prefill", engine=self)
         core = self._core
         with core.cond:
             core.free.sort()
@@ -950,6 +1018,10 @@ class GenerationEngine:
             self._finish_slot(st, why, now)
 
     def _decode_once(self, active: List[Tuple[int, _SlotState]]) -> None:
+        # fault site: an armed exception is exactly a kernel/step failure
+        # (the loop fails every stream and stops); armed latency models a
+        # slow or wedged device for the stall watchdog
+        faults.fire("engine.decode", engine=self)
         tokens = np.zeros((self.max_slots,), np.int32)
         positions = np.zeros((self.max_slots,), np.int32)
         for slot, st in active:
@@ -1104,6 +1176,8 @@ class GenerationEngine:
             core.drain = drain
             core.cond.notify_all()
         self._thread.join(timeout)
+        if self._watchdog is not None and not self._thread.is_alive():
+            self._watchdog.close()
         if not self._thread.is_alive():
             # the loop has exited: a request that raced the close flag in
             # must fail rather than strand its consumer. NOT safe while

@@ -21,7 +21,9 @@ import logging
 import threading
 from typing import Any, Callable, Optional
 
+from bigdl_tpu import faults
 from bigdl_tpu.ckpt.manifest import load_manifest, verify_entry, verify_shards
+from bigdl_tpu.faults import RetryPolicy
 from bigdl_tpu.utils.checkpoint import deserialize_payload
 
 log = logging.getLogger("bigdl_tpu.serving")
@@ -35,7 +37,8 @@ class CheckpointWatcher:
                  poll_interval: float = 2.0, *,
                  template: Optional[dict] = None,
                  reload_existing: bool = True,
-                 on_reload: Optional[Callable[[Any], None]] = None):
+                 on_reload: Optional[Callable[[Any], None]] = None,
+                 poll_backoff: Optional[RetryPolicy] = None):
         self.service = service
         self.directory = str(directory)
         self.poll_interval = float(poll_interval)
@@ -45,6 +48,14 @@ class CheckpointWatcher:
         self._template = template
         self._on_reload = on_reload
         self._skip_tag: "str | None" = None
+        # ERROR polls (unreadable manifest, transient reload failure)
+        # back off on the shared poll schedule — base poll_interval,
+        # doubling to the cap with deterministic jitter — instead of
+        # re-reading a broken directory at full rate forever; one clean
+        # poll resets the schedule
+        self._poll_policy = poll_backoff or RetryPolicy.poll_schedule(
+            self.poll_interval)
+        self._error_polls = 0
         self._stop = threading.Event()
         if not reload_existing:
             # adopt the current tip as the baseline WITHOUT reloading it:
@@ -60,15 +71,24 @@ class CheckpointWatcher:
         while not self._stop.is_set():
             try:
                 self.poll_once()
+                self._error_polls = 0
             except Exception:
                 # a bad poll (unreadable manifest, reload rejection) must
                 # not kill the watcher: the NEXT commit may be fine
-                log.exception("checkpoint watch poll failed; will retry")
-            self._stop.wait(self.poll_interval)
+                self._error_polls += 1
+                log.exception(
+                    "checkpoint watch poll failed; retrying in %.1fs",
+                    self._poll_policy.backoff(self._error_polls - 1))
+            self._stop.wait(
+                self.poll_interval if self._error_polls == 0
+                else self._poll_policy.backoff(self._error_polls - 1))
 
     def poll_once(self) -> bool:
         """One poll: reload iff the manifest tip is a new committed entry
         whose blob verifies. Returns True when a reload happened."""
+        # fault site: an armed OSError is exactly an unreadable-manifest
+        # read (network fs hiccup); the watcher logs, backs off, retries
+        faults.fire("ckpt.watch_manifest", directory=self.directory)
         entries = load_manifest(self.directory)
         if not entries:
             return False

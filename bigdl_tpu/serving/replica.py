@@ -20,8 +20,13 @@ list of backends) — and the set adds the cross-replica concerns:
   consecutive failures; at ``max_failures`` it is quarantined and
   traffic fails over to its siblings instead of failing the front door.
   A quarantined replica rejoins only after a ``probe`` succeeds against
-  it (a background prober polls every ``probe_interval`` seconds;
-  ``probe_once()`` is the synchronous handle for tests and operators).
+  it. The background prober paces itself on the shared
+  :class:`~bigdl_tpu.faults.RetryPolicy` backoff: the first probe after
+  an eviction comes at ``probe_interval``, and each quarantined pass
+  without a rejoin doubles the wait (deterministic jitter, capped at
+  ~30 s) so a long-dead backend is not hammered forever; a rejoin or a
+  fresh eviction resets the schedule. ``probe_once()`` is the
+  synchronous handle for tests and operators.
 - **draining rolling reloads** — ``reload(params)`` sweeps the replicas
   ONE at a time: mark draining (no new placements), wait for in-flight
   work to finish, swap weights via the backend's atomic ``reload``,
@@ -48,6 +53,8 @@ import time
 from concurrent.futures import CancelledError
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from bigdl_tpu import faults
+from bigdl_tpu.faults import RetryPolicy
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -92,8 +99,10 @@ class ReplicaSet:
     ``replicas`` is a non-empty sequence of backends (engines/services
     the set now OWNS — ``close()`` closes them). ``max_failures``
     consecutive engine failures quarantine a replica; ``probe(backend)``
-    (raises on failure) lets it rejoin, polled every ``probe_interval``
-    seconds when set. ``metrics`` defaults to the replicas' shared
+    (raises on failure) lets it rejoin, paced by ``probe_backoff`` (a
+    :class:`RetryPolicy`; default: base ``probe_interval``, doubling per
+    fruitless pass, capped at 30 s with deterministic jitter, reset on
+    rejoin/eviction). ``metrics`` defaults to the replicas' shared
     :class:`ServingMetrics` when they share one, else a fresh set-level
     instance; the replica gauges land there either way.
     """
@@ -103,6 +112,7 @@ class ReplicaSet:
                  max_failures: int = 2,
                  probe: Optional[Callable[[Any], Any]] = None,
                  probe_interval: float = 2.0,
+                 probe_backoff: Optional[RetryPolicy] = None,
                  name: str = "replicas"):
         replicas = list(replicas)
         if not replicas:
@@ -121,6 +131,17 @@ class ReplicaSet:
         self.metrics = metrics
         self._probe_fn = probe
         self.probe_interval = float(probe_interval)
+        # prober pacing: probe_interval is only the BASE of the shared
+        # RetryPolicy backoff — each quarantined pass without a rejoin
+        # doubles the wait (deterministic jitter, capped ~30 s), so a
+        # long-dead backend is not hammered every 2 s forever; a rejoin
+        # or a fresh eviction resets the schedule (and an eviction kicks
+        # the prober awake so the first probe comes at base delay)
+        self._probe_policy = probe_backoff or RetryPolicy.poll_schedule(
+            self.probe_interval)
+        self._probe_cond = threading.Condition()
+        self._probe_attempt = 0
+        self._probe_kick = False
         self._closed = False
         self._roll_lock = threading.Lock()  # one rolling reload at a time
         self._weights_version = 0           # bumped per reload() sweep
@@ -177,6 +198,10 @@ class ReplicaSet:
                 raise ReplicaUnavailable(
                     self.name, [rr.name for rr in self._replicas])
             try:
+                # fault site INSIDE the try: an armed failure routes
+                # through the same classification as a real backend's
+                # (client errors re-raise, engine errors mark + fail over)
+                faults.fire("replica.submit", replica=r.backend, index=r.index)
                 handle = r.backend.submit(x, **kwargs)
             except Overloaded as e:
                 overload = e  # healthy backpressure, not a health event
@@ -253,6 +278,14 @@ class ReplicaSet:
                 r.healthy = False
         if evict:
             self.metrics.record_eviction()
+            with self._probe_cond:
+                # a FRESH eviction restarts the probe schedule from the
+                # base interval (the capped backoff belongs to backends
+                # that have been dead a while) and wakes a prober parked
+                # on a long wait so the reset takes effect now
+                self._probe_attempt = 0
+                self._probe_kick = True
+                self._probe_cond.notify_all()
             log.warning(
                 "replica %s/%s quarantined after %d consecutive failures "
                 "(last, at %s: %s); traffic fails over to siblings",
@@ -268,12 +301,48 @@ class ReplicaSet:
             r.served += 1
             r.failures = 0
 
+    def _probe_wait(self, delay: float) -> str:
+        """Block until ``delay`` elapses ("elapsed"), the schedule is
+        reset by a fresh eviction ("kick" — re-wait from the new base
+        delay), or the set closes ("stop"). Separated out so the backoff
+        regression test can drive the schedule with a fake clock."""
+        deadline = time.monotonic() + delay
+        with self._probe_cond:
+            while not self._stop.is_set() and not self._probe_kick:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._probe_cond.wait(left)
+            if self._stop.is_set():
+                return "stop"
+            if self._probe_kick:
+                self._probe_kick = False
+                return "kick"
+            return "elapsed"
+
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval):
+        while True:
+            with self._probe_cond:
+                attempt = self._probe_attempt
+            why = self._probe_wait(self._probe_policy.backoff(attempt))
+            if why == "stop":
+                return
+            if why == "kick":
+                continue  # schedule reset: wait the fresh base delay
             try:
-                self.probe_once()
+                rejoined = self.probe_once()
             except Exception:
                 log.exception("replica probe pass failed; will retry")
+                rejoined = 0
+            with self._cond:
+                quarantined = any(not r.healthy for r in self._replicas)
+            with self._probe_cond:
+                if rejoined or not quarantined:
+                    # progress (or a healthy fleet): the next quarantine
+                    # era starts from the base interval again
+                    self._probe_attempt = 0
+                elif not self._probe_kick:  # don't outrun a fresh reset
+                    self._probe_attempt += 1
 
     def probe_once(self) -> int:
         """Probe every quarantined replica once; rejoin the ones whose
@@ -404,6 +473,8 @@ class ReplicaSet:
                 return
             self._closed = True
         self._stop.set()
+        with self._probe_cond:
+            self._probe_cond.notify_all()  # wake a prober mid-backoff
         if self._prober is not None:
             self._prober.join(timeout)
         for r in self._replicas:

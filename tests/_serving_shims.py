@@ -10,9 +10,30 @@ the engine's paged-mode detection sees the right surface), so a future
 kernels-surface change has one shim to update. ``bench.py`` keeps its
 own ``_FixedCostKernels`` — same idea, but it is part of the measured
 methodology and documented there.
+
+FAILURE injection, by contrast, no longer gets a wrapper class: the
+engine fires the ``engine.decode`` / ``engine.prefill`` fault sites on
+every step, so step-failure tests arm those through
+``bigdl_tpu.faults`` (one mechanism for the serving, replica, and
+engine suites — and the same one ``bench.py --mode chaos`` drives).
+:func:`arm_step_failure` is the shared recipe; the conftest's autouse
+fixture resets the injector between tests.
 """
 
 import time
+
+
+def arm_step_failure(target_engine, *, after=0, site="engine.decode",
+                     message="injected replica death", exc=None):
+    """Arm ``site`` to kill ``target_engine`` (and only it) once its
+    step counter passes ``after`` — the FaultInjector port of the old
+    per-test ``_DyingKernels``-style wrappers. Returns the live
+    ``FaultSpec`` (``spec.fired`` counts injections)."""
+    from bigdl_tpu import faults
+
+    return faults.arm(
+        site, after=after, exc=exc or RuntimeError(message),
+        only=lambda engine=None, **_: engine is target_engine)
 
 
 class SlowKernels:

@@ -34,3 +34,14 @@ def _reset_engine():
     Engine.reset()
     yield
     Engine.reset()
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    # the fault injector is process-global by design; a site left armed
+    # by one test must never fire inside another
+    from bigdl_tpu import faults
+
+    faults.reset()
+    yield
+    faults.reset()

@@ -555,3 +555,67 @@ def test_malformed_shard_metadata_fails_verification_not_restore(tmp_path):
     _save_steps(mgr, [3])  # GC over the malformed entry must not raise
     assert mgr.restore_latest(_tmpl())[1].step == 3
     mgr.close()
+
+
+# ------------------------------------------------ transient-IO healing ----
+
+from bigdl_tpu import faults  # noqa: E402
+from bigdl_tpu.faults import RetryPolicy  # noqa: E402
+
+
+def test_save_heals_fail_once_blob_write(tmp_path):
+    """A flaky filesystem (fail-once OSError on the blob write) is
+    absorbed by the writer's RetryPolicy: the save commits, the entry
+    verifies, and restore returns the exact payload."""
+    spec = faults.arm("ckpt.blob_write", nth=1, exc=OSError)
+    p = _params(5)
+    with CheckpointManager(str(tmp_path)) as mgr:
+        mgr.save("model.iter3", p, meta={"iteration": 3}).result(timeout=30)
+        restored = mgr.restore_latest()
+    assert spec.fired == 1  # the fault really hit the write path
+    payload, entry = restored
+    assert entry.step == 3
+    np.testing.assert_array_equal(payload["params"]["dense"]["weight"],
+                                  p["dense"]["weight"])
+
+
+def test_save_heals_fail_once_manifest_write(tmp_path):
+    spec = faults.arm("ckpt.manifest_write", nth=1, exc=OSError)
+    with CheckpointManager(str(tmp_path)) as mgr:
+        _save_steps(mgr, [1, 2])
+        entries = mgr.entries()
+    assert spec.fired == 1
+    assert [e.step for e in entries] == [1, 2]
+
+
+def test_save_exhausted_retries_still_fails_loudly(tmp_path):
+    """Persistent IO failure: the bounded budget runs out and the save
+    handle (and wait()) surface the OSError — never a silent drop — and
+    the previously committed entry is untouched for fallback."""
+    mgr = CheckpointManager(
+        str(tmp_path),
+        retry=RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0))
+    _save_steps(mgr, [1])  # a good commit to fall back on
+    spec = faults.arm("ckpt.blob_write", exc=OSError("disk on fire"))
+    h = mgr.save("model.iter2", _params(2), meta={"iteration": 2})
+    with pytest.raises(OSError, match="disk on fire"):
+        h.result(timeout=30)
+    assert spec.fired == 3  # the full attempt budget was spent
+    with pytest.raises(OSError):
+        mgr.wait()
+    faults.disarm("ckpt.blob_write")
+    # the verified-fallback chain is untouched: iter1 still restores
+    payload, entry = mgr.restore_latest()
+    assert entry.step == 1
+    mgr.close()
+
+
+def test_save_permanent_error_is_not_retried(tmp_path):
+    """A non-OSError failure (structure bug, not a disk hiccup) must not
+    burn the retry budget."""
+    spec = faults.arm("ckpt.blob_write", exc=TypeError("not transient"))
+    with CheckpointManager(str(tmp_path)) as mgr:
+        h = mgr.save("model.iter1", _params(1), meta={"iteration": 1})
+        with pytest.raises(TypeError):
+            h.result(timeout=30)
+    assert spec.fired == 1

@@ -1,0 +1,354 @@
+"""The fault-injection + self-healing tier (bigdl_tpu/faults).
+
+Contract under test:
+
+- armed sites fire on their exact schedule (nth / after / rate / every),
+  deterministically — a keyed ``rate`` plan is a pure function of
+  ``(seed, site, key)``, independent of call interleaving;
+- disarmed sites are free (no state mutated, nothing raised) and the
+  per-element hot-path cost is far inside the pipeline's ~25 us budget;
+- RetryPolicy classifies transient-vs-permanent, heals transients
+  within its budget, re-raises on exhaustion, and its backoff schedule
+  (exponential, capped, deterministically jittered) is exactly
+  reproducible — the fake-clock property the prober test leans on;
+- Watchdog fires once per armed period with a diagnostic naming the
+  stalled work, never fires while beats arrive, and goes quiet when
+  disarmed.
+"""
+
+import threading
+import time
+
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.faults import (
+    FaultInjector,
+    InjectedFault,
+    RetryPolicy,
+    StallError,
+    Watchdog,
+)
+
+
+# ------------------------------------------------------------ injector ----
+
+
+def test_disarmed_site_is_free_and_armed_nth_fires_exactly_once():
+    for _ in range(100):
+        faults.fire("scratch.site")  # disarmed: no-op
+    spec = faults.arm("scratch.site", nth=3)
+    fired = []
+    for i in range(6):
+        try:
+            faults.fire("scratch.site")
+        except InjectedFault as e:
+            fired.append((i, str(e)))
+    assert [i for i, _ in fired] == [2]
+    assert "scratch.site" in fired[0][1] and "call 3" in fired[0][1]
+    assert spec.calls == 6 and spec.fired == 1
+
+
+def test_after_fires_every_call_past_n_and_times_caps_total():
+    faults.arm("scratch.site", after=2, times=2,
+               exc=RuntimeError("boom"))
+    outcomes = []
+    for _ in range(6):
+        try:
+            faults.fire("scratch.site")
+            outcomes.append("ok")
+        except RuntimeError:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "ok", "boom", "boom", "ok", "ok"]
+
+
+def test_rate_plan_is_keyed_and_order_independent():
+    """With key= (the pipeline passes the element index), whether element
+    k faults is a pure function of (seed, site, k) — the exact property
+    that keeps ordered-mode output bit-identical across worker counts."""
+    def schedule(keys):
+        inj = FaultInjector()
+        inj.arm("pipe.elem", rate=0.3, seed=11)
+        hit = set()
+        for k in keys:
+            try:
+                inj.fire("pipe.elem", key=k)
+            except InjectedFault:
+                hit.add(k)
+        return hit
+
+    keys = list(range(200))
+    a = schedule(keys)
+    b = schedule(list(reversed(keys)))
+    assert a == b
+    assert 20 < len(a) < 100  # ~30% of 200, loose bounds
+
+
+def test_only_predicate_scopes_a_site_to_one_object():
+    target, other = object(), object()
+    faults.arm("scratch.site", only=lambda owner=None, **_: owner is target)
+    faults.fire("scratch.site", owner=other)  # filtered: no fault
+    with pytest.raises(InjectedFault):
+        faults.fire("scratch.site", owner=target)
+    # filtered calls don't advance the matching-call counter
+    assert faults.spec("scratch.site").calls == 1
+
+
+def test_latency_only_plan_sleeps_without_raising():
+    faults.arm("scratch.site", latency=0.05, times=1)
+    t0 = time.perf_counter()
+    faults.fire("scratch.site")
+    assert time.perf_counter() - t0 >= 0.04
+    t0 = time.perf_counter()
+    faults.fire("scratch.site")  # times exhausted: no sleep
+    assert time.perf_counter() - t0 < 0.04
+
+
+def test_armed_context_manager_disarms_and_snapshot_keeps_history():
+    inj = FaultInjector()
+    with inj.armed("scratch.site", nth=1):
+        with pytest.raises(InjectedFault):
+            inj.fire("scratch.site")
+    inj.fire("scratch.site")  # disarmed again
+    snap = inj.snapshot()
+    assert snap["scratch.site"] == {"calls": 1, "fired": 1}
+
+
+def test_disarmed_fire_overhead_within_pipeline_budget():
+    """The per-element budget from PERF_NOTES round 6 is ~25 us; the
+    disarmed check must be noise against it (<= 2 us/call here, with a
+    wide margin for CI jitter)."""
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        faults.fire("pipeline.worker", key=i)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 2e-6, f"disarmed fire costs {per_call * 1e6:.2f} us"
+
+
+# --------------------------------------------------------- retry policy ----
+
+
+def test_retry_heals_transients_and_reraises_on_exhaustion():
+    calls = []
+
+    def flaky(fail_n):
+        calls.append(1)
+        if len(calls) <= fail_n:
+            raise OSError("disk hiccup")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+    assert p.call(flaky, 2, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+    with pytest.raises(OSError, match="disk hiccup"):
+        p.call(flaky, 99, sleep=lambda s: None)
+    assert len(calls) == 3  # the full budget, then loud failure
+
+
+def test_retry_permanent_errors_raise_immediately():
+    p = RetryPolicy(max_attempts=5, transient=(OSError,))
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("config error")
+
+    with pytest.raises(ValueError):
+        p.call(bad, sleep=lambda s: None)
+    assert len(calls) == 1
+    # classify= overrides the type tuple entirely
+    p2 = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0,
+                     classify=lambda e: "hiccup" in str(e))
+    calls.clear()
+
+    def bad2():
+        calls.append(1)
+        raise ValueError("hiccup")
+
+    with pytest.raises(ValueError):
+        p2.call(bad2, sleep=lambda s: None)
+    assert len(calls) == 2  # retried once despite being a ValueError
+
+
+def test_backoff_schedule_is_deterministic_capped_and_jittered():
+    p = RetryPolicy(max_attempts=8, base_delay=2.0, max_delay=30.0,
+                    multiplier=2.0, jitter=0.1, seed=5)
+    a = [p.backoff(i) for i in range(8)]
+    b = [p.backoff(i) for i in range(8)]
+    assert a == b  # deterministic
+    raw = [min(2.0 * 2.0 ** i, 30.0) for i in range(8)]
+    for got, base in zip(a, raw):
+        assert abs(got - base) <= 0.05 * base + 1e-9  # jitter is +/-5%
+        assert got != base  # but jitter is actually applied
+    assert all(x <= 30.0 * 1.05 for x in a)  # capped (modulo jitter)
+    # distinct seeds desynchronize (no thundering herd on shared storage)
+    q = RetryPolicy(max_attempts=8, base_delay=2.0, jitter=0.1, seed=6)
+    assert [q.backoff(i) for i in range(8)] != a
+
+
+def test_retry_delays_match_backoff_and_are_slept():
+    slept = []
+    p = RetryPolicy(max_attempts=4, base_delay=0.01, jitter=0.1, seed=3)
+    with pytest.raises(OSError):
+        p.call(lambda: (_ for _ in ()).throw(OSError("x")),
+               sleep=slept.append)
+    assert slept == p.delays()
+    assert len(slept) == 3
+
+
+# ------------------------------------------------------------- watchdog ----
+
+
+def test_watchdog_fires_once_with_diagnostic_then_rearms():
+    stalls = []
+    wd = Watchdog("test", 0.08, stalls.append)
+    wd.arm("unit A")
+    deadline = time.monotonic() + 5
+    while not stalls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)  # must NOT fire again within the same armed period
+    assert len(stalls) == 1
+    msg = str(stalls[0])
+    assert isinstance(stalls[0], StallError)
+    assert "unit A" in msg and "test" in msg and "deadline 0.1s" in msg
+    wd.disarm()
+    wd.arm("unit B")  # a fresh period fires again
+    deadline = time.monotonic() + 5
+    while len(stalls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(stalls) == 2 and "unit B" in str(stalls[1])
+    wd.close()
+
+
+def test_watchdog_beats_prevent_stall_and_disarm_idles():
+    stalls = []
+    with Watchdog("beaten", 0.15, stalls.append) as wd:
+        with wd.watching("steady work"):
+            for _ in range(6):
+                time.sleep(0.05)
+                wd.beat()
+        time.sleep(0.3)  # disarmed: no deadline at all
+    assert stalls == []
+    assert wd.stalls == 0
+
+
+def test_watchdog_on_stall_runs_off_the_stuck_thread():
+    seen = {}
+
+    def on_stall(err):
+        seen["thread"] = threading.current_thread().name
+
+    wd = Watchdog("offthread", 0.05, on_stall)
+    wd.arm("stuck step")
+    deadline = time.monotonic() + 5
+    while "thread" not in seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.close()
+    assert seen["thread"].startswith("bigdl-watchdog")
+
+
+def test_backoff_saturates_for_huge_attempt_counts():
+    """An unbounded attempt counter (a prober stuck on a backend dead
+    for hours) must saturate at max_delay, not overflow float
+    exponentiation and kill the daemon thread."""
+    p = RetryPolicy(max_attempts=1, base_delay=2.0, max_delay=30.0,
+                    multiplier=2.0, jitter=0.1, seed=4)
+    for attempt in (100, 1024, 10**6):
+        d = p.backoff(attempt)
+        assert 30.0 * 0.95 <= d <= 30.0 * 1.05
+
+
+def test_backoff_cap_never_undercuts_a_large_base_interval():
+    """ReplicaSet/CheckpointWatcher default policies: a probe/poll
+    interval ABOVE the 30 s cap must lift the cap — backing off to
+    LESS than the healthy-path interval would invert the intent."""
+    from bigdl_tpu.serving.replica import ReplicaSet
+
+    rs = ReplicaSet([object()], probe=None, probe_interval=60.0)
+    assert rs._probe_policy.backoff(0) >= 60.0 * 0.95
+    assert rs._probe_policy.backoff(9) >= 60.0 * 0.95
+
+
+def test_rearm_without_disarm_keeps_history_counts():
+    """Re-arming an armed site (chaos harnesses swap plans mid-soak)
+    must fold the old spec's counters into history — snapshot() is how
+    a soak proves its schedule actually fired."""
+    inj = FaultInjector()
+    inj.arm("scratch.site", nth=1)
+    with pytest.raises(InjectedFault):
+        inj.fire("scratch.site")
+    inj.arm("scratch.site", latency=0.0, times=0)  # replace, no disarm
+    snap = inj.snapshot()
+    assert snap["scratch.site"]["fired"] == 1
+    assert snap["scratch.site"]["calls"] == 1
+
+
+def test_injected_fault_pickles_round_trip():
+    """InjectedFault must survive pickling — it is the default payload
+    of the process-pool failure path (worker -> consumer queue)."""
+    import pickle
+
+    e = pickle.loads(pickle.dumps(InjectedFault("feed.producer", 3)))
+    assert isinstance(e, InjectedFault)
+    assert e.site == "feed.producer" and e.call_index == 3
+    assert "feed.producer" in str(e) and "call 3" in str(e)
+
+
+def test_watchdog_refires_after_a_healed_stall():
+    """A handler that HEALS a stall (instead of aborting) must get a
+    fresh detection for the next stall of the same armed period —
+    progress (a beat) re-enables the one-shot."""
+    stalls = []
+    wd = Watchdog("healed", 0.08, stalls.append)
+    wd.arm("long run")
+    deadline = time.monotonic() + 5
+    while len(stalls) < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.beat()  # the handler healed the cause; progress resumed
+    deadline = time.monotonic() + 5
+    while len(stalls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.close()
+    assert len(stalls) >= 2  # the SECOND stall was detected too
+
+
+def test_multi_fire_instance_plans_raise_fresh_copies():
+    """An armed exception INSTANCE on a multi-fire plan must raise a
+    fresh copy per injection — a later fire must not mutate the
+    __traceback__ a consumer already captured."""
+    inj = FaultInjector()
+    inj.arm("scratch.site", exc=RuntimeError("shared"), times=2)
+    caught = []
+    for _ in range(2):
+        try:
+            inj.fire("scratch.site")
+        except RuntimeError as e:
+            caught.append(e)
+    assert caught[0] is not caught[1]
+    assert str(caught[0]) == str(caught[1]) == "shared"
+    assert caught[0].__traceback__ is not caught[1].__traceback__
+
+
+def test_optimizer_set_watchdog_rejects_nonpositive_timeout():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset import DataSet
+
+    opt = optim.LocalOptimizer(
+        nn.Sequential(nn.Linear(2, 2)), DataSet.array([]),
+        nn.MSECriterion(), batch_size=2)
+    with pytest.raises(ValueError, match="timeout"):
+        opt.set_watchdog(0.0)
+    with pytest.raises(ValueError, match="timeout"):
+        opt.set_watchdog(-1)
+
+
+def test_poll_schedule_shared_recipe():
+    p = RetryPolicy.poll_schedule(2.0)
+    assert abs(p.backoff(0) - 2.0) <= 0.2
+    assert p.backoff(10) <= 30.0 * 1.05
+    big = RetryPolicy.poll_schedule(60.0)
+    assert big.backoff(0) >= 60.0 * 0.95  # base above cap lifts the cap

@@ -291,3 +291,93 @@ def test_feed_dataset_fail_unblocks_consumer():
     with pytest.raises(IOError):
         next(ds.batches(0, train=True))
     ds.close()
+
+
+# ------------------------------------------------ fault site (ISSUE 8) ----
+
+
+def test_injected_producer_death_mid_frame_sticky_and_names_site():
+    """The ``feed.producer`` fault site kills a producer reader
+    mid-stream without any hand-rolled socket choreography: the PR-4
+    sticky-fail contract must hold (first raise AND every re-entry of
+    batches() fail — truncated data never passes for EOF) and the error
+    chain must name the site that injected the death."""
+    from bigdl_tpu import faults
+
+    # frame 0 is the handshake-adjacent first frame; kill frame 1 so one
+    # good batch is already queued when the producer dies
+    spec = faults.arm("feed.producer",
+                      only=lambda key=None, **_: key == 1)
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=1)
+    t = _producer(ds.bound_address,
+                  [(np.full((2, 2), i, np.float32),) for i in range(4)])
+    t.start()
+    with pytest.raises(IOError, match="failed") as ei:
+        list(ds.batches(0, train=False))
+    # the chained cause names the injection site (both failure paths —
+    # the in-stream marker and the sticky flag — chain the original)
+    assert "feed.producer" in str(ei.value.__cause__)
+    assert spec.fired == 1
+    # sticky: a retry loop re-entering batches() must keep failing fast
+    with pytest.raises(IOError, match="feed job failed"):
+        list(ds.batches(0, train=False))
+    t.join(timeout=10)
+    ds.close()
+
+
+def test_injected_death_one_of_many_producers_still_sticky():
+    """PR-4 regression under the injector: one producer of three dying
+    (injected) fails the consumer even while siblings keep pushing."""
+    from bigdl_tpu import faults
+
+    ds = SocketFeedDataSet(("127.0.0.1", 0), n_producers=3)
+    addr = ds.bound_address
+
+    # the injector counts MATCHING calls across all three reader
+    # threads; killing call 5 lands on whichever producer reads it,
+    # which is exactly the point — any producer death is sticky
+    spec = faults.arm("feed.producer", nth=5)
+    producers = [_producer(addr, [(np.full((2, 2), p * 10 + i, np.float32),)
+                                  for i in range(4)]) for p in range(3)]
+    for t in producers:
+        t.start()
+    with pytest.raises(IOError, match="failed"):
+        list(ds.batches(0, train=False))
+    assert spec.fired == 1
+    with pytest.raises(IOError, match="failed"):
+        list(ds.batches(0, train=False))
+    for t in producers:
+        t.join(timeout=10)
+    ds.close()
+
+
+# ------------------------------------------- optimizer step watchdog -----
+
+
+def test_optimizer_watchdog_unblocks_dead_feed():
+    """A SocketFeedDataSet whose producer job never connects would block
+    optimize() forever on the empty queue; the step watchdog poisons the
+    stream and the loop surfaces the stall diagnostic instead."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.faults import StallError
+
+    from bigdl_tpu.dataset import FunctionTransformer
+
+    feed = SocketFeedDataSet(("127.0.0.1", 0), n_producers=1, epoch_size=32)
+    # wrap with >> so the stall handler must WALK to the base dataset's
+    # fail() hook (TransformedDataSet does not forward it)
+    ds = feed >> FunctionTransformer(lambda b: b)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                               batch_size=8)
+    opt.host_prefetch_depth = 0  # block in batches(), not a feeder thread
+    opt.set_end_when(optim.Trigger.max_iteration(3))
+    opt.set_watchdog(0.3)
+    t0 = time.monotonic()
+    with pytest.raises(IOError, match="fail") as ei:
+        opt.optimize()
+    assert time.monotonic() - t0 < 15  # unblocked by the watchdog
+    assert isinstance(opt.watchdog_error, StallError)
+    assert "no progress" in str(ei.value.__cause__)
+    feed.close()

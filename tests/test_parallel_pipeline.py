@@ -448,3 +448,116 @@ def test_example_parallel_input_pipeline_runs():
         ["--workers", "2", "--maxIteration", "3", "-s", "64"])
     assert params is not None
     assert any(n.startswith("augment") for n in stats.snapshot())
+
+
+# ----------------------------------------------- supervision (ISSUE 8) ----
+
+from bigdl_tpu import faults  # noqa: E402
+
+
+def test_supervised_restart_heals_transient_faults_bit_identical():
+    """A transiently-faulting worker is restarted and the chunk replayed;
+    ordered output stays bit-identical to the fault-free run across
+    worker counts, chunk sizes, AND restart schedules (the per-element
+    reseed makes the replay exact)."""
+    elems = _imgs()
+    ref = list(ParallelTransformer(_aug_chain(), 1, base_seed=42)
+               .apply(iter(elems)))
+    # three distinct restart schedules: a single nth fault, a seeded
+    # rate plan capped by times (re-draws go quiet once exhausted), and
+    # a denser capped plan — all healed inside the per-worker budget
+    plans = [dict(nth=3), dict(rate=0.2, seed=3, times=3),
+             dict(rate=0.5, seed=9, times=5)]
+    for plan in plans:
+        for n, chunk in ((1, 1), (4, 1), (4, 3)):
+            stats = PipelineStats()
+            spec = faults.arm("pipeline.worker", **plan)
+            out = list(ParallelTransformer(
+                _aug_chain(), n, chunk=chunk, base_seed=42, stats=stats,
+                max_worker_restarts=8).apply(iter(elems)))
+            faults.disarm("pipeline.worker")
+            assert spec.fired >= 1, f"plan {plan} never fired"
+            assert len(out) == len(ref)
+            for (a, la), (b, lb) in zip(ref, out):
+                assert la == lb
+                np.testing.assert_array_equal(a, b)
+            # every injected fault cost exactly one supervised restart
+            snap = next(iter(stats.snapshot().values()))
+            assert snap["restarts"] == spec.fired
+
+
+def test_supervision_exhausted_keeps_original_traceback():
+    """A poison element (faults every replay) exhausts the restart
+    budget and the consumer still gets the ORIGINAL exception — with
+    the site named in its message — not the last retry's."""
+    spec = faults.arm("pipeline.worker", exc=ValueError,
+                      only=lambda key=None, **_: key == 7)
+    with pytest.raises(ValueError, match="pipeline.worker") as ei:
+        list(ParallelTransformer(_aug_chain(), 2, base_seed=42,
+                                 max_worker_restarts=2)
+             .apply(iter(_imgs())))
+    # original attempt + 2 supervised restarts, then loud failure
+    assert spec.fired == 3
+    assert "call 1" in str(ei.value)  # the FIRST injection, not the third
+
+
+def test_supervision_original_error_survives_differing_retries():
+    """When the retry fails DIFFERENTLY than the first attempt (state
+    corrupted by the fault, say), the consumer must still see the first
+    attempt's exception."""
+    calls = {"n": 0}
+
+    def flaky(t):
+        if t[1] == 7:
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("the original failure")
+            raise RuntimeError("a different retry failure")
+        return t
+
+    with pytest.raises(ValueError, match="the original failure"):
+        list(ParallelTransformer(FunctionTransformer(flaky), 2,
+                                 max_worker_restarts=1)
+             .apply(iter(_imgs())))
+    assert calls["n"] == 2  # the retry DID run
+
+
+def test_supervision_zero_budget_fails_on_first_fault():
+    faults.arm("pipeline.worker", nth=1)
+    with pytest.raises(faults.InjectedFault):
+        list(ParallelTransformer(_aug_chain(), 2, base_seed=42,
+                                 max_worker_restarts=0)
+             .apply(iter(_imgs())))
+
+
+def _proc_flaky(t, flag_dir=None):
+    import os
+
+    if t[1] == 7:
+        flag = os.path.join(flag_dir, "fired")
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            raise ValueError("proc transient 7")
+    return t
+
+
+def test_process_pool_supervision_heals_transient(tmp_path):
+    """Process workers supervise themselves: a fail-once element is
+    replayed by the restarted worker and the stream completes bit-equal
+    to the serial run."""
+    import functools
+
+    elems = _imgs()
+    fn = functools.partial(_proc_flaky, flag_dir=str(tmp_path))
+    stats = PipelineStats()
+    out = list(ParallelTransformer(FunctionTransformer(fn), 2,
+                                   processes=True, max_worker_restarts=1,
+                                   stats=stats)
+               .apply(iter(elems)))
+    assert (tmp_path / "fired").exists()  # the fault really fired
+    assert [l for _, l in out] == list(range(20))
+    for (a, _), (b, _) in zip(elems, out):
+        np.testing.assert_array_equal(a, b)
+    # the child's restart crossed the process boundary into StageStats
+    snap = next(iter(stats.snapshot().values()))
+    assert snap["restarts"] == 1

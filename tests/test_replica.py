@@ -87,22 +87,7 @@ def make_engine(lm, **kw):
 
 
 from _serving_shims import SlowKernels as _SlowKernels  # noqa: E402
-
-
-class _DyingKernels(_SlowKernels):
-    """Raises from decode after ``die_after`` calls — a replica dying
-    mid-stream (step failure: the engine fails its streams and stops)."""
-
-    def __init__(self, inner, die_after, step_sleep=0.002):
-        super().__init__(inner, step_sleep)
-        self.calls = 0
-        self.die_after = die_after
-
-    def decode(self, *a):
-        self.calls += 1
-        if self.calls > self.die_after:
-            raise RuntimeError("injected replica death")
-        return super().decode(*a)
+from _serving_shims import arm_step_failure  # noqa: E402
 
 
 class _GatedBackend:
@@ -219,15 +204,19 @@ def test_all_replicas_overloaded_raises_overloaded_not_unavailable(lm):
 def test_replica_death_midstream_fails_over_to_sibling(lm, lm_ref):
     """Kill replica r0 mid-stream: its stream fails with the injected
     error, the set evicts it, and EVERY subsequent request is served by
-    r1 — the front door never raises."""
+    r1 — the front door never raises. The death is injected through the
+    engine's own ``engine.decode`` fault site (scoped to r0 with
+    ``only=``), not a hand-rolled kernels wrapper."""
     model, params, kernels = lm
-    dying = make_engine(lm, kernels=_DyingKernels(kernels, die_after=3))
+    dying = make_engine(lm, kernels=_SlowKernels(kernels))
     healthy = make_engine(lm, kernels=_SlowKernels(kernels))
+    spec = arm_step_failure(dying, after=3)
     rs = ReplicaSet([dying, healthy], max_failures=1)
 
     doomed = rs.submit(PROMPTS[0], max_new_tokens=30)  # least-loaded: r0
     with pytest.raises(RuntimeError, match="injected replica death"):
         doomed.result(timeout=30)
+    assert spec.fired >= 1  # the site, not a wrapper, killed the step
     deadline = time.monotonic() + 10
     while rs.healthy_replicas != ["r1"] and time.monotonic() < deadline:
         time.sleep(0.005)
@@ -762,3 +751,119 @@ def test_router_rejects_unowned_replica_list(lm):
     with pytest.raises(ValueError, match="owned"):
         router.register("lm", [make_engine(lm)], owned=False)
     router.close()
+
+
+# ---------------------------------------- prober backoff + fault sites ----
+
+from bigdl_tpu import faults  # noqa: E402
+from bigdl_tpu.faults import RetryPolicy  # noqa: E402
+
+
+def test_prober_backoff_caps_and_resets_fake_clock():
+    """Satellite regression (ISSUE 8): the prober paces itself on the
+    shared RetryPolicy backoff — base, 2x, 4x, ... capped at 30 s with
+    deterministic jitter — instead of hammering a long-dead backend
+    every probe_interval forever, and a successful rejoin resets the
+    schedule to the base interval. Driven entirely against a fake clock
+    (the wait hook records the requested delay and returns instantly)."""
+    flaky = _GatedBackend()
+    flaky.fail_submit = True
+    probe_calls = []
+
+    def probe(b):
+        probe_calls.append(1)
+        if len(probe_calls) < 6:
+            raise RuntimeError("still dead")
+
+    policy = RetryPolicy(max_attempts=1, base_delay=2.0, max_delay=30.0,
+                         multiplier=2.0, jitter=0.1, seed=4)
+    rs = ReplicaSet([flaky], max_failures=1, probe=probe,
+                    probe_interval=0,  # no thread: the test drives the loop
+                    probe_backoff=policy)
+    with pytest.raises(ReplicaUnavailable):
+        rs.submit([1])  # single failure evicts r0
+    assert rs.healthy_replicas == []
+
+    delays = []
+
+    def fake_wait(delay):
+        delays.append(delay)
+        with rs._probe_cond:
+            rs._probe_kick = False  # what the real wait does on a kick
+        return "stop" if len(delays) > 8 else "elapsed"
+
+    rs._probe_wait = fake_wait
+    rs._probe_loop()  # runs on the test thread until fake_wait says stop
+
+    # 5 fruitless probes walk the schedule up; the 6th rejoins and the
+    # schedule resets to the base interval
+    assert delays == [policy.backoff(i)
+                      for i in (0, 1, 2, 3, 4, 5, 0, 0, 0)]
+    assert delays[4] <= 30.0 * 1.05 and delays[5] <= 30.0 * 1.05  # capped
+    assert delays[0] != 2.0  # deterministic jitter is actually applied
+    assert delays[3] > 10.0  # ...but the growth is real (16 s +/- 5%)
+    assert rs.healthy_replicas == ["r0"]
+    assert rs.metrics.snapshot()["replica_rejoins"] == 1
+    rs.close()
+
+
+def test_fresh_eviction_kicks_prober_and_resets_schedule():
+    """An eviction landing while the prober sleeps a capped 30 s wait
+    must wake it and restart the schedule from the base interval — the
+    backoff belongs to long-dead backends, not fresh failures."""
+    flaky = _GatedBackend()
+    rs = ReplicaSet([flaky, _GatedBackend()], max_failures=1,
+                    probe=lambda b: None, probe_interval=0)
+    with rs._probe_cond:
+        rs._probe_attempt = 7  # parked deep in a previous quarantine era
+        rs._probe_kick = False
+    rs.submit([1])._finish(RuntimeError("engine boom"))  # evicts r0
+    deadline = time.monotonic() + 10
+    while rs.healthy_replicas != ["r1"] and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with rs._probe_cond:
+        assert rs._probe_attempt == 0 and rs._probe_kick
+    rs.close()
+
+
+def test_prober_thread_rejoins_with_backoff_loop():
+    """Liveness of the real prober thread under the backoff loop: a
+    backend that recovers after two failed probes rejoins without any
+    manual probe_once() call."""
+    flaky = _GatedBackend()
+    flaky.fail_submit = True
+    probes = []
+
+    def probe(b):
+        probes.append(1)
+        if len(probes) <= 2:
+            raise RuntimeError("still dead")
+
+    rs = ReplicaSet([flaky, _GatedBackend()], max_failures=1, probe=probe,
+                    probe_interval=0.02,
+                    probe_backoff=RetryPolicy(
+                        max_attempts=1, base_delay=0.02, max_delay=0.1))
+    rs.submit([1])._finish(RuntimeError("engine boom"))  # evicts r0
+    deadline = time.monotonic() + 15
+    while len(rs.healthy_replicas) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rs.healthy_replicas == ["r0", "r1"]
+    assert len(probes) >= 3
+    rs.close()
+
+
+def test_replica_submit_site_injects_failover():
+    """An armed ``replica.submit`` fault routes through the same
+    classification as a real backend failure: the hit replica is
+    marked, the request fails over, and the front door never raises."""
+    a, b = _GatedBackend(), _GatedBackend()
+    rs = ReplicaSet([a, b], max_failures=2)
+    spec = faults.arm("replica.submit", nth=1,
+                      exc=RuntimeError("injected submit fault"))
+    s = rs.submit([1])  # first placement faults, retried on the sibling
+    assert spec.fired == 1
+    assert rs.snapshot()["replicas"]["r0"]["failed"] == 1
+    assert (a.streams or b.streams)
+    (a if a.streams else b).release()
+    s.result(timeout=10)
+    rs.close()

@@ -543,3 +543,36 @@ def test_watch_checkpoints_skips_entry_with_bad_shard(setup, tmp_path):
         np.asarray(expected)[0], rtol=1e-5)
     watcher.stop(timeout=10)
     svc.close()
+
+
+def test_watch_checkpoints_heals_transient_manifest_read(setup, tmp_path):
+    """A flaky network filesystem (fail-twice OSError on the manifest
+    poll, via the ``ckpt.watch_manifest`` fault site) must not kill the
+    watcher or skip the commit: the error polls back off on the shared
+    RetryPolicy and the reload lands once the reads heal."""
+    from bigdl_tpu import faults
+    from bigdl_tpu.ckpt import CheckpointManager
+    from bigdl_tpu.faults import RetryPolicy
+    from bigdl_tpu.serving import watch_checkpoints
+
+    model, params, state, x = setup
+    ckdir = str(tmp_path / "ck")
+    scaled = jax.tree_util.tree_map(lambda a: np.asarray(a) * 3.0, params)
+    with CheckpointManager(ckdir, fsync=False) as mgr:
+        mgr.save("model.iter1", scaled, state, {},
+                 meta={"iteration": 1}, blocking=True)
+
+    spec = faults.arm("ckpt.watch_manifest", times=2, exc=OSError)
+    svc = InferenceService(model, params, state, max_wait_ms=1.0)
+    watcher = watch_checkpoints(
+        svc, ckdir, poll_interval=0.02,
+        poll_backoff=RetryPolicy(max_attempts=1, base_delay=0.02,
+                                 max_delay=0.2))
+    deadline = time.monotonic() + 15
+    while watcher.reloads < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert spec.fired == 2  # both injected read failures actually hit
+    assert watcher.reloads == 1 and watcher.last_entry.step == 1
+    assert watcher._error_polls == 0  # one clean poll reset the backoff
+    watcher.stop(timeout=10)
+    svc.close()

@@ -650,3 +650,79 @@ def test_router_snapshot_and_table(lm):
     assert router.names() == ["lm"]
     assert len(router.predict("lm", [9], timeout=30, max_new_tokens=2)) == 2
     router.close()
+
+
+# ----------------------------------------- fault sites + stall watchdog ----
+
+from bigdl_tpu import faults  # noqa: E402
+from bigdl_tpu.faults import StallError  # noqa: E402
+from _serving_shims import arm_step_failure  # noqa: E402
+
+
+def test_step_failure_via_site_fails_streams_and_stops_engine(lm):
+    """The engine's own ``engine.decode`` fault site is the one
+    injection mechanism for step failures: streams fail with the
+    injected error (original exception preserved), the loop stops, and
+    new submits are refused."""
+    eng = make_engine(lm, kernels=_SlowKernels(lm[2]))
+    spec = arm_step_failure(eng, after=2, message="injected step death")
+    s = eng.submit([1, 5, 9], max_new_tokens=20)
+    with pytest.raises(RuntimeError, match="injected step death"):
+        s.result(timeout=30)
+    assert spec.fired >= 1
+    with pytest.raises(RuntimeError, match="step failure"):
+        eng.submit([2])
+    assert len(s.tokens) >= 1  # tokens produced before the death remain
+    eng.close()
+
+
+def test_engine_watchdog_fails_streams_on_stalled_step():
+    """A wedged decode step (armed latency far past ``stall_timeout``)
+    must not hang consumers: the watchdog fails every pending/active
+    stream with a StallError diagnostic, submits are refused, and once
+    the stuck step finally returns the loop reconciles the slot table
+    and exits."""
+    stub = _EchoPosition()
+    eng = GenerationEngine(stub, {}, max_slots=2, max_len=32,
+                           max_prompt_len=8, stall_timeout=0.15)
+    faults.arm("engine.decode", latency=1.2, times=1)
+    a = eng.submit([1, 2, 3], max_new_tokens=10)
+    b = eng.submit([4, 5], max_new_tokens=10)
+    with pytest.raises(StallError, match="no progress"):
+        a.result(timeout=30)
+    with pytest.raises(StallError, match="failing pending work"):
+        b.result(timeout=30)
+    with pytest.raises(RuntimeError, match="step failure"):
+        eng.submit([6])
+    # the wedged step returns ~1 s later; the loop thread reconciles the
+    # slots/pages and exits instead of stepping a failed engine
+    deadline = time.monotonic() + 15
+    while (eng.active_slots or eng._thread.is_alive()) \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert eng.active_slots == 0
+    assert not eng._thread.is_alive()
+    # the exiting loop owns watchdog retirement (close() may have been
+    # skipped while the step was wedged): its thread and strong engine
+    # ref must be gone without any close() call
+    deadline = time.monotonic() + 10
+    while eng._watchdog._thread.is_alive() \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not eng._watchdog._thread.is_alive()
+    eng.close()
+
+
+def test_engine_watchdog_quiet_on_healthy_traffic(lm):
+    """A generous watchdog never fires on normal decoding, and close()
+    retires its thread."""
+    model, params, _ = lm
+    eng = make_engine(lm, stall_timeout=10.0)
+    out = eng.generate([1, 5, 9], max_new_tokens=6, timeout=30)
+    assert out == ref_greedy(model, params, [1, 5, 9], 6)
+    assert eng._watchdog.stalls == 0
+    eng.close()
+    deadline = time.monotonic() + 5
+    while eng._watchdog._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not eng._watchdog._thread.is_alive()
