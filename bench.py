@@ -75,6 +75,43 @@ def measure_peak_flops(dtype=jnp.bfloat16, n=4096, short=128, long=512):
     return float(np.median(peaks))
 
 
+def measure_peak_int8_flops(n=4096, short=128, long=512):
+    """Empirical peak int8 OP/s: dependency-chained s8 x s8 -> s32
+    ``dot_general`` (the MXU's native int8 path — round 5 measured
+    ~1.9x the bf16 peak). The int32 accumulator is renarrowed to int8
+    between links with a shift+cast (cheap VPU work that preserves the
+    data dependency; no float rescale, so the chain stays integer).
+    Same differential-median scheme as ``measure_peak_flops`` — the lm
+    bench divides the int8 leg's MFU by THIS peak, never the float one
+    (an int8 dot over the bf16 denominator would report MFU > 1)."""
+    rs = np.random.RandomState(3)
+    w = jnp.asarray(rs.randint(-127, 128, (n, n)), jnp.int8)
+    x = jnp.asarray(rs.randint(-127, 128, (n, n)), jnp.int8)
+
+    def chain(iters):
+        @jax.jit
+        def f(x, w):
+            def link(i, x):
+                acc = jax.lax.dot_general(
+                    x, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return (acc >> 8).astype(jnp.int8)
+
+            y = jax.lax.fori_loop(0, iters, link, x)
+            return jnp.int32(y).sum()
+
+        return f
+
+    f_short, f_long = chain(short), chain(long)
+    int(f_short(x, w)); int(f_long(x, w))  # compile
+    peaks = []
+    for _ in range(5):
+        t0 = time.perf_counter(); int(f_short(x, w)); ts = time.perf_counter() - t0
+        t0 = time.perf_counter(); int(f_long(x, w)); tl = time.perf_counter() - t0
+        peaks.append(2 * n**3 * (long - short) / (tl - ts))
+    return float(np.median(peaks))
+
+
 # bf16 peak FLOP/s per chip by TPU generation (spec sheet) — reported for
 # reference alongside the empirical measurement, never used as denominator
 SPEC_PEAK = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
@@ -271,6 +308,20 @@ class _FixedCostKernels:
         return self.inner.decode_traces
 
 
+def _bench_cache_sharding(mesh, kv_dtype_name):
+    """Cache sharding for a sharded bench engine: pages on the heads
+    axis, plus the replicated scale-pool sharding when KV is int8 (the
+    engine's exact-match check requires the pair)."""
+    from jax.sharding import NamedSharding
+
+    from bigdl_tpu.parallel import kv_cache_pspec, kv_scale_pspec
+
+    cs = NamedSharding(mesh, kv_cache_pspec())
+    if kv_dtype_name == "int8":
+        return (cs, NamedSharding(mesh, kv_scale_pspec()))
+    return cs
+
+
 def run_generation_bench(args):
     """Generation serving benchmark: continuous batching
     (``serving.GenerationEngine``) vs run-to-completion static batching
@@ -311,11 +362,18 @@ def run_generation_bench(args):
       default 8 ms under ``--smoke`` — see ``_FixedCostKernels``). The
       smoke gate requires replicated tokens/sec >= 1.5x single-replica,
       plus per-replica occupancy rows from each replica's own
-      ``ServingMetrics``."""
-    from jax.sharding import NamedSharding
+      ``ServingMetrics``.
 
+    PR 9 — the quantized tier: ``--kv-dtype int8`` stores KV pages int8
+    with per-token fp32 scale pools and adds the capacity-at-fixed-BYTES
+    column vs bf16 (replayed through the real allocator with
+    ``paging.page_bytes`` pricing the scale overhead; smoke gate
+    >= 1.8x); ``--quantize int8`` runs every GEMM as s8 x s8 -> s32
+    with per-channel rescale. Both schedulers quantize identically, so
+    the zero-mismatch gate covers the whole int8 tier — engine vs
+    static, sharded vs single-device, greedy and sampled."""
     from bigdl_tpu.nn.layers.attention import Transformer
-    from bigdl_tpu.parallel import kv_cache_pspec, serving_meshes
+    from bigdl_tpu.parallel import serving_meshes
     from bigdl_tpu.serving import (
         GenerationEngine,
         PagePool,
@@ -330,6 +388,9 @@ def run_generation_bench(args):
     smoke = args.smoke
     slots = args.serve_slots
     page_size = args.page_size
+    kv_dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+                "int8": "int8"}[args.kv_dtype]
+    quantize = None if args.quantize == "none" else args.quantize
     # smoke/CPU: a model small enough to compile in seconds but large
     # enough that the jitted step dwarfs the loop's Python bookkeeping
     if on_tpu:
@@ -355,7 +416,7 @@ def run_generation_bench(args):
                 f"--xla_force_host_platform_device_count=N)")
         mesh = serving_meshes(1, args.tp)[0]
         engine_kernels = PagedDecodeKernels(
-            model, cache_sharding=NamedSharding(mesh, kv_cache_pspec()))
+            model, cache_sharding=_bench_cache_sharding(mesh, args.kv_dtype))
 
     rs = np.random.RandomState(0)
     n_requests = args.requests or 4 * slots
@@ -382,7 +443,8 @@ def run_generation_bench(args):
     engine = GenerationEngine(
         model, params, max_slots=slots, max_len=max_len,
         max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
-        kernels=engine_kernels, page_size=page_size, seed=0, mesh=mesh)
+        kernels=engine_kernels, page_size=page_size, seed=0, mesh=mesh,
+        cache_dtype=kv_dtype, quantize=quantize)
     engine.warmup()
 
     # continuous: submit everything, the engine packs slots between steps
@@ -402,7 +464,8 @@ def run_generation_bench(args):
     souts, static_steps = static_generate(
         model, params, requests, max_slots=slots, max_len=max_len,
         kernels=kernels, prompt_buckets=engine.prompt_buckets,
-        page_size=page_size, seed=0,
+        page_size=page_size, seed=0, cache_dtype=kv_dtype,
+        quantize=quantize,
         sampling=[sample_spec] * n_requests if args.sample else None)
     static_wall = time.perf_counter() - t0
     static_tokens = sum(len(o) for o in souts)
@@ -410,22 +473,59 @@ def run_generation_bench(args):
     # capacity column: at the KV-byte budget of `slots` DENSE lanes, how
     # many concurrent sequences of a 4:1 short:long mix does the page
     # pool admit? Replayed through the real allocator (full reservation
-    # at admission, exactly what the engine commits to).
-    from bigdl_tpu.serving.paging import pages_per_lane
+    # at admission, exactly what the engine commits to). Byte math is
+    # dtype-aware (paging.page_bytes): a page is priced in the ACTUAL
+    # cache dtype including, for int8, its per-token fp32 scale rows —
+    # capacity claims never assume pages are free to describe.
+    from bigdl_tpu.serving.paging import page_bytes, pages_per_lane
 
-    budget_pages = slots * pages_per_lane(max_len, page_size)  # dense budget
-    pool = PagePool(budget_pages, page_size, max_len)
-    cap_rs = np.random.RandomState(1)
-    capacity_paged = 0
-    while True:
-        plen = int(cap_rs.randint(3, max_prompt + 1))
-        new = long_new if capacity_paged % 5 == 4 else short_new
-        need = pool.pages_for(min(plen + new - 1, max_len))
-        if not pool.can_reserve(need):
-            break
-        pool.alloc(need)
-        capacity_paged += 1
+    heads, head_dim = model.num_heads, model.hidden_size // model.num_heads
+
+    def replay_capacity(n_pages):
+        """Admissions of the 4:1 mix a pool of ``n_pages`` accepts —
+        same deterministic request sequence for every dtype leg."""
+        pool = PagePool(n_pages, page_size, max_len)
+        cap_rs = np.random.RandomState(1)
+        admitted = 0
+        while True:
+            plen = int(cap_rs.randint(3, max_prompt + 1))
+            new = long_new if admitted % 5 == 4 else short_new
+            need = pool.pages_for(min(plen + new - 1, max_len))
+            if not pool.can_reserve(need):
+                return admitted
+            pool.alloc(need)
+            admitted += 1
+
+    ppn = pages_per_lane(max_len, page_size)
+    run_page_bytes = page_bytes(
+        page_size, heads, head_dim,
+        "int8" if args.kv_dtype == "int8" else kv_dtype)
+    # same-dtype ratio (the PR-6 paging win): budget = `slots` dense
+    # lanes in the run's own dtype, so the byte width cancels and the
+    # page-count replay is unchanged
+    capacity_paged = replay_capacity(slots * ppn)
     capacity_ratio = capacity_paged / slots
+    # int8-vs-bf16 at FIXED BYTES (the PR-9 compounding win): price a
+    # bf16 dense-lane budget, ask how many pages each dtype fits —
+    # scale pools included — and replay the same mix through both
+    int8_fields = {}
+    if args.kv_dtype == "int8":
+        bf16_pb = page_bytes(page_size, heads, head_dim, jnp.bfloat16)
+        int8_pb = page_bytes(page_size, heads, head_dim, "int8")
+        budget_bytes = slots * ppn * bf16_pb
+        # the bf16 leg's budget cancels to the dense page count
+        # (budget_bytes // bf16_pb == slots * ppn), which is exactly the
+        # replay capacity_paged already measured — reuse it
+        cap_bf16 = capacity_paged
+        cap_int8 = replay_capacity(budget_bytes // int8_pb)
+        int8_fields = {
+            "kv_budget_bytes_per_layer": budget_bytes,
+            "capacity_bf16_seqs": cap_bf16,
+            "capacity_int8_seqs": cap_int8,
+            "capacity_int8_vs_bf16": round(cap_int8 / max(cap_bf16, 1), 3),
+            "int8_scale_overhead": round(
+                int8_pb / (bf16_pb / 2) - 1.0, 4),
+        }
 
     # greedy decode is deterministic: both schedulers must produce the
     # SAME tokens — a throughput number from divergent outputs is bogus.
@@ -465,14 +565,17 @@ def run_generation_bench(args):
             if mesh_i is None:
                 kern = kernels  # share the compiled single-device triple
             else:
-                kern = PagedDecodeKernels(model, cache_sharding=NamedSharding(
-                    mesh_i, kv_cache_pspec()))
+                kern = PagedDecodeKernels(
+                    model,
+                    cache_sharding=_bench_cache_sharding(mesh_i,
+                                                         args.kv_dtype))
             if step_cost_ms > 0:
                 kern = _FixedCostKernels(kern, step_cost_ms / 1e3)
             eng = GenerationEngine(
                 model, params, max_slots=rep_slots, max_len=max_len,
                 max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
                 kernels=kern, page_size=page_size, seed=0, mesh=mesh_i,
+                cache_dtype=kv_dtype, quantize=quantize,
                 metrics=ServingMetrics())
             eng.warmup()
             return eng
@@ -540,6 +643,13 @@ def run_generation_bench(args):
         "capacity_dense_slots": slots,
         "capacity_paged_seqs": capacity_paged,
         "capacity_paged_vs_dense": round(capacity_ratio, 3),
+        "kv_dtype": args.kv_dtype,
+        "quantize": args.quantize,
+        "kv_page_bytes_per_layer": run_page_bytes,
+        "kv_bytes_peak": snap["pages_peak"] * run_page_bytes
+        * model.num_hidden_layers,
+        "quantized_gemms": snap["quantized_gemms"],
+        **int8_fields,
         "tp": args.tp,
         "replicas": args.replicas,
         "step_cost_ms": step_cost_ms,
@@ -587,6 +697,172 @@ def run_generation_bench(args):
                 "concurrent sequences at a fixed KV-byte budget (gate: "
                 ">= 2x on the 4:1 short:long mix)"
                 % result["capacity_paged_vs_dense"])
+        if args.kv_dtype == "int8" and result["capacity_int8_vs_bf16"] < 1.8:
+            raise SystemExit(
+                "generation smoke: int8 KV pages admit only %.2fx the "
+                "bf16 concurrent sequences at the same byte budget "
+                "(gate: >= 1.8x with scale pools priced in — the int8 "
+                "byte saving must survive its own overhead)"
+                % result["capacity_int8_vs_bf16"])
+
+
+def run_lm_bench(args):
+    """LM throughput + empirical MFU (``--mode lm``): jitted
+    full-sequence forward and engine-shaped decode steps over the
+    serving ``nn.Transformer``, with a ``--quantize int8`` A/B leg.
+
+    BENCH has tracked only the conv-heavy ResNet-50 step while the MFU
+    north star talks about MXU-rate compute; this mode measures the
+    GEMM-shaped workload directly. Same differential-timing scheme as
+    ``perf/lm_perf.py``: two scan lengths, slope = per-step time, so
+    dispatch overhead cancels. MFU counts USEFUL flops (GEMMs + the
+    attended context, not pad/masked lanes) against the measured
+    matmul peak of the same precision family — the int8 leg divides by
+    a measured s8 x s8 -> s32 peak (``measure_peak_int8_flops``), the
+    float leg by the float/bf16 peak, so on the MXU (int8 ~1.9x bf16)
+    the int8 MFU reports actual int8-path utilization instead of a
+    >1.0 number priced against the wrong family. On CPU the column is
+    a smoke-level sanity number; the on-chip round is where it binds.
+    The int8 leg reports its ratio vs float: on the MXU the int8 dot
+    runs ~1.9x bf16 (round 5); on CPU it is typically SLOWER (no VNNI
+    path through XLA) — the A/B column exists so the on-chip number
+    lands somewhere."""
+    from bigdl_tpu.nn.layers.attention import Transformer
+    from bigdl_tpu.nn.quantized import quantize_for_serving
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        vocab, hidden, heads, filt, layers = 8192, 512, 8, 2048, 4
+        batch, seq, slots, dec_steps = 8, 128, 16, 32
+        peak = measure_peak_flops(jnp.bfloat16)
+        peak_int8 = (measure_peak_int8_flops()
+                     if args.quantize == "int8" else None)
+    else:
+        vocab, hidden, heads, filt, layers = 256, 128, 4, 256, 2
+        batch, seq, slots, dec_steps = 4, 64, 8, 16
+        peak = measure_peak_flops(jnp.float32, n=512, short=16, long=48)
+        peak_int8 = (measure_peak_int8_flops(n=512, short=16, long=48)
+                     if args.quantize == "int8" else None)
+
+    model = Transformer(vocab_size=vocab, hidden_size=hidden,
+                        num_heads=heads, filter_size=filt,
+                        num_hidden_layers=layers)
+    params, _ = model.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(1, vocab, (batch, seq)), jnp.int32)
+
+    # useful flops per token: the 6 GEMMs + lm head (2*N*K each) plus
+    # score/value attention matmuls over the actually-attended context
+    gemm_tok = 2 * (4 * hidden * hidden + 2 * hidden * filt) * layers \
+        + 2 * hidden * vocab
+    fwd_attn_tok = 4 * hidden * (seq / 2) * layers     # avg causal ctx
+    fwd_flops_tok = gemm_tok + fwd_attn_tok
+
+    def time_slope(make_runner, n1, n2, reps=5):
+        """Best-of differential: (t(n2) - t(n1)) / (n2 - n1)."""
+        r1, r2 = make_runner(n1), make_runner(n2)
+
+        def best(r):
+            jax.block_until_ready(r())
+            b = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(r())
+                b = min(b, time.perf_counter() - t0)
+            return b
+
+        return (best(r2) - best(r1)) / (n2 - n1)
+
+    toks0 = jnp.asarray(rs.randint(1, vocab, (slots,)), jnp.int32)
+    pos0 = jnp.full((slots,), seq // 2, jnp.int32)
+
+    def leg(p, peak_denom):
+        def fwd_runner(n):
+            # each iteration's input depends on the previous argmax so
+            # XLA cannot hoist the loop-invariant forward out of the
+            # scan (a constant-input scan times as ONE forward)
+            @jax.jit
+            def f(p, ids):
+                def step(ids, _):
+                    lg, _ = model.apply(p, ids, training=False)
+                    nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                    ids = jnp.roll(ids, -1, axis=1).at[:, -1].set(nxt)
+                    return ids, None
+                ids, _ = jax.lax.scan(step, ids, None, length=n)
+                return ids
+            return lambda: f(p, ids)
+
+        fwd_dt = time_slope(fwd_runner, 2, 6)
+        fwd_tps = batch * seq / fwd_dt
+
+        cache = model.init_cache(slots, seq)
+
+        def dec_runner(n):
+            @jax.jit
+            def f(p, cache, toks, pos):
+                def step(carry, _):
+                    cache, toks, pos = carry
+                    lg, cache = model.decode_step(p, cache, toks, pos)
+                    toks = jnp.argmax(lg, -1).astype(jnp.int32)
+                    return (cache, toks, pos + 1), None
+                (cache, toks, _), _ = jax.lax.scan(
+                    step, (cache, toks, pos), None, length=n)
+                return toks
+            return lambda: f(p, cache, toks0, pos0)
+
+        dec_dt = time_slope(dec_runner, 2, 2 + dec_steps)
+        dec_tps = slots / dec_dt
+        dec_attn_tok = 4 * hidden * (seq // 2) * layers
+        return {
+            "forward_tokens_per_sec": round(fwd_tps, 1),
+            "forward_mfu": round(fwd_tps * fwd_flops_tok / peak_denom, 4),
+            "decode_tokens_per_sec": round(dec_tps, 1),
+            "decode_mfu": round(
+                dec_tps * (gemm_tok + dec_attn_tok) / peak_denom, 4),
+        }
+
+    result = {
+        "metric": "lm_forward_tokens_per_sec",
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "model": {"vocab": vocab, "hidden": hidden, "heads": heads,
+                  "filter": filt, "layers": layers, "batch": batch,
+                  "seq": seq, "decode_slots": slots},
+        "matmul_peak_flops": peak,
+        **leg(params, peak),
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timing": "differential scan slope (dispatch cancels), best-of-5",
+    }
+    result["value"] = result["forward_tokens_per_sec"]
+    if args.quantize == "int8":
+        qparams = quantize_for_serving(params)
+        # the int8 leg's MFU denominator is the measured int8 peak —
+        # "same precision family" for real (the mixed float attention
+        # inside the leg makes this slightly conservative on-chip)
+        q = leg(qparams, peak_int8)
+        result["int8_matmul_peak_flops"] = peak_int8
+        result.update({f"int8_{k}": v for k, v in q.items()})
+        result["int8_vs_float_forward"] = round(
+            q["forward_tokens_per_sec"]
+            / result["forward_tokens_per_sec"], 3)
+        result["int8_vs_float_decode"] = round(
+            q["decode_tokens_per_sec"]
+            / result["decode_tokens_per_sec"], 3)
+    print(json.dumps(result))
+    if args.smoke:
+        need = ["forward_tokens_per_sec", "forward_mfu",
+                "decode_tokens_per_sec", "decode_mfu"]
+        if args.quantize == "int8":
+            need += ["int8_vs_float_forward", "int8_vs_float_decode",
+                     "int8_matmul_peak_flops",
+                     "int8_forward_mfu", "int8_decode_mfu"]
+        bad = [k for k in need
+               if not np.isfinite(result.get(k, float("nan")))
+               or result[k] <= 0]
+        if bad:
+            raise SystemExit(f"lm smoke: non-finite/non-positive {bad}")
 
 
 def run_checkpoint_bench(args):
@@ -1272,7 +1548,7 @@ def run_chaos_bench(args):
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("train", "serving", "checkpoint",
-                                       "pipeline", "chaos"),
+                                       "pipeline", "chaos", "lm"),
                     default="train",
                     help="train = supervised ResNet-50 throughput (default); "
                          "serving = dynamic-batching requests/sec + latency "
@@ -1285,7 +1561,11 @@ def _parse_args(argv=None):
                          "chaos = deterministic fault-injection soak over "
                          "train-with-checkpoints + serve-with-replicas "
                          "(bit-identical recovery, API-only front-door "
-                         "errors, zero resource leaks)")
+                         "errors, zero resource leaks); "
+                         "lm = transformer forward/decode tokens/sec + "
+                         "empirical MFU (the MXU-heavy workload the MFU "
+                         "north star describes), with a --quantize int8 "
+                         "A/B leg")
     ap.add_argument("--concurrency", type=int, default=32,
                     help="serving: concurrent client threads")
     ap.add_argument("--requests", type=int, default=0,
@@ -1326,6 +1606,20 @@ def _parse_args(argv=None):
                          "inside the jitted step; seeded per request, so "
                          "the continuous-vs-static mismatch gate still "
                          "applies")
+    ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
+                    default="fp32",
+                    help="serving --generate: KV page-pool storage dtype. "
+                         "int8 stores pages with per-token fp32 scale "
+                         "pools and adds the capacity-at-fixed-bytes "
+                         "column vs bf16 (--smoke gates it >= 1.8x, scale "
+                         "pools priced into the budget)")
+    ap.add_argument("--quantize", choices=("none", "int8"), default="none",
+                    help="serving --generate / lm: int8 post-training "
+                         "quantization of the GEMM weights "
+                         "(per-output-channel scales, s8 x s8 -> s32 "
+                         "dot_general — the MXU's ~1.9x-over-bf16 path); "
+                         "both schedulers quantize identically, so the "
+                         "mismatch gate covers the quantized tier")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="chaos: root seed of every fault schedule (the "
                          "soak replays exactly for a given seed)")
@@ -1722,6 +2016,10 @@ def main():
     elif args.mode == "chaos":
         # invariant soak (pass/fail), not a measurement; runs in-process
         run_chaos_bench(args)
+    elif args.mode == "lm":
+        # differential step timing cancels dispatch overhead like the
+        # train mode; small enough to run without the supervisor
+        run_lm_bench(args)
     elif args.worker:
         run_bench(args)
     else:

@@ -1,0 +1,121 @@
+"""Functional int8 building blocks for the quantized serving tier.
+
+Leaf module on purpose (imports nothing from ``bigdl_tpu.nn``): both the
+reference-tier module rewrite (``nn/quantized.py``) and the serving hot
+path (``nn/layers/linear.py`` / the ``Transformer`` lm head / the paged
+KV pools) call these, and a ``layers -> quantized -> layers`` cycle must
+not exist.
+
+Numerics contract (the tests pin all of it against numpy oracles):
+
+- **weights**: symmetric per-output-channel int8 —
+  ``scale = max|w| / 127`` per row of the (out, in) weight,
+  ``w_q = clip(round(w / scale), -127, 127)``. ``jnp.round`` is
+  round-half-to-even, bitwise ``np.round`` — the oracle replays it
+  exactly.
+- **activations**: symmetric PER-TOKEN (per-row) int8, computed
+  dynamically INSIDE the jitted step. Per-row, not per-tensor, is
+  load-bearing for the serving tier: a decode batch holds every active
+  slot's activations, and a batch-wide absmax would make one request's
+  quantization — and therefore its logits and its sampled stream —
+  depend on who its neighbours are, breaking the engine's
+  schedule-invariance contract (caught by the order-reversal tests).
+  One scale per row keeps each request a pure function of itself, and
+  is the more accurate choice anyway; the VPU absmax is noise next to
+  the MXU GEMM either way.
+- **matmul**: a TRUE ``s8 x s8 -> s32`` ``lax.dot_general``
+  (``preferred_element_type=int32``) — on TPU this is the MXU's native
+  int8 path at ~1.9x the bf16 rate (350-373 TOP/s measured,
+  ``perf/micro_int8.py`` round 5). Integer accumulation is exact, so
+  the jitted GEMM matches an int64-safe numpy oracle BIT-for-bit; the
+  fp32 rescale ``acc * (scale_x * scale_w)`` is the only rounding.
+- **KV rows**: per-token (per-row) scales shared across heads — one
+  fp32 scale per written K (and V) row. Write-local by construction:
+  no page ever needs requantizing, a recycled page carries no stale
+  scale state, and chunked prefill stays bitwise equal to whole-prompt
+  prefill even at int8 (each row's quantization depends only on the
+  row itself).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# floor for every scale: keeps all-zero tensors/rows well-defined
+# (q = 0, dequant = 0) without a division guard in the hot path
+EPS = 1e-8
+
+
+def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(out, in) float weight -> (int8 weight, (out,) fp32 scales),
+    symmetric per-output-channel (reference ``Desc.scala`` scales)."""
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=1)
+    scale = jnp.maximum(absmax, EPS) / 127.0
+    wq = jnp.clip(jnp.round(w / scale[:, None]), -127, 127).astype(jnp.int8)
+    return wq, scale.astype(jnp.float32)
+
+
+def quantize_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Dynamic symmetric per-token int8: (M, K) float -> (int8 x,
+    (M,) fp32 scales), one scale per row. Runs inside the jitted step;
+    see the module docstring for why serving activations quantize
+    per row, never per batch."""
+    x = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=-1), EPS) / 127.0
+    xq = jnp.clip(jnp.round(x / scale[:, None]), -127, 127).astype(jnp.int8)
+    return xq, scale
+
+
+def int8_accum(xq: jax.Array, wq: jax.Array) -> jax.Array:
+    """The raw MXU op: (M, K) s8 x (N, K) s8 -> (M, N) s32, contracting
+    K. Exact integer accumulation — no silent upcast (test-asserted on
+    the jaxpr)."""
+    return lax.dot_general(
+        xq, wq, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def int8_linear(x: jax.Array, wq: jax.Array, w_scale: jax.Array,
+                bias: Optional[jax.Array] = None) -> jax.Array:
+    """Quantized GEMM for a (out, in) int8 weight: dynamic per-token
+    activation quantization, ``s8 x s8 -> s32`` dot, fp32
+    (row-scale x channel-scale) rescale. ``x`` is (..., in); returns
+    (..., out) in ``x.dtype``."""
+    shape = x.shape
+    xq, x_scale = quantize_rows(x.reshape(-1, shape[-1]))
+    acc = int8_accum(xq, wq)
+    y = acc.astype(jnp.float32) * (
+        x_scale[:, None] * w_scale[None, :].astype(jnp.float32))
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.reshape(shape[:-1] + (wq.shape[0],)).astype(x.dtype)
+
+
+# ------------------------------------------------------------- KV rows ----
+
+
+def quantize_kv_rows(rows: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-token KV quantization: ``rows`` (..., H, D) float ->
+    (int8 rows, (...,) fp32 scales), one scale per row across all heads.
+    Shared-across-heads keeps the scale pool free of a heads axis, so
+    it replicates cleanly under tensor parallelism while the int8 pages
+    shard on heads; the cross-head absmax is an exact max, so sharded
+    and single-device quantization agree bitwise."""
+    rows = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(rows), axis=(-2, -1))
+    scale = jnp.maximum(absmax, EPS) / 127.0
+    q = jnp.clip(jnp.round(rows / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_lanes(lanes: jax.Array, scales: jax.Array,
+                     dtype=jnp.float32) -> jax.Array:
+    """int8 lanes (..., H, L, D) x per-row scales (..., L) -> float
+    lanes. The inverse of :func:`quantize_kv_rows` after a page
+    gather."""
+    return lanes.astype(dtype) * scales[..., None, :, None].astype(dtype)

@@ -36,7 +36,8 @@ from bigdl_tpu.ops.attention import (
     dot_product_attention,
     paged_attention,
 )
-from bigdl_tpu.ops.flash_attention import gather_kv_lanes
+from bigdl_tpu.nn.int8 import dequantize_lanes, quantize_kv_rows
+from bigdl_tpu.ops.flash_attention import gather_kv_lanes, gather_scale_lanes
 
 
 def position_encoding(length: int, hidden_size: int, dtype=jnp.float32) -> jax.Array:
@@ -105,6 +106,14 @@ class Attention(Module):
             # (test-enforced); on TPU the decode step instead streams
             # pages through the Pallas gather kernel ("use_kernel").
             pk, pv = paged["k"], paged["v"]
+            # int8 pools carry per-token scale pools (num_pages, page
+            # _size) next to the pages: scatter quantizes the new rows
+            # (one fp32 scale per row, shared across heads — see
+            # nn.int8.quantize_kv_rows), gather dequantizes. A float
+            # pool has no scale entries and traces the PR-6 path
+            # bit-unchanged.
+            pks, pvs = paged.get("k_scale"), paged.get("v_scale")
+            int8_kv = pks is not None
             page_size = pk.shape[2]
             if getattr(cache_index, "ndim", 0) == 1:
                 # decode: one token per slot; map is (S, ppn)
@@ -113,8 +122,16 @@ class Attention(Module):
                 pg = jnp.take_along_axis(
                     page_map, (pos // page_size)[:, None], axis=1)[:, 0]
                 row = pos % page_size
-                pk = pk.at[pg, :, row].set(k[:, :, 0, :].astype(pk.dtype))
-                pv = pv.at[pg, :, row].set(v[:, :, 0, :].astype(pv.dtype))
+                if int8_kv:
+                    kq, ksc = quantize_kv_rows(k[:, :, 0, :])
+                    vq, vsc = quantize_kv_rows(v[:, :, 0, :])
+                    pk = pk.at[pg, :, row].set(kq)
+                    pv = pv.at[pg, :, row].set(vq)
+                    pks = pks.at[pg, row].set(ksc)
+                    pvs = pvs.at[pg, row].set(vsc)
+                else:
+                    pk = pk.at[pg, :, row].set(k[:, :, 0, :].astype(pk.dtype))
+                    pv = pv.at[pg, :, row].set(v[:, :, 0, :].astype(pv.dtype))
                 if bias is not None:
                     # positions fully define the mask in a paged decode
                     # step; no caller passes one (keep the contract
@@ -124,6 +141,7 @@ class Attention(Module):
                         "paged decode attention takes no external bias")
                 out3 = paged_attention(
                     q[:, :, 0, :], pk, pv, page_map, pos,
+                    k_scales=pks, v_scales=pvs,
                     use_kernel=paged.get("use_kernel"))
                 out = out3[:, :, None, :]
             else:
@@ -146,19 +164,33 @@ class Attention(Module):
                     pages_row[jnp.clip(pos // page_size, 0, ppn - 1)],
                     paged["trash"])
                 row = pos % page_size
-                pk = pk.at[pg, :, row].set(
-                    k[0].transpose(1, 0, 2).astype(pk.dtype))
-                pv = pv.at[pg, :, row].set(
-                    v[0].transpose(1, 0, 2).astype(pv.dtype))
-                lk = gather_kv_lanes(pk, pages_row)[None]
-                lv = gather_kv_lanes(pv, pages_row)[None]
+                if int8_kv:
+                    kq, ksc = quantize_kv_rows(k[0].transpose(1, 0, 2))
+                    vq, vsc = quantize_kv_rows(v[0].transpose(1, 0, 2))
+                    pk = pk.at[pg, :, row].set(kq)
+                    pv = pv.at[pg, :, row].set(vq)
+                    pks = pks.at[pg, row].set(ksc)
+                    pvs = pvs.at[pg, row].set(vsc)
+                    lk = dequantize_lanes(
+                        gather_kv_lanes(pk, pages_row),
+                        gather_scale_lanes(pks, pages_row))[None]
+                    lv = dequantize_lanes(
+                        gather_kv_lanes(pv, pages_row),
+                        gather_scale_lanes(pvs, pages_row))[None]
+                else:
+                    pk = pk.at[pg, :, row].set(
+                        k[0].transpose(1, 0, 2).astype(pk.dtype))
+                    pv = pv.at[pg, :, row].set(
+                        v[0].transpose(1, 0, 2).astype(pv.dtype))
+                    lk = gather_kv_lanes(pk, pages_row)[None]
+                    lv = gather_kv_lanes(pv, pages_row)[None]
                 rows = idx + t[:, None]
                 cols = jnp.arange(lk.shape[2])[None, :]
                 validity = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
                 out = dot_product_attention(
                     q, lk, lv, validity if bias is None else bias + validity)
             out = self.run_child(ctx, "output_layer", self._join_heads(out))
-            return out, (pk, pv)
+            return out, ((pk, pv, pks, pvs) if int8_kv else (pk, pv))
         if cache is not None:
             ck, cv = cache
             idx = cache_index if cache_index is not None else 0
@@ -350,6 +382,14 @@ class Transformer(Module):
 
     def _logits(self, ctx: Context, h):
         if self.share_embedding:
+            if "embedding_q" in ctx.params:
+                # int8 lm head (quantize_for_serving): the float
+                # embedding keeps doing lookups; the GEMM against it
+                # runs s8 x s8 -> s32 with per-vocab-row rescale
+                from bigdl_tpu.nn.int8 import int8_linear
+
+                return int8_linear(h, ctx.param("embedding_q"),
+                                   ctx.param("lm_scale"))
             emb = ctx.param("embedding").astype(h.dtype)
             return jnp.einsum("bsh,vh->bsv", h, emb)
         return self.run_child(ctx, "project", h)
@@ -422,12 +462,26 @@ class Transformer(Module):
         shape ``(num_pages, num_heads, page_size, head_dim)``. Page ids
         are the caller's to manage (the serving tier's ``PagePool``
         reserves one physical page as the trash page for masked
-        writes)."""
+        writes).
+
+        ``dtype="int8"`` (or ``jnp.int8``) stores pages int8 with
+        per-token fp32 scale pools of shape ``(num_pages, page_size)``
+        riding alongside: the entry becomes ``(K, V, K_scale, V_scale)``
+        and the attention layer quantizes on scatter / dequantizes on
+        gather (``nn.int8``) — half the bf16 KV bytes plus a
+        ``4 / (num_heads * head_dim * itemsize)`` scale overhead."""
         if self.transformer_type != LANGUAGE_MODEL:
             raise ValueError("incremental decoding needs a language_model "
                              "transformer (decoder-only)")
         head_dim = self.hidden_size // self.num_heads
         shape = (num_pages, self.num_heads, page_size, head_dim)
+        if jnp.dtype(dtype) == jnp.int8:
+            sshape = (num_pages, page_size)
+            return {name: (jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(sshape, jnp.float32),
+                           jnp.zeros(sshape, jnp.float32))
+                    for name in self._decoder_names()}
         return {name: (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for name in self._decoder_names()}
 
@@ -451,10 +505,14 @@ class Transformer(Module):
         x = self.run_child(ctx, "embed_drop", x)
         new_cache = dict(cache)
         for name in self._decoder_names():
-            pk, pv = cache[name]
+            entry = cache[name]
+            pk, pv = entry[0], entry[1]
+            pks, pvs = (entry[2], entry[3]) if len(entry) == 4 else (None,
+                                                                     None)
             x, new_cache[name] = self._modules[name].forward(
                 ctx.child(name), x, cache_index=start,
-                paged={"k": pk, "v": pv, "map": pages_row, "trash": trash},
+                paged={"k": pk, "v": pv, "k_scale": pks, "v_scale": pvs,
+                       "map": pages_row, "trash": trash},
                 write_len=length)
         if not need_logits:
             return new_cache
@@ -481,11 +539,14 @@ class Transformer(Module):
         x = x + pe[positions][:, None, :]
         new_cache = dict(cache)
         for name in self._decoder_names():
-            pk, pv = cache[name]
+            entry = cache[name]
+            pk, pv = entry[0], entry[1]
+            pks, pvs = (entry[2], entry[3]) if len(entry) == 4 else (None,
+                                                                     None)
             x, new_cache[name] = self._modules[name].forward(
                 ctx.child(name), x, cache_index=positions,
-                paged={"k": pk, "v": pv, "map": page_map,
-                       "use_kernel": use_kernel})
+                paged={"k": pk, "v": pv, "k_scale": pks, "v_scale": pvs,
+                       "map": page_map, "use_kernel": use_kernel})
         x = self.run_child(ctx, "final_norm", x)
         return self._logits(ctx, x)[:, 0, :], new_cache
 

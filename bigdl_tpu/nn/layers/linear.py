@@ -51,6 +51,18 @@ class Linear(Module):
         return p
 
     def forward(self, ctx: Context, x):
+        if "weight_q" in ctx.params:
+            # int8 serving tree (nn.quantized.quantize_for_serving): the
+            # params CARRY the quantization, so every caller — full
+            # forward, prefill/decode_step, their paged twins — runs the
+            # s8 x s8 -> s32 MXU path with zero signature changes. The
+            # branch resolves at trace time (dict membership), so float
+            # trees trace exactly the code below, bit-unchanged.
+            from bigdl_tpu.nn.int8 import int8_linear
+
+            return int8_linear(
+                x, ctx.param("weight_q"), ctx.param("scale"),
+                ctx.param("bias") if self.with_bias else None)
         w = ctx.param("weight").astype(x.dtype)
         y = jnp.dot(x, w.T)
         if self.with_bias:

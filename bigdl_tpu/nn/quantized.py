@@ -10,8 +10,10 @@ TPU-native design:
 - weights are quantized **per output channel** to int8 symmetric
   (``w_q = round(w / scale)``, ``scale = max|w| / 127``), like the
   reference's per-output scales;
-- activations are quantized **dynamically per tensor** at runtime
-  (the reference computes input min/max per forward too);
+- activations are quantized **dynamically per sample** at runtime (one
+  scale per batch row — a batch-wide absmax would couple co-batched
+  serving requests; the reference computes input min/max per forward
+  too, and calibrated static scales skip the pass entirely);
 - the Linear matmul runs as a true int8 x int8 -> int32
   ``lax.dot_general`` (``preferred_element_type=int32``) — on TPU this is
   the MXU's native int8 path at double the bf16 throughput;
@@ -42,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bigdl_tpu.nn import int8 as _int8
 from bigdl_tpu.nn.containers import Sequential
 from bigdl_tpu.nn.graph import Graph, Node
 from bigdl_tpu.nn.layers.conv import SpatialConvolution
@@ -59,20 +62,33 @@ def _quantize_weight(w: jax.Array, channel_axis: int = 0):
 
 
 def _quantize_activation(x: jax.Array, static_scale=None):
-    """Symmetric per-tensor int8. With a calibrated ``static_scale`` > 0
-    the dynamic absmax pass is skipped (reference ``GenerateInt8Scales``
-    computes static activation scales offline; dynamic is the fallback).
-    ``lax.cond`` (not ``where``) so the full-tensor absmax reduction is
+    """Symmetric int8 activations. The DYNAMIC path quantizes PER
+    SAMPLE (one scale per batch row, absmax over the rest): a
+    per-tensor absmax over a packed batch would make one request's
+    output depend on which requests the DynamicBatcher co-batched it
+    with — the same neighbour-coupling the serving engine's per-token
+    scales exist to prevent (an `InferenceService(quantize="int8")`
+    answer must be a function of the request, not of concurrent
+    traffic). With a calibrated ``static_scale`` > 0 the absmax pass is
+    skipped entirely and one fixed scale serves every sample (reference
+    ``GenerateInt8Scales`` semantics — also coupling-free, by
+    constancy). ``lax.cond`` (not ``where``) so the reduction is
     genuinely NOT executed on the calibrated path."""
+    axes = tuple(range(1, x.ndim))
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
 
     def dyn(_):
-        return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127.0
+        return jnp.maximum(jnp.max(jnp.abs(x), axis=axes, keepdims=True),
+                           1e-8) / 127.0
 
     if static_scale is None:
         scale = dyn(None)
     else:
-        scale = lax.cond(static_scale > 0,
-                         lambda _: static_scale.astype(jnp.float32), dyn, None)
+        scale = lax.cond(
+            static_scale > 0,
+            lambda _: jnp.broadcast_to(static_scale.astype(jnp.float32),
+                                       shape),
+            dyn, None)
     xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return xq, scale
 
@@ -220,12 +236,14 @@ class QuantizedSpatialConvolution(Module):
                 feature_group_count=self.n_group,
                 dimension_numbers=_dimension_numbers(self.data_format),
             )
+        # scale_x is per SAMPLE, (B, 1, 1, 1) — it broadcasts against y
+        # directly; only the per-channel weight scale needs axis placement
         if self.data_format == "NCHW":
-            y = y * (scale_x * scale_w)[None, :, None, None]
+            y = y * scale_x * scale_w[None, :, None, None]
             if self.with_bias:
                 y = y + ctx.param("bias")[None, :, None, None]
         else:
-            y = y * (scale_x * scale_w)
+            y = y * scale_x * scale_w
             if self.with_bias:
                 y = y + ctx.param("bias")
         return y.astype(x.dtype)
@@ -294,6 +312,103 @@ def quantize(module: Module, params) -> Tuple[Module, Any]:
         if new_sub:
             new_params[name] = new_sub
     return clone, new_params
+
+
+def quantize_for_serving(params):
+    """Post-training int8 transform for the SERVING ``nn.Transformer``
+    param tree (the decode surface: ``prefill``/``decode_step`` and
+    their paged twins).
+
+    Every GEMM weight — the q/k/v/output projections, FFN up/down, and
+    the lm head — is replaced by symmetric per-output-channel int8
+    (``weight`` -> ``weight_q`` int8 + ``scale`` fp32 (out,)); norms,
+    biases and the embedding-lookup table stay float. ``Linear.forward``
+    and ``Transformer._logits`` detect the quantized keys and execute as
+    a true ``s8 x s8 -> s32`` ``dot_general`` with dynamic PER-TOKEN
+    activation quantization inside the jitted step
+    (``nn.int8.quantize_rows``) — the MXU's ~1.9x-over-bf16 path
+    (round-5 measurement). Per-token (one scale per row), never
+    per-tensor, is load-bearing: a decode batch holds every active slot,
+    and a batch-wide absmax would make one request's logits depend on
+    its co-scheduled neighbours, breaking the stream = f(seed)
+    schedule-invariance contract the order-reversal tests pin
+    (PERF_NOTES round 8). Shapes and the
+    tree structure are a pure function of the input tree, so a reload
+    that re-runs this transform hits the SAME compiled executable.
+
+    A shared-embedding lm head gets a dedicated int8 copy
+    (``embedding_q`` + ``lm_scale``, quantized per vocab row) next to
+    the float ``embedding`` used for lookups — int8 lookup would
+    perturb the hidden stream for no GEMM win.
+
+    Returns a NEW params tree; the input is untouched. Generic rule: a
+    subtree whose keys are exactly ``{weight[, bias]}`` with a 2-D
+    weight is a GEMM (norm weights are 1-D, convs never appear in the
+    decode surface)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        keys = set(node.keys())
+        if "weight" in keys and keys <= {"weight", "bias"} \
+                and getattr(node["weight"], "ndim", 0) == 2:
+            wq, scale = _int8.quantize_weight(node["weight"])
+            out = {"weight_q": wq, "scale": scale}
+            if "bias" in node:
+                out["bias"] = jnp.asarray(node["bias"], jnp.float32)
+            return out
+        out = {k: walk(v) for k, v in node.items()}
+        if "embedding" in keys and "project" not in keys \
+                and getattr(node["embedding"], "ndim", 0) == 2:
+            # shared-embedding head only: an untied Transformer carries a
+            # "project" Linear (quantized by the rule above) and never
+            # reads embedding_q — emitting it there would hold dead int8
+            # bytes and over-count quantized_gemms
+            eq, es = _int8.quantize_weight(node["embedding"])
+            out["embedding_q"] = eq
+            out["lm_scale"] = es
+        return out
+
+    return walk(params)
+
+
+def count_quantized_gemms(params) -> int:
+    """Number of int8 GEMMs in a ``quantize_for_serving`` param tree —
+    the ``ServingMetrics.quantized_gemms`` gauge for the engine path.
+    Correct THERE because that transform only ever emits ``weight_q``
+    for weights that execute the s8 x s8 -> s32 dot (the decode surface
+    has no convs). For the module-rewrite (reference-tier) path use
+    :func:`count_executed_gemms` — a param-tree count would also pick
+    up quantized convs that execute as float."""
+    if not isinstance(params, dict):
+        return 0
+    n = int("weight_q" in params) + int("embedding_q" in params)
+    return n + sum(count_quantized_gemms(v) for v in params.values()
+                   if isinstance(v, dict))
+
+
+def count_executed_gemms(module: Module) -> int:
+    """GEMMs of a quantized MODULE tree that actually execute the
+    s8 x s8 -> s32 path — the ``ServingMetrics.quantized_gemms`` gauge
+    for ``InferenceService(quantize="int8")``. ``QuantizedLinear``
+    always runs the int8 dot; ``QuantizedSpatialConvolution`` counts
+    only under ``BIGDL_INT8_CONV=dot`` — its default executes the
+    quantized integer values as a FLOAT conv (exactness tier, not an
+    int8 GEMM; see the module docstring), so counting it would report
+    MXU-int8 engagement that never happens. The env var is read at call
+    time, mirroring the per-trace read in the conv forward."""
+    n = 0
+    if isinstance(module, QuantizedLinear):
+        n += 1
+    elif isinstance(module, QuantizedSpatialConvolution):
+        n += int(os.environ.get("BIGDL_INT8_CONV", "float") == "dot")
+    seen = set()
+    for child in module.modules.values():
+        if id(child) in seen:  # shared graph nodes count once
+            continue
+        seen.add(id(child))
+        n += count_executed_gemms(child)
+    return n
 
 
 def calibrate(qmodule: Module, qparams, batches, state=None):

@@ -55,6 +55,8 @@ def paged_attention(
     *,
     sm_scale: Optional[float] = None,
     use_kernel: Optional[bool] = None,
+    k_scales: Optional[jax.Array] = None,
+    v_scales: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode-step attention over a paged (block-table) KV cache.
 
@@ -66,6 +68,9 @@ def paged_attention(
     elsewhere; the reference path is bit-identical to dense slot-table
     attention on the same backend (test-enforced), which is what lets
     the serving tier swap lanes for pages without changing one token.
+    Int8 pools pass their per-token fp32 scale pools
+    (``k_scales``/``v_scales``, shape (num_pages, page_size)): both
+    paths dequantize on gather.
     """
     platform = jax.devices()[0].platform
     if use_kernel is None:
@@ -74,9 +79,11 @@ def paged_attention(
         return _fa.paged_flash_attention(
             q, k_pages, v_pages, page_map, positions, sm_scale,
             interpret=(platform != "tpu"),
+            k_scales=k_scales, v_scales=v_scales,
         )
     return _fa.paged_attention_reference(
-        q, k_pages, v_pages, page_map, positions, sm_scale)
+        q, k_pages, v_pages, page_map, positions, sm_scale,
+        k_scales=k_scales, v_scales=v_scales)
 
 
 def dot_product_attention(

@@ -189,18 +189,41 @@ def gather_kv_lanes(pages: jax.Array, page_map: jax.Array) -> jax.Array:
     return lanes.reshape(page_map.shape[:-1] + (h, -1, d))
 
 
+def gather_scale_lanes(scales: jax.Array, page_map: jax.Array) -> jax.Array:
+    """Companion gather for int8 KV: (num_pages, page_size) per-token
+    scale pool + (..., ppn) page map -> logical scale lanes
+    (..., ppn * page_size), row-aligned with :func:`gather_kv_lanes`
+    output so ``nn.int8.dequantize_lanes`` can broadcast them."""
+    ps = scales.shape[1]
+    lanes = jnp.take(scales, page_map, axis=0)   # (..., ppn, ps)
+    return lanes.reshape(page_map.shape[:-1] + (page_map.shape[-1] * ps,))
+
+
 def paged_attention_reference(q, k_pages, v_pages, page_map, positions,
-                              sm_scale: Optional[float] = None):
+                              sm_scale: Optional[float] = None,
+                              k_scales=None, v_scales=None):
     """Decode-shaped paged attention, pure jnp (the XLA/tier-1 path).
 
     ``q``: (S, H, D) one query per slot; ``k_pages``/``v_pages``:
     (num_pages, H, page_size, D); ``page_map``: (S, ppn) int32 physical
     page per logical page; ``positions``: (S,) int32 — key column ``j``
     is valid for slot ``s`` iff ``j <= positions[s]`` (the row the
-    current token was just written to). Returns (S, H, D)."""
+    current token was just written to). Returns (S, H, D).
+
+    ``k_scales``/``v_scales`` (both or neither): int8 pools' per-token
+    fp32 scale pools of shape (num_pages, page_size) — lanes are
+    dequantized after the gather (``value = int8 * scale``); masked
+    columns still contribute exact zeros whatever a recycled page or a
+    stale scale holds, so the bit-identity argument of the float path
+    carries over unchanged."""
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     lk = gather_kv_lanes(k_pages, page_map)    # (S, H, L, D)
     lv = gather_kv_lanes(v_pages, page_map)
+    if k_scales is not None:
+        from bigdl_tpu.nn.int8 import dequantize_lanes
+
+        lk = dequantize_lanes(lk, gather_scale_lanes(k_scales, page_map))
+        lv = dequantize_lanes(lv, gather_scale_lanes(v_scales, page_map))
     length = lk.shape[2]
     rows = positions[:, None]                  # one query row per slot
     cols = jnp.arange(length)
@@ -210,8 +233,9 @@ def paged_attention_reference(q, k_pages, v_pages, page_map, positions,
     return out[:, :, 0, :]
 
 
-def _paged_kernel(pm_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, sm_scale, page_size, n_pages):
+def _paged_kernel(pm_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, sm_scale, page_size,
+                  n_pages):
     s = pl.program_id(0)
     pi = pl.program_id(2)
 
@@ -228,6 +252,11 @@ def _paged_kernel(pm_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[...].reshape(1, -1).astype(jnp.float32)    # (1, D)
         k = k_ref[0, 0].astype(jnp.float32)                  # (ps, D)
         v = v_ref[0, 0].astype(jnp.float32)
+        if ks_ref is not None:
+            # int8 pages: per-token scales ride in their own (1, ps)
+            # block DMA'd through the same scalar-prefetched page id
+            k = k * ks_ref[0][:, None]
+            v = v * vs_ref[0][:, None]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -260,26 +289,49 @@ def _paged_kernel(pm_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_flash_attention(q, k_pages, v_pages, page_map, positions,
                           sm_scale: Optional[float] = None,
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          k_scales=None, v_scales=None):
     """Pallas paged gather-attention: online-softmax over a slot's mapped
     pages, page ids scalar-prefetched so each K/V block DMA reads the
     physical page directly. Same signature/semantics as
-    :func:`paged_attention_reference` (q: (S, H, D) -> (S, H, D))."""
+    :func:`paged_attention_reference` (q: (S, H, D) -> (S, H, D));
+    int8 pools pass their per-token scale pools, each streamed as a
+    (1, page_size) block through the same prefetched page id and applied
+    before the score matmul."""
     n_slots, heads, d = q.shape
     n_phys, _, page_size, _ = k_pages.shape
     ppn = page_map.shape[1]
     scale = sm_scale if sm_scale is not None else d ** -0.5
+    int8_kv = k_scales is not None
+
+    in_specs = [
+        pl.BlockSpec((1, 1, d), lambda s, h, p, pm, pos: (s, h, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
+    ]
+    args = [q, k_pages, v_pages]
+    if int8_kv:
+        in_specs += [
+            pl.BlockSpec((1, page_size),
+                         lambda s, h, p, pm, pos: (pm[s, p], 0)),
+            pl.BlockSpec((1, page_size),
+                         lambda s, h, p, pm, pos: (pm[s, p], 0)),
+        ]
+        args += [k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)]
+        kernel = functools.partial(
+            _paged_kernel, sm_scale=scale, page_size=page_size, n_pages=ppn)
+    else:
+        kernel = functools.partial(
+            lambda pm, pos, qf, kf, vf, o, acc, m, l, **kw: _paged_kernel(
+                pm, pos, qf, kf, vf, None, None, o, acc, m, l, **kw),
+            sm_scale=scale, page_size=page_size, n_pages=ppn)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_slots, heads, ppn),
-        in_specs=[
-            pl.BlockSpec((1, 1, d), lambda s, h, p, pm, pos: (s, h, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda s, h, p, pm, pos: (pm[s, p], h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, d), lambda s, h, p, pm, pos: (s, h, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, d), jnp.float32),
@@ -287,15 +339,13 @@ def paged_flash_attention(q, k_pages, v_pages, page_map, positions,
             pltpu.VMEM((1, _MIN_LANE), jnp.float32),
         ],
     )
-    kernel = functools.partial(
-        _paged_kernel, sm_scale=scale, page_size=page_size, n_pages=ppn)
+    out_dtype = jnp.float32 if int8_kv else q.dtype
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_slots, heads, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_slots, heads, d), out_dtype),
         interpret=interpret,
-    )(page_map.astype(jnp.int32), positions.astype(jnp.int32),
-      q, k_pages, v_pages)
+    )(page_map.astype(jnp.int32), positions.astype(jnp.int32), *args)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

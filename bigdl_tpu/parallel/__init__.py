@@ -35,6 +35,7 @@ from bigdl_tpu.parallel.tp import (
     TensorParallelAttention,
     TensorParallelFFN,
     kv_cache_pspec,
+    kv_scale_pspec,
     transformer_tp_pspecs,
 )
 from bigdl_tpu.parallel.ring_attention import ring_attention
@@ -61,7 +62,7 @@ __all__ = [
     "axis_size", "serving_meshes", "shard_tree", "tree_shardings",
     "ColumnParallelLinear", "RowParallelLinear",
     "TensorParallelAttention", "TensorParallelFFN",
-    "kv_cache_pspec", "transformer_tp_pspecs",
+    "kv_cache_pspec", "kv_scale_pspec", "transformer_tp_pspecs",
     "ring_attention", "ulysses_attention",
     "Pipeline", "pipeline_apply", "HeteroPipeline", "make_pp_train_step",
     "MoE", "SwitchFFN",

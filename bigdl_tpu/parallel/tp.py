@@ -191,8 +191,18 @@ def kv_cache_pspec(axis: str = "tp") -> P:
     return P(None, axis)
 
 
+def kv_scale_pspec() -> P:
+    """PartitionSpec for the int8 KV pools' per-token scale pools
+    (``(num_pages, page_size)`` fp32, ``init_paged_cache`` with
+    ``dtype="int8"``): REPLICATED. Scales are shared across heads, so
+    they have no heads axis to shard on; the write-side cross-head absmax
+    becomes one tiny all-reduce max GSPMD derives — an exact reduction,
+    so sharded and single-device int8 quantization agree bitwise."""
+    return P()
+
+
 def transformer_tp_pspecs(model, mesh: Optional[Mesh] = None,
-                          axis: str = "tp"):
+                          axis: str = "tp", params=None):
     """Sparse Megatron PartitionSpec tree for an ``nn.Transformer``'s
     params (LANGUAGE_MODEL mode — the serving decode surface).
 
@@ -200,6 +210,15 @@ def transformer_tp_pspecs(model, mesh: Optional[Mesh] = None,
     replicates everything else: embedding, norms, output biases). With a
     ``mesh``, validates that the ``axis`` size divides ``num_heads`` —
     attention is parallel over whole heads, never head fractions.
+
+    Pass the actual ``params`` tree to cover an int8 serving tree
+    (``nn.quantized.quantize_for_serving``): ``weight_q`` shards exactly
+    like ``weight``, and the per-output-channel ``scale`` vector follows
+    its channels — sharded over ``axis`` for column-parallel layers
+    (each shard rescales the heads it owns), replicated for
+    row-parallel ones (their output channels are not sharded; the s32
+    partial sums psum exactly, so sharded int8 GEMMs stay bitwise equal
+    to single-device).
     """
     from bigdl_tpu.nn.layers.attention import LANGUAGE_MODEL, Transformer
 
@@ -218,14 +237,29 @@ def transformer_tp_pspecs(model, mesh: Optional[Mesh] = None,
                 f"mesh axis '{axis}' size {tp} must divide num_heads "
                 f"{model.num_heads} (heads shard whole, like "
                 f"TensorParallelAttention)")
-    col = {"weight": P(axis, None)}       # ColumnParallelLinear pattern
-    row = {"weight": P(None, axis)}       # RowParallelLinear pattern
+    quantized = False
+    if params is not None:
+        first = next((n for n in model.modules
+                      if n.startswith("decoder_")), None)
+        try:
+            leaf = params[first]["self_attention"]["inner"]["q_layer"]
+            quantized = "weight_q" in leaf
+        except (KeyError, TypeError):
+            quantized = False
+    if quantized:
+        col = {"weight_q": P(axis, None), "scale": P(axis)}
+        row = {"weight_q": P(None, axis), "scale": P()}
+        ffn_up = {"weight_q": P(axis, None), "scale": P(axis),
+                  "bias": P(axis)}
+        ffn_down = {"weight_q": P(None, axis), "scale": P(), "bias": P()}
+    else:
+        col = {"weight": P(axis, None)}   # ColumnParallelLinear pattern
+        row = {"weight": P(None, axis)}   # RowParallelLinear pattern
+        ffn_up = {"weight": P(axis, None), "bias": P(axis)}
+        ffn_down = {"weight": P(None, axis), "bias": P()}
     attn = {"inner": {"q_layer": col, "k_layer": col, "v_layer": col,
                       "output_layer": row}}
-    ffn = {"inner": {"filter_layer": {"weight": P(axis, None),
-                                      "bias": P(axis)},
-                     "output_layer": {"weight": P(None, axis),
-                                      "bias": P()}}}
+    ffn = {"inner": {"filter_layer": ffn_up, "output_layer": ffn_down}}
     layer = {"self_attention": attn, "ffn": ffn}
     return {name: layer for name in model.modules
             if name.startswith("decoder_")}

@@ -30,6 +30,15 @@ package supplies the TPU-native translation:
   latency distributions, padding waste, and the token-level generation
   fields (TTFT, tokens/sec, slot occupancy).
 
+The int8 fast tier rides the same surfaces: ``quantize="int8"`` on
+:class:`GenerationEngine` / :class:`InferenceService` runs every GEMM
+as a true ``s8 x s8 -> s32`` MXU dot (``nn.quantized
+.quantize_for_serving``), and ``cache_dtype="int8"`` stores KV pages
+int8 with per-token fp32 scale pools — ~2x the concurrent sequences
+per KV byte on top of paging's win, with compile-once, donation,
+sharding, and hot-reload contracts intact (see README "Quantized
+serving").
+
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """

@@ -84,7 +84,7 @@ from bigdl_tpu.serving.errors import (
     StreamCancelled,
 )
 from bigdl_tpu.serving.metrics import ServingMetrics
-from bigdl_tpu.serving.paging import PagePool, pages_per_lane
+from bigdl_tpu.serving.paging import PagePool, page_bytes, pages_per_lane
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -113,16 +113,33 @@ def _cache_pinner(cache_sharding):
     cache holds call after call (donor and result layouts match) and
     (b) GSPMD can never drift the cache layout between steps, which would
     miss the executable cache and break compile-once. ``None`` (the
-    single-device engine) is the identity."""
+    single-device engine) is the identity.
+
+    An int8 paged cache passes a PAIR ``(page_sharding, scale_sharding)``
+    — 4-D page pools pin to the heads-sharded spec, the 2-D per-token
+    scale pools to the replicated one (``parallel.tp.kv_scale_pspec``)."""
     if cache_sharding is None:
         return lambda cache: cache
 
     def pin(cache):
         return jax.tree_util.tree_map(
-            lambda a: jax.lax.with_sharding_constraint(a, cache_sharding),
-            cache)
+            jax.lax.with_sharding_constraint, cache,
+            _cache_sharding_tree(cache, cache_sharding))
 
     return pin
+
+
+def _cache_sharding_tree(cache, cache_sharding):
+    """Expand an engine cache sharding (a single sharding, or the int8
+    (pages, scales) pair) into the per-leaf tree both ``jax.device_put``
+    and the in-jit pinner consume — the ONE place the leaf-to-sharding
+    dispatch rule lives (4-D leaves are page pools, 2-D leaves are
+    per-token scale pools)."""
+    if isinstance(cache_sharding, tuple):
+        page_s, scale_s = cache_sharding
+        return jax.tree_util.tree_map(
+            lambda a: page_s if a.ndim == 4 else scale_s, cache)
+    return jax.tree_util.tree_map(lambda _: cache_sharding, cache)
 
 
 class DecodeKernels:
@@ -481,8 +498,7 @@ def _fail_streams(core: _Core, error: BaseException,
             engine._pool.release(st.pages or ())
             st.pages = None
             engine._page_map[slot] = engine._pool.trash
-        engine.metrics.set_pages(engine._pool.in_use,
-                                 engine._pool.num_pages)
+        engine._report_pages()
     for r in reqs:
         if not r.stream.done:
             r.stream._finish(error)
@@ -587,7 +603,8 @@ class GenerationEngine:
                  mesh=None,
                  param_pspecs=None,
                  shard_axis: str = "tp",
-                 stall_timeout: Optional[float] = None):
+                 stall_timeout: Optional[float] = None,
+                 quantize: Optional[str] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -600,6 +617,31 @@ class GenerationEngine:
         self.max_queue = int(max_queue)
         self.metrics = metrics or ServingMetrics()
         self.seed = int(seed)
+        # the int8 serving tier (PR 9): `quantize="int8"` rewrites the
+        # GEMM weights to per-channel int8 ONCE here (and again inside
+        # every reload, so checkpoint watchers keep feeding float
+        # params); `cache_dtype="int8"` stores KV pages int8 with
+        # per-token fp32 scale pools riding alongside. Both knobs keep
+        # every standing contract: the quantized tree's shapes/dtypes
+        # are a pure function of the float tree (reload never
+        # recompiles), and the int8 cache donates/pins/shards exactly
+        # like the float one.
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
+        self.quantize = quantize
+        if quantize == "int8":
+            from bigdl_tpu.nn.quantized import (
+                count_quantized_gemms,
+                quantize_for_serving,
+            )
+
+            self._quantize_params = quantize_for_serving
+            params = quantize_for_serving(params)
+            self.metrics.set_quantized_gemms(count_quantized_gemms(params))
+        else:
+            self._quantize_params = None
+        self.cache_dtype_name = np.dtype(cache_dtype).name
         # sharded (tensor-parallel) mode: params placed per the Megatron
         # pspecs (parallel.tp), the KV cache — dense lanes or paged pools
         # — sharded on the HEADS axis; the jitted kernels become pjit and
@@ -615,16 +657,24 @@ class GenerationEngine:
             from bigdl_tpu.parallel.mesh import tree_shardings
             from bigdl_tpu.parallel.tp import (
                 kv_cache_pspec,
+                kv_scale_pspec,
                 transformer_tp_pspecs,
             )
 
             if param_pspecs is None:
                 param_pspecs = transformer_tp_pspecs(model, mesh,
-                                                     axis=shard_axis)
+                                                     axis=shard_axis,
+                                                     params=params)
             self._param_shardings = tree_shardings(mesh, params, param_pspecs)
             params = jax.device_put(params, self._param_shardings)
             self._cache_sharding = NamedSharding(mesh,
                                                  kv_cache_pspec(shard_axis))
+            if self.cache_dtype_name == "int8":
+                # int8 pools carry 2-D per-token scale pools next to the
+                # 4-D pages: pages shard on heads, scales replicate
+                self._cache_sharding = (
+                    self._cache_sharding,
+                    NamedSharding(mesh, kv_scale_pspec()))
             if kernels is not None and getattr(
                     kernels, "cache_sharding",
                     None) != self._cache_sharding:
@@ -635,8 +685,9 @@ class GenerationEngine:
                 raise ValueError(
                     "a sharded engine needs kernels built with the engine's "
                     "exact cache_sharding (NamedSharding of this mesh + "
-                    f"{kv_cache_pspec(shard_axis)}); pass kernels=None to "
-                    "build matching ones")
+                    f"{kv_cache_pspec(shard_axis)}; int8 caches pair it "
+                    "with a replicated scale-pool sharding); pass "
+                    "kernels=None to build matching ones")
         # mode: the kernels pick it when given; otherwise paged whenever
         # the model speaks the paged API (the dense lanes are the PR-5
         # baseline, kept for bit-identity tests and plain-cache models).
@@ -647,6 +698,12 @@ class GenerationEngine:
         else:
             self.paged = bool(page_size) and hasattr(model,
                                                     "decode_step_paged")
+        if self.cache_dtype_name == "int8" and not self.paged:
+            raise ValueError(
+                "cache_dtype='int8' needs the paged engine (int8 KV lives "
+                "in the page pools with per-token scale pools; the dense "
+                "slot-lane path is the float PR-5 baseline, kept bitwise "
+                "untouched)")
         if self.paged:
             # chunked prefill lifts the prompt-length wall: anything that
             # leaves room for one generated token is admitted and chunked
@@ -683,7 +740,18 @@ class GenerationEngine:
             self._top_ks = np.zeros((self.max_slots,), np.int32)
             self._top_ps = np.ones((self.max_slots,), np.float32)
             self._keys = np.zeros((self.max_slots, 2), np.uint32)
-            self.metrics.set_pages(0, self.num_pages)
+            # dtype-aware byte accounting for the kv_bytes_in_use gauge:
+            # bytes one reserved page costs across ALL layers, scale
+            # pools included (paging.page_bytes); 0 for models that do
+            # not expose transformer dims (the gauge then stays silent)
+            heads = getattr(model, "num_heads", 0)
+            hidden = getattr(model, "hidden_size", 0)
+            layers = getattr(model, "num_hidden_layers", 0)
+            self._kv_page_bytes = (
+                layers * page_bytes(self.page_size, heads, hidden // heads,
+                                    self.cache_dtype_name)
+                if heads and hidden and layers else 0)
+            self._report_pages()
         else:
             self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
             self.kernels = kernels or DecodeKernels(
@@ -693,7 +761,9 @@ class GenerationEngine:
         if self._cache_sharding is not None:
             # heads-axis placement from step zero: the kernels' in-step
             # constraint then keeps every successive donated cache here
-            self._cache = jax.device_put(self._cache, self._cache_sharding)
+            self._cache = jax.device_put(
+                self._cache,
+                _cache_sharding_tree(self._cache, self._cache_sharding))
         self._params = params
         self._failed: Optional[BaseException] = None
         self._core = _Core(self.max_slots)
@@ -860,6 +930,16 @@ class GenerationEngine:
         if active:
             self._decode_once(active)
 
+    def _report_pages(self) -> None:
+        """Publish page occupancy plus the dtype-aware byte gauge (the
+        same reserved pages, priced in the cache's ACTUAL dtype with
+        scale pools included)."""
+        self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
+        if self._kv_page_bytes:
+            self.metrics.set_kv_cache(
+                self._pool.in_use * self._kv_page_bytes,
+                self.cache_dtype_name)
+
     def _pages_needed(self, req: _GenRequest) -> int:
         # rows written = prompt + generated - 1 (the final token is
         # returned but never written back before the slot retires)
@@ -906,7 +986,7 @@ class GenerationEngine:
                         pages=pages, page_row=row, prefill_pos=0)
         with core.cond:
             core.active[slot] = st
-        self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
+        self._report_pages()
 
     def _prefill_chunk_once(self, slot: int, st: _SlotState) -> None:
         """Advance one prompt chunk for a prefilling slot. Non-final
@@ -984,7 +1064,7 @@ class GenerationEngine:
             self._top_ks[slot] = 0
             self._top_ps[slot] = 1.0
             self._keys[slot] = 0
-            self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
+            self._report_pages()
 
     def _admit(self, req: _GenRequest) -> None:
         now = time.monotonic()
@@ -1154,6 +1234,12 @@ class GenerationEngine:
             raise ValueError(
                 "GenerationEngine.reload takes params only: incremental "
                 "decode runs stateless (no BN-style buffers)")
+        if self._quantize_params is not None:
+            # a quantized engine reloads from FLOAT checkpoints: the
+            # transform is a pure function of shapes, so the quantized
+            # tree's signature matches the serving one and the jitted
+            # step is NOT recompiled (pjit-cache test-enforced)
+            params = self._quantize_params(params)
         require_matching_signature("params", self._params, params)
         # device_put once: host arrays would re-transfer every step and
         # miss the jit cache (uncommitted args key a different executable).
@@ -1238,7 +1324,8 @@ def static_generate(model, params, requests, *, max_slots: int,
                     prompt_buckets: Optional[Sequence[int]] = None,
                     page_size: int = 16, num_pages: Optional[int] = None,
                     prefill_chunk: Optional[int] = None, seed: int = 0,
-                    sampling: Optional[Sequence[dict]] = None):
+                    sampling: Optional[Sequence[dict]] = None,
+                    quantize: Optional[str] = None):
     """Run-to-completion static batching BASELINE over the same jitted
     kernels the engine uses: admit ``max_slots`` requests, decode until
     EVERY one finishes (the longest sequence holds the whole batch
@@ -1253,7 +1340,27 @@ def static_generate(model, params, requests, *, max_slots: int,
     the engine — apples to apples stays apples. ``sampling`` is an
     optional per-request list of dicts (``temperature`` / ``top_k`` /
     ``top_p`` / ``seed``); seeds derive exactly like the engine's, so a
-    sampled run produces IDENTICAL streams under either scheduler."""
+    sampled run produces IDENTICAL streams under either scheduler.
+
+    ``quantize="int8"`` / ``cache_dtype="int8"`` mirror the engine knobs
+    (the transform is deterministic, so an int8 engine and an int8
+    static run still emit identical tokens — the bench mismatch gate
+    covers the quantized tier too)."""
+    if quantize == "int8":
+        from bigdl_tpu.nn.quantized import quantize_for_serving
+
+        params = quantize_for_serving(params)
+    elif quantize is not None:
+        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+    if np.dtype(cache_dtype) == np.int8 and not (
+            hasattr(kernels, "chunk") if kernels is not None
+            else page_size and hasattr(model, "decode_step_paged")):
+        # same guard the engine applies: the dense slot-lane path has no
+        # scale pools, so an int8 cache there would truncate K/V to
+        # zeros and decode garbage without a single error
+        raise ValueError(
+            "cache_dtype='int8' needs the paged kernels (int8 KV lives in "
+            "the page pools with per-token scale pools)")
     if kernels is None:
         kernels = (PagedDecodeKernels(model)
                    if page_size and hasattr(model, "decode_step_paged")

@@ -93,6 +93,12 @@ class ServingMetrics:
         self.replica_rejoins = 0     # probe-verified returns to service
         self.rolling_reloads = 0     # completed rolling reload sweeps
         self._replica_inflight: Dict[str, int] = {}  # per-replica gauge
+        # quantized-serving fields (PR 9); unset for an unquantized /
+        # non-paged backend — snapshot/table keep the earlier shapes
+        # (the same append-only golden contract as every block above)
+        self.kv_bytes_in_use = 0     # reserved KV bytes, scale pools incl.
+        self.kv_cache_dtype = ""     # "" until a paged engine reports one
+        self.quantized_gemms = 0     # int8 GEMMs in the serving params
 
     # ------------------------------------------------------- mutators ----
 
@@ -180,6 +186,21 @@ class ServingMetrics:
             self.pages_in_use = in_use
             self.pages_total = total
             self.pages_peak = max(self.pages_peak, in_use)
+
+    # ----------------------------------------- quantization mutators ----
+
+    def set_kv_cache(self, bytes_in_use: int, dtype: str) -> None:
+        """KV byte-occupancy gauge (paged engine): bytes the reserved
+        pages cost in the cache's ACTUAL dtype, scale pools included —
+        dtype-aware so int8 and bf16 engines report comparable numbers."""
+        with self._lock:
+            self.kv_bytes_in_use = int(bytes_in_use)
+            self.kv_cache_dtype = str(dtype)
+
+    def set_quantized_gemms(self, n: int) -> None:
+        """How many GEMMs of the serving params run int8 (0 = float)."""
+        with self._lock:
+            self.quantized_gemms = int(n)
 
     # --------------------------------------------- replica mutators ----
 
@@ -273,6 +294,11 @@ class ServingMetrics:
                 "replica_rejoins": self.replica_rejoins,
                 "rolling_reloads": self.rolling_reloads,
                 "replica_inflight": dict(self._replica_inflight),
+                # quantized-serving fields (PR 9): appended after every
+                # earlier key, never reordered
+                "kv_bytes_in_use": self.kv_bytes_in_use,
+                "kv_cache_dtype": self.kv_cache_dtype,
+                "quantized_gemms": self.quantized_gemms,
             }
 
     def format_table(self) -> str:
@@ -335,4 +361,12 @@ class ServingMetrics:
             dist = " ".join(f"{k}:{v}" for k, v in
                             sorted(s["replica_inflight"].items()))
             row("replica_inflight", dist or "-")
+        # quantized-serving rows: appended strictly after the replica
+        # block and only when an engine actually reported a KV dtype or
+        # quantized GEMMs — every earlier table stays a byte-identical
+        # strict prefix (append-only golden contract, test-enforced)
+        if s["kv_cache_dtype"] or s["quantized_gemms"]:
+            row("kv_bytes_in_use", s["kv_bytes_in_use"])
+            row("kv_cache_dtype", s["kv_cache_dtype"] or "-")
+            row("quantized_gemms", s["quantized_gemms"])
         return "\n".join(lines)

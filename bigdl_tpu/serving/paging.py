@@ -33,6 +33,24 @@ import heapq
 from typing import List, Sequence
 
 
+def page_bytes(page_size: int, num_heads: int, head_dim: int,
+               cache_dtype="float32") -> int:
+    """Bytes ONE physical KV page costs per layer: the K and V pages
+    plus, for ``int8``, the per-token fp32 scale-pool rows that ride
+    alongside them (``nn.Transformer.init_paged_cache``). The ONE place
+    the dtype-aware byte accounting lives — the engine's
+    ``kv_bytes_in_use`` gauge and the bench capacity column both read
+    it, so int8-vs-bf16 capacity claims price the scale overhead
+    honestly instead of pretending pages are free to describe."""
+    import numpy as np
+
+    if np.dtype(cache_dtype) == np.int8:
+        per_row = num_heads * head_dim * 1 + 4       # int8 row + f32 scale
+    else:
+        per_row = num_heads * head_dim * np.dtype(cache_dtype).itemsize
+    return 2 * page_size * per_row                   # K and V
+
+
 def pages_per_lane(max_len: int, page_size: int) -> int:
     """Logical pages covering one full-length lane (ceil division). The
     ONE place this rounding lives — the engine, static baseline, and

@@ -76,7 +76,35 @@ class InferenceService:
                  max_batch_size: int = 8, max_wait_ms: float = 2.0,
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 forward_fn=None, mesh=None, param_pspecs=None):
+                 forward_fn=None, mesh=None, param_pspecs=None,
+                 quantize: Optional[str] = None):
+        # int8 post-training quantization at the door (the reference's
+        # AbstractModule.quantize() applied to serving): the module tree
+        # is rewritten once (Linear/conv -> int8 twins, nn.quantized),
+        # reloads re-run the params transform against the ORIGINAL float
+        # module so checkpoint watchers keep feeding float trees — the
+        # quantized tree's shapes are a pure function of the float tree,
+        # so reload never recompiles.
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
+        self.quantize = quantize
+        self._quantize_params = None
+        if quantize == "int8":
+            from bigdl_tpu.nn.quantized import (
+                count_executed_gemms,
+                quantize as _quantize_tree,
+            )
+
+            float_model = model
+            model, params = _quantize_tree(float_model, params)
+            self._quantize_params = (
+                lambda p: _quantize_tree(float_model, p)[1])
+            metrics = metrics or ServingMetrics()
+            # count from the MODULE tree, not the param tree: quantized
+            # convs default to executing as float (BIGDL_INT8_CONV) and
+            # must not inflate the "GEMMs running int8" gauge
+            metrics.set_quantized_gemms(count_executed_gemms(model))
         self.model = model
         state = state or {}
         # sharded (tensor-parallel) mode: with a mesh, params are placed
@@ -138,6 +166,11 @@ class InferenceService:
         ``ValueError`` and the old weights keep serving. A batch already
         in flight finishes on the weights it started with; the next batch
         sees the new pair — never a torn mix (test-enforced)."""
+        if self._quantize_params is not None:
+            # a quantized service reloads from FLOAT checkpoints; the
+            # deterministic transform keeps the serving signature, so
+            # the jitted forward is not recompiled
+            params = self._quantize_params(params)
         old_params, old_state = self._weights
         require_matching_signature("params", old_params, params)
         if state is not None:

@@ -154,3 +154,53 @@ def test_int8_dot_conv_matches_float_path(monkeypatch):
         assert y_f.shape == y_d.shape, (k, stride, pad)
         np.testing.assert_allclose(np.asarray(y_d), np.asarray(y_f),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_activation_scales_are_per_sample(rng):
+    """Regression (PR-9 review): a request's output through a quantized
+    layer must not depend on which requests the DynamicBatcher happened
+    to co-batch it with. A per-TENSOR activation absmax leaks a
+    large-magnitude neighbour into everyone's quantization step; per-
+    SAMPLE scales make row i a pure function of row i — so running a
+    row alone and running it next to a 100x-magnitude neighbour must
+    agree BITWISE (same row -> same scale -> same int8 codes)."""
+    m = nn.Linear(8, 4)
+    p, s = m.init(rng)
+    qm, qp = quantize(m, p)
+    row = 0.5 * np.ones((1, 8), np.float32)
+    neighbour = 50.0 * np.ones((1, 8), np.float32)
+    alone, _ = qm.apply(qp, jnp.asarray(row))
+    packed, _ = qm.apply(qp, jnp.asarray(np.concatenate([row, neighbour])))
+    np.testing.assert_array_equal(np.asarray(alone)[0],
+                                  np.asarray(packed)[0])
+
+    # conv path (also pins the per-sample scale x per-channel weight
+    # scale broadcast in the NCHW rescale)
+    mc = nn.SpatialConvolution(2, 3, 3, 3, pad_w=1, pad_h=1)
+    pc, sc = mc.init(jax.random.key(7))
+    qmc, qpc = quantize(mc, pc)
+    img = np.random.RandomState(0).randn(1, 2, 6, 6).astype(np.float32)
+    big = 100.0 * np.ones((1, 2, 6, 6), np.float32)
+    alone_c, _ = qmc.apply(qpc, jnp.asarray(img), state=sc)
+    packed_c, _ = qmc.apply(qpc, jnp.asarray(np.concatenate([img, big])),
+                            state=sc)
+    np.testing.assert_array_equal(np.asarray(alone_c)[0],
+                                  np.asarray(packed_c)[0])
+
+
+def test_count_executed_gemms_excludes_float_convs(rng, monkeypatch):
+    """Regression (PR-9 review): the quantized_gemms gauge counts GEMMs
+    that actually RUN s8 x s8 -> s32. Quantized convs execute as float
+    by default (BIGDL_INT8_CONV) and must not count; flipping the env
+    var to the true-int8 conv path adds them back."""
+    from bigdl_tpu.nn.quantized import count_executed_gemms
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+        nn.Reshape([4 * 6 * 6]), nn.Linear(4 * 6 * 6, 10))
+    p, _ = m.init(rng)
+    qm, _ = quantize(m, p)
+    monkeypatch.delenv("BIGDL_INT8_CONV", raising=False)
+    assert count_executed_gemms(qm) == 1  # the Linear only
+    monkeypatch.setenv("BIGDL_INT8_CONV", "dot")
+    assert count_executed_gemms(qm) == 2  # conv joins the int8 path
