@@ -278,17 +278,19 @@ class _FixedCostKernels:
     1-core runner could not otherwise show. Both sides of the comparison
     run the same cost, so the ratio is honest."""
 
-    def __init__(self, inner, step_sleep_s):
+    def __init__(self, inner, step_sleep_s, prompt_sleep_s=None):
         self.inner = inner
         self.step_sleep_s = float(step_sleep_s)
+        self.prompt_sleep_s = (self.step_sleep_s if prompt_sleep_s is None
+                               else float(prompt_sleep_s))
         self.cache_sharding = getattr(inner, "cache_sharding", None)
 
     def prefill(self, *a, **kw):
-        time.sleep(self.step_sleep_s)
+        time.sleep(self.prompt_sleep_s)
         return self.inner.prefill(*a, **kw)
 
     def chunk(self, *a, **kw):
-        time.sleep(self.step_sleep_s)
+        time.sleep(self.prompt_sleep_s)
         return self.inner.chunk(*a, **kw)
 
     def decode(self, *a, **kw):
@@ -302,6 +304,66 @@ class _FixedCostKernels:
     @property
     def chunk_traces(self):
         return self.inner.chunk_traces
+
+    @property
+    def decode_traces(self):
+        return self.inner.decode_traces
+
+
+class _FixedCostSpecKernels:
+    """Speculative-kernels wrapper with SEPARATE fixed per-call costs
+    for the draft step and the target verify — the modeled cost ratio
+    c_draft = draft_ms / target_ms is what the speculative speedup
+    formula E[speedup] = (E[accepted] + 1) / (1 + (k+1) * c_draft)
+    prices in, and a CPU smoke run cannot show it without modeling
+    (both models' real CPU steps are microseconds apart). The prompt
+    path (prefill/chunk/draft_write) runs UNPRICED on both legs —
+    speculation targets the decode loop, and pricing two identical
+    prefill paths only dilutes the measured ratio with constant
+    time."""
+
+    def __init__(self, inner, draft_sleep_s, target_sleep_s):
+        self.inner = inner
+        self.draft_sleep_s = float(draft_sleep_s)
+        self.target_sleep_s = float(target_sleep_s)
+        self.cache_sharding = getattr(inner, "cache_sharding", None)
+
+    def prefill(self, *a, **kw):
+        return self.inner.prefill(*a, **kw)
+
+    def chunk(self, *a, **kw):
+        return self.inner.chunk(*a, **kw)
+
+    def draft_write(self, *a, **kw):
+        return self.inner.draft_write(*a, **kw)
+
+    def draft(self, *a, **kw):
+        time.sleep(self.draft_sleep_s)
+        return self.inner.draft(*a, **kw)
+
+    def verify(self, *a, **kw):
+        time.sleep(self.target_sleep_s)
+        return self.inner.verify(*a, **kw)
+
+    @property
+    def prefill_traces(self):
+        return self.inner.prefill_traces
+
+    @property
+    def chunk_traces(self):
+        return self.inner.chunk_traces
+
+    @property
+    def draft_write_traces(self):
+        return self.inner.draft_write_traces
+
+    @property
+    def draft_traces(self):
+        return self.inner.draft_traces
+
+    @property
+    def verify_traces(self):
+        return self.inner.verify_traces
 
     @property
     def decode_traces(self):
@@ -371,7 +433,17 @@ def run_generation_bench(args):
     >= 1.8x); ``--quantize int8`` runs every GEMM as s8 x s8 -> s32
     with per-channel rescale. Both schedulers quantize identically, so
     the zero-mismatch gate covers the whole int8 tier — engine vs
-    static, sharded vs single-device, greedy and sampled."""
+    static, sharded vs single-device, greedy and sampled.
+
+    PR 10 — ``--speculate K``: the draft-verified column. A speculative
+    engine (K draft proposals per round, one target verify forward)
+    runs the same workload as a plain paged engine at fixed per-model
+    step costs (``--step-cost-ms`` prices the target, ``--draft-cost-ms``
+    the draft — the modeled cost ratio of a distilled cheap draft).
+    Gates under ``--smoke``: tokens/sec >= 1.5x plain at the modeled
+    ratio, ZERO greedy mismatches (speculative greedy is lossless), and
+    no kernel re-traces after warmup (acceptance lengths are data).
+    Composes with ``--kv-dtype int8`` / ``--quantize int8``."""
     from bigdl_tpu.nn.layers.attention import Transformer
     from bigdl_tpu.parallel import serving_meshes
     from bigdl_tpu.serving import (
@@ -614,6 +686,96 @@ def run_generation_bench(args):
             "per_replica": per_replica,
         }
 
+    # speculative column (PR 10): a draft-verified engine proposing
+    # --speculate K tokens per round vs the plain paged engine on the
+    # SAME workload at fixed per-model step costs. The draft runs the
+    # target's own weights — the in-family acceptance upper bound,
+    # standing in for a distilled draft — but is PRICED at the modeled
+    # cheap-draft cost (--draft-cost-ms vs --step-cost-ms), which is
+    # the ratio the speedup formula E[speedup] = (E[accepted] + 1) /
+    # (1 + (k+1) * c_draft) actually depends on; the measured
+    # acceptance rate is reported so the formula can be re-priced at
+    # any draft quality. Both legs run GREEDY (speculative sampling is
+    # keyed per output position, plain sampling per step — sampled
+    # streams are deterministic within each scheme but not across
+    # them), so the zero-mismatch gate is the lossless-greedy check.
+    spec_fields = {}
+    if args.speculate > 0:
+        from bigdl_tpu.serving import SpeculativeKernels
+
+        spec_k = args.speculate
+        # 24 ms: the modeled costs must dominate the real CPU compute of
+        # the tiny bench models (a few ms/call, and the speculative leg
+        # makes k+1 more calls per round) or runner noise eats the ratio
+        spec_target_ms = step_cost_ms if step_cost_ms > 0 else 24.0
+        spec_draft_ms = args.draft_cost_ms
+
+        plain = GenerationEngine(
+            model, params, max_slots=slots, max_len=max_len,
+            max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
+            kernels=_FixedCostKernels(kernels, spec_target_ms / 1e3,
+                                      prompt_sleep_s=0.0),
+            page_size=page_size, seed=0, cache_dtype=kv_dtype,
+            quantize=quantize, metrics=ServingMetrics())
+        plain.warmup()
+        t0 = time.perf_counter()
+        ps = [plain.submit(p, max_new_tokens=m) for p, m in requests]
+        plain_outs = [s.result(timeout=600) for s in ps]
+        plain_wall = time.perf_counter() - t0
+        plain_tokens = sum(len(o) for o in plain_outs)
+        plain.close()
+
+        skern = SpeculativeKernels(model, model)
+        spec_eng = GenerationEngine(
+            model, params, max_slots=slots, max_len=max_len,
+            max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
+            kernels=_FixedCostSpecKernels(skern, spec_draft_ms / 1e3,
+                                          spec_target_ms / 1e3),
+            page_size=page_size, seed=0, cache_dtype=kv_dtype,
+            quantize=quantize, metrics=ServingMetrics(),
+            speculate=(model, params, spec_k))
+        spec_eng.warmup()
+        warm_traces = (skern.draft_traces, skern.verify_traces,
+                       skern.chunk_traces, skern.prefill_traces,
+                       skern.draft_write_traces)
+        t0 = time.perf_counter()
+        ss = [spec_eng.submit(p, max_new_tokens=m) for p, m in requests]
+        spec_outs = [s.result(timeout=600) for s in ss]
+        spec_wall = time.perf_counter() - t0
+        spec_tokens = sum(len(o) for o in spec_outs)
+        spec_snap = spec_eng.metrics.snapshot()
+        post_traces = (skern.draft_traces, skern.verify_traces,
+                       skern.chunk_traces, skern.prefill_traces,
+                       skern.draft_write_traces)
+        spec_eng.close()
+
+        spec_tps = spec_tokens / spec_wall
+        plain_tps = plain_tokens / plain_wall
+        spec_mismatches = sum(1 for a, b in zip(plain_outs, spec_outs)
+                              if a != b)
+        acc = spec_snap["acceptance_rate"]
+        c_draft = spec_draft_ms / spec_target_ms
+        spec_fields = {
+            "speculate_k": spec_k,
+            "speculative_tokens_per_sec": round(spec_tps, 2),
+            "plain_tokens_per_sec": round(plain_tps, 2),
+            "speculative_vs_plain": round(spec_tps / plain_tps, 3),
+            "acceptance_rate": round(acc, 4),
+            "verify_steps": spec_snap["verify_steps"],
+            "draft_tokens": spec_snap["draft_tokens"],
+            "accepted_tokens": spec_snap["accepted_tokens"],
+            "spec_target_cost_ms": spec_target_ms,
+            "spec_draft_cost_ms": spec_draft_ms,
+            # the formula's prediction at the MEASURED acceptance and
+            # the modeled cost ratio — decode-loop only, so the
+            # measured end-to-end ratio (which also pays prefill)
+            # should land at or below it
+            "modeled_speedup": round(
+                (acc * spec_k + 1) / (1 + (spec_k + 1) * c_draft), 3),
+            "speculative_mismatches": spec_mismatches,
+            "speculative_compile_once": warm_traces == post_traces,
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -653,7 +815,9 @@ def run_generation_bench(args):
         "tp": args.tp,
         "replicas": args.replicas,
         "step_cost_ms": step_cost_ms,
+        "speculate": args.speculate,
         **rep_fields,
+        **spec_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -697,6 +861,29 @@ def run_generation_bench(args):
                 "concurrent sequences at a fixed KV-byte budget (gate: "
                 ">= 2x on the 4:1 short:long mix)"
                 % result["capacity_paged_vs_dense"])
+        if args.speculate > 0:
+            if result["speculative_mismatches"]:
+                raise SystemExit(
+                    "generation smoke: %d request(s) decoded different "
+                    "tokens speculatively vs plain greedy — speculative "
+                    "greedy decode must be LOSSLESS (token-identical), "
+                    "whatever the draft proposes"
+                    % result["speculative_mismatches"])
+            if not result["speculative_compile_once"]:
+                raise SystemExit(
+                    "generation smoke: a speculative kernel re-traced "
+                    "after warmup — acceptance lengths are data, not "
+                    "shapes; compile-once must hold across admissions/"
+                    "retirements/acceptance lengths")
+            if result["speculative_vs_plain"] < 1.5:
+                raise SystemExit(
+                    "generation smoke: speculative decoding sustains only "
+                    "%.2fx plain tokens/sec at the modeled %.2f draft/"
+                    "target cost ratio (gate: >= 1.5x — k accepted drafts "
+                    "must amortize the memory-bound target step)"
+                    % (result["speculative_vs_plain"],
+                       result["spec_draft_cost_ms"]
+                       / result["spec_target_cost_ms"]))
         if args.kv_dtype == "int8" and result["capacity_int8_vs_bf16"] < 1.8:
             raise SystemExit(
                 "generation smoke: int8 KV pages admit only %.2fx the "
@@ -1262,6 +1449,10 @@ def run_chaos_bench(args):
       entirely by the surviving replica;
     - **watchdog**: a wedged decode step (armed latency) fails its
       streams with a StallError diagnostic instead of hanging;
+    - **speculative**: a draft-step fault mid-speculation fails the
+      in-flight streams with the INJECTED error through the stream API
+      (the engine's step contract) and BOTH models' page lanes drain to
+      zero per owner;
     - **drain**: KV pages return to zero on every engine, no
       /dev/shm segment leaks, and every bigdl-owned thread retires.
 
@@ -1498,6 +1689,51 @@ def run_chaos_bench(args):
     if wd_engine.pages_in_use:
         violations.append("watchdog: stalled engine leaked KV pages")
 
+    # ----------------------------------------------- speculative leg ----
+    # PR 10: a draft-step fault mid-speculation honours the engine's
+    # step contract — the in-flight streams fail with the INJECTED
+    # error through the API (a consumed donated cache cannot be
+    # retried; nothing hangs, nothing escapes untyped) and BOTH models'
+    # page lanes drain to zero, per-owner, not just in aggregate.
+    spec_engine = GenerationEngine(
+        model, params, max_slots=slots, max_len=max_len,
+        max_prompt_len=max_prompt, max_queue=4 * n_requests,
+        page_size=8, seed=seed, metrics=ServingMetrics(),
+        speculate=(model, params, 2))
+    spec_engine.warmup()
+    clean = spec_engine.generate([1, 2, 3], max_new_tokens=4, timeout=60)
+    if len(clean) != 4:
+        violations.append("speculative: clean pre-fault generation came "
+                          "back short")
+    faults.arm("engine.draft", after=1, times=1,
+               only=lambda engine=None, **_: engine is spec_engine)
+    sstreams = []
+    for _ in range(3):
+        plen = int(rs.randint(1, max_prompt + 1))
+        sstreams.append(spec_engine.submit(
+            rs.randint(1, 60, (plen,)).tolist(),
+            max_new_tokens=int(rs.randint(6, 12))))
+    spec_injected = 0
+    for s in sstreams:
+        try:
+            s.result(timeout=60)
+        except InjectedFault:
+            spec_injected += 1
+        except Exception as e:
+            violations.append(f"speculative: non-API stream error {e!r}")
+    faults.disarm("engine.draft")
+    if spec_injected < 1:
+        violations.append("speculative: the mid-speculation draft fault "
+                          "never failed a stream")
+    spec_target_pages = spec_engine._pool.in_use_by("target")
+    spec_draft_pages = spec_engine._pool.in_use_by("draft")
+    spec_engine.close()
+    if spec_engine.pages_in_use or spec_target_pages or spec_draft_pages:
+        violations.append(
+            f"speculative: KV pages leaked after the draft fault "
+            f"(target={spec_target_pages}, draft={spec_draft_pages}, "
+            f"total={spec_engine.pages_in_use})")
+
     # ----------------------------------------------------------- drain ----
     deadline = time.monotonic() + 15
     leftover = own_threads()
@@ -1529,6 +1765,7 @@ def run_chaos_bench(args):
         "serve_final_wave_ok": final_ok,
         "replica_death_fired": death.fired,
         "submit_faults_fired": flaky_submit.fired,
+        "speculative_streams_failed": spec_injected,
         "threads_leftover": leftover,
         "shm_leaked": shm_leaked,
         "violations": violations,
@@ -1606,6 +1843,22 @@ def _parse_args(argv=None):
                          "inside the jitted step; seeded per request, so "
                          "the continuous-vs-static mismatch gate still "
                          "applies")
+    ap.add_argument("--speculate", type=int, default=0,
+                    help="serving --generate: add the speculative-decoding "
+                         "column — a draft-verified engine proposing K "
+                         "tokens per round vs the plain paged engine on "
+                         "the same workload at fixed per-model step costs "
+                         "(--step-cost-ms for the target, --draft-cost-ms "
+                         "for the draft); --smoke gates >= 1.5x tokens/sec "
+                         "at the modeled cost ratio with zero greedy "
+                         "mismatches and compile-once intact")
+    ap.add_argument("--draft-cost-ms", type=float, default=2.0,
+                    help="serving --generate --speculate: fixed per-call "
+                         "cost of one draft decode step (the modeled "
+                         "cheap-draft cost — default 2 ms vs the 24 ms "
+                         "default target step, a ~12x-smaller distilled "
+                         "draft; the target verify runs at "
+                         "--step-cost-ms)")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

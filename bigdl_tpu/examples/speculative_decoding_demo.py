@@ -1,0 +1,134 @@
+"""Speculative-decoding demo: a draft+target pair behind the router,
+head-to-head against the plain paged engine.
+
+A cheap draft model proposes ``k`` tokens per round; ONE target forward
+(the verify step) scores all of them and the rejection sampler keeps the
+longest prefix the target agrees with, plus one token the target chose
+itself. Greedy speculative output is token-identical to plain greedy
+decode — the demo asserts it — and the target runs far fewer forwards
+than it emits tokens, which is the whole win on bandwidth-bound
+hardware (every decode step streams the full KV cache + all GEMM
+weights; see the expected-speedup formula in the README).
+
+``--draft self`` (default) runs the target as its own draft — the
+acceptance UPPER bound, standing in for a well-distilled family member.
+``--draft small`` runs a fresh random quarter-size draft instead: with
+untrained weights the two models rarely agree, which is the acceptance
+FLOOR — the demo is honest about both ends.
+
+Run: ``python -m bigdl_tpu.examples.speculative_decoding_demo -n 12``
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+
+def build_lm(vocab_size: int = 128, small: bool = False):
+    from bigdl_tpu.nn.layers.attention import Transformer
+
+    if small:
+        return Transformer(vocab_size=vocab_size, hidden_size=40,
+                           num_heads=2, filter_size=80,
+                           num_hidden_layers=1)
+    return Transformer(vocab_size=vocab_size, hidden_size=160, num_heads=4,
+                       filter_size=320, num_hidden_layers=2)
+
+
+def main(argv=None):
+    from bigdl_tpu.serving import GenerationEngine, ModelRouter
+
+    ap = argparse.ArgumentParser("speculative-decoding-demo")
+    ap.add_argument("-n", "--requests", type=int, default=12,
+                    help="total generation requests")
+    ap.add_argument("-k", "--speculate", type=int, default=3,
+                    help="draft tokens proposed per verify round")
+    ap.add_argument("-s", "--slots", type=int, default=4,
+                    help="engine slot-table size")
+    ap.add_argument("--max-len", type=int, default=96,
+                    help="KV cache length (prompt + generation)")
+    ap.add_argument("--new", type=int, default=24,
+                    help="max_new_tokens per request")
+    ap.add_argument("--draft", choices=("self", "small"), default="self",
+                    help="'self' = the target drafts for itself "
+                         "(acceptance upper bound); 'small' = a fresh "
+                         "random quarter-size draft (the floor)")
+    args = ap.parse_args(argv)
+
+    vocab = 128
+    model = build_lm(vocab)
+    params, _ = model.init(jax.random.key(0))
+    if args.draft == "self":
+        draft, dparams = model, params
+    else:
+        draft = build_lm(vocab, small=True)
+        dparams, _ = draft.init(jax.random.key(1))
+
+    rs = np.random.RandomState(0)
+    requests = [(rs.randint(1, vocab, (int(rs.randint(2, 13)),)).tolist(),
+                 args.new) for _ in range(args.requests)]
+
+    # one family behind one front door: the plain engine serves "lm",
+    # the draft+target pair serves "lm-spec" — both greedy, so their
+    # outputs MUST match token for token (speculation is lossless)
+    plain = GenerationEngine(
+        model, params, max_slots=args.slots, max_len=args.max_len,
+        max_prompt_len=16, max_queue=max(64, 2 * args.requests),
+        page_size=8)
+    spec = GenerationEngine(
+        model, params, max_slots=args.slots, max_len=args.max_len,
+        max_prompt_len=16, max_queue=max(64, 2 * args.requests),
+        page_size=8, speculate=(draft, dparams, args.speculate))
+    plain.warmup()
+    spec.warmup()
+    router = ModelRouter()
+    router.register("lm", plain)
+    router.register("lm-spec", spec)
+
+    def run(name):
+        t0 = time.monotonic()
+        streams = [router.submit(name, p, max_new_tokens=m)
+                   for p, m in requests]
+        outs = [s.result(timeout=300) for s in streams]
+        return outs, time.monotonic() - t0
+
+    plain_outs, plain_wall = run("lm")
+    spec_outs, spec_wall = run("lm-spec")
+    psnap = plain.metrics.snapshot()
+    ssnap = spec.metrics.snapshot()
+    print(spec.metrics.format_table())
+    router.close()
+
+    mismatches = sum(1 for a, b in zip(plain_outs, spec_outs) if a != b)
+    assert mismatches == 0, (
+        f"{mismatches} streams diverged — speculative greedy decode "
+        f"must be lossless")
+
+    tokens = sum(len(o) for o in spec_outs)
+    plain_tps = sum(len(o) for o in plain_outs) / plain_wall
+    spec_tps = tokens / spec_wall
+    acc = ssnap["acceptance_rate"]
+    amort = tokens / max(ssnap["verify_steps"], 1)
+    print(f"plain      : {plain_tps:7.0f} tok/s "
+          f"({psnap['decode_steps']} target forwards for {tokens} tokens)")
+    print(f"speculative: {spec_tps:7.0f} tok/s "
+          f"({ssnap['verify_steps']} target forwards for {tokens} tokens "
+          f"= {amort:.2f} tokens per verify)")
+    print(f"acceptance : {acc * 100:.0f}% of {ssnap['draft_tokens']} "
+          f"drafted tokens (k={args.speculate}, draft={args.draft}); "
+          f"0 greedy mismatches")
+    print("the wall-clock win needs a chip (or the bench's modeled "
+          "per-model step costs): on CPU the draft is not actually "
+          "cheaper, but the target amortization above is the real lever")
+    ssnap["speculative_vs_plain"] = spec_tps / plain_tps
+    ssnap["mismatches"] = mismatches
+    ssnap["tokens_per_verify"] = amort
+    return ssnap
+
+
+if __name__ == "__main__":
+    main()

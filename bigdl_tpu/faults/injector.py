@@ -57,6 +57,10 @@ SITES: Dict[str, str] = {
     "engine.decode": "GenerationEngine decode step (ctx: engine=)",
     "engine.prefill": "GenerationEngine prefill / prefill chunk "
                       "(ctx: engine=)",
+    "engine.draft": "GenerationEngine speculative draft leg, once per "
+                    "round before the k+1 draft steps (ctx: engine=)",
+    "engine.verify": "GenerationEngine speculative target verify step, "
+                     "once per round (ctx: engine=)",
     "feed.producer": "SocketFeedDataSet producer reader, once per frame "
                      "(key = frame index)",
 }

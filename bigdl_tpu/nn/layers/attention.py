@@ -115,7 +115,58 @@ class Attention(Module):
             pks, pvs = paged.get("k_scale"), paged.get("v_scale")
             int8_kv = pks is not None
             page_size = pk.shape[2]
-            if getattr(cache_index, "ndim", 0) == 1:
+            if getattr(cache_index, "ndim", 0) == 1 and q.shape[2] > 1:
+                # verify (speculative decoding): W candidate rows per
+                # slot at positions cache_index .. cache_index+W-1; map
+                # is (S, ppn). Same scatter-then-gather sequence as the
+                # decode branch below, widened to W rows — rows past the
+                # lane end (a slot running out its token budget mid-
+                # verify) route to the trash page so they can never land
+                # in a page another slot owns. Rejected candidates'
+                # rows stay in place: they sit past the slot's rewound
+                # position, so they are causally masked until the next
+                # verify overwrites them — the recycled-page argument.
+                page_map = paged["map"]
+                ppn = page_map.shape[1]
+                max_len = ppn * page_size
+                w = q.shape[2]
+                pos = cache_index[:, None] + jnp.arange(w)[None, :]
+                pg = jnp.take_along_axis(
+                    page_map, jnp.clip(pos // page_size, 0, ppn - 1),
+                    axis=1)
+                trash = paged.get("trash")
+                if trash is not None:
+                    pg = jnp.where(pos < max_len, pg, trash)
+                row = pos % page_size
+                kr = k.transpose(0, 2, 1, 3)        # (S, W, H, D)
+                vr = v.transpose(0, 2, 1, 3)
+                if int8_kv:
+                    kq, ksc = quantize_kv_rows(kr)
+                    vq, vsc = quantize_kv_rows(vr)
+                    pk = pk.at[pg, :, row].set(kq)
+                    pv = pv.at[pg, :, row].set(vq)
+                    pks = pks.at[pg, row].set(ksc)
+                    pvs = pvs.at[pg, row].set(vsc)
+                    lk = dequantize_lanes(
+                        gather_kv_lanes(pk, page_map),
+                        gather_scale_lanes(pks, page_map))
+                    lv = dequantize_lanes(
+                        gather_kv_lanes(pv, page_map),
+                        gather_scale_lanes(pvs, page_map))
+                else:
+                    pk = pk.at[pg, :, row].set(kr.astype(pk.dtype))
+                    pv = pv.at[pg, :, row].set(vr.astype(pv.dtype))
+                    lk = gather_kv_lanes(pk, page_map)   # (S, H, L, D)
+                    lv = gather_kv_lanes(pv, page_map)
+                if bias is not None:
+                    raise ValueError(
+                        "paged verify attention takes no external bias")
+                cols = jnp.arange(lk.shape[2])
+                validity = jnp.where(
+                    cols[None, None, :] <= pos[:, :, None], 0.0,
+                    -1e9)[:, None]                  # (S, 1, W, L)
+                out = dot_product_attention(q, lk, lv, validity)
+            elif getattr(cache_index, "ndim", 0) == 1:
                 # decode: one token per slot; map is (S, ppn)
                 page_map = paged["map"]
                 pos = cache_index
@@ -549,6 +600,42 @@ class Transformer(Module):
                        "map": page_map, "use_kernel": use_kernel})
         x = self.run_child(ctx, "final_norm", x)
         return self._logits(ctx, x)[:, 0, :], new_cache
+
+    def decode_verify_paged(self, params, cache, tokens, positions,
+                            page_map, trash):
+        """The verify step of speculative decoding: a positioned
+        multi-token prefill over EVERY slot at once. ``tokens`` (S, W)
+        is each slot's last accepted token followed by its W-1 draft
+        candidates; ``positions`` (S,) the cache row the first of them
+        writes. Writes K/V rows ``positions .. positions+W-1`` into the
+        paged pools (rows past the lane end route to ``trash``) and
+        returns ``(logits (S, W, vocab), new_cache)`` — row ``i`` is the
+        next-token distribution after the candidate at position
+        ``positions + i``, so one call scores all W candidate
+        continuations that plain decode would take W sequential steps to
+        score. Rows are per-slot independent exactly like
+        :meth:`decode_step_paged` (retire-and-readmit stays safe)."""
+        ctx = Context(params, {}, False, None)
+        emb = ctx.param("embedding")
+        w = tokens.shape[1]
+        x = emb[tokens] * (self.hidden_size ** 0.5)          # (S, W, h)
+        page_size = jax.tree_util.tree_leaves(cache)[0].shape[2]
+        max_len = page_map.shape[1] * page_size
+        pe = position_encoding(max_len, self.hidden_size, x.dtype)
+        pos = positions[:, None] + jnp.arange(w)[None, :]
+        x = x + pe[jnp.clip(pos, 0, max_len - 1)]
+        new_cache = dict(cache)
+        for name in self._decoder_names():
+            entry = cache[name]
+            pk, pv = entry[0], entry[1]
+            pks, pvs = (entry[2], entry[3]) if len(entry) == 4 else (None,
+                                                                     None)
+            x, new_cache[name] = self._modules[name].forward(
+                ctx.child(name), x, cache_index=positions,
+                paged={"k": pk, "v": pv, "k_scale": pks, "v_scale": pvs,
+                       "map": page_map, "trash": trash})
+        x = self.run_child(ctx, "final_norm", x)
+        return self._logits(ctx, x), new_cache
 
     def decode_step(self, params, cache, tokens, positions):
         """One decode step for EVERY slot at once: ``tokens`` (S,) are each

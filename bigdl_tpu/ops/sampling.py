@@ -104,6 +104,276 @@ def sample_tokens(logits, temperature, top_k, top_p,
     return toks, new_keys
 
 
+# ------------------------------------------------ speculative decoding ----
+# Draft-verified generation (Leviathan et al. 2023): a cheap draft model
+# proposes k tokens, ONE target forward scores all k+1 positions, and the
+# rejection sampler below accepts the longest draft prefix the target
+# agrees with, then emits one more token from the normalized residual
+# (or, past a full acceptance, from the target's own distribution). Two
+# properties are load-bearing here:
+#
+# - **greedy is lossless.** ``temperature <= 0`` rows are represented as
+#   one-hot argmax DELTAS by :func:`filtered_probs`, so the accept test
+#   ``u < p/q`` degenerates to exact-prefix match and every residual /
+#   bonus pick lands on the target argmax — speculative greedy output is
+#   token-identical to plain greedy decode, whatever the draft proposes.
+# - **per-(request, output-position) keys.** Unlike the per-step split
+#   chain of :func:`sample_tokens`, every uniform here is drawn from
+#   ``fold_in(fold_in(request_key, stream), output_position)`` — a pure
+#   function of the request and the position the token would occupy.
+#   Acceptance-length variance therefore cannot desync a stream: however
+#   many tokens a verify step emits, and however rounds align across
+#   schedulers, the draw for output position t is always the same.
+#
+# Streams separate the three draw sites per position (a draft proposal,
+# its accept test, and the residual/bonus pick never share a uniform).
+
+DRAFT_STREAM = 1
+ACCEPT_STREAM = 2
+EXTRA_STREAM = 3
+
+
+def position_uniform(key_data, stream: int, positions) -> jax.Array:
+    """Per-(request, output-position) uniforms: ``key_data`` (S, 2)
+    uint32 raw request keys, ``positions`` (S,) or (S, K) int32 output
+    positions -> matching-shape float32 draws in [0, 1). Host replay:
+    :func:`position_uniform_host`."""
+    positions = jnp.asarray(positions, jnp.int32)
+
+    def one(kd, pos):
+        k = jax.random.fold_in(kd, stream)
+        k = jax.random.fold_in(k, pos)
+        return jax.random.uniform(k, (), jnp.float32)
+
+    if positions.ndim == 1:
+        return jax.vmap(one)(key_data, positions)
+    return jax.vmap(jax.vmap(one, in_axes=(None, 0)))(key_data, positions)
+
+
+def position_uniform_host(key_data, stream: int, position: int) -> float:
+    """Host-side replay of one :func:`position_uniform` draw for a
+    single ``(2,)`` request key — the oracle's source of uniforms."""
+    k = jax.random.fold_in(jnp.asarray(key_data, jnp.uint32), int(stream))
+    k = jax.random.fold_in(k, int(position))
+    return float(jax.random.uniform(k, (), jnp.float32))
+
+
+def filtered_probs(logits, temperature, top_k, top_p) -> jax.Array:
+    """The sampling DISTRIBUTION each slot actually draws from, in vocab
+    order: ``logits`` (S, V) -> (S, V) float32 probabilities, normalized
+    over the kept set after temperature scaling and the same top-k /
+    top-p prefix filters as :func:`sample_tokens`. ``temperature <= 0``
+    rows return the one-hot argmax delta — greedy expressed as a
+    distribution, which is what lets the speculative accept/residual
+    formulas cover greedy rows with no special cases."""
+    logits = logits.astype(jnp.float32)
+    n, vocab = logits.shape
+    temperature = temperature.astype(jnp.float32)
+    t_safe = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / t_safe
+    probs = jax.nn.softmax(scaled, axis=-1)
+    order = jnp.argsort(-scaled, axis=-1)                 # stable, desc
+    sp = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sp, axis=-1)
+    ranks = jnp.arange(vocab)[None, :]
+    k_eff = jnp.where(top_k <= 0, vocab,
+                      jnp.clip(top_k, 1, vocab))[:, None]
+    p_eff = jnp.where((top_p <= 0.0) | (top_p >= 1.0), 1.0,
+                      top_p.astype(jnp.float32))[:, None]
+    keep_sorted = (ranks < k_eff) & (((csum - sp) < p_eff) | (ranks == 0))
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(n)[:, None], order].set(keep_sorted)
+    w = jnp.where(keep, probs, 0.0)
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, axis=-1), vocab,
+                            dtype=jnp.float32)
+    return jnp.where((temperature > 0)[:, None], w, greedy)
+
+
+def pick_token(weights, u) -> jax.Array:
+    """Inverse-CDF pick over an UNNORMALIZED weight vector per slot:
+    ``weights`` (S, V) >= 0, ``u`` (S,) in [0, 1) -> (S,) int32 token
+    ids. The pick is the smallest index whose inclusive cumulative
+    weight exceeds ``u * total``, clamped to the last positive-weight
+    index (the u-near-1 f32 rounding guard — same edge the PR-6 sampler
+    clamps); an all-zero row falls back to its argmax."""
+    vocab = weights.shape[-1]
+    csum = jnp.cumsum(weights, axis=-1)
+    total = csum[:, -1]
+    pick = jnp.sum(csum <= u[:, None] * total[:, None], axis=-1)
+    last_pos = vocab - 1 - jnp.argmax((weights > 0)[:, ::-1], axis=-1)
+    pick = jnp.minimum(pick, last_pos)
+    return jnp.where(total > 0, pick,
+                     jnp.argmax(weights, axis=-1)).astype(jnp.int32)
+
+
+def draft_sample(logits, temperature, top_k, top_p, key_data,
+                 out_pos) -> Tuple[jax.Array, jax.Array]:
+    """One draft proposal per slot: sample from the draft model's
+    filtered distribution using the DRAFT_STREAM draw for each slot's
+    output position. Returns ``(tokens (S,) int32, dists (S, V)
+    float32)`` — the full distribution rides along because the verify
+    step needs it for the accept ratio and the residual. Greedy rows
+    (``temperature <= 0``) return the argmax and its one-hot delta."""
+    dists = filtered_probs(logits, temperature, top_k, top_p)
+    u = position_uniform(key_data, DRAFT_STREAM, out_pos)
+    return pick_token(dists, u), dists
+
+
+def speculative_sample(target_logits, draft_tokens, draft_dists,
+                       temperature, top_k, top_p, key_data,
+                       out_base) -> Tuple[jax.Array, jax.Array]:
+    """The rejection sampler of speculative decoding, batched per slot.
+
+    - ``target_logits``: (S, k+1, V) — the verify step's logits at the
+      last accepted token and each of the k draft candidates.
+    - ``draft_tokens``: (S, k) int32 draft proposals.
+    - ``draft_dists``: (S, k, V) float32 — the draft's filtered sampling
+      distribution at each proposal (from :func:`draft_sample`).
+    - ``temperature`` / ``top_k`` / ``top_p``: (S,) per-slot params,
+      applied identically to every target row.
+    - ``key_data``: (S, 2) uint32 raw request keys; ``out_base``: (S,)
+      int32 — the output position draft token 0 would occupy.
+
+    Returns ``(n_accepted (S,) int32, tokens (S, k+1) int32)``: token
+    column ``i < n_accepted`` is the accepted draft token, column
+    ``n_accepted`` is the extra token (residual resample on rejection,
+    target-distribution bonus past a full acceptance), columns beyond it
+    repeat the extra token and must be ignored by the caller.
+
+    Accept test ``i``: ``u_i < p(d_i) / q(d_i)`` with ``u_i`` the
+    ACCEPT_STREAM draw at output position ``out_base + i`` — so the
+    emitted marginal equals the target's filtered distribution exactly
+    (Leviathan et al. 2023), and greedy rows (delta distributions from
+    :func:`filtered_probs`) reduce to exact-prefix match with every
+    emitted token a target argmax."""
+    target_logits = target_logits.astype(jnp.float32)
+    s, k1, vocab = target_logits.shape
+    k = k1 - 1
+    greedy_rows = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+
+    def _greedy(_):
+        # all-greedy fast path: exact-prefix match against the target
+        # argmax rows; every emitted token is a target argmax. The
+        # sampled branch computes the identical result for greedy rows
+        # (delta distributions) — this branch just skips the O(S*k*V
+        # log V) filtering machinery when nothing in the batch samples.
+        acc = (greedy_rows[:, :k] == draft_tokens).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(acc, axis=-1), axis=-1)
+        extra = jnp.take_along_axis(greedy_rows, n[:, None],
+                                    axis=1)[:, 0]
+        return n.astype(jnp.int32), extra
+
+    def _sampled(_):
+        flat = target_logits.reshape(s * k1, vocab)
+        rep = lambda a: jnp.repeat(a, k1, axis=0)
+        p = filtered_probs(flat, rep(temperature), rep(top_k),
+                           rep(top_p)).reshape(s, k1, vocab)
+        p_d = jnp.take_along_axis(p[:, :k], draft_tokens[..., None],
+                                  axis=-1)[..., 0]           # (S, k)
+        q_d = jnp.take_along_axis(draft_dists, draft_tokens[..., None],
+                                  axis=-1)[..., 0]           # (S, k)
+        pos = out_base[:, None] + jnp.arange(k)[None, :]
+        u = position_uniform(key_data, ACCEPT_STREAM, pos)   # (S, k)
+        acc = (u * jnp.maximum(q_d, 1e-30) < p_d).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(acc, axis=-1), axis=-1)
+        p_n = jnp.take_along_axis(p, n[:, None, None], axis=1)[:, 0]
+        q_pad = jnp.concatenate(
+            [draft_dists, jnp.zeros((s, 1, vocab), jnp.float32)], axis=1)
+        q_n = jnp.take_along_axis(q_pad, n[:, None, None], axis=1)[:, 0]
+        residual = jnp.maximum(p_n - q_n, 0.0)
+        u_x = position_uniform(key_data, EXTRA_STREAM, out_base + n)
+        extra = pick_token(residual, u_x)
+        return n.astype(jnp.int32), extra
+
+    n, extra = jax.lax.cond(jnp.any(temperature > 0.0), _sampled,
+                            _greedy, None)
+    cand = jnp.concatenate(
+        [draft_tokens, jnp.zeros((s, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(jnp.arange(k1)[None, :] < n[:, None], cand,
+                       extra[:, None]).astype(jnp.int32)
+    return n, tokens
+
+
+def numpy_reference_filtered(logits, temperature, top_k,
+                             top_p) -> np.ndarray:
+    """Pure-numpy single-slot mirror of :func:`filtered_probs` (vocab
+    order), same f32 op sequence."""
+    logits = np.asarray(logits, np.float32)
+    vocab = logits.shape[-1]
+    if temperature <= 0:
+        out = np.zeros(vocab, np.float32)
+        out[int(np.argmax(logits))] = 1.0
+        return out
+    scaled = (logits / np.float32(temperature)).astype(np.float32)
+    m = scaled.max()
+    e = np.exp((scaled - m).astype(np.float32))
+    probs = (e / e.sum()).astype(np.float32)
+    order = np.argsort(-scaled, kind="stable")
+    sp = probs[order]
+    csum = np.cumsum(sp, dtype=np.float32)
+    ranks = np.arange(vocab)
+    k_eff = vocab if top_k <= 0 else min(max(int(top_k), 1), vocab)
+    p_eff = 1.0 if (top_p <= 0.0 or top_p >= 1.0) else np.float32(top_p)
+    keep_sorted = (ranks < k_eff) & (((csum - sp) < p_eff) | (ranks == 0))
+    keep = np.zeros(vocab, bool)
+    keep[order] = keep_sorted
+    w = np.where(keep, probs, np.float32(0.0)).astype(np.float32)
+    return (w / w.sum()).astype(np.float32)
+
+
+def numpy_reference_pick(weights, u) -> int:
+    """Pure-numpy mirror of :func:`pick_token` for one slot."""
+    weights = np.asarray(weights, np.float32)
+    vocab = weights.shape[-1]
+    csum = np.cumsum(weights, dtype=np.float32)
+    total = csum[-1]
+    if not total > 0:
+        return int(np.argmax(weights))
+    pick = int(np.sum(csum <= np.float32(u) * total))
+    positive = np.flatnonzero(weights > 0)
+    return int(min(pick, positive[-1] if positive.size else vocab - 1))
+
+
+def numpy_reference_draft(logits, temperature, top_k, top_p, key_data,
+                          out_pos):
+    """Single-slot oracle for :func:`draft_sample`: -> (token, dist)."""
+    dist = numpy_reference_filtered(logits, temperature, top_k, top_p)
+    u = position_uniform_host(key_data, DRAFT_STREAM, out_pos)
+    return numpy_reference_pick(dist, u), dist
+
+
+def numpy_reference_speculative(target_logits, draft_tokens, draft_dists,
+                                temperature, top_k, top_p, key_data,
+                                out_base):
+    """Single-slot oracle for one :func:`speculative_sample` step:
+    ``target_logits`` (k+1, V), ``draft_tokens`` (k,), ``draft_dists``
+    (k, V); -> ``(n_accepted, emitted token list of length
+    n_accepted + 1)``. Uniforms replay via
+    :func:`position_uniform_host`, so the oracle is driven by exactly
+    the draws the jitted sampler consumes."""
+    target_logits = np.asarray(target_logits, np.float32)
+    k = len(draft_tokens)
+    p = [numpy_reference_filtered(target_logits[i], temperature, top_k,
+                                  top_p) for i in range(k + 1)]
+    n = 0
+    for i in range(k):
+        d = int(draft_tokens[i])
+        u = position_uniform_host(key_data, ACCEPT_STREAM,
+                                  int(out_base) + i)
+        q = np.float32(draft_dists[i][d])
+        if np.float32(u) * max(q, np.float32(1e-30)) < p[i][d]:
+            n += 1
+        else:
+            break
+    q_n = (np.asarray(draft_dists[n], np.float32) if n < k
+           else np.zeros_like(p[n]))
+    residual = np.maximum(p[n] - q_n, np.float32(0.0)).astype(np.float32)
+    u_x = position_uniform_host(key_data, EXTRA_STREAM, int(out_base) + n)
+    extra = numpy_reference_pick(residual, u_x)
+    return n, [int(t) for t in draft_tokens[:n]] + [extra]
+
+
 def split_key_data(key_data: np.ndarray):
     """Host-side replay of the per-step key evolution: returns
     ``(new_key_data, u)`` exactly as one :func:`sample_tokens` call

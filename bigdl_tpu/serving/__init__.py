@@ -49,6 +49,7 @@ from bigdl_tpu.serving.engine import (
     GenerationEngine,
     GenerationStream,
     PagedDecodeKernels,
+    SpeculativeKernels,
     static_generate,
 )
 from bigdl_tpu.serving.paging import PagePool
@@ -82,6 +83,7 @@ __all__ = [
     "ReplicaUnavailable",
     "ServingError",
     "ServingMetrics",
+    "SpeculativeKernels",
     "StreamCancelled",
     "UnknownModel",
     "bucket_sizes_for",

@@ -76,7 +76,15 @@ import numpy as np
 from bigdl_tpu import faults
 from bigdl_tpu.core.rng import request_seed, threefry_key_data
 from bigdl_tpu.faults import StallError, Watchdog
-from bigdl_tpu.ops.sampling import sample_tokens
+from bigdl_tpu.ops.sampling import (
+    EXTRA_STREAM,
+    draft_sample,
+    filtered_probs,
+    pick_token,
+    position_uniform,
+    sample_tokens,
+    speculative_sample,
+)
 from bigdl_tpu.serving.batcher import bucket_sizes_for
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
@@ -309,6 +317,216 @@ class PagedDecodeKernels:
             np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32))
 
 
+class _SpecTraceCounts:
+    """Trace counters for the speculative kernel set (same GC discipline
+    as :class:`_TraceCounts` — the jitted closures capture THIS, never
+    the kernel owner)."""
+
+    __slots__ = ("prefill", "chunk", "draft_write", "draft", "verify")
+
+    def __init__(self):
+        self.prefill = 0
+        self.chunk = 0
+        self.draft_write = 0
+        self.draft = 0
+        self.verify = 0
+
+
+class SpeculativeKernels:
+    """The jitted kernel set for draft-verified (speculative) generation
+    over TWO paged decode-capable models sharing one positional
+    contract: a cheap ``draft_model`` proposes candidate tokens with
+    ordinary single-row decode steps, and the ``model`` (the target)
+    scores all of them in ONE multi-token ``verify`` forward
+    (``Transformer.decode_verify_paged``), whose logits feed the
+    rejection sampler (``ops.sampling.speculative_sample``).
+
+    Kernels (cache argument donated in every one):
+
+    - ``prefill`` / ``chunk`` — the target's prompt path, as in
+      :class:`PagedDecodeKernels`, except the first generated token is
+      drawn with the speculative tier's per-(request, output-position)
+      keys (position 0) instead of the per-step split chain — so a
+      sampled stream is a pure function of its request seed under ANY
+      acceptance history;
+    - ``draft_write`` — the draft model's prompt path (K/V writes only,
+      no logits): the draft needs the prompt in its own cache before it
+      can propose;
+    - ``draft`` — one draft decode step for every slot: the draft's
+      logits are sampled into ``(tokens, dists)`` where ``dists`` is the
+      draft's full filtered distribution per slot — the verify step
+      needs it for the accept ratio and the residual;
+    - ``verify`` — the target's multi-token step over ``[last_token,
+      d_1..d_k]`` plus the rejection sampler: returns ``(n_accepted,
+      emitted tokens, new cache)``.
+
+    All shapes are fixed (``k`` is baked into the verify width), so each
+    kernel compiles exactly once — the compile-once contract of the
+    paged engine survives speculation, whatever the acceptance lengths
+    do (trace-counter test-enforced). ``cache_sharding`` pins BOTH
+    models' page pools (the leaf-shape dispatch in
+    ``_cache_sharding_tree`` is dimension-based, so one sharding serves
+    both caches)."""
+
+    def __init__(self, model, draft_model, *, donate: bool = True,
+                 use_kernel: Optional[bool] = None, cache_sharding=None):
+        if not hasattr(draft_model, "decode_step_paged"):
+            raise ValueError(
+                "speculative decoding needs a PAGED draft model "
+                "(decode_step_paged — see nn.Transformer)")
+        if getattr(model, "vocab_size", None) != getattr(
+                draft_model, "vocab_size", None):
+            raise ValueError(
+                f"draft and target models must share one vocabulary, got "
+                f"{getattr(draft_model, 'vocab_size', None)} vs "
+                f"{getattr(model, 'vocab_size', None)}")
+        self.model = model
+        self.draft_model = draft_model
+        self.cache_sharding = cache_sharding
+        self.counts = _SpecTraceCounts()
+        counts = self.counts
+        pin = _cache_pinner(cache_sharding)
+
+        def prefill(params, cache, pages, tokens, start, length, trash,
+                    temp, top_k, top_p, key):
+            counts.prefill += 1
+            logits, cache = model.prefill_paged(
+                params, cache, pages, tokens, start, length, trash)
+            dist = filtered_probs(logits[None], temp, top_k, top_p)
+            u = position_uniform(key, EXTRA_STREAM,
+                                 jnp.zeros((1,), jnp.int32))
+            return pick_token(dist, u)[0], pin(cache)
+
+        def chunk(params, cache, pages, tokens, start, length, trash):
+            counts.chunk += 1
+            return pin(model.prefill_paged(params, cache, pages, tokens,
+                                           start, length, trash,
+                                           need_logits=False))
+
+        def draft_write(dparams, dcache, pages, tokens, start, length,
+                        trash):
+            counts.draft_write += 1
+            return pin(draft_model.prefill_paged(
+                dparams, dcache, pages, tokens, start, length, trash,
+                need_logits=False))
+
+        def draft(dparams, dcache, tokens, positions, page_map, temps,
+                  top_ks, top_ps, keys, out_pos):
+            counts.draft += 1
+            logits, dcache = draft_model.decode_step_paged(
+                dparams, dcache, tokens, positions, page_map,
+                use_kernel=use_kernel)
+            toks, dists = draft_sample(logits, temps, top_ks, top_ps,
+                                       keys, out_pos)
+            return toks, dists, pin(dcache)
+
+        def verify(params, cache, last_tokens, draft_tokens, positions,
+                   page_map, trash, temps, top_ks, top_ps, keys,
+                   out_base, draft_dists):
+            counts.verify += 1
+            tokens = jnp.stack((last_tokens,) + tuple(draft_tokens),
+                               axis=1)
+            logits, cache = model.decode_verify_paged(
+                params, cache, tokens, positions, page_map, trash)
+            n_acc, out = speculative_sample(
+                logits, jnp.stack(tuple(draft_tokens), axis=1),
+                jnp.stack(tuple(draft_dists), axis=1),
+                temps, top_ks, top_ps, keys, out_base)
+            return n_acc, out, pin(cache)
+
+        dn = (1,) if donate else ()
+        self._prefill = jax.jit(prefill, donate_argnums=dn)
+        self._chunk = jax.jit(chunk, donate_argnums=dn)
+        self._draft_write = jax.jit(draft_write, donate_argnums=dn)
+        self._draft = jax.jit(draft, donate_argnums=dn)
+        self._verify = jax.jit(verify, donate_argnums=dn)
+
+    # trace counters (compile-once assertions read these)
+    @property
+    def prefill_traces(self) -> int:
+        return self.counts.prefill
+
+    @property
+    def chunk_traces(self) -> int:
+        return self.counts.chunk
+
+    @property
+    def draft_write_traces(self) -> int:
+        return self.counts.draft_write
+
+    @property
+    def draft_traces(self) -> int:
+        return self.counts.draft
+
+    @property
+    def verify_traces(self) -> int:
+        return self.counts.verify
+
+    # decode_traces aliases verify for surfaces (engine properties,
+    # step-cost wrappers) that treat "the per-iteration kernel" uniformly
+    @property
+    def decode_traces(self) -> int:
+        return self.counts.verify
+
+    def prefill(self, params, cache, pages, tokens, start, length, trash,
+                temperature=0.0, top_k=0, top_p=1.0, key=None):
+        """Final (or only) chunk of one prompt through the TARGET:
+        writes its K/V rows and samples the first generated token (the
+        EXTRA_STREAM draw at output position 0). -> ``(token, new
+        cache)``; donates ``cache``."""
+        if key is None:
+            key = np.zeros(2, np.uint32)
+        return self._prefill(
+            params, cache, np.asarray(pages, np.int32),
+            np.asarray(tokens, np.int32), int(start), int(length),
+            int(trash), np.asarray([temperature], np.float32),
+            np.asarray([top_k], np.int32), np.asarray([top_p], np.float32),
+            np.asarray(key, np.uint32).reshape(1, 2))
+
+    def chunk(self, params, cache, pages, tokens, start, length, trash):
+        """Non-final prompt chunk through the TARGET: K/V writes only.
+        -> new cache (donates the old one)."""
+        return self._chunk(
+            params, cache, np.asarray(pages, np.int32),
+            np.asarray(tokens, np.int32), int(start), int(length),
+            int(trash))
+
+    def draft_write(self, dparams, dcache, pages, tokens, start, length,
+                    trash):
+        """Prompt chunk through the DRAFT (final or not — the draft
+        never samples during prefill). -> new draft cache (donated)."""
+        return self._draft_write(
+            dparams, dcache, np.asarray(pages, np.int32),
+            np.asarray(tokens, np.int32), int(start), int(length),
+            int(trash))
+
+    def draft(self, dparams, dcache, tokens, positions, page_map, temps,
+              top_ks, top_ps, keys, out_pos):
+        """One draft decode step for every slot. -> ``(tokens (S,),
+        dists (S, V), new draft cache)``; donates ``dcache``."""
+        return self._draft(
+            dparams, dcache, np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32),
+            np.asarray(page_map, np.int32), np.asarray(temps, np.float32),
+            np.asarray(top_ks, np.int32), np.asarray(top_ps, np.float32),
+            np.asarray(keys, np.uint32), np.asarray(out_pos, np.int32))
+
+    def verify(self, params, cache, last_tokens, draft_tokens, positions,
+               page_map, trash, temps, top_ks, top_ps, keys, out_base,
+               draft_dists):
+        """The target's verify forward + rejection sampler.
+        ``draft_tokens`` / ``draft_dists`` are the k-tuples of device
+        arrays the draft steps returned. -> ``(n_accepted (S,), tokens
+        (S, k+1), new cache)``; donates ``cache``."""
+        return self._verify(
+            params, cache, np.asarray(last_tokens, np.int32),
+            tuple(draft_tokens), np.asarray(positions, np.int32),
+            np.asarray(page_map, np.int32), int(trash),
+            np.asarray(temps, np.float32), np.asarray(top_ks, np.int32),
+            np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32),
+            np.asarray(out_base, np.int32), tuple(draft_dists))
+
+
 class GenerationStream:
     """Iterator-future for one generation request.
 
@@ -445,12 +663,15 @@ class _SlotState:
     (chunked prefill interleaves with neighbours' decode steps)."""
 
     __slots__ = ("req", "last_token", "position", "generated", "t_admit",
-                 "phase", "pages", "page_row", "prefill_pos")
+                 "phase", "pages", "page_row", "prefill_pos",
+                 "draft_pages", "dpage_row")
 
     def __init__(self, req: _GenRequest, last_token: int, position: int,
                  generated: int, t_admit: float, phase: str = "decode",
                  pages: Optional[List[int]] = None,
-                 page_row=None, prefill_pos: int = 0):
+                 page_row=None, prefill_pos: int = 0,
+                 draft_pages: Optional[List[int]] = None,
+                 dpage_row=None):
         self.req = req
         self.last_token = last_token
         self.position = position          # cache row the NEXT token writes
@@ -460,6 +681,8 @@ class _SlotState:
         self.pages = pages                # reserved physical pages (paged)
         self.page_row = page_row          # (ppn,) int32 map row (paged)
         self.prefill_pos = prefill_pos    # next prompt index to prefill
+        self.draft_pages = draft_pages    # draft-lane pages (speculative)
+        self.dpage_row = dpage_row        # draft (ppn,) map row (spec)
 
 
 class _Core:
@@ -498,6 +721,12 @@ def _fail_streams(core: _Core, error: BaseException,
             engine._pool.release(st.pages or ())
             st.pages = None
             engine._page_map[slot] = engine._pool.trash
+            if engine.speculative:
+                # BOTH lanes of a speculative slot return to the pool —
+                # a mid-verify failure must not strand the draft lane
+                engine._pool.release(st.draft_pages or ())
+                st.draft_pages = None
+                engine._dpage_map[slot] = engine._pool.trash
         engine._report_pages()
     for r in reqs:
         if not r.stream.done:
@@ -604,7 +833,8 @@ class GenerationEngine:
                  param_pspecs=None,
                  shard_axis: str = "tp",
                  stall_timeout: Optional[float] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 speculate: Optional[tuple] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -630,6 +860,32 @@ class GenerationEngine:
             raise ValueError(f"quantize must be None or 'int8', "
                              f"got {quantize!r}")
         self.quantize = quantize
+        # speculative decoding (PR 10): `speculate=(draft_model,
+        # draft_params, k)` pairs the target with a cheap draft of the
+        # same model family (same vocabulary). Each scheduler iteration
+        # then runs k+1 draft decode steps (the +1 pre-writes the
+        # would-be bonus row in the draft cache, so a full acceptance
+        # leaves no K/V hole) and ONE target verify forward that scores
+        # all k candidates at once — the memory-bandwidth-bound target
+        # decode is amortized over up to k+1 emitted tokens per round.
+        # Greedy speculative output is token-identical to plain greedy
+        # decode (test-enforced); the draft and target reserve
+        # side-by-side lanes in the ONE PagePool, tagged per owner.
+        self.speculative = False
+        self.spec_k = 0
+        self.draft_model = None
+        draft_params = None
+        if speculate is not None:
+            try:
+                self.draft_model, draft_params, self.spec_k = speculate
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "speculate must be a (draft_model, draft_params, k) "
+                    "triple")
+            self.spec_k = int(self.spec_k)
+            if self.spec_k < 1:
+                raise ValueError("speculate k must be >= 1")
+            self.speculative = True
         if quantize == "int8":
             from bigdl_tpu.nn.quantized import (
                 count_quantized_gemms,
@@ -638,6 +894,9 @@ class GenerationEngine:
 
             self._quantize_params = quantize_for_serving
             params = quantize_for_serving(params)
+            if draft_params is not None:
+                # the draft serves too: its GEMMs ride the same int8 tier
+                draft_params = quantize_for_serving(draft_params)
             self.metrics.set_quantized_gemms(count_quantized_gemms(params))
         else:
             self._quantize_params = None
@@ -651,6 +910,7 @@ class GenerationEngine:
         self.mesh = mesh
         self._param_shardings = None
         self._cache_sharding = None
+        self._draft_param_shardings = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -667,6 +927,17 @@ class GenerationEngine:
                                                      params=params)
             self._param_shardings = tree_shardings(mesh, params, param_pspecs)
             params = jax.device_put(params, self._param_shardings)
+            if draft_params is not None:
+                # the draft shards on the same mesh with its OWN Megatron
+                # pspecs (tp must divide its head count too); its page
+                # pools reuse the target's heads-axis cache sharding
+                dspecs = transformer_tp_pspecs(self.draft_model, mesh,
+                                               axis=shard_axis,
+                                               params=draft_params)
+                self._draft_param_shardings = tree_shardings(
+                    mesh, draft_params, dspecs)
+                draft_params = jax.device_put(draft_params,
+                                              self._draft_param_shardings)
             self._cache_sharding = NamedSharding(mesh,
                                                  kv_cache_pspec(shard_axis))
             if self.cache_dtype_name == "int8":
@@ -694,10 +965,23 @@ class GenerationEngine:
         # `chunk` is the paged-triple discriminator so wrappers (fixed
         # step-cost shims, failure injectors) duck-type either flavour.
         if kernels is not None:
+            if hasattr(kernels, "verify") != self.speculative:
+                # a speculative engine needs the draft model/params from
+                # `speculate=` AND kernels that carry the verify step;
+                # half of either is a silent wrong-mode engine
+                raise ValueError(
+                    "speculate=(draft_model, draft_params, k) and "
+                    "SpeculativeKernels go together: pass both or "
+                    "neither")
             self.paged = hasattr(kernels, "chunk")
         else:
             self.paged = bool(page_size) and hasattr(model,
                                                     "decode_step_paged")
+        if self.speculative and not (
+                bool(page_size) and hasattr(model, "decode_step_paged")):
+            raise ValueError(
+                "speculative decoding needs the paged engine (the draft "
+                "and target caches live side by side in one PagePool)")
         if self.cache_dtype_name == "int8" and not self.paged:
             raise ValueError(
                 "cache_dtype='int8' needs the paged engine (int8 KV lives "
@@ -723,14 +1007,25 @@ class GenerationEngine:
             self.prompt_buckets = bucket_sizes_for(
                 min(self.max_prompt_len, self.prefill_chunk))
             # dense-equivalent pool by default; shrink num_pages to trade
-            # worst-case capacity for more concurrent typical requests
+            # worst-case capacity for more concurrent typical requests.
+            # A speculative engine reserves TWO lanes per slot (target +
+            # draft) out of the one pool, so its default doubles — the
+            # device pools of both models span the shared id space.
             ppn = pages_per_lane(self.max_len, self.page_size)
-            self.num_pages = int(num_pages or self.max_slots * ppn)
+            self._lanes = lanes = 2 if self.speculative else 1
+            self.num_pages = int(num_pages or self.max_slots * ppn * lanes)
             self._pool = PagePool(self.num_pages, self.page_size,
                                   self.max_len)
-            self.kernels = kernels or PagedDecodeKernels(
-                model, use_kernel=use_paged_kernel,
-                cache_sharding=self._cache_sharding)
+            if kernels is not None:
+                self.kernels = kernels
+            elif self.speculative:
+                self.kernels = SpeculativeKernels(
+                    model, self.draft_model, use_kernel=use_paged_kernel,
+                    cache_sharding=self._cache_sharding)
+            else:
+                self.kernels = PagedDecodeKernels(
+                    model, use_kernel=use_paged_kernel,
+                    cache_sharding=self._cache_sharding)
             self._cache = model.init_paged_cache(
                 self.num_pages + 1, self.page_size, cache_dtype)
             # per-slot step inputs, mutated on admission/retirement only
@@ -740,6 +1035,16 @@ class GenerationEngine:
             self._top_ks = np.zeros((self.max_slots,), np.int32)
             self._top_ps = np.ones((self.max_slots,), np.float32)
             self._keys = np.zeros((self.max_slots, 2), np.uint32)
+            if self.speculative:
+                # the draft cache spans the same page-id space; its map
+                # rows park on the shared trash page exactly like the
+                # target's. In speculative mode `_keys` holds each
+                # slot's REQUEST key (constant — draws are keyed by
+                # output position, never by step).
+                self._dcache = self.draft_model.init_paged_cache(
+                    self.num_pages + 1, self.page_size, cache_dtype)
+                self._dpage_map = np.full((self.max_slots, ppn),
+                                          self._pool.trash, np.int32)
             # dtype-aware byte accounting for the kv_bytes_in_use gauge:
             # bytes one reserved page costs across ALL layers, scale
             # pools included (paging.page_bytes); 0 for models that do
@@ -751,6 +1056,16 @@ class GenerationEngine:
                 layers * page_bytes(self.page_size, heads, hidden // heads,
                                     self.cache_dtype_name)
                 if heads and hidden and layers else 0)
+            self._kv_dpage_bytes = 0
+            if self.speculative:
+                dheads = getattr(self.draft_model, "num_heads", 0)
+                dhidden = getattr(self.draft_model, "hidden_size", 0)
+                dlayers = getattr(self.draft_model, "num_hidden_layers", 0)
+                self._kv_dpage_bytes = (
+                    dlayers * page_bytes(self.page_size, dheads,
+                                         dhidden // dheads,
+                                         self.cache_dtype_name)
+                    if dheads and dhidden and dlayers else 0)
             self._report_pages()
         else:
             self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
@@ -764,7 +1079,13 @@ class GenerationEngine:
             self._cache = jax.device_put(
                 self._cache,
                 _cache_sharding_tree(self._cache, self._cache_sharding))
+            if self.speculative:
+                self._dcache = jax.device_put(
+                    self._dcache,
+                    _cache_sharding_tree(self._dcache,
+                                         self._cache_sharding))
         self._params = params
+        self._draft_params = draft_params
         self._failed: Optional[BaseException] = None
         self._core = _Core(self.max_slots)
         # stall watchdog: a decode/prefill call that makes no progress
@@ -824,7 +1145,7 @@ class GenerationEngine:
         if mnt < 1:
             raise ValueError("no room to generate even one token")
         if self.paged:
-            need = self._pool.pages_for(
+            need = self._lanes * self._pool.pages_for(
                 min(len(prompt) + mnt - 1, self.max_len))
             if need > self.num_pages:
                 # a reservation the pool can NEVER satisfy would block the
@@ -909,7 +1230,7 @@ class GenerationEngine:
                 if not core.pending or not core.free:
                     break
                 if self.paged and not self._pool.can_reserve(
-                        self._pages_needed(core.pending[0])):
+                        self._lanes * self._pages_needed(core.pending[0])):
                     break
                 req = core.pending.popleft()
                 depth = len(core.pending)
@@ -928,21 +1249,33 @@ class GenerationEngine:
             active = sorted((s, st) for s, st in core.active.items()
                             if st.phase == "decode")
         if active:
-            self._decode_once(active)
+            if self.speculative:
+                self._speculative_round(active)
+            else:
+                self._decode_once(active)
 
     def _report_pages(self) -> None:
         """Publish page occupancy plus the dtype-aware byte gauge (the
         same reserved pages, priced in the cache's ACTUAL dtype with
-        scale pools included)."""
+        scale pools included; a speculative engine prices target and
+        draft lanes at their own models' per-page cost)."""
         self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
-        if self._kv_page_bytes:
-            self.metrics.set_kv_cache(
-                self._pool.in_use * self._kv_page_bytes,
-                self.cache_dtype_name)
+        if not self._kv_page_bytes:
+            return
+        if self.speculative:
+            in_bytes = (self._pool.in_use_by("target")
+                        * self._kv_page_bytes
+                        + self._pool.in_use_by("draft")
+                        * self._kv_dpage_bytes)
+        else:
+            in_bytes = self._pool.in_use * self._kv_page_bytes
+        self.metrics.set_kv_cache(in_bytes, self.cache_dtype_name)
 
     def _pages_needed(self, req: _GenRequest) -> int:
-        # rows written = prompt + generated - 1 (the final token is
-        # returned but never written back before the slot retires)
+        # PER-LANE pages: rows written = prompt + generated - 1 (the
+        # final token is returned but never written back before the slot
+        # retires). A speculative slot reserves this many for EACH of
+        # its two lanes (`_lanes` — the draft writes the same positions)
         return self._pool.pages_for(
             min(len(req.prompt) + req.max_new_tokens - 1, self.max_len))
 
@@ -978,12 +1311,24 @@ class GenerationEngine:
         with core.cond:
             core.free.sort()
             slot = core.free.pop(0)
-        pages = self._pool.alloc(self._pages_needed(req))
+        need = self._pages_needed(req)
+        pages = self._pool.alloc(need, owner="target")
         row = np.full((self._pool.pages_per_slot,), self._pool.trash,
                       np.int32)
         row[:len(pages)] = pages
+        draft_pages = None
+        drow = None
+        if self.speculative:
+            # the draft lane reserves the same row budget side by side
+            # (one pool, owner-tagged so the drain invariants are
+            # assertable per lane)
+            draft_pages = self._pool.alloc(need, owner="draft")
+            drow = np.full((self._pool.pages_per_slot,), self._pool.trash,
+                           np.int32)
+            drow[:len(draft_pages)] = draft_pages
         st = _SlotState(req, self.pad_id, 0, 0, now, phase="prefill",
-                        pages=pages, page_row=row, prefill_pos=0)
+                        pages=pages, page_row=row, prefill_pos=0,
+                        draft_pages=draft_pages, dpage_row=drow)
         with core.cond:
             core.active[slot] = st
         self._report_pages()
@@ -1011,6 +1356,12 @@ class GenerationEngine:
             self._cache = self.kernels.chunk(
                 self._params, self._cache, pages_row, tokens, start,
                 self.prefill_chunk, self._pool.trash)
+            if self.speculative:
+                # the draft needs the prompt in its own cache before it
+                # can propose: same chunk, draft lane
+                self._dcache = self.kernels.draft_write(
+                    self._draft_params, self._dcache, st.dpage_row,
+                    tokens, start, self.prefill_chunk, self._pool.trash)
             st.prefill_pos += self.prefill_chunk
             st.position = st.prefill_pos
             self.metrics.record_chunk(self.prefill_chunk, self.prefill_chunk)
@@ -1025,12 +1376,28 @@ class GenerationEngine:
         self._temps[slot] = req.temperature
         self._top_ks[slot] = req.top_k
         self._top_ps[slot] = req.top_p
-        tok_dev, key_dev, self._cache = self.kernels.prefill(
-            self._params, self._cache, pages_row, padded, start, remaining,
-            self._pool.trash, self._temps[slot], self._top_ks[slot],
-            self._top_ps[slot], self._request_key(req))
+        if self.speculative:
+            # speculative sampling is keyed by (request, output
+            # position), never by step — `_keys[slot]` holds the CONSTANT
+            # request key and the kernels fold positions in
+            key = self._request_key(req)
+            tok_dev, self._cache = self.kernels.prefill(
+                self._params, self._cache, pages_row, padded, start,
+                remaining, self._pool.trash, self._temps[slot],
+                self._top_ks[slot], self._top_ps[slot], key)
+            self._dcache = self.kernels.draft_write(
+                self._draft_params, self._dcache, st.dpage_row, padded,
+                start, remaining, self._pool.trash)
+            self._keys[slot] = key
+            self._dpage_map[slot] = st.dpage_row
+        else:
+            tok_dev, key_dev, self._cache = self.kernels.prefill(
+                self._params, self._cache, pages_row, padded, start,
+                remaining, self._pool.trash, self._temps[slot],
+                self._top_ks[slot], self._top_ps[slot],
+                self._request_key(req))
+            self._keys[slot] = np.asarray(key_dev)[0]
         tok = int(np.asarray(tok_dev))
-        self._keys[slot] = np.asarray(key_dev)[0]
         self._page_map[slot] = pages_row
         now = time.monotonic()
         self.metrics.record_prefill(remaining, bucket,
@@ -1060,6 +1427,10 @@ class GenerationEngine:
             self._pool.release(st.pages or ())
             st.pages = None
             self._page_map[slot] = self._pool.trash
+            if self.speculative:
+                self._pool.release(st.draft_pages or ())
+                st.draft_pages = None
+                self._dpage_map[slot] = self._pool.trash
             self._temps[slot] = 0.0
             self._top_ks[slot] = 0
             self._top_ps[slot] = 1.0
@@ -1137,6 +1508,89 @@ class GenerationEngine:
             self._release_slot(slot, st)
             self._finish_slot(st, why, now)
 
+    def _speculative_round(self, active: List[Tuple[int, _SlotState]]) -> None:
+        """One speculative iteration over every decoding slot: k+1 draft
+        decode steps (each feeding the previous step's device-resident
+        tokens straight back in — the +1 pre-writes the bonus token's
+        K/V row in the draft cache so a full acceptance leaves no hole),
+        then ONE target verify forward scoring all k candidates, then
+        host-side accept/rollback bookkeeping.
+
+        Rollback is free by construction: a rejection just leaves the
+        slot's position at the last accepted row, and the rejected
+        candidates' K/V rows sit causally masked past it until the next
+        round overwrites them — the same recycled-page bit-cleanliness
+        the paged cache already guarantees."""
+        faults.fire("engine.draft", engine=self)
+        k = self.spec_k
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        out_base = np.zeros((self.max_slots,), np.int32)
+        for slot, st in active:
+            tokens[slot] = st.last_token
+            positions[slot] = st.position
+            out_base[slot] = st.generated
+        d_tokens = []
+        d_dists = []
+        cur = tokens
+        for i in range(k + 1):
+            # positions clamp at the lane end: a slot about to retire at
+            # max_len keeps fixed shapes (garbage proposals there are
+            # rejected or discarded by the room cap below)
+            pos_i = np.minimum(positions + i, self.max_len - 1)
+            cur, dist, self._dcache = self.kernels.draft(
+                self._draft_params, self._dcache, cur, pos_i,
+                self._dpage_map, self._temps, self._top_ks, self._top_ps,
+                self._keys, out_base + i)
+            # host round trip on purpose: feeding the committed device
+            # output straight back would key a SECOND pjit executable
+            # (committed vs uncommitted int32[S]) — compile-once pins
+            # exactly one entry per kernel
+            cur = np.asarray(cur)
+            if i < k:
+                d_tokens.append(cur)
+                d_dists.append(dist)
+        faults.fire("engine.verify", engine=self)
+        n_dev, out_dev, self._cache = self.kernels.verify(
+            self._params, self._cache, tokens, d_tokens, positions,
+            self._page_map, self._pool.trash, self._temps, self._top_ks,
+            self._top_ps, self._keys, out_base, d_dists)
+        n_acc = np.asarray(n_dev)
+        outs = np.asarray(out_dev)
+        now = time.monotonic()
+        self.metrics.record_decode_step(len(active), self.max_slots)
+        accepted_total = 0
+        pushed_total = 0
+        sampled = 0
+        retired = []
+        for slot, st in active:
+            room = min(st.req.max_new_tokens - st.generated,
+                       self.max_len - st.position)
+            emit = min(int(n_acc[slot]) + 1, room)
+            pushed = 0
+            for j in range(emit):
+                tok = int(outs[slot, j])
+                st.req.stream._push(tok, now)
+                pushed += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    break
+            accepted_total += min(int(n_acc[slot]), pushed)
+            pushed_total += pushed
+            st.last_token = int(outs[slot, pushed - 1])
+            st.position += pushed
+            st.generated += pushed
+            sampled += pushed if st.req.sampled else 0
+            why = self._retire_why(st, st.req, now)
+            if why is not None:
+                retired.append((slot, st, why))
+        self.metrics.record_verify_step(k * len(active), accepted_total,
+                                        pushed_total - len(active))
+        if sampled:
+            self.metrics.record_sampled(sampled)
+        for slot, st, why in retired:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
+
     def _retire_why(self, st: Optional[_SlotState], req: _GenRequest,
                     now: float) -> Optional[str]:
         """Retirement disposition, or None to keep decoding. Order:
@@ -1189,7 +1643,48 @@ class GenerationEngine:
             if core.pending or core.active:
                 raise RuntimeError("warmup() must run before traffic")
         zeros = np.zeros((self.max_slots,), np.int32)
-        if self.paged:
+        if self.paged and self.speculative:
+            # every write routes to the trash page (the map rows are
+            # parked there). One call per kernel shape: the draft step
+            # and the verify step each have exactly ONE shape however
+            # the acceptance lengths vary at runtime.
+            trash_row = np.full((self._pool.pages_per_slot,),
+                                self._pool.trash, np.int32)
+            k = self.spec_k
+            _, wd, self._dcache = self.kernels.draft(
+                self._draft_params, self._dcache, zeros, zeros,
+                self._dpage_map, self._temps, self._top_ks, self._top_ps,
+                self._keys, zeros)
+            # verify must see the RUNTIME argument kinds: draft tokens
+            # arrive as host arrays (the round's committed-output
+            # normalization) but dists stay device-resident — a numpy
+            # dist here would warm a second executable for the same
+            # trace (pjit keys on committed-ness, not just shape)
+            zt = [np.zeros((self.max_slots,), np.int32)] * k
+            zd = [wd] * k
+            _, _, self._cache = self.kernels.verify(
+                self._params, self._cache, zeros, zt, zeros,
+                self._page_map, self._pool.trash, self._temps,
+                self._top_ks, self._top_ps, self._keys, zeros, zd)
+            if self.max_prompt_len > self.prefill_chunk:
+                chunk_pad = np.full((self.prefill_chunk,), self.pad_id,
+                                    np.int32)
+                self._cache = self.kernels.chunk(
+                    self._params, self._cache, trash_row, chunk_pad, 0,
+                    self.prefill_chunk, self._pool.trash)
+                self._dcache = self.kernels.draft_write(
+                    self._draft_params, self._dcache, trash_row,
+                    chunk_pad, 0, self.prefill_chunk, self._pool.trash)
+            for bucket in self.prompt_buckets:
+                pad = np.full((bucket,), self.pad_id, np.int32)
+                _, self._cache = self.kernels.prefill(
+                    self._params, self._cache, trash_row, pad, 0, bucket,
+                    self._pool.trash)
+                self._dcache = self.kernels.draft_write(
+                    self._draft_params, self._dcache, trash_row, pad, 0,
+                    bucket, self._pool.trash)
+            jax.block_until_ready(self._dcache)
+        elif self.paged:
             # every write below routes to the trash page (the map rows
             # are parked there), so warmup garbage can never surface
             trash_row = np.full((self._pool.pages_per_slot,),
@@ -1309,6 +1804,14 @@ class GenerationEngine:
         return getattr(self.kernels, "chunk_traces", 0)
 
     @property
+    def draft_compilations(self) -> int:
+        return getattr(self.kernels, "draft_traces", 0)
+
+    @property
+    def verify_compilations(self) -> int:
+        return getattr(self.kernels, "verify_traces", 0)
+
+    @property
     def pages_in_use(self) -> int:
         return self._pool.in_use if self.paged else 0
 
@@ -1325,7 +1828,8 @@ def static_generate(model, params, requests, *, max_slots: int,
                     page_size: int = 16, num_pages: Optional[int] = None,
                     prefill_chunk: Optional[int] = None, seed: int = 0,
                     sampling: Optional[Sequence[dict]] = None,
-                    quantize: Optional[str] = None):
+                    quantize: Optional[str] = None,
+                    speculate: Optional[tuple] = None):
     """Run-to-completion static batching BASELINE over the same jitted
     kernels the engine uses: admit ``max_slots`` requests, decode until
     EVERY one finishes (the longest sequence holds the whole batch
@@ -1345,11 +1849,24 @@ def static_generate(model, params, requests, *, max_slots: int,
     ``quantize="int8"`` / ``cache_dtype="int8"`` mirror the engine knobs
     (the transform is deterministic, so an int8 engine and an int8
     static run still emit identical tokens — the bench mismatch gate
-    covers the quantized tier too)."""
+    covers the quantized tier too).
+
+    ``speculate=(draft_model, draft_params, k)`` mirrors the engine's
+    draft-verified mode over :class:`SpeculativeKernels`: the same
+    position-keyed draws make a speculative static run emit the
+    ENGINE's exact streams (greedy and sampled), which is the
+    schedule-invariance gate the speculative bench leans on."""
+    draft_model = draft_params = None
+    spec_k = 0
+    if speculate is not None:
+        draft_model, draft_params, spec_k = speculate
+        spec_k = int(spec_k)
     if quantize == "int8":
         from bigdl_tpu.nn.quantized import quantize_for_serving
 
         params = quantize_for_serving(params)
+        if draft_params is not None:
+            draft_params = quantize_for_serving(draft_params)
     elif quantize is not None:
         raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
     if np.dtype(cache_dtype) == np.int8 and not (
@@ -1362,10 +1879,29 @@ def static_generate(model, params, requests, *, max_slots: int,
             "cache_dtype='int8' needs the paged kernels (int8 KV lives in "
             "the page pools with per-token scale pools)")
     if kernels is None:
-        kernels = (PagedDecodeKernels(model)
-                   if page_size and hasattr(model, "decode_step_paged")
-                   else DecodeKernels(model))
+        if speculate is not None:
+            kernels = SpeculativeKernels(model, draft_model)
+        else:
+            kernels = (PagedDecodeKernels(model)
+                       if page_size and hasattr(model, "decode_step_paged")
+                       else DecodeKernels(model))
     requests = [([int(t) for t in p], int(m)) for p, m in requests]
+    if hasattr(kernels, "verify"):  # speculative set (or a wrapper)
+        if speculate is None:
+            raise ValueError(
+                "SpeculativeKernels need speculate=(draft_model, "
+                "draft_params, k)")
+        return _static_generate_spec(
+            model, params, requests, kernels, draft_params, spec_k,
+            max_slots=max_slots, max_len=max_len, eos_id=eos_id,
+            pad_id=pad_id, cache_dtype=cache_dtype,
+            prompt_buckets=prompt_buckets, page_size=page_size,
+            num_pages=num_pages, prefill_chunk=prefill_chunk, seed=seed,
+            sampling=sampling, draft_model=draft_model)
+    if speculate is not None:
+        raise ValueError(
+            "speculate= needs SpeculativeKernels (pass kernels=None to "
+            "build them)")
     if hasattr(kernels, "chunk"):  # paged triple (or a wrapper around one)
         return _static_generate_paged(
             model, params, requests, kernels, max_slots=max_slots,
@@ -1419,6 +1955,152 @@ def static_generate(model, params, requests, *, max_slots: int,
         for i, s in enumerate(states):
             outputs[base + i] = s["tokens"]
     return outputs, total_steps
+
+
+def _static_generate_spec(model, params, requests, kernels, draft_params,
+                          spec_k, *, max_slots, max_len, eos_id, pad_id,
+                          cache_dtype, prompt_buckets, page_size,
+                          num_pages, prefill_chunk, seed, sampling,
+                          draft_model):
+    """Speculative body of :func:`static_generate`: group-at-a-time
+    run-to-completion over the SAME draft/verify kernels the engine
+    runs. Draws are keyed by (request, output position), so the emitted
+    streams are identical to the engine's under any grouping — the
+    speculative analogue of the paged body's schedule invariance.
+    Returns ``(token lists, verify rounds executed)``."""
+    from bigdl_tpu.core.rng import request_seed as _request_seed
+    from bigdl_tpu.core.rng import threefry_key_data as _tkd
+
+    k = int(spec_k)
+    chunk = int(prefill_chunk or min(64, max_len - 1))
+    longest = max(len(p) for p, _ in requests)
+    buckets = list(prompt_buckets or bucket_sizes_for(min(longest, chunk)))
+    num_pages = int(num_pages
+                    or max_slots * 2 * pages_per_lane(max_len, page_size))
+    pool = PagePool(num_pages, page_size, max_len)
+    cache = model.init_paged_cache(num_pages + 1, page_size, cache_dtype)
+    dcache = draft_model.init_paged_cache(num_pages + 1, page_size,
+                                          cache_dtype)
+    ppn = pool.pages_per_slot
+    page_map = np.full((max_slots, ppn), pool.trash, np.int32)
+    dpage_map = np.full((max_slots, ppn), pool.trash, np.int32)
+    temps = np.zeros((max_slots,), np.float32)
+    top_ks = np.zeros((max_slots,), np.int32)
+    top_ps = np.ones((max_slots,), np.float32)
+    keys = np.zeros((max_slots, 2), np.uint32)
+
+    outputs: List[Optional[List[int]]] = [None] * len(requests)
+    total_rounds = 0
+    for base in range(0, len(requests), max_slots):
+        group = requests[base:base + max_slots]
+        states = []
+        for slot, (prompt, mnt) in enumerate(group):
+            n = len(prompt)
+            target = min(mnt, max_len - n)
+            spec = dict(sampling[base + slot] or {}) if sampling else {}
+            req_seed = spec.get("seed")
+            if req_seed is None:
+                req_seed = _request_seed(
+                    seed, np.asarray(prompt, np.int32).tobytes(), n)
+            temps[slot] = float(spec.get("temperature", 0.0))
+            top_ks[slot] = int(spec.get("top_k", 0))
+            top_ps[slot] = float(spec.get("top_p", 1.0))
+            keys[slot] = _tkd(req_seed)
+            need = pool.pages_for(min(n + target - 1, max_len))
+            if not pool.can_reserve(2 * need):
+                raise ValueError(
+                    f"num_pages={num_pages} cannot hold a speculative "
+                    f"static group (needs {2 * need} more pages) — grow "
+                    f"the pool or shrink max_slots")
+            pages = pool.alloc(need, owner="target")
+            dpages = pool.alloc(need, owner="draft")
+            page_map[slot, :] = pool.trash
+            page_map[slot, :len(pages)] = pages
+            dpage_map[slot, :] = pool.trash
+            dpage_map[slot, :len(dpages)] = dpages
+            start = 0
+            while n - start > chunk:
+                piece = np.asarray(prompt[start:start + chunk], np.int32)
+                cache = kernels.chunk(params, cache, page_map[slot],
+                                      piece, start, chunk, pool.trash)
+                dcache = kernels.draft_write(
+                    draft_params, dcache, dpage_map[slot], piece, start,
+                    chunk, pool.trash)
+                start += chunk
+            remaining = n - start
+            bucket = next(b for b in buckets if b >= remaining)
+            padded = np.full((bucket,), pad_id, np.int32)
+            padded[:remaining] = prompt[start:]
+            tok_dev, cache = kernels.prefill(
+                params, cache, page_map[slot], padded, start, remaining,
+                pool.trash, temps[slot], top_ks[slot], top_ps[slot],
+                keys[slot])
+            dcache = kernels.draft_write(
+                draft_params, dcache, dpage_map[slot], padded, start,
+                remaining, pool.trash)
+            tok = int(np.asarray(tok_dev))
+            states.append({
+                "tokens": [tok], "last": tok, "pos": n,
+                "target": target, "pages": pages, "dpages": dpages,
+                "done": (eos_id is not None and tok == eos_id) or target <= 1,
+            })
+        while not all(s["done"] for s in states):
+            tokens = np.zeros((max_slots,), np.int32)
+            positions = np.zeros((max_slots,), np.int32)
+            out_base = np.zeros((max_slots,), np.int32)
+            for slot, s in enumerate(states):
+                tokens[slot] = s["last"]
+                positions[slot] = s["pos"]
+                out_base[slot] = len(s["tokens"])
+            d_tokens = []
+            d_dists = []
+            cur = tokens
+            for i in range(k + 1):
+                pos_i = np.minimum(positions + i, max_len - 1)
+                cur, dist, dcache = kernels.draft(
+                    draft_params, dcache, cur, pos_i, dpage_map, temps,
+                    top_ks, top_ps, keys, out_base + i)
+                cur = np.asarray(cur)   # one executable: see engine loop
+                if i < k:
+                    d_tokens.append(cur)
+                    d_dists.append(dist)
+            n_dev, out_dev, cache = kernels.verify(
+                params, cache, tokens, d_tokens, positions, page_map,
+                pool.trash, temps, top_ks, top_ps, keys, out_base,
+                d_dists)
+            n_acc = np.asarray(n_dev)
+            outs = np.asarray(out_dev)
+            total_rounds += 1
+            for slot, s in enumerate(states):
+                if s["done"]:
+                    continue
+                room = min(s["target"] - len(s["tokens"]),
+                           max_len - s["pos"])
+                emit = min(int(n_acc[slot]) + 1, room)
+                pushed = 0
+                for j in range(emit):
+                    tok = int(outs[slot, j])
+                    s["tokens"].append(tok)
+                    pushed += 1
+                    if eos_id is not None and tok == eos_id:
+                        break
+                s["last"] = int(outs[slot, pushed - 1])
+                s["pos"] += pushed
+                if ((eos_id is not None and s["last"] == eos_id)
+                        or len(s["tokens"]) >= s["target"]
+                        or s["pos"] >= max_len):
+                    s["done"] = True
+        for i, s in enumerate(states):
+            outputs[base + i] = s["tokens"]
+            pool.release(s["pages"])
+            pool.release(s["dpages"])
+        page_map[:] = pool.trash
+        dpage_map[:] = pool.trash
+        temps[:] = 0.0
+        top_ks[:] = 0
+        top_ps[:] = 1.0
+        keys[:] = 0
+    return outputs, total_rounds
 
 
 def _static_generate_paged(model, params, requests, kernels, *, max_slots,

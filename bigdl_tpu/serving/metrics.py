@@ -99,6 +99,12 @@ class ServingMetrics:
         self.kv_bytes_in_use = 0     # reserved KV bytes, scale pools incl.
         self.kv_cache_dtype = ""     # "" until a paged engine reports one
         self.quantized_gemms = 0     # int8 GEMMs in the serving params
+        # speculative-decoding counters (PR 10); zero for a
+        # non-speculative engine — snapshot/table keep the earlier
+        # shapes (same append-only golden contract as every block above)
+        self.draft_tokens = 0        # candidate tokens the draft proposed
+        self.accepted_tokens = 0     # candidates the verify step accepted
+        self.verify_steps = 0        # executed target verify forwards
 
     # ------------------------------------------------------- mutators ----
 
@@ -202,6 +208,24 @@ class ServingMetrics:
         with self._lock:
             self.quantized_gemms = int(n)
 
+    # ------------------------------------------ speculative mutators ----
+
+    def record_verify_step(self, n_draft: int, n_accepted: int,
+                           n_extra_tokens: int = 0) -> None:
+        """One speculative round's target verify forward: the draft
+        proposed ``n_draft`` candidate tokens across the batch and
+        ``n_accepted`` of them were accepted AND emitted.
+        ``n_extra_tokens`` is the round's emitted tokens beyond the
+        one-per-active-slot that ``record_decode_step`` already counted
+        (speculation's whole win) — they fold into ``tokens_out``.
+        ``acceptance_rate`` is a property of the draft's proposals
+        alone: accepted / drafted."""
+        with self._lock:
+            self.verify_steps += 1
+            self.draft_tokens += int(n_draft)
+            self.accepted_tokens += int(n_accepted)
+            self.tokens_out += int(n_extra_tokens)
+
     # --------------------------------------------- replica mutators ----
 
     def set_replicas(self, healthy: int, total: int,
@@ -299,6 +323,14 @@ class ServingMetrics:
                 "kv_bytes_in_use": self.kv_bytes_in_use,
                 "kv_cache_dtype": self.kv_cache_dtype,
                 "quantized_gemms": self.quantized_gemms,
+                # speculative-decoding fields (PR 10): appended after
+                # every earlier key, never reordered
+                "draft_tokens": self.draft_tokens,
+                "accepted_tokens": self.accepted_tokens,
+                "acceptance_rate": (self.accepted_tokens
+                                    / self.draft_tokens
+                                    if self.draft_tokens else 0.0),
+                "verify_steps": self.verify_steps,
             }
 
     def format_table(self) -> str:
@@ -369,4 +401,13 @@ class ServingMetrics:
             row("kv_bytes_in_use", s["kv_bytes_in_use"])
             row("kv_cache_dtype", s["kv_cache_dtype"] or "-")
             row("quantized_gemms", s["quantized_gemms"])
+        # speculative rows: appended strictly after the quantized block
+        # and only when a speculative engine actually verified — every
+        # earlier table stays a byte-identical strict prefix
+        # (append-only golden contract, test-enforced)
+        if s["verify_steps"]:
+            row("draft_tokens", s["draft_tokens"])
+            row("accepted_tokens", s["accepted_tokens"])
+            row("acceptance_rate", f"{s['acceptance_rate'] * 100:.1f}%")
+            row("verify_steps", s["verify_steps"])
         return "\n".join(lines)

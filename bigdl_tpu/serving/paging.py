@@ -30,7 +30,7 @@ Policy notes:
 from __future__ import annotations
 
 import heapq
-from typing import List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 def page_bytes(page_size: int, num_heads: int, head_dim: int,
@@ -79,6 +79,15 @@ class PagePool:
         self._free: List[int] = list(range(self.num_pages))
         heapq.heapify(self._free)
         self.in_use = 0  # peak tracking lives in ServingMetrics.set_pages
+        # owner tag per reserved page id (only for tagged allocs): one
+        # slot may hold SEVERAL reservations — a speculative engine
+        # reserves a target lane and a draft lane side by side — and the
+        # drain invariants ("every lane returned") need to be assertable
+        # per owner, not just in aggregate. release() looks the tag up
+        # by page id, so callers cannot desync the per-owner gauges by
+        # forgetting to repeat the tag.
+        self._page_owner: Dict[int, str] = {}
+        self._owner_counts: Dict[str, int] = {}
 
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` KV rows (>= 1)."""
@@ -87,22 +96,38 @@ class PagePool:
     def can_reserve(self, n: int) -> bool:
         return len(self._free) >= n
 
-    def alloc(self, n: int) -> List[int]:
-        """Reserve ``n`` pages (smallest ids first). Raises if the pool
-        cannot satisfy the request — callers gate on :meth:`can_reserve`
-        at admission, so this firing means an accounting bug."""
+    def alloc(self, n: int, owner: Optional[str] = None) -> List[int]:
+        """Reserve ``n`` pages (smallest ids first), optionally tagged
+        with an ``owner`` label (e.g. ``"target"`` / ``"draft"`` lanes).
+        Raises if the pool cannot satisfy the request — callers gate on
+        :meth:`can_reserve` at admission, so this firing means an
+        accounting bug."""
         if n > len(self._free):
             raise RuntimeError(
                 f"page pool exhausted: want {n}, have {len(self._free)} "
                 f"of {self.num_pages}")
         pages = [heapq.heappop(self._free) for _ in range(n)]
         self.in_use += n
+        if owner is not None:
+            for p in pages:
+                self._page_owner[p] = owner
+            self._owner_counts[owner] = (
+                self._owner_counts.get(owner, 0) + n)
         return pages
 
     def release(self, pages: Sequence[int]) -> None:
         for p in pages:
-            heapq.heappush(self._free, int(p))
+            p = int(p)
+            heapq.heappush(self._free, p)
+            owner = self._page_owner.pop(p, None)
+            if owner is not None:
+                self._owner_counts[owner] -= 1
         self.in_use -= len(pages)
+
+    def in_use_by(self, owner: str) -> int:
+        """Reserved pages currently tagged ``owner`` (0 for unknown
+        owners) — the per-lane drain gauge the speculative tests pin."""
+        return self._owner_counts.get(owner, 0)
 
     @property
     def free_pages(self) -> int:

@@ -186,6 +186,23 @@ def test_continuous_batching_demo_runs():
     assert snap["continuous_vs_static"] > 0
 
 
+def test_speculative_decoding_demo_runs():
+    """The speculative demo: draft+target behind the router, zero greedy
+    mismatches (asserted inside), self-draft acceptance near the upper
+    bound, and the target amortized over more tokens than forwards."""
+    from bigdl_tpu.examples import speculative_decoding_demo
+
+    snap = speculative_decoding_demo.main(
+        ["-n", "8", "-s", "2", "--new", "12", "--max-len", "48"])
+    assert snap["mismatches"] == 0
+    assert snap["verify_steps"] > 0
+    assert snap["acceptance_rate"] >= 0.5  # self-draft: near the bound
+    # amortization clearly above the zero-acceptance floor (~`slots`
+    # tokens per verify from batching alone; self-draft at k=3 lands
+    # near the k+1=4-per-slot ceiling)
+    assert snap["tokens_per_verify"] > 4.0
+
+
 def test_parallel_training_example_runs():
     from bigdl_tpu.examples import parallel_training
 
