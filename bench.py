@@ -196,6 +196,32 @@ def run_host_pipeline(model, criterion, method, batch, n_iters, compute_dtype,
     return batch * chunk / dt
 
 
+def _write_metrics_out(args, sources):
+    """``--metrics-out PATH``: dump an ``obs.MetricsRegistry`` JSON
+    ``collect()`` over everything this run touched — the machine-
+    readable capture path behind the "columns bench.py grew in PRs 3-10
+    but BENCH_r* never recorded" debt (CI uploads these from the smoke
+    steps). ``sources`` maps registry names to metric sources (None
+    entries skip); the process fault injector and flight recorder ride
+    along in every mode."""
+    path = getattr(args, "metrics_out", None)
+    if not path:
+        return
+    from bigdl_tpu import faults
+    from bigdl_tpu.obs import MetricsRegistry, flight_recorder, to_json
+
+    reg = MetricsRegistry()
+    for name, src in sources.items():
+        if src is None:
+            continue
+        reg.register(name, src)
+    reg.register("faults", faults.default())
+    reg.register("flight_recorder", flight_recorder())
+    with open(path, "w") as fh:
+        fh.write(to_json(reg.collect(), indent=2) + "\n")
+    print(f"metrics-out: wrote {path}", file=sys.stderr)
+
+
 def run_serving_bench(args):
     """Serving-tier benchmark: N client threads of single-image requests
     against ``bigdl_tpu.serving.InferenceService`` (dynamic batching).
@@ -248,6 +274,7 @@ def run_serving_bench(args):
 
     snap = svc.metrics.snapshot()
     lat = snap["latency_ms"] or {}
+    _write_metrics_out(args, {"serving": svc.metrics})
     print(json.dumps({
         "metric": "resnet50_serving_requests_per_sec",
         "value": round(snap["served"] / wall, 2),
@@ -824,6 +851,10 @@ def run_generation_bench(args):
         "timing": "wall-clock submit-all -> last stream done; same jitted "
                   "kernels for both schedulers",
     }
+    _write_metrics_out(args, {"serving": engine.metrics,
+                              "pages": engine._pool,
+                              "timeline": engine.timeline,
+                              "bench": result})
     print(json.dumps(result))
     if smoke:
         required = ("value", "static_tokens_per_sec", "continuous_vs_static",
@@ -1037,6 +1068,7 @@ def run_lm_bench(args):
         result["int8_vs_float_decode"] = round(
             q["decode_tokens_per_sec"]
             / result["decode_tokens_per_sec"], 3)
+    _write_metrics_out(args, {"bench": result})
     print(json.dumps(result))
     if args.smoke:
         need = ["forward_tokens_per_sec", "forward_mfu",
@@ -1150,7 +1182,7 @@ def run_checkpoint_bench(args):
 
     block_ms = blocked_sync / iters * 1e3
     async_ms = blocked_async / iters * 1e3
-    print(json.dumps({
+    result = {
         "metric": "checkpoint_async_step_overhead_ms",
         "value": round(async_ms, 4),
         "unit": "ms/step",
@@ -1176,7 +1208,9 @@ def run_checkpoint_bench(args):
                   "per step (exact); loop_delta_* are whole-loop deltas vs "
                   "the no-save run (jitter-prone); async drain overlaps "
                   "training in real runs",
-    }))
+    }
+    _write_metrics_out(args, {"bench": result})
+    print(json.dumps(result))
 
 
 def run_pipeline_bench(args):
@@ -1413,6 +1447,7 @@ def run_pipeline_bench(args):
             result = retry
         result["retried"] = True
 
+    _write_metrics_out(args, {"bench": result})
     print(json.dumps(result))
     if smoke:
         required = ("value", "stage_rates", "augment_scaling",
@@ -1484,12 +1519,23 @@ def run_chaos_bench(args):
         StreamCancelled,
     )
 
+    from bigdl_tpu.obs import flight_recorder
+
     t_start = time.perf_counter()
     seed = args.chaos_seed
     smoke = args.smoke
     train_iters = args.chaos_iters or (12 if smoke else 24)
     n_requests = args.chaos_requests or (24 if smoke else 64)
     violations = []
+
+    # flight-recorder reconciliation: every armed fault that fires must
+    # leave a structured breadcrumb, so a failed soak is reconstructable
+    # from the recorder instead of a bare traceback. `fired_expected`
+    # accumulates FaultInjector.snapshot() totals across the legs (each
+    # faults.reset() clears the injector history, never the recorder).
+    recorder = flight_recorder()
+    fired_before = recorder.count("fault.fired")
+    fired_expected = 0
 
     def own_threads():
         prefixes = ("bigdl-", "ckpt-writer", "pipeline-")
@@ -1542,6 +1588,7 @@ def run_chaos_bench(args):
                    times=2, exc=OSError)
         chaos_params, chaos_restored = train_once(os.path.join(root, "chaos"))
         train_fired = {s: v["fired"] for s, v in faults.snapshot().items()}
+        fired_expected += sum(train_fired.values())
         faults.reset()
 
         ref_leaves = jax.tree_util.tree_leaves(ref_params)
@@ -1684,6 +1731,7 @@ def run_chaos_bench(args):
         pass
     except Exception as e:
         violations.append(f"watchdog: wrong stall error {e!r}")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
     faults.reset()
     wd_engine.close(timeout=30)
     if wd_engine.pages_in_use:
@@ -1710,9 +1758,15 @@ def run_chaos_bench(args):
     sstreams = []
     for _ in range(3):
         plen = int(rs.randint(1, max_prompt + 1))
-        sstreams.append(spec_engine.submit(
-            rs.randint(1, 60, (plen,)).tolist(),
-            max_new_tokens=int(rs.randint(6, 12))))
+        try:
+            sstreams.append(spec_engine.submit(
+                rs.randint(1, 60, (plen,)).tolist(),
+                max_new_tokens=int(rs.randint(6, 12))))
+        except RuntimeError:
+            # the injected draft fault already stopped the engine:
+            # refusing new submits IS the step contract — the streams
+            # submitted before the fault carry the invariant checks
+            break
     spec_injected = 0
     for s in sstreams:
         try:
@@ -1722,6 +1776,7 @@ def run_chaos_bench(args):
         except Exception as e:
             violations.append(f"speculative: non-API stream error {e!r}")
     faults.disarm("engine.draft")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
     if spec_injected < 1:
         violations.append("speculative: the mid-speculation draft fault "
                           "never failed a stream")
@@ -1749,6 +1804,19 @@ def run_chaos_bench(args):
         if shm_leaked:
             violations.append(f"drain: leaked shm segments: {shm_leaked}")
 
+    # ------------------------------------------------ flight recorder ----
+    # every fault the injector fired must have landed one structured
+    # "fault.fired" event — the reconstructability invariant
+    fired_recorded = recorder.count("fault.fired") - fired_before
+    if fired_recorded != fired_expected:
+        violations.append(
+            f"recorder: {fired_recorded} fault.fired events recorded but "
+            f"the injector fired {fired_expected} — chaos runs must be "
+            f"reconstructable from the flight recorder")
+    if recorder.count("watchdog.stall") < 1:
+        violations.append("recorder: the watchdog stall left no "
+                          "flight-recorder event")
+
     result = {
         "metric": "chaos_soak_pass",
         "value": 0.0 if violations else 1.0,
@@ -1766,6 +1834,8 @@ def run_chaos_bench(args):
         "replica_death_fired": death.fired,
         "submit_faults_fired": flaky_submit.fired,
         "speculative_streams_failed": spec_injected,
+        "recorder_fault_events": fired_recorded,
+        "recorder_fault_expected": fired_expected,
         "threads_leftover": leftover,
         "shm_leaked": shm_leaked,
         "violations": violations,
@@ -1777,8 +1847,15 @@ def run_chaos_bench(args):
         "timing": "invariant soak, not a throughput measurement; all "
                   "fault schedules are pure functions of --chaos-seed",
     }
+    _write_metrics_out(args, {"serving": replicas[0].metrics,
+                              "speculative": spec_engine.metrics,
+                              "bench": result})
     print(json.dumps(result))
     if violations:
+        # the flight recorder's whole point: a failed soak prints what
+        # recently happened, not just which invariant broke
+        print("flight recorder (last 40 events):\n"
+              + recorder.format_events(last=40), file=sys.stderr)
         raise SystemExit("chaos soak FAILED:\n  - " + "\n  - ".join(violations))
 
 
@@ -1899,6 +1976,13 @@ def _parse_args(argv=None):
                          "static tokens/sec AND paged KV admits >= 2x the "
                          "dense concurrent sequences at a fixed KV budget "
                          "(the CI gates)")
+    ap.add_argument("--metrics-out", type=str, default=None,
+                    help="all modes: dump an obs.MetricsRegistry JSON "
+                         "collect() over everything the run touched "
+                         "(serving/pages/timeline/faults/flight recorder "
+                         "+ the result line) to PATH at end of run — the "
+                         "machine-readable artifact CI uploads from the "
+                         "smoke steps")
     ap.add_argument("--batch", type=int, default=0, help="0 = auto")
     ap.add_argument("--short", type=int, default=4)
     ap.add_argument("--long", type=int, default=20)
@@ -2057,7 +2141,7 @@ def run_bench(args):
             print(f"host-pipeline measurement failed: {e}", file=sys.stderr)
             host_rate = xfer_bw = None
 
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         **({"host_pipeline_images_per_sec": round(host_rate, 2),
@@ -2075,7 +2159,9 @@ def run_bench(args):
         "mfu_spec_table": None if mfu_spec is None else round(mfu_spec, 4),
         "first_step_loss": round(first_loss, 4),
         "timing": "differential (cancels RPC dispatch overhead; host fetch forces sync)",
-    }))
+    }
+    _write_metrics_out(args, {"bench": result})
+    print(json.dumps(result))
 
 
 _DIAG = {"printed": False}
@@ -2178,6 +2264,8 @@ def supervise(args):
                 "--long", str(args.long)]
         if not (args.host_pipeline and with_host_pipeline):
             argv.append("--no-host-pipeline")
+        if args.metrics_out:
+            argv += ["--metrics-out", args.metrics_out]
         return argv
 
     while True:

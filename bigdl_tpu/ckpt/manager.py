@@ -58,6 +58,7 @@ from bigdl_tpu.ckpt.manifest import (
     write_manifest,
 )
 from bigdl_tpu.faults import RetryPolicy
+from bigdl_tpu.obs.recorder import record_event
 from bigdl_tpu.utils.checkpoint import (
     deserialize_payload,
     latest_checkpoint,
@@ -146,6 +147,13 @@ class CheckpointManager:
         self._closed = False
         self._preempted = threading.Event()
         self._prev_handlers: List[Tuple[int, Any]] = []
+        # obs-tier counters: committed/failed saves and verification
+        # fallbacks during restore, surfaced via snapshot() into the
+        # metrics registry (the manifest itself stays the durable truth)
+        self.commits = 0
+        self.commit_failures = 0
+        self.restores = 0
+        self.restore_fallbacks = 0  # manifest entries skipped (corrupt)
 
     # ------------------------------------------------------------- save --
     def save(
@@ -201,6 +209,22 @@ class CheckpointManager:
         return handle
 
     def _commit(self, tag, snapshot, meta, step, preempted) -> ManifestEntry:
+        try:
+            entry = self._commit_inner(tag, snapshot, meta, step, preempted)
+        except BaseException as e:
+            with self._lock:
+                self.commit_failures += 1
+            record_event("ckpt.commit_failed", tag=tag, step=int(step),
+                         error=type(e).__name__)
+            raise
+        with self._lock:
+            self.commits += 1
+        record_event("ckpt.commit", tag=tag, step=entry.step,
+                     preempted=entry.preempted)
+        return entry
+
+    def _commit_inner(self, tag, snapshot, meta, step,
+                      preempted) -> ManifestEntry:
         blob = serialize_payload(snapshot["params"], snapshot["module_state"],
                                  snapshot["optim_state"])
         meta.setdefault("wall_time", time.time())
@@ -335,6 +359,10 @@ class CheckpointManager:
         for entry in reversed(entries):
             blob = verify_entry(self.directory, entry)
             if blob is None:
+                with self._lock:
+                    self.restore_fallbacks += 1
+                record_event("ckpt.fallback", tag=entry.tag,
+                             why="blob_verification")
                 log.warning(
                     "checkpoint '%s' failed verification (missing, "
                     "truncated, or checksum mismatch); falling back to the "
@@ -343,6 +371,10 @@ class CheckpointManager:
             if not verify_shards(self.directory, entry):
                 # a sharded entry restores only when EVERY host shard
                 # verifies — one torn shard fails the whole entry over
+                with self._lock:
+                    self.restore_fallbacks += 1
+                record_event("ckpt.fallback", tag=entry.tag,
+                             why="shard_verification")
                 log.warning(
                     "checkpoint '%s' has a missing or corrupt per-host "
                     "shard; falling back to the previous manifest entry",
@@ -363,6 +395,8 @@ class CheckpointManager:
                     "provided template — structure/config mismatch (e.g. "
                     "a different model or optim method), not disk "
                     "corruption") from e
+            with self._lock:
+                self.restores += 1
             return payload, entry
         if entries:
             # every manifest entry failed verification: do NOT fall through
@@ -490,6 +524,21 @@ class CheckpointManager:
         self._preempted.set()
 
     # -------------------------------------------------------- queries --
+    def snapshot(self) -> Dict[str, Any]:
+        """Registry-friendly gauges: commit/fallback counters, pending
+        saves, and the healing policy's retry counts. Pure host state —
+        no manifest read, so scraping cannot hit the disk."""
+        with self._lock:
+            pending = sum(1 for h in self._inflight.values()
+                          if not h.done())
+            return {"commits": self.commits,
+                    "commit_failures": self.commit_failures,
+                    "restores": self.restores,
+                    "restore_fallbacks": self.restore_fallbacks,
+                    "pending_saves": pending,
+                    "preemption_requested": self._preempted.is_set(),
+                    "retry": self.retry.snapshot()}
+
     def entries(self) -> List[ManifestEntry]:
         """Committed entries, oldest -> newest."""
         return load_manifest(self.directory)

@@ -41,6 +41,7 @@ import zlib
 from typing import Any, Callable, Dict, Optional
 
 from bigdl_tpu.core.rng import uniform01
+from bigdl_tpu.obs.recorder import record_event
 
 # Catalogue of the sites wired into the stack (name -> where it fires).
 # Purely documentary — fire() accepts any name, and tests may invent
@@ -228,6 +229,14 @@ class FaultInjector:
             exc = None if (spec.latency > 0 and spec.exc is None) \
                 else spec._build_exc()
             latency = spec.latency
+            call_index = spec.calls
+        # flight-recorder breadcrumb (outside the lock, before the
+        # effect lands): chaos runs reconcile these against snapshot()
+        # to prove every scheduled fault is reconstructable
+        record_event("fault.fired", site=site, key=key, call=call_index,
+                     effect=("latency" if exc is None
+                             else type(exc).__name__),
+                     latency=latency)
         if latency > 0:
             time.sleep(latency)  # outside the lock: never stall siblings
         if exc is not None:

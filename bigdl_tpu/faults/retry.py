@@ -20,10 +20,12 @@ schedule for loops that wait rather than call (the prober).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Callable, Optional, Tuple, Type
 
 from bigdl_tpu.core.rng import uniform01
+from bigdl_tpu.obs.recorder import record_event
 
 log = logging.getLogger("bigdl_tpu.faults")
 
@@ -62,6 +64,20 @@ class RetryPolicy:
         self.seed = int(seed)
         self.transient = tuple(transient)
         self.classify = classify
+        # healing gauges (obs tier): how often this policy absorbed a
+        # transient, and how often the budget ran out anyway — the
+        # registry surfaces them next to the counters of whatever the
+        # policy protects (ckpt writer, watcher, prober)
+        self._lock = threading.Lock()
+        self.retries = 0      # transient failures retried (healed-so-far)
+        self.exhaustions = 0  # budgets exhausted (last error re-raised)
+
+    def snapshot(self) -> dict:
+        """Registry-friendly counters."""
+        with self._lock:
+            return {"retries": self.retries,
+                    "exhaustions": self.exhaustions,
+                    "max_attempts": self.max_attempts}
 
     @classmethod
     def poll_schedule(cls, base_interval: float, *,
@@ -131,7 +147,20 @@ class RetryPolicy:
             except BaseException as e:
                 if not self.is_transient(e) \
                         or attempt + 1 >= self.max_attempts:
+                    if self.is_transient(e):
+                        # transient but out of budget: exhaustion, not
+                        # a permanent error — count it so the registry
+                        # can tell "healed" from "gave up"
+                        with self._lock:
+                            self.exhaustions += 1
+                        record_event("retry.exhausted", what=what,
+                                     error=type(e).__name__,
+                                     attempts=self.max_attempts)
                     raise
+                with self._lock:
+                    self.retries += 1
+                record_event("retry", what=what, error=type(e).__name__,
+                             attempt=attempt + 1)
                 delay = self.backoff(attempt)
                 log.warning(
                     "%s failed with transient %s: %s — retrying in %.3fs "

@@ -123,7 +123,17 @@ class Watchdog:
                     f"watchdog '{self.name}': no progress in {label} for "
                     f"{age:.1f}s (deadline {self.timeout:.1f}s) — failing "
                     "pending work instead of hanging")
+            from bigdl_tpu.obs.recorder import flight_recorder
+
+            recorder = flight_recorder()
+            recorder.record("watchdog.stall", name=self.name, label=label,
+                            age=round(age, 3), timeout=self.timeout)
             log.error("%s", err)
+            # the stall is exactly the moment "what just happened?"
+            # matters: dump the recorder's recent events next to the
+            # diagnostic instead of leaving a bare error line
+            log.error("flight recorder (last 16 events):\n%s",
+                      recorder.format_events(last=16))
             try:
                 self.on_stall(err)
             except Exception:

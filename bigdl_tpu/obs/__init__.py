@@ -1,0 +1,70 @@
+"""Unified telemetry plane: tracing, metrics registry, export, flight
+recorder, and the engine step timeline.
+
+The reference made TRAINING observable (``DL/visualization/Summary
+.scala`` -> this repo's ``visualization/`` TensorBoard tier); the
+serving stack grew far past it with only per-component ad-hoc
+``snapshot()`` dicts. This package is the common plane on top — it
+WRAPS the existing per-component surfaces (whose shapes stay
+golden-order test-pinned), never replaces them:
+
+- :class:`Tracer` / :class:`RequestTrace` — per-request span trees for
+  the full serving lifecycle (submit -> queue wait -> page reservation
+  -> prefill chunks -> counted decode/verify steps -> retirement),
+  carried on the stream/future through ``ModelRouter -> ReplicaSet ->
+  GenerationEngine``; JSONL export + :func:`format_trace` waterfalls;
+  disabled cost is one ``is None`` test (< 2 us, test-pinned);
+- :class:`MetricsRegistry` — components register their gauges once,
+  one ``collect()`` produces a flat stable-key snapshot across
+  serving + paging + replicas + ckpt + faults + pipeline + train;
+- :func:`to_prometheus` / :func:`to_json` — exporters over a collect;
+- :class:`MetricsEndpoint` — stdlib HTTP thread serving ``/metrics``
+  (text exposition), ``/metrics.json``, and ``/healthz`` (aggregated
+  :func:`engine_health` / :func:`replica_health` checks — the probe
+  surface the cross-host fleet will reuse);
+- :class:`FlightRecorder` / :func:`record_event` — bounded ring of
+  structured incidents (faults fired, evictions/rejoins, watchdog
+  stalls, retries, checkpoint commits/fallbacks) so a failed soak
+  prints the last N events instead of a bare traceback;
+- :class:`StepTimeline` — per-iteration engine breakdown (host
+  scheduling vs device wait, prefill/decode/verify split, queue depth
+  and occupancy), always on, bounded.
+
+See README "Observability" for the wiring recipe and runbook.
+"""
+
+from bigdl_tpu.obs.endpoint import (
+    MetricsEndpoint,
+    engine_health,
+    replica_health,
+)
+from bigdl_tpu.obs.exporters import prometheus_name, to_json, to_prometheus
+from bigdl_tpu.obs.recorder import FlightRecorder, flight_recorder, record_event
+from bigdl_tpu.obs.registry import MetricsRegistry
+from bigdl_tpu.obs.timeline import StepTimeline
+from bigdl_tpu.obs.trace import (
+    RequestTrace,
+    Span,
+    Tracer,
+    format_trace,
+    submit_trace,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "MetricsEndpoint",
+    "MetricsRegistry",
+    "RequestTrace",
+    "Span",
+    "StepTimeline",
+    "Tracer",
+    "engine_health",
+    "flight_recorder",
+    "format_trace",
+    "prometheus_name",
+    "record_event",
+    "replica_health",
+    "submit_trace",
+    "to_json",
+    "to_prometheus",
+]

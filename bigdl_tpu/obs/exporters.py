@@ -1,0 +1,71 @@
+"""Exporters: render a registry snapshot as Prometheus text or JSON.
+
+Prometheus text exposition format (version 0.0.4) is the scrape lingua
+franca — the autoscaling and canary items on the ROADMAP both consume
+it. Rules applied here:
+
+- metric names are ``<prefix>_<key>`` with every character outside
+  ``[a-zA-Z0-9_]`` mapped to ``_`` (the exposition charset); the prefix
+  guarantees a legal leading character;
+- only numeric values export (bools as 0/1); strings and Nones are
+  registry/JSON-only detail — Prometheus gauges are numbers;
+- every metric renders exactly once: a post-sanitization collision
+  (``a.b`` vs ``a_b``) keeps the FIRST key, matching the registry's
+  insertion order (and the round-trip test asserts uniqueness);
+- everything is typed ``gauge`` with the raw dotted key as HELP —
+  counters monotonically increase anyway, and rate() works on gauges
+  scraped as such for this stack's purposes.
+
+The JSON exporter is the machine-readable artifact path
+(``bench.py --metrics-out``): the registry's flat dict verbatim, plus
+nothing — timestamps and run metadata belong to the caller's envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Any, Dict
+
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prometheus_name(key: str, prefix: str = "bigdl") -> str:
+    """Registry key -> legal exposition metric name."""
+    return f"{prefix}_{_SANITIZE.sub('_', str(key))}"
+
+
+def _format_value(v) -> str:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(v)
+    return str(v)
+
+
+def to_prometheus(flat: Dict[str, Any], prefix: str = "bigdl") -> str:
+    """Render a flat registry snapshot as text exposition format."""
+    lines = []
+    seen = set()
+    for key, v in flat.items():
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            continue  # strings/None stay JSON-only
+        name = prometheus_name(key, prefix)
+        if name in seen:
+            continue  # first key wins (registry insertion order)
+        seen.add(name)
+        lines.append(f"# HELP {name} {key}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_format_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def to_json(flat: Dict[str, Any], indent=None) -> str:
+    """Render a flat registry snapshot as JSON (non-JSON-able values
+    stringify rather than fail the dump)."""
+    return json.dumps(flat, indent=indent, default=str)

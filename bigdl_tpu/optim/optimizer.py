@@ -110,6 +110,11 @@ class Optimizer:
         self.watchdog_timeout: Optional[float] = None
         self._watchdog_on_stall: Optional[Callable] = None
         self.watchdog_error = None
+        # obs tier (set_metrics_registry): the registry the per-step
+        # gauges publish into, and the last-iteration values it reads
+        self.obs_registry = None
+        self._last_lr = 0.0
+        self._last_throughput = 0.0
         self._rng = jax.random.key(self.config.seed)
 
     # ------------------------------------------------ builder setters ----
@@ -238,6 +243,33 @@ class Optimizer:
                 fail(err)
                 return
             ds = getattr(ds, "base", None)
+
+    def set_metrics_registry(self, registry,
+                             name: str = "train") -> "Optimizer":
+        """Publish the train-side step gauges (loss / throughput /
+        learning rate / iteration) into an
+        :class:`~bigdl_tpu.obs.MetricsRegistry`, NEXT TO — not instead
+        of — the TensorBoard summary writer: one ``collect()`` then
+        surfaces training beside the serving/paging/replica/ckpt/fault
+        gauges. When a parallel input pipeline or a checkpoint manager
+        is configured (call this AFTER ``set_data_pipeline`` /
+        ``set_checkpoint``), their per-stage rates and commit counters
+        register under ``<name>.pipeline`` / ``<name>.ckpt``."""
+        registry.register(name, self._obs_snapshot)
+        if self.pipeline_stats is not None:
+            registry.register(f"{name}.pipeline", self.pipeline_stats)
+        if self.checkpoint_manager is not None:
+            registry.register(f"{name}.ckpt", self.checkpoint_manager)
+        self.obs_registry = registry
+        return self
+
+    def _obs_snapshot(self) -> dict:
+        """Per-interval step gauges for the metrics registry."""
+        return {"iteration": self.state.iteration,
+                "epoch": self.state.epoch,
+                "loss": self.state.loss,
+                "throughput": self._last_throughput,
+                "learning_rate": self._last_lr}
 
     def set_train_summary(self, summary) -> "Optimizer":
         self.train_summary = summary
@@ -529,6 +561,8 @@ class Optimizer:
             # here since both just advanced together)
             method = next(iter(self.optim_methods.values()))
             lr = float(method.schedule(method.learning_rate, state.iteration - 1, state.epoch))
+            self._last_lr = lr
+            self._last_throughput = bsz / max(dt, 1e-9)
             if state.iteration % self.config.log_every_n_steps == 0:
                 log.info(
                     "Epoch %d iteration %d: loss %.6f, lr %.5g. Throughput is %.1f records/second.",

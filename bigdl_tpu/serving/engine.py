@@ -76,6 +76,8 @@ import numpy as np
 from bigdl_tpu import faults
 from bigdl_tpu.core.rng import request_seed, threefry_key_data
 from bigdl_tpu.faults import StallError, Watchdog
+from bigdl_tpu.obs.timeline import StepTimeline
+from bigdl_tpu.obs.trace import submit_trace
 from bigdl_tpu.ops.sampling import (
     EXTRA_STREAM,
     draft_sample,
@@ -550,6 +552,9 @@ class GenerationStream:
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
+        # per-request trace context (obs.RequestTrace); rides the stream
+        # so routers/replica sets can annotate it without new signatures
+        self.trace = None
 
     # ------------------------------------------------- engine side ----
 
@@ -731,6 +736,9 @@ def _fail_streams(core: _Core, error: BaseException,
     for r in reqs:
         if not r.stream.done:
             r.stream._finish(error)
+        tr = r.stream.trace
+        if tr is not None and not tr.done:
+            tr.finish(outcome="failed", error=type(error).__name__)
 
 
 def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
@@ -834,7 +842,11 @@ class GenerationEngine:
                  shard_axis: str = "tp",
                  stall_timeout: Optional[float] = None,
                  quantize: Optional[str] = None,
-                 speculate: Optional[tuple] = None):
+                 speculate: Optional[tuple] = None,
+                 tracer=None,
+                 timeline_capacity: int = 512,
+                 profile_dir: Optional[str] = None,
+                 profile_iters: int = 10):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -847,6 +859,21 @@ class GenerationEngine:
         self.max_queue = int(max_queue)
         self.metrics = metrics or ServingMetrics()
         self.seed = int(seed)
+        # observability plane (PR 11): `tracer` (an obs.Tracer) turns on
+        # per-request span traces — None (the default) costs one `is
+        # None` test on the submit path and one attribute load per
+        # decode step (the disarmed-fault-site budget, test-pinned).
+        # `timeline` is the always-on bounded per-iteration breakdown
+        # (host vs device, prefill/decode/verify split, queue depth and
+        # occupancy); its aggregate feeds the metrics' engine_steps
+        # block. `profile_dir` arms an opt-in jax.profiler trace
+        # bracketing the first `profile_iters` scheduler iterations.
+        self.tracer = tracer
+        self.timeline = StepTimeline(timeline_capacity)
+        self._profile_dir = profile_dir
+        self._profile_iters = int(profile_iters)
+        self._profile_state = 0   # 0 = armed/idle, 1 = tracing, 2 = done
+        self._profile_count = 0
         # the int8 serving tier (PR 9): `quantize="int8"` rewrites the
         # GEMM weights to per-channel int8 ONCE here (and again inside
         # every reload, so checkpoint watchers keep feeding float
@@ -1157,25 +1184,41 @@ class GenerationEngine:
                     f"or grow num_pages")
         stream = GenerationStream()
         now = stream.t_submit
+        # trace context attaches BEFORE the request can reach the loop
+        # thread (admission reads stream.trace); tracer=None is free
+        tr = submit_trace(self.tracer, "generate", prompt_len=len(prompt),
+                          max_new_tokens=mnt, sampled=temperature > 0.0)
+        stream.trace = tr
         req = _GenRequest(prompt, mnt,
                           None if deadline is None else now + float(deadline),
                           stream, temperature=temperature, top_k=int(top_k),
                           top_p=float(top_p),
                           seed=None if seed is None else int(seed))
         core = self._core
-        with core.cond:
-            if self._failed is not None:
-                raise RuntimeError(
-                    "generation engine stopped after a step failure"
-                ) from self._failed
-            if core.closed:
-                raise RuntimeError("generation engine is closed")
-            if len(core.pending) >= self.max_queue:
-                self.metrics.record_rejected()
-                raise Overloaded(len(core.pending), self.max_queue)
-            core.pending.append(req)
-            depth = len(core.pending)
-            core.cond.notify_all()
+        try:
+            with core.cond:
+                if self._failed is not None:
+                    raise RuntimeError(
+                        "generation engine stopped after a step failure"
+                    ) from self._failed
+                if core.closed:
+                    raise RuntimeError("generation engine is closed")
+                if len(core.pending) >= self.max_queue:
+                    self.metrics.record_rejected()
+                    raise Overloaded(len(core.pending), self.max_queue)
+                if tr is not None:
+                    # BEFORE the enqueue: once the loop thread can see
+                    # the request it may admit, run, and finish() the
+                    # trace — a post-notify event would mutate a trace
+                    # already retired into the finished ring
+                    tr.event("submit", queue_depth=len(core.pending) + 1)
+                core.pending.append(req)
+                depth = len(core.pending)
+                core.cond.notify_all()
+        except BaseException:
+            if tr is not None:
+                tr.finish(outcome="rejected")
+            raise
         self.metrics.set_queue_depth(depth)
         return stream
 
@@ -1223,7 +1266,12 @@ class GenerationEngine:
         (paged: only while the pool can cover the head request's full
         reservation — FIFO, so page pressure delays rather than reorders),
         advance one prefill chunk per prefilling slot, then one decode
-        step over every decoding slot."""
+        step over every decoding slot. Each iteration lands one row in
+        the step timeline (host vs device split) and the aggregate in
+        the metrics' ``engine_steps`` block."""
+        t_iter = time.monotonic()
+        self._profile_tick()
+        prefill_s = decode_s = verify_s = 0.0
         core = self._core
         while True:
             with core.cond:
@@ -1243,16 +1291,54 @@ class GenerationEngine:
             with core.cond:
                 prefilling = sorted((s, st) for s, st in core.active.items()
                                     if st.phase == "prefill")
-            for slot, st in prefilling:
-                self._prefill_chunk_once(slot, st)
+            if prefilling:
+                t0 = time.monotonic()
+                for slot, st in prefilling:
+                    self._prefill_chunk_once(slot, st)
+                prefill_s = time.monotonic() - t0
         with core.cond:
             active = sorted((s, st) for s, st in core.active.items()
                             if st.phase == "decode")
         if active:
+            t0 = time.monotonic()
             if self.speculative:
                 self._speculative_round(active)
+                verify_s = time.monotonic() - t0
             else:
                 self._decode_once(active)
+                decode_s = time.monotonic() - t0
+        with core.cond:
+            depth = len(core.pending)
+            n_active = len(core.active)
+        device_s = prefill_s + decode_s + verify_s
+        host_s = max(0.0, time.monotonic() - t_iter - device_s)
+        self.timeline.record(
+            host_s=host_s, prefill_s=prefill_s, decode_s=decode_s,
+            verify_s=verify_s, active=n_active, queue_depth=depth,
+            occupancy=n_active / self.max_slots,
+            pages_in_use=self._pool.in_use if self.paged else 0)
+        self.metrics.record_engine_step(host_s, device_s)
+
+    def _profile_tick(self) -> None:
+        """Opt-in ``jax.profiler`` bracket: with ``profile_dir`` set,
+        start a device trace at the first scheduler iteration and stop
+        it after ``profile_iters`` — the on-chip step breakdown the
+        BENCH/MFU round reads. Never lets a profiler failure (no
+        backend support, a second concurrent trace) break serving."""
+        if self._profile_dir is None or self._profile_state == 2:
+            return
+        try:
+            if self._profile_state == 0:
+                jax.profiler.start_trace(self._profile_dir)
+                self._profile_state = 1
+                return
+            self._profile_count += 1
+            if self._profile_count >= self._profile_iters:
+                jax.profiler.stop_trace()
+                self._profile_state = 2
+        except Exception:
+            log.exception("engine profiler bracket failed; disabled")
+            self._profile_state = 2
 
     def _report_pages(self) -> None:
         """Publish page occupancy plus the dtype-aware byte gauge (the
@@ -1312,6 +1398,11 @@ class GenerationEngine:
             core.free.sort()
             slot = core.free.pop(0)
         need = self._pages_needed(req)
+        tr = req.stream.trace
+        reserve_sp = None
+        if tr is not None:
+            tr.span("queue_wait", tr.t0)
+            reserve_sp = tr.begin_span("page_reserve")
         pages = self._pool.alloc(need, owner="target")
         row = np.full((self._pool.pages_per_slot,), self._pool.trash,
                       np.int32)
@@ -1326,6 +1417,8 @@ class GenerationEngine:
             drow = np.full((self._pool.pages_per_slot,), self._pool.trash,
                            np.int32)
             drow[:len(draft_pages)] = draft_pages
+        if tr is not None:
+            tr.end_span(reserve_sp, pages=need * self._lanes, slot=slot)
         st = _SlotState(req, self.pad_id, 0, 0, now, phase="prefill",
                         pages=pages, page_row=row, prefill_pos=0,
                         draft_pages=draft_pages, dpage_row=drow)
@@ -1350,7 +1443,10 @@ class GenerationEngine:
         start = st.prefill_pos
         remaining = len(prompt) - start
         pages_row = st.page_row  # NOT self._page_map: see _admit_paged
+        tr = req.stream.trace
         if remaining > self.prefill_chunk:
+            sp = (tr.begin_span("prefill_chunk") if tr is not None
+                  else None)
             tokens = np.asarray(prompt[start:start + self.prefill_chunk],
                                 np.int32)
             self._cache = self.kernels.chunk(
@@ -1365,7 +1461,10 @@ class GenerationEngine:
             st.prefill_pos += self.prefill_chunk
             st.position = st.prefill_pos
             self.metrics.record_chunk(self.prefill_chunk, self.prefill_chunk)
+            if tr is not None:
+                tr.end_span(sp, tokens=self.prefill_chunk, final=False)
             return
+        final_sp = tr.begin_span("prefill_chunk") if tr is not None else None
         bucket = next(b for b in self.prompt_buckets if b >= remaining)
         padded = np.full((bucket,), self.pad_id, np.int32)
         padded[:remaining] = prompt[start:]
@@ -1404,6 +1503,9 @@ class GenerationEngine:
                                     now - req.stream.t_submit)
         if req.sampled:
             self.metrics.record_sampled(1)
+        if tr is not None:
+            tr.end_span(final_sp, tokens=remaining, final=True)
+            tr.event("first_token")
         req.stream._push(tok, now)
         st.phase = "decode"
         st.last_token = tok
@@ -1448,6 +1550,11 @@ class GenerationEngine:
         with core.cond:
             core.free.sort()
             slot = core.free.pop(0)
+        tr = req.stream.trace
+        sp = None
+        if tr is not None:
+            tr.span("queue_wait", tr.t0)
+            sp = tr.begin_span("prefill_chunk", slot=slot)
         n = len(req.prompt)
         bucket = next(b for b in self.prompt_buckets if b >= n)
         padded = np.full((bucket,), self.pad_id, np.int32)
@@ -1457,6 +1564,9 @@ class GenerationEngine:
         tok = int(np.asarray(tok_dev))
         now = time.monotonic()
         self.metrics.record_prefill(n, bucket, now - req.stream.t_submit)
+        if tr is not None:
+            tr.end_span(sp, tokens=n, final=True)
+            tr.event("first_token")
         req.stream._push(tok, now)
         st = _SlotState(req, tok, n, 1, now)
         why = self._retire_why(st, req, now)
@@ -1498,6 +1608,9 @@ class GenerationEngine:
             st.position += 1
             st.generated += 1
             sampled += st.req.sampled
+            tr = st.req.stream.trace
+            if tr is not None:
+                tr.tick("decode")
             st.req.stream._push(tok, now)
             why = self._retire_why(st, st.req, now)
             if why is not None:
@@ -1576,6 +1689,9 @@ class GenerationEngine:
                     break
             accepted_total += min(int(n_acc[slot]), pushed)
             pushed_total += pushed
+            tr = st.req.stream.trace
+            if tr is not None:
+                tr.tick("verify_round")
             st.last_token = int(outs[slot, pushed - 1])
             st.position += pushed
             st.generated += pushed
@@ -1630,6 +1746,9 @@ class GenerationEngine:
             self.metrics.record_served(dur, queue_wait or 0.0)
             self.metrics.record_stream(generated, dur)
             stream._finish(None, now)
+        tr = stream.trace
+        if tr is not None:
+            tr.finish(outcome=why, tokens=generated)
 
     # -------------------------------------------------------- lifecycle ----
 
@@ -1757,6 +1876,14 @@ class GenerationEngine:
             core.drain = drain
             core.cond.notify_all()
         self._thread.join(timeout)
+        if self._profile_state == 1:
+            # a profile bracket wider than the traffic that ran: close
+            # it rather than leak an open device trace
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                log.exception("stopping engine profiler trace failed")
+            self._profile_state = 2
         if self._watchdog is not None and not self._thread.is_alive():
             self._watchdog.close()
         if not self._thread.is_alive():

@@ -105,6 +105,14 @@ class ServingMetrics:
         self.draft_tokens = 0        # candidate tokens the draft proposed
         self.accepted_tokens = 0     # candidates the verify step accepted
         self.verify_steps = 0        # executed target verify forwards
+        # engine step-timeline counters (PR 11); zero until an engine
+        # scheduler loop actually iterates — snapshot/table keep the
+        # earlier shapes (same append-only golden contract as every
+        # block above). The per-iteration detail lives in the engine's
+        # obs.StepTimeline ring; these are the aggregate split.
+        self.engine_steps = 0        # scheduler loop iterations
+        self.step_host_s = 0.0       # host scheduling/bookkeeping time
+        self.step_device_s = 0.0     # kernel-call wait (all phases)
 
     # ------------------------------------------------------- mutators ----
 
@@ -226,6 +234,17 @@ class ServingMetrics:
             self.accepted_tokens += int(n_accepted)
             self.tokens_out += int(n_extra_tokens)
 
+    # ------------------------------------------- step-timeline mutators ----
+
+    def record_engine_step(self, host_s: float, device_s: float) -> None:
+        """One engine scheduler iteration: ``host_s`` spent on host-side
+        scheduling/bookkeeping, ``device_s`` inside the iteration's
+        kernel-call regions (prefill chunks + decode/verify)."""
+        with self._lock:
+            self.engine_steps += 1
+            self.step_host_s += float(host_s)
+            self.step_device_s += float(device_s)
+
     # --------------------------------------------- replica mutators ----
 
     def set_replicas(self, healthy: int, total: int,
@@ -331,6 +350,15 @@ class ServingMetrics:
                                     / self.draft_tokens
                                     if self.draft_tokens else 0.0),
                 "verify_steps": self.verify_steps,
+                # engine step-timeline fields (PR 11): appended after
+                # every earlier key, never reordered
+                "engine_steps": self.engine_steps,
+                "step_host_ms": round(self.step_host_s * 1e3, 3),
+                "step_device_ms": round(self.step_device_s * 1e3, 3),
+                "step_host_frac": (
+                    self.step_host_s
+                    / (self.step_host_s + self.step_device_s)
+                    if self.step_host_s + self.step_device_s else 0.0),
             }
 
     def format_table(self) -> str:
@@ -410,4 +438,13 @@ class ServingMetrics:
             row("accepted_tokens", s["accepted_tokens"])
             row("acceptance_rate", f"{s['acceptance_rate'] * 100:.1f}%")
             row("verify_steps", s["verify_steps"])
+        # step-timeline rows: appended strictly after the speculative
+        # block and only when an engine scheduler loop actually
+        # iterated — every earlier table stays a byte-identical strict
+        # prefix (append-only golden contract, test-enforced)
+        if s["engine_steps"]:
+            row("engine_steps", s["engine_steps"])
+            row("step_host_ms", f"{s['step_host_ms']:.3f}")
+            row("step_device_ms", f"{s['step_device_ms']:.3f}")
+            row("step_host_frac", f"{s['step_host_frac'] * 100:.1f}%")
         return "\n".join(lines)

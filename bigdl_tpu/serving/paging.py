@@ -129,6 +129,21 @@ class PagePool:
         owners) — the per-lane drain gauge the speculative tests pin."""
         return self._owner_counts.get(owner, 0)
 
+    def snapshot(self) -> dict:
+        """Occupancy + per-owner gauges for the obs registry — the
+        host-side allocator truth the engine's metrics gauges derive
+        from. Read-only over plain ints/dicts (the engine loop owns all
+        mutation), so a scrape from another thread is safe."""
+        return {
+            "pages_total": self.num_pages,
+            "pages_in_use": self.in_use,
+            "pages_free": len(self._free),
+            "page_size": self.page_size,
+            "pages_per_slot": self.pages_per_slot,
+            "by_owner": {k: v for k, v in sorted(self._owner_counts.items())
+                         if v},
+        }
+
     @property
     def free_pages(self) -> int:
         return len(self._free)

@@ -55,6 +55,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from bigdl_tpu import faults
 from bigdl_tpu.faults import RetryPolicy
+from bigdl_tpu.obs.recorder import record_event
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -213,6 +214,11 @@ class ReplicaSet:
                 self._note_failure(r, e, where="submit")
                 tried.append(r)
                 continue
+            tr = getattr(handle, "trace", None)
+            if tr is not None:
+                # the set stamps placement onto the backend's trace —
+                # the context rides the handle across the layering
+                tr.annotate(replica=r.name, replica_set=self.name)
             self._track(r, handle)
             return handle
 
@@ -278,6 +284,9 @@ class ReplicaSet:
                 r.healthy = False
         if evict:
             self.metrics.record_eviction()
+            record_event("replica.evicted", set=self.name, replica=r.name,
+                         failures=r.failures, where=where,
+                         error=type(error).__name__)
             with self._probe_cond:
                 # a FRESH eviction restarts the probe schedule from the
                 # base interval (the capped backoff belongs to backends
@@ -389,6 +398,7 @@ class ReplicaSet:
                 r.failures = 0
             rejoined += 1
             self.metrics.record_rejoin()
+            record_event("replica.rejoined", set=self.name, replica=r.name)
             log.info("replica %s/%s rejoined after a successful probe",
                      self.name, r.name)
         if rejoined:
@@ -456,6 +466,8 @@ class ReplicaSet:
                     r.draining = False
                 self._update_gauges()
             self.metrics.record_rolling_reload()
+            record_event("replica.rolling_reload", set=self.name,
+                         version=version)
 
     # ------------------------------------------------------ lifecycle ----
 

@@ -166,6 +166,11 @@ class ModelRouter:
         except BaseException:
             release_once()
             raise
+        # trace context rides the handle (obs tier): the router stamps
+        # the model name onto whatever trace the backend started
+        tr = getattr(handle, "trace", None)
+        if tr is not None:
+            tr.annotate(model=model_name)
         try:
             handle.add_done_callback(release_once)
         except BaseException:

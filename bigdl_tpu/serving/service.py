@@ -31,6 +31,7 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from bigdl_tpu.obs.trace import submit_trace
 from bigdl_tpu.serving.batcher import DynamicBatcher, _Request
 from bigdl_tpu.serving.metrics import ServingMetrics
 
@@ -77,7 +78,8 @@ class InferenceService:
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
                  forward_fn=None, mesh=None, param_pspecs=None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 tracer=None):
         # int8 post-training quantization at the door (the reference's
         # AbstractModule.quantize() applied to serving): the module tree
         # is rewritten once (Linear/conv -> int8 twins, nn.quantized),
@@ -140,6 +142,9 @@ class InferenceService:
             _model_forward(model))
         self._signature = None  # (treedef, leaf shapes/dtypes) of request 1
         self._sig_lock = threading.Lock()  # check-and-set must be atomic
+        # per-request tracing (obs.Tracer); None is free — one `is
+        # None` test on the submit path, the disarmed-fault-site budget
+        self.tracer = tracer
         self.batcher = DynamicBatcher(
             self._forward_batch, max_batch_size=max_batch_size,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
@@ -202,10 +207,35 @@ class InferenceService:
         self._check_signature(x)
         now = time.monotonic()
         fut: Future = Future()
+        tr = submit_trace(self.tracer, "predict")
+        if tr is not None:
+            # the trace context rides the future, like the engine's
+            # stream — routers/replica sets annotate it downstream
+            fut.trace = tr
+            tr.event("submit")
         req = _Request(x, fut, now,
                        None if deadline is None else now + float(deadline))
-        self.batcher.submit(req)  # raises Overloaded / RuntimeError(closed)
+        try:
+            self.batcher.submit(req)  # raises Overloaded / closed
+        except BaseException:
+            if tr is not None:
+                tr.finish(outcome="rejected")
+            raise
+        if tr is not None:
+            fut.add_done_callback(self._finish_trace)
         return fut
+
+    @staticmethod
+    def _finish_trace(fut) -> None:
+        tr = getattr(fut, "trace", None)
+        if tr is None or tr.done:
+            return
+        if fut.cancelled():
+            tr.finish(outcome="cancelled")
+            return
+        err = fut.exception()
+        tr.finish(outcome="done" if err is None else "failed",
+                  **({} if err is None else {"error": type(err).__name__}))
 
     def _check_signature(self, x) -> None:
         """One service serves one input signature (structure + per-leaf

@@ -607,10 +607,11 @@ def test_kv_metrics_rows_append_after_replica_golden():
                      "quantized_gemms"]
     snap = m.snapshot()
     keys = list(snap.keys())
-    # the PR-9 block sits immediately before the PR-10 speculative keys
-    # (append-only: each PR's rows land AFTER every earlier block)
-    assert keys[-7:-4] == ["kv_bytes_in_use", "kv_cache_dtype",
-                           "quantized_gemms"]
+    # the PR-9 block sits immediately before the PR-10 speculative and
+    # PR-11 step-timeline keys (append-only: each PR's rows land AFTER
+    # every earlier block)
+    assert keys[-11:-8] == ["kv_bytes_in_use", "kv_cache_dtype",
+                            "quantized_gemms"]
     assert snap["kv_bytes_in_use"] == 5 * 5248
     assert snap["kv_cache_dtype"] == "int8"
     assert snap["quantized_gemms"] == 13

@@ -1,0 +1,618 @@
+"""Unified telemetry plane (bigdl_tpu/obs/) — PR 11.
+
+The load-bearing properties, per the subsystem contract:
+
+- per-request TRACES are structurally deterministic: the span tree of a
+  chunked (and a speculative) request through ModelRouter -> ReplicaSet
+  -> GenerationEngine is a pure function of the workload under a fake
+  clock, annotated with routing context at every layer, exported as
+  JSONL + a waterfall;
+- tracing DISABLED is free: the submit-path hook costs < 2 us/call
+  (the faults disarmed-site budget);
+- one MetricsRegistry.collect() surfaces serving + paging + replica +
+  ckpt + faults + pipeline + train gauges under flat STABLE keys, and
+  the Prometheus endpoint round-trips them over real HTTP (every
+  numeric key present exactly once, valid exposition charset);
+- /healthz reflects replica eviction and rejoin; endpoint close() joins
+  its thread (no leaks — the chaos drain-gate pattern);
+- the flight recorder is bounded, fault firings/watchdog stalls leave
+  structured events, RetryPolicy and CheckpointManager count their
+  healing;
+- the engine step-timeline rows append strictly after the PR-10
+  speculative block (the append-only golden contract).
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.obs import (
+    FlightRecorder,
+    MetricsEndpoint,
+    MetricsRegistry,
+    Tracer,
+    engine_health,
+    flight_recorder,
+    format_trace,
+    prometheus_name,
+    replica_health,
+    submit_trace,
+    to_prometheus,
+)
+from bigdl_tpu.serving import (
+    GenerationEngine,
+    ModelRouter,
+    PagedDecodeKernels,
+    PagePool,
+    ReplicaSet,
+    ServingMetrics,
+    SpeculativeKernels,
+)
+
+SLOTS, MAXLEN, MAXPROMPT, CHUNK = 4, 48, 16, 4
+
+
+class FakeClock:
+    """Deterministic monotonic clock: +1 ms per read (the faults-tier
+    fake-clock pattern)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        with self._lock:
+            self.t += 0.001
+            return self.t
+
+
+@pytest.fixture(scope="module")
+def paged_lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+    params, _ = model.init(jax.random.key(0))
+    kernels = PagedDecodeKernels(model)  # shared: compile once
+    return model, params, kernels
+
+
+# ------------------------------------------------------------ tracing ----
+
+
+def _traced_run(paged_lm):
+    """One full routed workload under a fresh tracer + fake clock:
+    ModelRouter -> ReplicaSet(2 engines) -> paged engines, with a
+    chunked prompt in the mix. Returns traces sorted by submit order."""
+    model, params, kernels = paged_lm
+    tracer = Tracer(clock=FakeClock())
+    engines = [GenerationEngine(model, params, max_slots=SLOTS,
+                                max_len=MAXLEN, max_prompt_len=MAXPROMPT,
+                                kernels=kernels, page_size=8,
+                                prefill_chunk=CHUNK, tracer=tracer,
+                                metrics=ServingMetrics())
+               for _ in range(2)]
+    router = ModelRouter()
+    router.register("lm", engines)
+    requests = [([1, 5, 9], 4),
+                (list(range(1, 11)), 5),   # 10 tokens: chunked (4+4+2)
+                ([2, 4], 3)]
+    # submit all THEN wait: both replicas serve concurrently, placement
+    # (least-loaded, index tiebreak) stays a pure function of the
+    # single-threaded submission order
+    streams = [router.submit("lm", p, max_new_tokens=m)
+               for p, m in requests]
+    outs = [s.result(timeout=60) for s in streams]
+    router.close()  # drains + joins the loops BEFORE counters are read
+    timeline_iters = sum(e.timeline.snapshot()["iterations"]
+                         for e in engines)
+    engine_steps = sum(e.metrics.snapshot()["engine_steps"]
+                       for e in engines)
+    traces = sorted(tracer.finished(), key=lambda t: t.trace_id)
+    return tracer, traces, outs, timeline_iters, engine_steps
+
+
+@pytest.mark.slow  # compile-heavy (2 engines + buckets): the 870 s
+# tier-1 budget is already spent by the earlier tiers — plain
+# `pytest tests/` runs this (the ROADMAP slow-marker pattern)
+def test_trace_structure_deterministic_through_router_and_replicas(
+        paged_lm):
+    """The span tree of every request — chunked included, across 2
+    engines behind a ReplicaSet behind a ModelRouter — is run-invariant,
+    and each layer stamped its routing context onto the trace."""
+    tracer1, traces1, outs1, tl_iters, steps = _traced_run(paged_lm)
+    tracer2, traces2, outs2, _, _ = _traced_run(paged_lm)
+    assert outs1 == outs2  # the workload itself is deterministic
+    assert len(traces1) == len(traces2) == 3
+    assert [t.structure() for t in traces1] \
+        == [t.structure() for t in traces2]
+    # the chunked request's waterfall: 3 prefill chunks, counted decode
+    chunked = traces1[1]
+    names = [sp.name for sp in chunked.spans]
+    assert names == ["queue_wait", "page_reserve", "prefill_chunk",
+                     "prefill_chunk", "prefill_chunk", "decode"]
+    assert chunked.spans[-1].count == 5 - 1  # prefill emits token 1
+    assert [sp.attrs.get("final") for sp in chunked.spans[2:5]] \
+        == [False, False, True]
+    # every layer annotated: the router's model name, the set's
+    # placement, the engine's outcome + token count
+    for t in traces1:
+        assert t.attrs["model"] == "lm"
+        assert t.attrs["replica_set"] == "lm"
+        assert t.attrs["replica"] in ("r0", "r1")
+        assert t.outcome == "done"
+        assert t.attrs["tokens"] == t.attrs["max_new_tokens"]
+        assert [e[0] for e in t.events] == ["submit", "first_token"]
+    # the engine loop fed the step timeline and the metrics block
+    assert tl_iters > 0 and steps == tl_iters
+    # the waterfall renders every lifecycle stage (durations are NOT
+    # compared here: the fake clock is shared by two engine loop
+    # threads, so absolute read counts interleave — structure is the
+    # run-invariant, and the single-engine tests pin the rest)
+    waterfall = format_trace(chunked)
+    for needle in ("outcome=done", "queue_wait", "page_reserve",
+                   "prefill_chunk", "decode", "x4", "first_token"):
+        assert needle in waterfall, needle
+
+
+@pytest.mark.slow  # compiles a SpeculativeKernels set (see above)
+def test_trace_structure_deterministic_speculative(paged_lm):
+    """A speculative request's trace counts verify ROUNDS (never one
+    span per round) and is run-invariant."""
+    model, params, _ = paged_lm
+    spec_kernels = SpeculativeKernels(model, model)
+
+    def run():
+        tracer = Tracer(clock=FakeClock())
+        eng = GenerationEngine(model, params, max_slots=2, max_len=MAXLEN,
+                               max_prompt_len=MAXPROMPT, page_size=8,
+                               prefill_chunk=CHUNK, tracer=tracer,
+                               kernels=spec_kernels,
+                               speculate=(model, params, 2),
+                               metrics=ServingMetrics())
+        out = eng.submit([1, 2, 3], max_new_tokens=5).result(timeout=60)
+        eng.close()
+        return out, [t.structure() for t in tracer.finished()]
+
+    out1, s1 = run()
+    out2, s2 = run()
+    assert out1 == out2 and s1 == s2 and len(s1) == 1
+    kind, outcome, spans, _ = s1[0]
+    assert outcome == "done"
+    span_names = [n for n, _ in spans]
+    assert span_names == ["queue_wait", "page_reserve", "prefill_chunk",
+                          "verify_round"]
+    assert dict(spans)["verify_round"] >= 1
+
+
+def test_trace_jsonl_export(paged_lm, tmp_path):
+    model, params, kernels = paged_lm
+    tracer = Tracer()
+    eng = GenerationEngine(model, params, max_slots=2, max_len=MAXLEN,
+                           max_prompt_len=MAXPROMPT, kernels=kernels,
+                           page_size=8, prefill_chunk=CHUNK,
+                           tracer=tracer, metrics=ServingMetrics())
+    eng.generate([3, 1, 4], max_new_tokens=3, timeout=60)
+    eng.close()
+    path = tmp_path / "traces.jsonl"
+    n = tracer.dump_jsonl(str(path))
+    lines = path.read_text().splitlines()
+    assert n == len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["outcome"] == "done"
+    assert [s["name"] for s in rec["spans"]][:2] == ["queue_wait",
+                                                     "page_reserve"]
+    assert tracer.snapshot() == {"started": 1, "finished": 1,
+                                 "active": 0, "retained": 1}
+
+
+def test_disabled_tracer_submit_hook_within_budget():
+    """Tracing off must be noise on the submit path: the hook is one
+    ``is None`` test (<= 2 us/call with wide CI margin — the same
+    budget the disarmed faults.fire pin uses)."""
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        submit_trace(None, "generate", prompt_len=7, max_new_tokens=8,
+                     sampled=False)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 2e-6, f"disabled hook costs {per_call * 1e6:.2f} us"
+
+
+# ----------------------------------------------------------- registry ----
+
+
+def _full_registry(tmp_path=None):
+    """A registry wired across every tier (no engine — pure host)."""
+    serving = ServingMetrics()
+    serving.record_batch(3, 4)
+    serving.record_served(0.010, 0.004)
+    serving.record_engine_step(0.001, 0.009)
+    pool = PagePool(8, 4, 16)
+    pool.alloc(2, owner="target")
+    stats_src = {"pipeline": __import__(
+        "bigdl_tpu.dataset.parallel_pipeline",
+        fromlist=["PipelineStats"]).PipelineStats()}
+    stats = stats_src["pipeline"]
+    stats.stage("produce").record(4, 400)
+    inj = faults.FaultInjector()
+    inj.arm("scratch.site", nth=1)
+    try:
+        inj.fire("scratch.site")
+    except faults.InjectedFault:
+        pass
+    policy = faults.RetryPolicy(max_attempts=2, base_delay=0.0)
+    reg = (MetricsRegistry()
+           .register("serving", serving)
+           .register("pages", pool)
+           .register("pipeline", stats)
+           .register("faults", inj)
+           .register("retry", policy)
+           .register("train", lambda: {"loss": 0.5, "iteration": 7,
+                                       "learning_rate": 0.1}))
+    return reg
+
+
+def test_registry_collect_flat_stable_keys():
+    reg = _full_registry()
+    flat1 = reg.collect()
+    flat2 = reg.collect()
+    assert list(flat1) == list(flat2)  # stable key ORDER, not just set
+    for key in ("serving.served", "serving.engine_steps",
+                "serving.step_host_frac", "pages.pages_in_use",
+                "pages.by_owner.target", "pipeline.produce.items",
+                "faults.scratch.site.fired", "retry.retries",
+                "train.loss", "train.learning_rate"):
+        assert key in flat1, key
+    assert flat1["pages.by_owner.target"] == 2
+    assert flat1["faults.scratch.site.fired"] == 1
+    assert flat1["train.iteration"] == 7
+
+
+def test_registry_rejects_duplicates_and_junk():
+    reg = MetricsRegistry()
+    reg.register("a", lambda: {"x": 1})
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("a", lambda: {})
+    with pytest.raises(ValueError, match="name"):
+        reg.register("", lambda: {})
+    with pytest.raises(TypeError, match="snapshot"):
+        reg.register("b", object())
+    # a raising source degrades to an error gauge, not a dead scrape
+    reg.register("broken", lambda: 1 / 0)
+    flat = reg.collect()
+    assert flat["broken.collect_error"] == 1
+    assert flat["a.x"] == 1
+
+
+# ----------------------------------------------------------- endpoint ----
+
+
+def _parse_exposition(text):
+    """Tiny in-test Prometheus text-format parser: name charset checked,
+    duplicate sample names rejected."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.partition(" ")
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), name
+        assert name not in samples, f"duplicate sample {name}"
+        samples[name] = float(value)
+    return samples
+
+
+def test_prometheus_http_round_trip():
+    reg = _full_registry()
+    with MetricsEndpoint(reg) as ep:
+        body = urllib.request.urlopen(ep.url("/metrics"),
+                                      timeout=10).read().decode()
+        jbody = urllib.request.urlopen(ep.url("/metrics.json"),
+                                       timeout=10).read().decode()
+    samples = _parse_exposition(body)
+    flat = reg.collect()
+    numeric = {k: v for k, v in flat.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    # every registered numeric key present EXACTLY once, value intact
+    for key, v in numeric.items():
+        name = prometheus_name(key)
+        assert name in samples, key
+        assert samples[name] == pytest.approx(float(v))
+    assert len(samples) == len({prometheus_name(k) for k in numeric})
+    # JSON side carries everything, strings included
+    parsed = json.loads(jbody)
+    assert parsed["serving.served"] == 1
+    # counters scraped twice are monotonic
+    with MetricsEndpoint(reg) as ep:
+        one = _parse_exposition(urllib.request.urlopen(
+            ep.url("/metrics"), timeout=10).read().decode())
+        flat2 = reg.collect()  # no traffic between scrapes
+        two = _parse_exposition(urllib.request.urlopen(
+            ep.url("/metrics"), timeout=10).read().decode())
+    assert two[prometheus_name("serving.served")] \
+        >= one[prometheus_name("serving.served")]
+    assert flat2["serving.served"] == 1
+
+
+class _StubHandle:
+    def __init__(self, error=None):
+        self.error = error
+        self.trace = None
+
+    def add_done_callback(self, fn):
+        fn(self)
+
+    def result(self, timeout=None):
+        if self.error is not None:
+            raise self.error
+        return [1]
+
+
+class _StubBackend:
+    def __init__(self):
+        self.metrics = ServingMetrics()
+        self.fail = False
+
+    def submit(self, x, **kw):
+        if self.fail:
+            raise RuntimeError("stub backend down")
+        return _StubHandle()
+
+    def reload(self, params, state=None):
+        pass
+
+    def close(self, drain=True, timeout=None):
+        pass
+
+
+def test_healthz_reflects_eviction_and_rejoin():
+    backends = [_StubBackend(), _StubBackend()]
+    rset = ReplicaSet(backends, max_failures=1, probe=lambda b: None,
+                      probe_interval=0, name="hz")
+    reg = MetricsRegistry().register("serving", rset.metrics)
+    ep = MetricsEndpoint(reg, health={"replicas": replica_health(rset)})
+
+    def healthz():
+        try:
+            resp = urllib.request.urlopen(ep.url("/healthz"), timeout=10)
+            return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    code, body = healthz()
+    assert code == 200 and body["ok"] is True
+    assert body["checks"]["replicas"]["degraded"] is False
+
+    backends[0].fail = True
+    rset.submit([1]).result()          # fails over; r0 evicted
+    code, body = healthz()
+    assert code == 200 and body["checks"]["replicas"]["degraded"] is True
+    assert body["checks"]["replicas"]["healthy"] == ["r1"]
+
+    backends[1].fail = True
+    with pytest.raises(Exception):
+        rset.submit([1])               # both down -> ReplicaUnavailable
+    code, body = healthz()
+    assert code == 503 and body["ok"] is False
+
+    backends[0].fail = backends[1].fail = False
+    assert rset.probe_once() == 2      # both rejoin
+    code, body = healthz()
+    assert code == 200 and body["checks"]["replicas"]["degraded"] is False
+    ep.close()
+    rset.close()
+
+
+def test_endpoint_close_joins_thread_no_leaks():
+    reg = MetricsRegistry().register("x", lambda: {"v": 1})
+    ep = MetricsEndpoint(reg)
+    assert urllib.request.urlopen(ep.url("/metrics"),
+                                  timeout=10).status == 200
+    ep.close()
+    ep.close()  # idempotent
+    assert not [t for t in threading.enumerate()
+                if t.name == "bigdl-obs-endpoint" and t.is_alive()]
+    with pytest.raises(Exception):
+        urllib.request.urlopen(ep.url("/metrics"), timeout=2)
+
+
+# ----------------------------------------------------- flight recorder ----
+
+
+def test_flight_recorder_is_bounded_and_structured():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("scratch.kind", i=i)
+    events = rec.dump()
+    assert len(events) == 4                      # ring bound
+    assert [e["i"] for e in events] == [6, 7, 8, 9]
+    assert rec.count() == 10                     # total keeps counting
+    snap = rec.snapshot()
+    assert snap["events_total"] == 10 and snap["events_retained"] == 4
+    table = rec.format_events()
+    assert "scratch.kind" in table and "i=9" in table
+    rec.clear()
+    assert rec.dump() == [] and rec.count() == 0
+
+
+def test_fault_fire_and_watchdog_stall_leave_recorder_events():
+    rec = flight_recorder()
+    base_faults = rec.count("fault.fired")
+    base_stalls = rec.count("watchdog.stall")
+    faults.arm("scratch.obs_site", nth=1)
+    try:
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("scratch.obs_site", key=3)
+        fired = [e for e in rec.dump(kind="fault.fired")
+                 if e.get("site") == "scratch.obs_site"]
+        assert fired and fired[-1]["effect"] == "InjectedFault"
+        assert fired[-1]["key"] == 3
+        assert rec.count("fault.fired") == base_faults + 1
+    finally:
+        faults.reset()
+
+    stalls = []
+    wd = faults.Watchdog("obs-test", 0.05, stalls.append)
+    wd.arm("unit of work")
+    deadline = time.monotonic() + 10
+    while not stalls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.close()
+    assert stalls
+    assert rec.count("watchdog.stall") == base_stalls + 1
+    ev = rec.dump(kind="watchdog.stall")[-1]
+    assert ev["name"] == "obs-test" and ev["label"] == "unit of work"
+
+
+def test_retry_policy_counts_healing_and_exhaustion():
+    policy = faults.RetryPolicy(max_attempts=3, base_delay=0.0)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert policy.call(flaky, sleep=lambda s: None) == "ok"
+    assert policy.snapshot()["retries"] == 2
+    assert policy.snapshot()["exhaustions"] == 0
+
+    def always_bad():
+        raise OSError("still broken")
+
+    with pytest.raises(OSError):
+        policy.call(always_bad, sleep=lambda s: None)
+    snap = policy.snapshot()
+    assert snap["retries"] == 4 and snap["exhaustions"] == 1
+    # permanent errors are NOT exhaustion
+    with pytest.raises(ValueError):
+        policy.call(lambda: (_ for _ in ()).throw(ValueError("perm")),
+                    sleep=lambda s: None)
+    assert policy.snapshot()["exhaustions"] == 1
+
+
+def test_ckpt_manager_counters_and_snapshot(tmp_path):
+    from bigdl_tpu.ckpt.manager import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    params = {"w": np.ones((2, 2), np.float32)}
+    with CheckpointManager(d) as mgr:
+        mgr.save("model.iter1", params, {}, {}, meta={"iteration": 1},
+                 blocking=True)
+        mgr.save("model.iter2", params, {}, {}, meta={"iteration": 2},
+                 blocking=True)
+        assert mgr.snapshot()["commits"] == 2
+        # corrupt the newest blob: restore must fall back and count it
+        with open(os.path.join(d, "model.iter2.ckpt"), "wb") as fh:
+            fh.write(b"garbage")
+        payload, entry = mgr.restore_latest()
+        assert entry.tag == "model.iter1"
+        snap = mgr.snapshot()
+        assert snap["restore_fallbacks"] == 1 and snap["restores"] == 1
+        assert snap["commit_failures"] == 0
+        assert snap["retry"]["retries"] == 0
+    rec_events = flight_recorder().dump(kind="ckpt")
+    assert any(e["kind"] == "ckpt.commit" and e["tag"] == "model.iter2"
+               for e in rec_events)
+    assert any(e["kind"] == "ckpt.fallback" for e in rec_events)
+
+
+def test_optimizer_registers_train_gauges(tmp_path):
+    """set_metrics_registry publishes the per-step train gauges (and
+    the configured pipeline/ckpt sources) into the same registry the
+    serving tiers use — one collect() spans train AND serve."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import optim
+    from bigdl_tpu.core.rng import RandomGenerator
+    from bigdl_tpu.dataset import DataSet, FunctionTransformer, \
+        SampleToMiniBatch
+    from bigdl_tpu.dataset.sample import Sample
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(32, 8).astype(np.float32)
+    ys = (xs.sum(axis=1) > 0).astype(np.int32)
+    ds = DataSet.array([(xs[i], ys[i]) for i in range(len(xs))],
+                       rng=RandomGenerator(5)) \
+        >> (FunctionTransformer(lambda t: Sample(t[0], np.int32(t[1])))
+            >> SampleToMiniBatch(16))
+    model = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2),
+                          nn.LogSoftMax())
+    opt = optim.LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                               batch_size=16)
+    opt.set_optim_method(optim.SGD(learning_rate=0.5))
+    opt.set_end_when(optim.Trigger.max_iteration(3))
+    opt.set_checkpoint(str(tmp_path / "ck"),
+                       optim.Trigger.several_iteration(2))
+    reg = MetricsRegistry().register("serving", ServingMetrics())
+    opt.set_metrics_registry(reg)
+    opt.optimize()
+    flat = reg.collect()
+    assert flat["train.iteration"] == 3
+    assert flat["train.learning_rate"] == pytest.approx(0.5)
+    assert flat["train.throughput"] > 0
+    assert np.isfinite(flat["train.loss"])
+    assert flat["train.ckpt.commits"] >= 1
+    assert "serving.served" in flat  # train + serve in ONE snapshot
+    opt.checkpoint_manager.close()
+
+
+# -------------------------------------------------------- step timeline ----
+
+
+def test_step_timeline_metrics_rows_append_after_speculative_block():
+    """PR-11 golden contract: step-timeline rows render strictly AFTER
+    the PR-10 speculative block — append-only, never reordered."""
+    m = ServingMetrics()
+    m.record_batch(3, 4)
+    m.record_served(0.010, 0.004)
+    m.record_prefill(5, 8, 0.002)
+    m.record_decode_step(3, 4)
+    m.record_chunk(8, 8)
+    m.set_pages(5, 32)
+    m.record_reload()
+    m.set_replicas(2, 2, {"r0": 1})
+    m.set_kv_cache(4096, "int8")
+    m.set_quantized_gemms(13)
+    m.record_verify_step(8, 5, 5)
+    pre_lines = m.format_table().splitlines()
+
+    m.record_engine_step(0.002, 0.006)
+    m.record_engine_step(0.001, 0.007)
+    full_lines = m.format_table().splitlines()
+    assert full_lines[:len(pre_lines)] == pre_lines
+    extra = [ln.split()[0] for ln in full_lines[len(pre_lines):]]
+    assert extra == ["engine_steps", "step_host_ms", "step_device_ms",
+                     "step_host_frac"]
+    snap = m.snapshot()
+    assert list(snap)[-4:] == ["engine_steps", "step_host_ms",
+                               "step_device_ms", "step_host_frac"]
+    assert snap["engine_steps"] == 2
+    assert snap["step_host_ms"] == pytest.approx(3.0)
+    assert snap["step_device_ms"] == pytest.approx(13.0)
+    assert snap["step_host_frac"] == pytest.approx(3 / 16)
+
+
+def test_step_timeline_ring_and_summary():
+    from bigdl_tpu.obs import StepTimeline
+
+    tl = StepTimeline(capacity=4)
+    for i in range(6):
+        tl.record(host_s=0.001, decode_s=0.004, active=2, queue_depth=i,
+                  occupancy=0.5)
+    assert tl.snapshot()["iterations"] == 6
+    assert tl.snapshot()["window_iterations"] == 4     # ring bound
+    assert tl.snapshot()["host_frac"] == pytest.approx(0.2)
+    rows = tl.recent(last=2)
+    assert [r["queue_depth"] for r in rows] == [4, 5]
+    table = tl.format_timeline()
+    assert table.splitlines()[0].split()[0] == "iter"
+    assert len(table.splitlines()) == 5                # header + ring

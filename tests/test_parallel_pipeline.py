@@ -293,6 +293,37 @@ def test_pipeline_stats_counters_and_table():
     assert stats.stage("augment x2") is st
 
 
+def test_pipeline_stats_table_golden_order():
+    """The golden-order contract ServingMetrics has had since PR 1,
+    extended to PipelineStats: the header columns and the per-stage row
+    order (stage REGISTRATION order, not alphabetical) are pinned —
+    consumers parse the table positionally, and the obs registry's
+    stable-key contract flattens the snapshot in this same order."""
+    stats = PipelineStats()
+    # registration order is deliberately non-alphabetical
+    stats.stage("produce").record(4, 400)
+    stats.stage("augment x2").record(4, 400)
+    stats.stage("stage").record(4, 400)
+    stats.stage("transfer").record(4, 400)
+    lines = stats.format_table().splitlines()
+    assert lines[0].split() == ["stage", "items", "MB", "items/s",
+                                "queue", "stall_s", "starve_s"]
+    assert [ln.split()[0] for ln in lines[1:]] == [
+        "produce", "augment", "stage", "transfer"]  # first token per row
+    # snapshot keys iterate in the same registration order, and each
+    # stage's key set is the pinned schema (append-only from here on)
+    snap = stats.snapshot()
+    assert list(snap) == ["produce", "augment x2", "stage", "transfer"]
+    assert list(snap["produce"]) == [
+        "items", "mb", "restarts", "items_per_sec", "stall_s",
+        "starve_s", "queue_mean", "queue_max", "queue_cap"]
+    # a later-registered stage APPENDS a row, never reorders the prefix
+    stats.stage("late").record(1, 10)
+    lines2 = stats.format_table().splitlines()
+    assert lines2[:len(lines)] == lines
+    assert lines2[len(lines)].split()[0] == "late"
+
+
 def test_pool_records_stats():
     stats = PipelineStats()
     out = list(ParallelTransformer(_aug_chain(), 2, base_seed=1,
