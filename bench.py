@@ -803,6 +803,83 @@ def run_generation_bench(args):
             "speculative_compile_once": warm_traces == post_traces,
         }
 
+    # prefix-cache column (PR 12): replay the workload prefix caching
+    # exists for — ONE shared system prompt (3 full pages) x N requests
+    # with unique tails, arriving one after another (multi-turn /
+    # templated traffic) — through a prefix-caching engine vs the same
+    # engine cache-off. The first request publishes the prompt's pages
+    # at retirement; every later one attaches them by reference and
+    # prefills only its tail, so the gated wins are (a) >= 2x fewer
+    # chunk/prefill kernel invocations and (b) a TTFT p50 reduction at
+    # hit-rate >= 0.9. Prompt kernels carry a fixed modeled cost
+    # (prefill is what the cache removes; the tiny CPU model's real
+    # microseconds would drown the ratio in Python bookkeeping), decode
+    # is unpriced on both legs, and greedy decode being deterministic
+    # the zero-mismatch gate doubles as the cache-on-vs-off
+    # bit-identity check.
+    prefix_fields = {}
+    prefix_cache_obj = None
+    if args.prefix_cache:
+        pfx_requests = args.requests or (16 if smoke else 32)
+        sys_len = 3 * page_size
+        hi = 200 if not on_tpu else 8000
+        pfx_rs = np.random.RandomState(2)
+        system = pfx_rs.randint(1, hi, (sys_len,)).tolist()
+        pfx_prompts = [system + pfx_rs.randint(1, hi, (3,)).tolist()
+                       for _ in range(pfx_requests)]
+        pfx_new = short_new + 2
+        prompt_cost_ms = 4.0
+
+        def run_prefix_leg(enabled):
+            eng = GenerationEngine(
+                model, params, max_slots=slots, max_len=max_len,
+                max_prompt_len=sys_len + 8,
+                max_queue=max(64, 2 * pfx_requests),
+                kernels=_FixedCostKernels(kernels, 0.0,
+                                          prompt_cost_ms / 1e3),
+                page_size=page_size, prefill_chunk=page_size, seed=0,
+                cache_dtype=kv_dtype, quantize=quantize,
+                metrics=ServingMetrics(), prefix_cache=enabled)
+            eng.warmup()
+            t0 = time.perf_counter()
+            outs = [eng.submit(p, max_new_tokens=pfx_new,
+                               **sample_spec).result(timeout=600)
+                    for p in pfx_prompts]
+            wall = time.perf_counter() - t0
+            leg_snap = eng.metrics.snapshot()
+            pcache = eng._prefix
+            eng.close()
+            return outs, leg_snap, wall, pcache
+
+        off_outs, off_snap, off_wall, _ = run_prefix_leg(False)
+        on_outs, on_snap, on_wall, prefix_cache_obj = run_prefix_leg(True)
+        pfx_mismatches = sum(1 for a, b in zip(off_outs, on_outs)
+                             if a != b)
+        inv_off = off_snap["prefill_chunks"] + off_snap["prefills"]
+        inv_on = on_snap["prefill_chunks"] + on_snap["prefills"]
+        ttft_off = (off_snap["ttft_ms"] or {}).get("p50")
+        ttft_on = (on_snap["ttft_ms"] or {}).get("p50")
+        prefix_fields = {
+            "prefix_requests": pfx_requests,
+            "prefix_system_pages": sys_len // page_size,
+            "prefix_hit_rate": round(on_snap["prefix_hit_rate"], 4),
+            "prefix_hits": on_snap["prefix_hits"],
+            "prefix_misses": on_snap["prefix_misses"],
+            "prefix_prefill_invocations_off": inv_off,
+            "prefix_prefill_invocations_on": inv_on,
+            "prefix_invocation_reduction": round(
+                inv_off / max(inv_on, 1), 3),
+            "prefix_chunks_skipped": on_snap["prefill_chunks_skipped"],
+            "prefix_ttft_p50_off_ms": ttft_off,
+            "prefix_ttft_p50_on_ms": ttft_on,
+            "prefix_ttft_reduction": round(ttft_off / ttft_on, 3)
+            if ttft_off and ttft_on else None,
+            "prefix_wall_off_s": round(off_wall, 3),
+            "prefix_wall_on_s": round(on_wall, 3),
+            "prefix_prompt_cost_ms": prompt_cost_ms,
+            "prefix_mismatches": pfx_mismatches,
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -843,8 +920,10 @@ def run_generation_bench(args):
         "replicas": args.replicas,
         "step_cost_ms": step_cost_ms,
         "speculate": args.speculate,
+        "prefix_cache": bool(args.prefix_cache),
         **rep_fields,
         **spec_fields,
+        **prefix_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -854,6 +933,7 @@ def run_generation_bench(args):
     _write_metrics_out(args, {"serving": engine.metrics,
                               "pages": engine._pool,
                               "timeline": engine.timeline,
+                              "prefix": prefix_cache_obj,
                               "bench": result})
     print(json.dumps(result))
     if smoke:
@@ -922,6 +1002,35 @@ def run_generation_bench(args):
                 "(gate: >= 1.8x with scale pools priced in — the int8 "
                 "byte saving must survive its own overhead)"
                 % result["capacity_int8_vs_bf16"])
+        if args.prefix_cache:
+            if result["prefix_mismatches"]:
+                raise SystemExit(
+                    "prefix smoke: %d request(s) decoded different tokens "
+                    "with the prefix cache on vs off — cached pages hold "
+                    "the same bits a fresh prefill writes; output must be "
+                    "BIT-identical" % result["prefix_mismatches"])
+            if result["prefix_hit_rate"] < 0.9:
+                raise SystemExit(
+                    "prefix smoke: hit rate %.2f on the shared-prefix "
+                    "replay (gate: >= 0.9 — one miss to publish, every "
+                    "later request must attach)"
+                    % result["prefix_hit_rate"])
+            if result["prefix_invocation_reduction"] < 2.0:
+                raise SystemExit(
+                    "prefix smoke: only %.2fx fewer chunk/prefill kernel "
+                    "invocations with the cache on (gate: >= 2x — hits "
+                    "must SKIP the covered chunks, not just count them)"
+                    % result["prefix_invocation_reduction"])
+            if (result["prefix_ttft_reduction"] is None
+                    or result["prefix_ttft_p50_on_ms"]
+                    > 0.8 * result["prefix_ttft_p50_off_ms"]):
+                raise SystemExit(
+                    "prefix smoke: TTFT p50 %.2f ms cache-on vs %.2f ms "
+                    "cache-off (gate: on <= 0.8x off at the modeled "
+                    "prompt cost — skipped prefill must shorten "
+                    "time-to-first-token)"
+                    % (result["prefix_ttft_p50_on_ms"] or -1,
+                       result["prefix_ttft_p50_off_ms"] or -1))
 
 
 def run_lm_bench(args):
@@ -1789,6 +1898,56 @@ def run_chaos_bench(args):
             f"(target={spec_target_pages}, draft={spec_draft_pages}, "
             f"total={spec_engine.pages_in_use})")
 
+    # ---------------------------------------------- prefix-cache leg ----
+    # PR 12: a fault injected between prefix attach (cache references
+    # taken, fresh pages reserved) and the first decode step fails the
+    # stream with the INJECTED error and releases every refcount — the
+    # drain gate extends to shared_pages == 0 after the terminal
+    # eviction, so a crashed prefix-caching engine can never strand
+    # pages behind the index.
+    faults.reset()  # the speculative leg's firings are already counted
+    pfx_engine = GenerationEngine(
+        model, params, max_slots=slots, max_len=max_len,
+        max_prompt_len=2 * 8,   # one full shared page + divergent tail
+        max_queue=4 * n_requests,
+        kernels=kernels, page_size=8, seed=seed,
+        metrics=ServingMetrics(), prefix_cache=True)
+    pfx_engine.warmup()
+    shared_prompt = rs.randint(1, 60, (8,)).tolist()   # one full page + tail
+    pfx_engine.generate(shared_prompt + [3], max_new_tokens=3, timeout=60)
+    pfx_clean = pfx_engine.generate(shared_prompt + [4], max_new_tokens=3,
+                                    timeout=60)
+    pfx_snap = pfx_engine.metrics.snapshot()
+    if len(pfx_clean) != 3 or pfx_snap["prefix_hits"] < 1:
+        violations.append(
+            f"prefix: clean shared-prefix serving broke before the fault "
+            f"(hits={pfx_snap['prefix_hits']}, out={len(pfx_clean)})")
+    if pfx_engine.shared_pages < 1:
+        violations.append("prefix: retirement published no shared pages")
+    faults.arm("engine.prefix_attach", nth=1, times=1,
+               only=lambda engine=None, **_: engine is pfx_engine)
+    pfx_injected = 0
+    try:
+        pfx_engine.generate(shared_prompt + [5], max_new_tokens=3,
+                            timeout=60)
+        violations.append("prefix: the attach fault never failed a stream")
+    except InjectedFault:
+        pfx_injected = 1
+    except Exception as e:
+        violations.append(f"prefix: non-API stream error {e!r}")
+    faults.disarm("engine.prefix_attach")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
+    faults.reset()
+    pfx_shared_after = pfx_engine.shared_pages
+    pfx_engine.close()
+    if pfx_shared_after or pfx_engine.pages_in_use \
+            or pfx_engine.shared_pages:
+        violations.append(
+            f"prefix: pages leaked after the attach fault "
+            f"(shared={pfx_shared_after}, in_use="
+            f"{pfx_engine.pages_in_use}) — refcounts must release and "
+            f"shared_pages drain to 0")
+
     # ----------------------------------------------------------- drain ----
     deadline = time.monotonic() + 15
     leftover = own_threads()
@@ -1834,6 +1993,9 @@ def run_chaos_bench(args):
         "replica_death_fired": death.fired,
         "submit_faults_fired": flaky_submit.fired,
         "speculative_streams_failed": spec_injected,
+        "prefix_attach_fault_failed_streams": pfx_injected,
+        "prefix_hits": pfx_snap["prefix_hits"],
+        "prefix_shared_pages_after_fault": pfx_shared_after,
         "recorder_fault_events": fired_recorded,
         "recorder_fault_expected": fired_expected,
         "threads_leftover": leftover,
@@ -1849,6 +2011,7 @@ def run_chaos_bench(args):
     }
     _write_metrics_out(args, {"serving": replicas[0].metrics,
                               "speculative": spec_engine.metrics,
+                              "prefix": pfx_engine._prefix,
                               "bench": result})
     print(json.dumps(result))
     if violations:
@@ -1936,6 +2099,15 @@ def _parse_args(argv=None):
                          "default target step, a ~12x-smaller distilled "
                          "draft; the target verify runs at "
                          "--step-cost-ms)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="serving --generate: add the shared-prefix replay "
+                         "column — ONE 3-page system prompt x N requests "
+                         "through a prefix-caching engine vs cache-off at "
+                         "a fixed modeled prompt-kernel cost; --smoke "
+                         "gates hit-rate >= 0.9, >= 2x fewer chunk/"
+                         "prefill invocations, TTFT p50 <= 0.8x off, and "
+                         "zero output mismatches (cache on/off must be "
+                         "bit-identical)")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

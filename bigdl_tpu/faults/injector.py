@@ -58,6 +58,10 @@ SITES: Dict[str, str] = {
     "engine.decode": "GenerationEngine decode step (ctx: engine=)",
     "engine.prefill": "GenerationEngine prefill / prefill chunk "
                       "(ctx: engine=)",
+    "engine.prefix_attach": "GenerationEngine paged admission with "
+                            "prefix caching on, after cached pages "
+                            "attach + fresh pages reserve, before the "
+                            "first prefill/decode step (ctx: engine=)",
     "engine.draft": "GenerationEngine speculative draft leg, once per "
                     "round before the k+1 draft steps (ctx: engine=)",
     "engine.verify": "GenerationEngine speculative target verify step, "

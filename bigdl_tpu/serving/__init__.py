@@ -30,6 +30,13 @@ package supplies the TPU-native translation:
   latency distributions, padding waste, and the token-level generation
   fields (TTFT, tokens/sec, slot occupancy).
 
+Prefix caching (PR 12) rides the paged engine: ``prefix_cache=True``
+shares FULL, immutable prompt pages across requests by refcounted
+reference (:class:`PrefixCache` radix index over the ``PagePool``) —
+repeated system prompts / few-shot templates prefill once and every
+later request skips the covered chunks, bit-identically (see README
+"Prefix caching").
+
 The int8 fast tier rides the same surfaces: ``quantize="int8"`` on
 :class:`GenerationEngine` / :class:`InferenceService` runs every GEMM
 as a true ``s8 x s8 -> s32`` MXU dot (``nn.quantized
@@ -53,6 +60,7 @@ from bigdl_tpu.serving.engine import (
     static_generate,
 )
 from bigdl_tpu.serving.paging import PagePool
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -79,6 +87,7 @@ __all__ = [
     "Overloaded",
     "PagePool",
     "PagedDecodeKernels",
+    "PrefixCache",
     "ReplicaSet",
     "ReplicaUnavailable",
     "ServingError",

@@ -57,6 +57,19 @@ concurrent users. Riding on the paged step:
 The dense :class:`DecodeKernels` path is kept verbatim as the PR-5
 baseline (and for decode-capable models without the paged API); the
 bit-identity acceptance tests decode the same prompts through both.
+
+PR 12 adds **prefix caching** (``prefix_cache=True``, paged engines
+only): retiring sequences publish their full prompt pages to a
+host-side radix index (``serving.prefix_cache.PrefixCache``) keyed by
+(model version, page-aligned token prefix); an admission whose prompt
+matches attaches those pages by refcounted reference and chunked
+prefill SKIPS the covered chunks entirely — only the divergent tail
+runs the chunk/prefill kernels. Zero device-side changes: the kernels
+already take page ids as data, so compile-once is untouched, and
+because cached bits equal freshly-computed bits, output with the cache
+on is bit-identical to off (test-enforced). Unreferenced cached
+prefixes evict LRU under page pressure before the FIFO admission wait
+triggers.
 """
 
 from __future__ import annotations
@@ -95,6 +108,7 @@ from bigdl_tpu.serving.errors import (
 )
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.paging import PagePool, page_bytes, pages_per_lane
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 
 log = logging.getLogger("bigdl_tpu.serving")
 
@@ -669,7 +683,7 @@ class _SlotState:
 
     __slots__ = ("req", "last_token", "position", "generated", "t_admit",
                  "phase", "pages", "page_row", "prefill_pos",
-                 "draft_pages", "dpage_row")
+                 "draft_pages", "dpage_row", "cache_version")
 
     def __init__(self, req: _GenRequest, last_token: int, position: int,
                  generated: int, t_admit: float, phase: str = "decode",
@@ -688,6 +702,7 @@ class _SlotState:
         self.prefill_pos = prefill_pos    # next prompt index to prefill
         self.draft_pages = draft_pages    # draft-lane pages (speculative)
         self.dpage_row = dpage_row        # draft (ppn,) map row (spec)
+        self.cache_version = 0            # prefix-index version at admit
 
 
 class _Core:
@@ -721,7 +736,7 @@ def _fail_streams(core: _Core, error: BaseException,
         core.pending.clear()
         core.free.extend(core.active.keys())
         core.active.clear()
-    if engine is not None and engine.paged and states:
+    if engine is not None and engine.paged:
         for slot, st in states:
             engine._pool.release(st.pages or ())
             st.pages = None
@@ -732,7 +747,16 @@ def _fail_streams(core: _Core, error: BaseException,
                 engine._pool.release(st.draft_pages or ())
                 st.draft_pages = None
                 engine._dpage_map[slot] = engine._pool.trash
-        engine._report_pages()
+        if engine._prefix is not None:
+            # terminal path (step failure, close, GC): the prefix index
+            # must drop its page references too, or a shared
+            # ServingMetrics reports phantom shared_pages/pages_in_use
+            # forever (chaos drain gate: shared_pages == 0)
+            engine._prefix.clear()
+            if engine._dprefix is not None:
+                engine._dprefix.clear()
+        if states or engine._prefix is not None:
+            engine._report_pages()
     for r in reqs:
         if not r.stream.done:
             r.stream._finish(error)
@@ -843,6 +867,7 @@ class GenerationEngine:
                  stall_timeout: Optional[float] = None,
                  quantize: Optional[str] = None,
                  speculate: Optional[tuple] = None,
+                 prefix_cache: bool = False,
                  tracer=None,
                  timeline_capacity: int = 512,
                  profile_dir: Optional[str] = None,
@@ -902,6 +927,24 @@ class GenerationEngine:
         self.spec_k = 0
         self.draft_model = None
         draft_params = None
+        # prefix caching (PR 12): content-addressed sharing of full,
+        # immutable prompt pages across requests over the one PagePool.
+        # Off by default — the cache holds page references past request
+        # lifetimes, so pool-drain invariants change shape with it on
+        # (output does NOT: cache on vs off is bit-identical,
+        # test-enforced). Built per lane below; a speculative engine
+        # keeps separate target/draft indexes because the two models'
+        # pages hold different K/V for the same tokens and must never
+        # be shared across owners.
+        self.prefix_caching = bool(prefix_cache)
+        self._prefix: Optional[PrefixCache] = None
+        self._dprefix: Optional[PrefixCache] = None
+        self._prefix_flush = False
+        # True after an eviction scan freed nothing; cleared whenever
+        # pages release or publish (evictability can only change then),
+        # so a page-blocked FIFO head does not re-walk the whole index
+        # every scheduler iteration
+        self._evict_stale = False
         if speculate is not None:
             try:
                 self.draft_model, draft_params, self.spec_k = speculate
@@ -1093,8 +1136,17 @@ class GenerationEngine:
                                          dhidden // dheads,
                                          self.cache_dtype_name)
                     if dheads and dhidden and dlayers else 0)
+            if self.prefix_caching:
+                self._prefix = PrefixCache(self._pool, name="target")
+                if self.speculative:
+                    self._dprefix = PrefixCache(self._pool, name="draft")
             self._report_pages()
         else:
+            if self.prefix_caching:
+                raise ValueError(
+                    "prefix_cache=True needs the paged engine (shared "
+                    "prefixes live in refcounted KV pages; the dense "
+                    "slot-lane path has no pages to share)")
             self.prompt_buckets = bucket_sizes_for(self.max_prompt_len)
             self.kernels = kernels or DecodeKernels(
                 model, cache_sharding=self._cache_sharding)
@@ -1271,15 +1323,32 @@ class GenerationEngine:
         the metrics' ``engine_steps`` block."""
         t_iter = time.monotonic()
         self._profile_tick()
+        if self._prefix is not None and self._prefix_flush:
+            # reload() ran on another thread: cached pages hold K/V the
+            # OLD params wrote — drop them here, on the only thread
+            # allowed to touch the pool, before any admission can probe
+            self._prefix_flush = False
+            self._prefix.clear()
+            if self._dprefix is not None:
+                self._dprefix.clear()
+            self._evict_stale = False
+            self._report_pages()
         prefill_s = decode_s = verify_s = 0.0
         core = self._core
         while True:
             with core.cond:
                 if not core.pending or not core.free:
                     break
-                if self.paged and not self._pool.can_reserve(
-                        self._lanes * self._pages_needed(core.pending[0])):
-                    break
+                if self.paged:
+                    need_alloc, probes = self._admit_need(core.pending[0])
+                    if not self._pool.can_reserve(need_alloc) and \
+                            not self._evict_for(need_alloc, probes):
+                        # page pressure: evict unreferenced cached
+                        # prefixes (LRU) first; only when the cache
+                        # cannot cover the shortfall does the FIFO
+                        # head-of-line wait trigger (delay, never
+                        # reorder)
+                        break
                 req = core.pending.popleft()
                 depth = len(core.pending)
             self.metrics.set_queue_depth(depth)
@@ -1346,6 +1415,11 @@ class GenerationEngine:
         scale pools included; a speculative engine prices target and
         draft lanes at their own models' per-page cost)."""
         self.metrics.set_pages(self._pool.in_use, self._pool.num_pages)
+        if self._prefix is not None:
+            self.metrics.set_shared_pages(
+                self._prefix.pages
+                + (self._dprefix.pages if self._dprefix is not None
+                   else 0))
         if not self._kv_page_bytes:
             return
         if self.speculative:
@@ -1364,6 +1438,74 @@ class GenerationEngine:
         # its two lanes (`_lanes` — the draft writes the same positions)
         return self._pool.pages_for(
             min(len(req.prompt) + req.max_new_tokens - 1, self.max_len))
+
+    # --------------------------------------------- prefix-cache hooks ----
+
+    def _prefix_probe(self, req: _GenRequest):
+        """Probe the per-lane prefix indexes for ``req``'s page-aligned
+        prompt prefix. Returns ``(cached token count, [(pages, nodes)
+        per lane])``; a speculative engine clamps to the COMMON hit
+        depth of both lanes — the chunk skip is shared, so a page one
+        lane lost to eviction forces the other to re-prefill it too.
+
+        A pending reload flush (``_prefix_flush``) forces a MISS: the
+        reload already swapped the params this request will decode
+        with, so every cached entry is stale even though the loop has
+        not cleared the index yet (that happens at the next ``_step``
+        top — admissions run after that check, but reload can land
+        between the check and this probe)."""
+        if self._prefix_flush:
+            empty = ([], [])
+            return 0, [empty, empty] if self._dprefix is not None \
+                else [empty]
+        n_tok, pages, nodes = self._prefix.lookup(req.prompt)
+        if self._dprefix is None:
+            return n_tok, [(pages, nodes)]
+        dn_tok, dpages, dnodes = self._dprefix.lookup(req.prompt)
+        k = min(n_tok, dn_tok) // self.page_size
+        return k * self.page_size, [(pages[:k], nodes[:k]),
+                                    (dpages[:k], dnodes[:k])]
+
+    def _admit_need(self, req: _GenRequest):
+        """Pages the pool must ALLOCATE to admit ``req`` (cache-attached
+        prefix pages are shared, not allocated), plus the probe result
+        protecting the matched chains from eviction."""
+        need = self._lanes * self._pages_needed(req)
+        if self._prefix is None:
+            return need, None
+        cached_len, probes = self._prefix_probe(req)
+        return need - self._lanes * (cached_len // self.page_size), probes
+
+    def _evict_for(self, need_alloc: int, probes) -> bool:
+        """Try to free enough cached pages for an admission short by
+        ``need_alloc - free`` pages: LRU leaf eviction per lane, never
+        touching the chains the admission itself matched. True when the
+        pool can now cover the reservation."""
+        if self._prefix is None or self._evict_stale:
+            # a prior scan found nothing evictable and no release or
+            # publish has happened since — the answer cannot have
+            # changed, skip the index walk
+            return False
+        protect = set()
+        for pr in probes or ():
+            protect.update(pr[1])
+        shortfall = need_alloc - self._pool.free_pages
+        freed = 0
+        for cache in (self._prefix, self._dprefix):
+            if cache is None or shortfall <= freed:
+                break
+            freed += cache.evict(shortfall - freed, frozenset(protect))
+        if freed == 0:
+            self._evict_stale = True
+        return self._pool.can_reserve(need_alloc)
+
+    def _chunk_invocations(self, n_tokens: int) -> int:
+        """Kernel invocations (non-final chunks + the final prefill) a
+        prompt tail of ``n_tokens`` costs — the unit the
+        ``prefill_chunks_skipped`` saving is counted in."""
+        if n_tokens <= 0:
+            return 0
+        return (n_tokens - 1) // self.prefill_chunk + 1
 
     def _request_key(self, req: _GenRequest) -> np.ndarray:
         seed = req.seed
@@ -1403,7 +1545,40 @@ class GenerationEngine:
         if tr is not None:
             tr.span("queue_wait", tr.t0)
             reserve_sp = tr.begin_span("page_reserve")
-        pages = self._pool.alloc(need, owner="target")
+        # prefix-cache probe: hit pages attach by REFERENCE (share) and
+        # their tokens never re-prefill; only the divergent tail and the
+        # generation budget allocate fresh pages. The attach is what
+        # copy-on-write protects — and because hits are page-ALIGNED and
+        # always leave >= 1 tail token, every write the request will
+        # ever issue (tail chunks, decode rows) lands at positions past
+        # the attached prefix, in pages it allocated itself: CoW
+        # reduces to the alignment assertion below.
+        cached_len = 0
+        hit_k = 0
+        shared_pages: List[int] = []
+        dshared_pages: List[int] = []
+        if self._prefix is not None:
+            cached_len, probes = self._prefix_probe(req)
+            assert cached_len % self.page_size == 0 \
+                and cached_len < len(req.prompt), \
+                "prefix attach must be page-aligned with a live tail"
+            hit_k = cached_len // self.page_size
+            if hit_k:
+                shared_pages = list(probes[0][0])
+                self._pool.share(shared_pages)
+                if self._dprefix is not None:
+                    dshared_pages = list(probes[1][0])
+                    self._pool.share(dshared_pages)
+            skipped = (self._chunk_invocations(len(req.prompt))
+                       - self._chunk_invocations(len(req.prompt)
+                                                 - cached_len))
+            self._prefix.record_probe(hit_k > 0, cached_len)
+            if self._dprefix is not None:
+                self._dprefix.record_probe(hit_k > 0, cached_len)
+            self.metrics.record_prefix_probe(hit_k > 0,
+                                             skipped * self._lanes)
+        pages = shared_pages + self._pool.alloc(need - hit_k,
+                                                owner="target")
         row = np.full((self._pool.pages_per_slot,), self._pool.trash,
                       np.int32)
         row[:len(pages)] = pages
@@ -1413,18 +1588,33 @@ class GenerationEngine:
             # the draft lane reserves the same row budget side by side
             # (one pool, owner-tagged so the drain invariants are
             # assertable per lane)
-            draft_pages = self._pool.alloc(need, owner="draft")
+            draft_pages = dshared_pages + self._pool.alloc(
+                need - hit_k, owner="draft")
             drow = np.full((self._pool.pages_per_slot,), self._pool.trash,
                            np.int32)
             drow[:len(draft_pages)] = draft_pages
         if tr is not None:
-            tr.end_span(reserve_sp, pages=need * self._lanes, slot=slot)
-        st = _SlotState(req, self.pad_id, 0, 0, now, phase="prefill",
-                        pages=pages, page_row=row, prefill_pos=0,
-                        draft_pages=draft_pages, dpage_row=drow)
+            tr.end_span(reserve_sp, pages=need * self._lanes, slot=slot,
+                        prefix_pages=hit_k * self._lanes)
+        st = _SlotState(req, self.pad_id, cached_len, 0, now,
+                        phase="prefill", pages=pages, page_row=row,
+                        prefill_pos=cached_len, draft_pages=draft_pages,
+                        dpage_row=drow)
+        if self._prefix is not None:
+            # stamp the index version the prompt is prefilled under:
+            # a retirement after a reload flush (version bumped) must
+            # NOT publish its old-params pages into the fresh index.
+            # One stamp covers both lanes — they flush in lockstep.
+            st.cache_version = self._prefix.version
         with core.cond:
             core.active[slot] = st
         self._report_pages()
+        if self._prefix is not None:
+            # fault site: an armed exception lands between the prefix
+            # attach (references taken) and the first prefill/decode
+            # step — the loop's failure path must release every
+            # refcount and leak zero shared pages (chaos-gated)
+            faults.fire("engine.prefix_attach", engine=self)
 
     def _prefill_chunk_once(self, slot: int, st: _SlotState) -> None:
         """Advance one prompt chunk for a prefilling slot. Non-final
@@ -1526,6 +1716,22 @@ class GenerationEngine:
             core.active.pop(slot, None)
             core.free.append(slot)
         if self.paged:
+            if (self._prefix is not None and st.pages
+                    and st.phase == "decode"
+                    and st.cache_version == self._prefix.version):
+                # publish the sequence's FULL prompt pages back to the
+                # index (phase=="decode" means the whole prompt is
+                # written; a mid-prefill retirement has nothing whole
+                # to share). New nodes take their own pool references
+                # BEFORE the request's are dropped below, so the pages
+                # never graze the free heap in between. The version
+                # check drops retirements that straddled a reload
+                # flush: their pages hold K/V the OLD params wrote and
+                # must never re-enter the fresh index.
+                self._prefix.publish(st.req.prompt, st.page_row)
+                if self._dprefix is not None:
+                    self._dprefix.publish(st.req.prompt, st.dpage_row)
+                self._evict_stale = False
             self._pool.release(st.pages or ())
             st.pages = None
             self._page_map[slot] = self._pool.trash
@@ -1537,6 +1743,7 @@ class GenerationEngine:
             self._top_ks[slot] = 0
             self._top_ps[slot] = 1.0
             self._keys[slot] = 0
+            self._evict_stale = False   # released pages: re-scan is live
             self._report_pages()
 
     def _admit(self, req: _GenRequest) -> None:
@@ -1863,6 +2070,13 @@ class GenerationEngine:
             self._params = jax.device_put(params, self._param_shardings)
         else:
             self._params = jax.device_put(params)
+        if self._prefix is not None:
+            # cached pages are keyed by (model version, prefix): pages
+            # the OLD params wrote must never serve the new ones. The
+            # pool is loop-thread-only, so flag the flush and let the
+            # loop clear the index at its next iteration (the same
+            # between-steps granularity the param swap itself has).
+            self._prefix_flush = True
         self.metrics.record_reload()
 
     def close(self, drain: bool = True,
@@ -1945,6 +2159,15 @@ class GenerationEngine:
     @property
     def free_pages(self) -> int:
         return self._pool.free_pages if self.paged else 0
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages the prefix index(es) currently hold references for
+        (0 without prefix caching) — the chaos drain gate's gauge."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.pages + (self._dprefix.pages
+                                     if self._dprefix is not None else 0)
 
 
 def static_generate(model, params, requests, *, max_slots: int,

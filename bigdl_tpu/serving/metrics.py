@@ -113,6 +113,14 @@ class ServingMetrics:
         self.engine_steps = 0        # scheduler loop iterations
         self.step_host_s = 0.0       # host scheduling/bookkeeping time
         self.step_device_s = 0.0     # kernel-call wait (all phases)
+        # prefix-cache counters (PR 12); zero until a prefix-caching
+        # paged engine actually probes — snapshot/table keep the
+        # earlier shapes (same append-only golden contract as every
+        # block above)
+        self.prefix_hits = 0         # admissions that attached cached pages
+        self.prefix_misses = 0       # admissions with no cached prefix
+        self.shared_pages = 0        # pages the prefix index holds (gauge)
+        self.prefill_chunks_skipped = 0  # chunk/prefill calls not executed
 
     # ------------------------------------------------------- mutators ----
 
@@ -245,6 +253,27 @@ class ServingMetrics:
             self.step_host_s += float(host_s)
             self.step_device_s += float(device_s)
 
+    # ----------------------------------------- prefix-cache mutators ----
+
+    def record_prefix_probe(self, hit: bool,
+                            chunks_skipped: int = 0) -> None:
+        """One paged admission's prefix-cache probe: ``hit`` when cached
+        pages were attached, ``chunks_skipped`` the chunk/prefill kernel
+        invocations the attach made unnecessary (the prefill-FLOPs
+        saving, counted against the cache-off invocation count)."""
+        with self._lock:
+            if hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+            self.prefill_chunks_skipped += int(chunks_skipped)
+
+    def set_shared_pages(self, n: int) -> None:
+        """Prefix-index size gauge: pages the cache currently holds
+        references for (drains to 0 on eviction/clear/close)."""
+        with self._lock:
+            self.shared_pages = int(n)
+
     # --------------------------------------------- replica mutators ----
 
     def set_replicas(self, healthy: int, total: int,
@@ -359,6 +388,16 @@ class ServingMetrics:
                     self.step_host_s
                     / (self.step_host_s + self.step_device_s)
                     if self.step_host_s + self.step_device_s else 0.0),
+                # prefix-cache fields (PR 12): appended after every
+                # earlier key, never reordered
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": (
+                    self.prefix_hits
+                    / (self.prefix_hits + self.prefix_misses)
+                    if self.prefix_hits + self.prefix_misses else 0.0),
+                "shared_pages": self.shared_pages,
+                "prefill_chunks_skipped": self.prefill_chunks_skipped,
             }
 
     def format_table(self) -> str:
@@ -447,4 +486,14 @@ class ServingMetrics:
             row("step_host_ms", f"{s['step_host_ms']:.3f}")
             row("step_device_ms", f"{s['step_device_ms']:.3f}")
             row("step_host_frac", f"{s['step_host_frac'] * 100:.1f}%")
+        # prefix-cache rows: appended strictly after the step-timeline
+        # block and only when a prefix-caching engine actually probed —
+        # every earlier table stays a byte-identical strict prefix
+        # (append-only golden contract, test-enforced)
+        if s["prefix_hits"] or s["prefix_misses"] or s["shared_pages"]:
+            row("prefix_hits", s["prefix_hits"])
+            row("prefix_misses", s["prefix_misses"])
+            row("prefix_hit_rate", f"{s['prefix_hit_rate'] * 100:.1f}%")
+            row("shared_pages", s["shared_pages"])
+            row("prefill_chunks_skipped", s["prefill_chunks_skipped"])
         return "\n".join(lines)

@@ -25,12 +25,25 @@ Policy notes:
   but never in the free list: bucket-padding writes and freed slots'
   map rows point there, so garbage can never land in a page another
   sequence owns. Its contents are arbitrary and always masked.
+- **refcounted read-only sharing (PR 12).** Every reserved page carries
+  a reference count: ``alloc`` starts it at 1, :meth:`share` adds a
+  reference (the prefix cache publishing a page, or a request attaching
+  a cached prefix page), ``release`` drops one — a page returns to the
+  free heap ONLY when its last reference goes, so a shared page can
+  never be handed to a new owner while somebody still reads it.
+  ``in_use`` and the per-owner gauges count DISTINCT pages (a page
+  shared by three requests is charged once, to its original alloc
+  owner), which keeps every drain invariant byte-exact under sharing.
 """
 
 from __future__ import annotations
 
 import heapq
 from typing import Dict, List, Optional, Sequence
+
+import numpy as np  # module-level on purpose: page_bytes sits on the
+# hot metrics path (one call per kv_bytes_in_use gauge read) — a
+# function-local import would re-run the sys.modules lookup per read
 
 
 def page_bytes(page_size: int, num_heads: int, head_dim: int,
@@ -42,8 +55,6 @@ def page_bytes(page_size: int, num_heads: int, head_dim: int,
     ``kv_bytes_in_use`` gauge and the bench capacity column both read
     it, so int8-vs-bf16 capacity claims price the scale overhead
     honestly instead of pretending pages are free to describe."""
-    import numpy as np
-
     if np.dtype(cache_dtype) == np.int8:
         per_row = num_heads * head_dim * 1 + 4       # int8 row + f32 scale
     else:
@@ -88,6 +99,10 @@ class PagePool:
         # forgetting to repeat the tag.
         self._page_owner: Dict[int, str] = {}
         self._owner_counts: Dict[str, int] = {}
+        # reference count per RESERVED page (absent = free). alloc sets
+        # 1; share() adds; release() subtracts and frees at zero — the
+        # prefix cache's read-only page sharing rides on this.
+        self._refs: Dict[int, int] = {}
 
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` KV rows (>= 1)."""
@@ -108,6 +123,8 @@ class PagePool:
                 f"of {self.num_pages}")
         pages = [heapq.heappop(self._free) for _ in range(n)]
         self.in_use += n
+        for p in pages:
+            self._refs[p] = 1
         if owner is not None:
             for p in pages:
                 self._page_owner[p] = owner
@@ -115,14 +132,47 @@ class PagePool:
                 self._owner_counts.get(owner, 0) + n)
         return pages
 
-    def release(self, pages: Sequence[int]) -> None:
+    def share(self, pages: Sequence[int]) -> None:
+        """Add one reference to each (already reserved) page. The page
+        keeps its original owner tag and stays charged ONCE in
+        ``in_use`` / the per-owner gauges — sharing is free to account.
+        Raises on a free page: a reference to memory nobody reserved is
+        exactly the use-after-free the refcount exists to prevent."""
         for p in pages:
             p = int(p)
+            refs = self._refs.get(p, 0)
+            if refs < 1:
+                raise RuntimeError(
+                    f"page {p} is not reserved; share() can only add "
+                    f"references to live pages")
+            self._refs[p] = refs + 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page returns to the free heap
+        (and its owner gauge) only when the LAST reference goes — a
+        shared page is never handed back while referenced."""
+        for p in pages:
+            p = int(p)
+            refs = self._refs.get(p)
+            if refs is None:
+                raise RuntimeError(
+                    f"page {p} released while not reserved (double "
+                    f"release, or a page id that never came from alloc)")
+            if refs > 1:
+                self._refs[p] = refs - 1
+                continue
+            del self._refs[p]
             heapq.heappush(self._free, p)
+            self.in_use -= 1
             owner = self._page_owner.pop(p, None)
             if owner is not None:
                 self._owner_counts[owner] -= 1
-        self.in_use -= len(pages)
+
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 = free). The prefix cache's
+        eviction gate: only pages it alone references (refcount == 1
+        from the cache's own share) may be evicted to the free heap."""
+        return self._refs.get(int(page), 0)
 
     def in_use_by(self, owner: str) -> int:
         """Reserved pages currently tagged ``owner`` (0 for unknown
@@ -142,6 +192,9 @@ class PagePool:
             "pages_per_slot": self.pages_per_slot,
             "by_owner": {k: v for k, v in sorted(self._owner_counts.items())
                          if v},
+            # pages currently multi-referenced (prefix-cache sharing);
+            # appended after every earlier key (append-only contract)
+            "pages_shared": sum(1 for r in self._refs.values() if r >= 2),
         }
 
     @property

@@ -1,0 +1,232 @@
+"""Content-addressed KV prefix sharing over the paged cache (PR 12).
+
+Real serving traffic re-prefills the same prompt prefix thousands of
+times — shared system prompts, few-shot templates, multi-turn chat.
+With the paged cache those prefix K/V rows are ALREADY sitting in
+physical pages when a sequence retires; the only missing piece is an
+index that finds them again. This module is that index, following the
+radix-tree KV reuse of vLLM/SGLang-style serving stacks (PAPERS.md):
+
+- **entries are full, immutable pages.** A prompt's K/V writes depend
+  only on the token ids and their absolute positions (prompts start at
+  position 0), so a completely written page is a pure function of
+  ``(model version, the page-aligned token prefix ending at it)``. Only
+  FULL prompt pages are published — the page a prompt ends mid-way
+  through keeps taking decode writes and is never shareable — and a
+  lookup never matches the whole prompt (at least one tail token must
+  re-prefill to produce the first-token logits), so a shared page is
+  read-only BY CONSTRUCTION: attach lengths are page-aligned, every
+  prefill/decode write of the attaching request lands at positions past
+  the attached prefix, i.e. in pages it allocated itself. Copy-on-write
+  therefore degenerates to the alignment assertion the engine makes at
+  attach time — no device-side copy path exists to need.
+- **the index is a radix tree of page-sized token chunks.** One node
+  per cached page, keyed under its parent by the page's token tuple;
+  matching walks chunk by chunk, so a hit is always a chain of
+  ancestors (a page is only usable together with its whole prefix).
+- **references, not copies.** The cache holds ONE
+  :meth:`~bigdl_tpu.serving.paging.PagePool.share` reference per cached
+  page; an attaching request adds its own. The pool frees a page only
+  at refcount zero, so eviction and retirement can race in any order
+  without a page ever reaching the free heap while referenced.
+- **LRU leaf eviction under page pressure.** When an admission cannot
+  reserve its pages, the engine evicts least-recently-used UNREFERENCED
+  leaves (cache-only refcount, no children) before falling back to the
+  FIFO head-of-line wait — cached prefixes are a cache, live requests
+  are not.
+
+All mutation happens on the engine loop thread (the same single-writer
+discipline as :class:`~bigdl_tpu.serving.paging.PagePool`);
+``snapshot()`` reads plain ints and is safe to scrape from the obs
+:class:`~bigdl_tpu.obs.registry.MetricsRegistry`.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import FrozenSet, List, Optional, Sequence, Tuple
+
+
+class _PrefixNode:
+    """One cached page: ``chunk`` is its page_size-token key under
+    ``parent``, ``page`` the physical page id the cache holds a pool
+    reference for, ``stamp`` the LRU clock of its last touch."""
+
+    __slots__ = ("chunk", "parent", "page", "children", "stamp")
+
+    def __init__(self, chunk, parent, page, stamp=0):
+        self.chunk = chunk
+        self.parent = parent
+        self.page = page
+        self.children = {}
+        self.stamp = stamp
+
+
+class PrefixCache:
+    """Radix index over full, immutable KV pages of one paged lane.
+
+    One instance per (engine, lane): a speculative engine keeps one for
+    its target pools and one for the draft pools — the two models'
+    pages hold different K/V for the same tokens and must never be
+    shared across lanes. ``version`` folds the model identity into the
+    keying: the engine bumps/clears on ``reload``, so pages written by
+    retired params can never serve new ones.
+    """
+
+    def __init__(self, pool, *, name: str = "prefix"):
+        self._pool = pool
+        self.page_size = int(pool.page_size)
+        self.name = name
+        self.version = 0
+        self._root = _PrefixNode(None, None, None)
+        self._pages = 0          # nodes == cached pages (gauge)
+        self._clock = 0          # LRU stamp source
+        self.hits = 0            # admissions that attached >= 1 page
+        self.misses = 0
+        self.hit_tokens = 0      # prompt tokens served from the cache
+        self.published_pages = 0
+        self.evicted_pages = 0
+
+    # ------------------------------------------------------- queries ----
+
+    @property
+    def pages(self) -> int:
+        """Pages the cache currently holds references for (gauge)."""
+        return self._pages
+
+    def lookup(self, prompt: Sequence[int]
+               ) -> Tuple[int, List[int], List[_PrefixNode]]:
+        """Longest cached page-aligned prefix of ``prompt`` that leaves
+        at least ONE tail token to prefill (the final chunk must run to
+        produce the first-token logits). Returns ``(matched token
+        count, page ids, nodes)``; touches the matched chain's LRU
+        stamps. Pure apart from the stamps — probing at the FIFO head
+        check and again at admission sees the same answer."""
+        ps = self.page_size
+        limit = (len(prompt) - 1) // ps    # full pages, tail preserved
+        node = self._root
+        pages: List[int] = []
+        nodes: List[_PrefixNode] = []
+        for i in range(limit):
+            child = node.children.get(
+                tuple(int(t) for t in prompt[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            node = child
+            pages.append(node.page)
+            nodes.append(node)
+        if nodes:
+            self._clock += 1
+            for nd in nodes:
+                nd.stamp = self._clock
+        return len(pages) * ps, pages, nodes
+
+    def record_probe(self, hit: bool, n_tokens: int = 0) -> None:
+        """Count one admission's probe outcome (the engine calls this
+        exactly once per admitted request per lane)."""
+        if hit:
+            self.hits += 1
+            self.hit_tokens += int(n_tokens)
+        else:
+            self.misses += 1
+
+    # ------------------------------------------------------ mutators ----
+
+    def publish(self, prompt: Sequence[int], page_row) -> int:
+        """Index the FULL prompt pages of a retiring sequence:
+        ``page_row[i]`` is the physical page holding prompt tokens
+        ``[i*ps, (i+1)*ps)``. Existing chains are descended (the pages
+        the request itself attached, or a prefix someone published
+        first — their duplicate physical pages simply drain with the
+        request's own references); new nodes take one pool reference
+        each. Returns the number of pages newly published."""
+        ps = self.page_size
+        self._clock += 1
+        node = self._root
+        added = 0
+        for i in range(len(prompt) // ps):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                page = int(page_row[i])
+                self._pool.share([page])
+                child = _PrefixNode(key, node, page, self._clock)
+                node.children[key] = child
+                self._pages += 1
+                self.published_pages += 1
+                added += 1
+            child.stamp = self._clock
+            node = child
+        return added
+
+    def evict(self, n_pages: int,
+              protect: FrozenSet[_PrefixNode] = frozenset()) -> int:
+        """Free up to ``n_pages`` pages by evicting least-recently-used
+        UNREFERENCED leaves (pool refcount exactly the cache's own, no
+        children — evicting an interior node would orphan its
+        descendants' chains). ``protect`` shields the chain a pending
+        admission just matched. Returns pages actually freed; evicting
+        a leaf may expose its parent, which joins the candidate heap."""
+        if n_pages <= 0 or not self._pages:
+            return 0
+        heap: List[Tuple[int, int, _PrefixNode]] = []
+
+        def _evictable(nd: _PrefixNode) -> bool:
+            return (not nd.children and nd not in protect
+                    and self._pool.refcount(nd.page) == 1)
+
+        stack = list(self._root.children.values())
+        while stack:
+            nd = stack.pop()
+            if nd.children:
+                stack.extend(nd.children.values())
+            elif _evictable(nd):
+                heapq.heappush(heap, (nd.stamp, id(nd), nd))
+        freed = 0
+        while heap and freed < n_pages:
+            _, _, leaf = heapq.heappop(heap)
+            parent = leaf.parent
+            del parent.children[leaf.chunk]
+            self._pool.release([leaf.page])
+            self._pages -= 1
+            self.evicted_pages += 1
+            freed += 1
+            if parent is not self._root and _evictable(parent):
+                heapq.heappush(heap, (parent.stamp, id(parent), parent))
+        return freed
+
+    def clear(self) -> int:
+        """Drop every cached page reference (engine close / failure /
+        param reload — cached K/V keyed by the old params must never
+        serve the new ones). Returns pages released; bumps ``version``
+        so stale external references to this index are identifiable."""
+        released = 0
+        stack = list(self._root.children.values())
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            self._pool.release([nd.page])
+            released += 1
+        self._root = _PrefixNode(None, None, None)
+        self._pages = 0
+        self.evicted_pages += released
+        self.version += 1
+        return released
+
+    # ------------------------------------------------------- readers ----
+
+    def snapshot(self) -> dict:
+        """Plain-int gauges for the obs registry (``register("prefix",
+        cache)``) — index size and probe/eviction counters."""
+        probes = self.hits + self.misses
+        return {
+            "entries": self._pages,
+            "shared_pages": self._pages,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hits / probes if probes else 0.0,
+            "hit_tokens": self.hit_tokens,
+            "published_pages": self.published_pages,
+            "evicted_pages": self.evicted_pages,
+            "version": self.version,
+        }

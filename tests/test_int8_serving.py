@@ -23,6 +23,8 @@ The load-bearing properties, per the subsystem contract:
   replica block (golden order).
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -429,6 +431,15 @@ class TestInt8Engine:
             prompt = [int(t) for t in rng.randint(1, 60, plen)]
             streams.append(eng.submit(prompt, max_new_tokens=2 + i % 5))
             seen_bytes.append(eng.metrics.snapshot()["kv_bytes_in_use"])
+        # the submit loop can outrun the engine loop's first admission
+        # on a loaded host (every sample then reads 0 before any pages
+        # are reserved): keep sampling while streams are in flight — a
+        # dead gauge still reads 0 at every point of the run and fails
+        deadline = time.monotonic() + 60
+        while (max(seen_bytes) == 0 and not all(s.done for s in streams)
+               and time.monotonic() < deadline):
+            seen_bytes.append(eng.metrics.snapshot()["kv_bytes_in_use"])
+            time.sleep(0.001)
         for s in streams:
             s.result(timeout=60)
         assert kernels.decode_traces == 1, "int8 decode recompiled"
@@ -607,11 +618,11 @@ def test_kv_metrics_rows_append_after_replica_golden():
                      "quantized_gemms"]
     snap = m.snapshot()
     keys = list(snap.keys())
-    # the PR-9 block sits immediately before the PR-10 speculative and
-    # PR-11 step-timeline keys (append-only: each PR's rows land AFTER
-    # every earlier block)
-    assert keys[-11:-8] == ["kv_bytes_in_use", "kv_cache_dtype",
-                            "quantized_gemms"]
+    # the PR-9 block sits immediately before the PR-10 speculative,
+    # PR-11 step-timeline, and PR-12 prefix-cache keys (append-only:
+    # each PR's rows land AFTER every earlier block)
+    assert keys[-16:-13] == ["kv_bytes_in_use", "kv_cache_dtype",
+                             "quantized_gemms"]
     assert snap["kv_bytes_in_use"] == 5 * 5248
     assert snap["kv_cache_dtype"] == "int8"
     assert snap["quantized_gemms"] == 13

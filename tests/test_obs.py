@@ -593,8 +593,9 @@ def test_step_timeline_metrics_rows_append_after_speculative_block():
     assert extra == ["engine_steps", "step_host_ms", "step_device_ms",
                      "step_host_frac"]
     snap = m.snapshot()
-    assert list(snap)[-4:] == ["engine_steps", "step_host_ms",
-                               "step_device_ms", "step_host_frac"]
+    # immediately before the PR-12 prefix-cache keys (append-only)
+    assert list(snap)[-9:-5] == ["engine_steps", "step_host_ms",
+                                 "step_device_ms", "step_host_frac"]
     assert snap["engine_steps"] == 2
     assert snap["step_host_ms"] == pytest.approx(3.0)
     assert snap["step_device_ms"] == pytest.approx(13.0)

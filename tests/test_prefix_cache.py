@@ -1,0 +1,569 @@
+"""Prefix caching (PR 12): refcounted KV page sharing across requests.
+
+The load-bearing properties, per the subsystem contract:
+
+- the HEADLINE: engine output with the prefix cache ON is bit-identical
+  to OFF — greedy and sampled, float and int8 KV, tp=1 and tp=2,
+  whole and chunked prompts, sequential and concurrent admission, any
+  admission order (cached pages hold the same bits a fresh prefill
+  writes, and the gather after them is pure data movement);
+- hits actually skip prefill work: the covered chunk/prefill kernel
+  invocations never run, counted in ``prefill_chunks_skipped``;
+- `PagePool` refcounting: a shared page is never handed to the free
+  heap while referenced, is charged ONCE in `in_use` / per-owner
+  gauges, and every retire/cancel/close(drain=False)/fault path drains
+  it exactly;
+- unreferenced cached prefixes evict LRU under page pressure BEFORE
+  the FIFO admission wait, never evicting a chain a pending admission
+  just matched or a page a live request still reads;
+- a fault between prefix attach and the first decode step releases
+  every refcount (`engine.prefix_attach` site, chaos-gated too);
+- `reload()` flushes the index (cached pages are keyed by model
+  version);
+- metrics rows append strictly after the PR-11 step-timeline block.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.faults import InjectedFault
+from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.serving import (
+    GenerationEngine,
+    PagePool,
+    PagedDecodeKernels,
+    PrefixCache,
+    ServingMetrics,
+)
+
+SLOTS, MAXLEN = 4, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+    params, _ = model.init(jax.random.key(0))
+    # one kernel triple for the whole module: the jit cache persists
+    # across engines, so each test pays bookkeeping, not recompilation
+    kernels = PagedDecodeKernels(model)
+    return model, params, kernels
+
+
+def make_engine(lm, **kw):
+    model, params, kernels = lm
+    kw.setdefault("max_slots", SLOTS)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("kernels", kernels)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefill_chunk", 4)
+    return GenerationEngine(model, params, **kw)
+
+
+PREFIX = [int(t) for t in np.random.RandomState(7).randint(1, 60, 12)]
+
+
+def shared_prefix_prompts():
+    """The workload shape prefix caching exists for: one 3-page system
+    prefix, divergent tails (short and chunk-spanning), plus one
+    unrelated prompt that must miss."""
+    long_tail = [int(t) for t in np.random.RandomState(8).randint(1, 60, 18)]
+    return ([PREFIX + [i + 1, i + 2] for i in range(4)]
+            + [PREFIX + long_tail]          # chunked divergent tail
+            + [[9, 2, 5]])                  # unrelated: miss
+
+
+# ----------------------------------------------------- pool refcounts ----
+
+
+class TestPagePoolRefcounts:
+    def test_share_release_lifecycle(self):
+        pool = PagePool(8, 4, 16)
+        pages = pool.alloc(2, owner="target")
+        pool.share(pages)                       # cache reference
+        assert all(pool.refcount(p) == 2 for p in pages)
+        pool.release(pages)                     # request retires
+        assert pool.in_use == 2                 # still cache-held
+        assert pool.free_pages == 6
+        assert all(pool.refcount(p) == 1 for p in pages)
+        pool.release(pages)                     # cache evicts
+        assert pool.in_use == 0 and pool.free_pages == 8
+        assert all(pool.refcount(p) == 0 for p in pages)
+
+    def test_shared_page_charged_once_per_owner(self):
+        """Satellite: snapshot owner-tag accounting under shared pages —
+        a refcounted page is charged exactly once, to its alloc owner,
+        however many references ride on it."""
+        pool = PagePool(8, 4, 16)
+        a = pool.alloc(3, owner="target")
+        pool.share(a)           # published to the cache
+        pool.share(a)           # attached by a second request
+        snap = pool.snapshot()
+        assert snap["by_owner"] == {"target": 3}
+        assert snap["pages_in_use"] == 3
+        assert snap["pages_shared"] == 3
+        pool.release(a)         # original request retires
+        pool.release(a)         # attaching request retires
+        snap = pool.snapshot()
+        assert snap["by_owner"] == {"target": 3}    # cache ref remains
+        assert snap["pages_shared"] == 0
+        pool.release(a)         # cache evicts: NOW the owner drains
+        snap = pool.snapshot()
+        assert snap["by_owner"] == {} and snap["pages_in_use"] == 0
+
+    def test_release_of_unreserved_page_raises(self):
+        pool = PagePool(4, 4, 16)
+        pages = pool.alloc(1)
+        pool.release(pages)
+        with pytest.raises(RuntimeError, match="not reserved"):
+            pool.release(pages)     # double release = accounting bug
+        with pytest.raises(RuntimeError, match="share"):
+            pool.share([3])         # free page cannot take a reference
+
+
+# ------------------------------------------------------- index (unit) ----
+
+
+class TestPrefixCacheIndex:
+    def test_lookup_is_page_aligned_and_never_whole_prompt(self):
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        prompt = list(range(1, 13))             # 12 tokens = 3 pages
+        pages = pool.alloc(3)
+        cache.publish(prompt, pages)
+        assert cache.pages == 3
+        # identical 12-token prompt: only 2 pages usable (>= 1 tail
+        # token must re-prefill to produce the first-token logits)
+        n, hit, _ = cache.lookup(prompt)
+        assert n == 8 and hit == pages[:2]
+        # longer prompt sharing the prefix: all 3 pages usable
+        n, hit, _ = cache.lookup(prompt + [40, 41])
+        assert n == 12 and hit == pages
+        # divergence inside page 2: only page 0 matches
+        n, hit, _ = cache.lookup(prompt[:4] + [50] * 8)
+        assert n == 4 and hit == pages[:1]
+        assert cache.lookup([50] * 12)[0] == 0
+
+    def test_publish_descends_existing_chains(self):
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        prompt = list(range(1, 13))
+        first = pool.alloc(3)
+        assert cache.publish(prompt, first) == 3
+        # a second retirement of the same prefix publishes NOTHING new
+        dup = pool.alloc(3)
+        assert cache.publish(prompt, dup) == 0
+        assert cache.pages == 3
+        pool.release(dup)       # its duplicate pages just drain
+        # the 3 cached pages are `first`'s own (charged once, ref 2)
+        assert pool.in_use == 3
+        pool.release(first)
+        assert pool.in_use == 3  # cache refs keep them reserved
+
+    def test_evict_lru_leaves_first_with_protect_and_refcounts(self):
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        old = list(range(1, 9))                  # 2 pages, older
+        hot = [20, 21, 22, 23]                   # 1 page, newer
+        p_old = pool.alloc(2)
+        p_hot = pool.alloc(1)
+        cache.publish(old, p_old)
+        cache.publish(hot, p_hot)
+        pool.release(p_old)
+        pool.release(p_hot)                      # cache-only refs now
+        # LRU: the old chain's LEAF goes first, then its parent
+        assert cache.evict(1) == 1
+        assert cache.lookup(old + [9])[0] == 4   # parent survived
+        # protect: the hot chain cannot be evicted when matched
+        _, _, nodes = cache.lookup(hot + [9])
+        assert cache.evict(10, frozenset(nodes)) == 1   # only old's root
+        assert cache.pages == 1
+        # a page a live request references is not evictable
+        _, hit, _ = cache.lookup(hot + [9])
+        pool.share(hit)                          # request attaches
+        assert cache.evict(10) == 0
+        pool.release(hit)
+        assert cache.evict(10) == 1 and cache.pages == 0
+        assert pool.in_use == 0
+
+    def test_clear_releases_everything(self):
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        pages = pool.alloc(3)
+        cache.publish(list(range(1, 13)), pages)
+        pool.release(pages)
+        v0 = cache.version
+        assert cache.clear() == 3
+        assert cache.pages == 0 and pool.in_use == 0
+        assert cache.version == v0 + 1
+        assert cache.snapshot()["shared_pages"] == 0
+
+
+# ----------------------------------------------------- engine headline ----
+
+
+class TestPrefixEngineIdentity:
+    @pytest.mark.parametrize("spec_kw,cache_dtype", [
+        ({}, jnp.float32),
+        (dict(temperature=0.9, top_k=20, top_p=0.95), jnp.float32),
+        ({}, "int8"),
+        (dict(temperature=0.9, top_k=20, top_p=0.95), "int8"),
+    ], ids=["greedy-f32", "sampled-f32", "greedy-int8", "sampled-int8"])
+    def test_bit_identical_cache_on_vs_off(self, lm, spec_kw, cache_dtype):
+        """THE acceptance assertion: same prompts (shared 3-page prefix,
+        short and chunk-spanning divergent tails, one unrelated miss)
+        with the cache on vs off produce identical streams — sequential
+        replay (maximal hits), concurrent wave, and reversed admission
+        order; greedy and sampled; float and int8 KV."""
+        prompts = shared_prefix_prompts()
+        lens = [6, 3, 8, 5, 4, 7]
+
+        def run(enabled, order=None, sequential=False):
+            eng = make_engine(lm, max_slots=2, seed=3,
+                              cache_dtype=cache_dtype,
+                              prefix_cache=enabled)
+            idx = list(order if order is not None else range(len(prompts)))
+            if sequential:
+                outs = {i: eng.generate(prompts[i], max_new_tokens=lens[i],
+                                        timeout=60, **spec_kw)
+                        for i in idx}
+            else:
+                streams = {i: eng.submit(prompts[i], max_new_tokens=lens[i],
+                                         **spec_kw) for i in idx}
+                outs = {i: s.result(timeout=60) for i, s in streams.items()}
+            snap = eng.metrics.snapshot()
+            eng.close()
+            assert eng.pages_in_use == 0 and eng.shared_pages == 0
+            return outs, snap
+
+        want, _ = run(False)
+        got_seq, snap = run(True, sequential=True)
+        assert got_seq == want
+        # sequential replay: every later shared-prefix request hits
+        assert snap["prefix_hits"] == 4
+        assert snap["prefill_chunks_skipped"] > 0
+        got_conc, _ = run(True)
+        assert got_conc == want
+        got_rev, _ = run(True, order=reversed(range(len(prompts))),
+                         sequential=True)
+        assert got_rev == want
+
+    def test_tp2_bit_identical_to_single_device(self, lm):
+        """Sharded edition: a tp=2 prefix-caching engine emits the
+        single-device cache-off engine's exact streams (cached pages
+        shard on heads like every other page; sharing is orthogonal to
+        placement)."""
+        from jax.sharding import NamedSharding
+
+        from bigdl_tpu.parallel import (
+            kv_cache_pspec,
+            serving_meshes,
+        )
+
+        model, params, _ = lm
+        prompts = shared_prefix_prompts()[:4]
+
+        want = {}
+        eng = make_engine(lm, max_slots=2)
+        for i, p in enumerate(prompts):
+            want[i] = eng.generate(p, max_new_tokens=5, timeout=60)
+        eng.close()
+
+        mesh = serving_meshes(1, 2)[0]
+        cs = NamedSharding(mesh, kv_cache_pspec())
+        skern = PagedDecodeKernels(model, cache_sharding=cs)
+        seng = GenerationEngine(model, params, max_slots=2, max_len=MAXLEN,
+                                kernels=skern, page_size=4,
+                                prefill_chunk=4, mesh=mesh,
+                                prefix_cache=True)
+        got = {i: seng.generate(p, max_new_tokens=5, timeout=60)
+               for i, p in enumerate(prompts)}
+        snap = seng.metrics.snapshot()
+        seng.close()
+        assert got == want
+        assert snap["prefix_hits"] == 3
+
+
+# ------------------------------------------------------ engine behaviour ----
+
+
+class TestPrefixEngineBehaviour:
+    def test_hits_skip_prefill_chunks(self, lm):
+        """The prefill-FLOPs saving is real, not just counted: with a
+        12-token prefix at prefill_chunk=4, the cache-off replay runs 3
+        chunk invocations per request; cache-on runs them once and
+        skips them for every hit."""
+        prompts = [PREFIX + [i + 1, i + 2] for i in range(5)]
+
+        def run(enabled):
+            eng = make_engine(lm, max_slots=2, prefix_cache=enabled)
+            for p in prompts:
+                eng.generate(p, max_new_tokens=3, timeout=30)
+            snap = eng.metrics.snapshot()
+            eng.close()
+            return snap, eng.metrics.snapshot()
+
+        off, _ = run(False)
+        on, closed = run(True)
+        assert off["prefill_chunks"] == 3 * len(prompts)
+        assert on["prefill_chunks"] == 3             # first request only
+        assert on["prefix_hits"] == 4 and on["prefix_misses"] == 1
+        assert on["prefix_hit_rate"] == pytest.approx(0.8)
+        assert on["prefill_chunks_skipped"] == 3 * 4
+        assert on["shared_pages"] == 3               # index live pre-close
+        assert closed["shared_pages"] == 0           # cleared at close
+
+    def test_shared_pages_gauge_live_while_serving(self, lm):
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        eng.generate(PREFIX + [1, 2], max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 3                 # 3 full prompt pages
+        assert eng.metrics.snapshot()["shared_pages"] == 3
+        assert eng._pool.in_use == 3                 # cache refs only
+        eng.generate(PREFIX + [3, 4], max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 3                 # same prefix, no growth
+        eng.close()
+        assert eng.shared_pages == 0
+        assert eng.metrics.snapshot()["shared_pages"] == 0
+
+    def test_eviction_under_pressure_before_fifo_wait(self, lm):
+        """A reservation the free heap cannot cover evicts unreferenced
+        cached prefixes (LRU) and admits IMMEDIATELY — the FIFO
+        head-of-line wait is the fallback, not the first resort."""
+        eng = make_engine(lm, max_slots=2, num_pages=8, prefix_cache=True)
+        eng.generate(PREFIX[:8] + [1], max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 2
+        # needs every page in the pool: the cached prefix must go
+        out = eng.generate([5, 6], max_new_tokens=31, timeout=30)
+        assert len(out) == 31
+        assert eng._prefix.evicted_pages == 2
+        assert eng.shared_pages == 0
+        # and the evicted-then-recycled pages decode cleanly afterwards
+        model, params, _ = lm
+        got = eng.generate(PREFIX[:8] + [1], max_new_tokens=3, timeout=30)
+        eng.close()
+        ref = make_engine(lm, max_slots=2)
+        want = ref.generate(PREFIX[:8] + [1], max_new_tokens=3, timeout=30)
+        ref.close()
+        assert got == want
+
+    def test_partial_eviction_keeps_usable_prefix(self, lm):
+        """Eviction takes leaves first, so a partially-evicted chain
+        still serves shorter hits — and the engine still emits exact
+        output over the shortened attach."""
+        eng = make_engine(lm, max_slots=2, num_pages=12, prefix_cache=True)
+        long_p = PREFIX + [int(t) for t in range(30, 44)]   # 26 tokens
+        want_long = eng.generate(long_p, max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 6                 # 24-token prefix
+        # force a 2-page shortfall: pool holds 12, cache 6, request
+        # needs 8 -> evicts the 2 LRU leaves, keeps the 4-page root run
+        out = eng.generate([7, 7], max_new_tokens=29, timeout=30)
+        assert len(out) == 29
+        assert eng.shared_pages == 4
+        got = eng.generate(long_p, max_new_tokens=3, timeout=30)
+        assert got == want_long                      # shorter hit, same bits
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert snap["prefix_hits"] == 1
+
+    def test_prefix_attach_fault_releases_refcounts(self, lm):
+        """Satellite: a fault injected between prefix attach and the
+        first decode step (engine.prefix_attach site) fails the stream
+        with the injected error, releases every refcount — shared pages
+        included — and leaks zero pages."""
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        eng.generate(PREFIX + [1, 1], max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 3
+        faults.arm("engine.prefix_attach", nth=1, times=1)
+        s = eng.submit(PREFIX + [2, 2], max_new_tokens=3)
+        with pytest.raises(InjectedFault):
+            s.result(timeout=30)
+        assert eng.pages_in_use == 0
+        assert eng.shared_pages == 0
+        snap = eng.metrics.snapshot()
+        assert snap["shared_pages"] == 0 and snap["pages_in_use"] == 0
+        eng.close()
+
+    def test_owner_accounting_on_cancel_and_close_nodrain(self, lm):
+        """Satellite: per-owner snapshot accounting stays exact under
+        shared pages on the cancel and close(drain=False) paths."""
+        eng = make_engine(lm, max_slots=1, prefix_cache=True,
+                          metrics=ServingMetrics())
+        eng.generate(PREFIX + [1, 1], max_new_tokens=3, timeout=30)
+        assert eng._pool.snapshot()["by_owner"] == {"target": 3}
+        # a hit request holds shared refs mid-flight; cancel must drop
+        # exactly its references, never the cache's
+        s = eng.submit(PREFIX + [2, 2], max_new_tokens=30)
+        deadline = time.monotonic() + 10
+        while not s.tokens and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert s.tokens
+        s.cancel()
+        with pytest.raises(Exception):
+            s.result(timeout=30)
+        assert eng._pool.snapshot()["by_owner"] == {"target": 3}
+        assert eng._pool.snapshot()["pages_shared"] == 0
+        # close(drain=False) with a stream in flight: everything drains
+        eng.submit(PREFIX + [3, 3], max_new_tokens=30)
+        eng.close(drain=False)
+        snap = eng._pool.snapshot()
+        assert snap["by_owner"] == {} and snap["pages_in_use"] == 0
+        assert eng.metrics.snapshot()["shared_pages"] == 0
+
+    def test_speculative_lanes_share_within_not_across(self, lm):
+        """A speculative engine keeps per-lane indexes: target pages
+        serve target lanes, draft pages draft lanes, output stays
+        token-identical to the plain engine, and both lanes' owner
+        gauges drain to zero."""
+        model, params, kernels = lm
+        prompts = [PREFIX[:8] + [i + 1] for i in range(3)]
+        plain = make_engine(lm, max_slots=2)
+        want = [plain.generate(p, max_new_tokens=5, timeout=60)
+                for p in prompts]
+        plain.close()
+
+        eng = GenerationEngine(model, params, max_slots=2, max_len=MAXLEN,
+                               page_size=4, prefill_chunk=4,
+                               prefix_cache=True,
+                               speculate=(model, params, 2))
+        got = [eng.generate(p, max_new_tokens=5, timeout=60)
+               for p in prompts]
+        assert got == want
+        assert eng._prefix.pages == 2 and eng._dprefix.pages == 2
+        snap = eng._pool.snapshot()
+        assert snap["by_owner"] == {"draft": 2, "target": 2}
+        assert eng.metrics.snapshot()["prefix_hits"] == 2
+        eng.close()
+        assert eng._pool.in_use_by("target") == 0
+        assert eng._pool.in_use_by("draft") == 0
+        assert eng.shared_pages == 0
+
+    def test_reload_flushes_the_index(self, lm):
+        """Cached pages are keyed by model version: reload() drops them
+        (no stale-K/V hit) and post-reload output matches a fresh
+        engine on the new params."""
+        model, params, _ = lm
+        params2, _ = model.init(jax.random.key(9))
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        eng.generate(PREFIX + [1, 1], max_new_tokens=3, timeout=30)
+        assert eng.shared_pages == 3
+        eng.reload(params2)
+        out = eng.generate(PREFIX + [2, 2], max_new_tokens=5, timeout=30)
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert snap["prefix_hits"] == 0        # post-reload probe missed
+        assert snap["prefix_misses"] == 2
+        ref = GenerationEngine(model, params2, max_slots=2, max_len=MAXLEN,
+                               kernels=None, page_size=4, prefill_chunk=4)
+        want = ref.generate(PREFIX + [2, 2], max_new_tokens=5, timeout=30)
+        ref.close()
+        assert out == want
+
+    def test_reload_mid_flight_does_not_republish_stale_pages(self, lm):
+        """Regression (review finding): a request in flight across
+        reload() retires AFTER the flush cleared the index — its prompt
+        pages hold K/V the OLD params wrote and must NOT be published
+        into the fresh index (version-stamp guard). Pre-fix, the next
+        same-prefix request attached stale KV and decoded wrong tokens
+        indefinitely."""
+        model, params, _ = lm
+        params2, _ = model.init(jax.random.key(11))
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        # long-running request admitted (and prompt prefilled) on the
+        # OLD params
+        s = eng.submit(PREFIX + [1, 1], max_new_tokens=30)
+        deadline = time.monotonic() + 10
+        while not s.tokens and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert s.tokens, "in-flight request never started"
+        eng.reload(params2)
+        s.result(timeout=60)        # retires well after the flush ran
+        out = eng.generate(PREFIX + [2, 2], max_new_tokens=5, timeout=30)
+        snap = eng.metrics.snapshot()
+        eng.close()
+        # the straddling retirement published nothing: the probe missed
+        assert snap["prefix_hits"] == 0, \
+            "stale old-params pages re-entered the flushed index"
+        ref = GenerationEngine(model, params2, max_slots=2,
+                               max_len=MAXLEN, kernels=None, page_size=4,
+                               prefill_chunk=4)
+        want = ref.generate(PREFIX + [2, 2], max_new_tokens=5, timeout=30)
+        ref.close()
+        assert out == want
+
+    def test_dense_engine_rejects_prefix_cache(self, lm):
+        from bigdl_tpu.serving import DecodeKernels
+
+        model, params, _ = lm
+        with pytest.raises(ValueError, match="paged"):
+            GenerationEngine(model, params, max_slots=2, max_len=MAXLEN,
+                             kernels=DecodeKernels(model),
+                             prefix_cache=True)
+
+
+# -------------------------------------------------------------- metrics ----
+
+
+def test_prefix_metrics_rows_append_after_golden_order():
+    """PR-12 golden contract: prefix-cache rows render strictly AFTER
+    the PR-11 step-timeline block — append-only, never reordered."""
+    m = ServingMetrics()
+    m.record_batch(3, 4)
+    m.record_served(0.010, 0.004)
+    m.record_prefill(5, 8, 0.002)
+    m.record_decode_step(3, 4)
+    m.record_chunk(8, 8)
+    m.set_pages(5, 32)
+    m.record_reload()
+    m.set_replicas(2, 2, {"r0": 1})
+    m.set_kv_cache(4096, "int8")
+    m.set_quantized_gemms(13)
+    m.record_verify_step(8, 5, 5)
+    m.record_engine_step(0.002, 0.006)
+    pre_lines = m.format_table().splitlines()
+
+    m.record_prefix_probe(True, 3)
+    m.record_prefix_probe(True, 3)
+    m.record_prefix_probe(False)
+    m.set_shared_pages(6)
+    full_lines = m.format_table().splitlines()
+    assert full_lines[:len(pre_lines)] == pre_lines
+    extra = [ln.split()[0] for ln in full_lines[len(pre_lines):]]
+    assert extra == ["prefix_hits", "prefix_misses", "prefix_hit_rate",
+                     "shared_pages", "prefill_chunks_skipped"]
+    snap = m.snapshot()
+    assert list(snap)[-5:] == ["prefix_hits", "prefix_misses",
+                               "prefix_hit_rate", "shared_pages",
+                               "prefill_chunks_skipped"]
+    assert snap["prefix_hits"] == 2 and snap["prefix_misses"] == 1
+    assert snap["prefix_hit_rate"] == pytest.approx(2 / 3)
+    assert snap["shared_pages"] == 6
+    assert snap["prefill_chunks_skipped"] == 6
+
+
+def test_prefix_cache_snapshot_registers_with_obs_registry(lm):
+    """The obs wiring: a PrefixCache is a snapshot() source the PR-11
+    MetricsRegistry collects (and its gauges ride ServingMetrics into
+    /metrics via the endpoint)."""
+    from bigdl_tpu.obs import MetricsRegistry
+
+    eng = make_engine(lm, max_slots=2, prefix_cache=True)
+    try:
+        eng.generate(PREFIX + [1, 1], max_new_tokens=3, timeout=30)
+        eng.generate(PREFIX + [2, 2], max_new_tokens=3, timeout=30)
+        reg = MetricsRegistry()
+        reg.register("serving", eng.metrics)
+        reg.register("pages", eng._pool)
+        reg.register("prefix", eng._prefix)
+        flat = reg.collect()
+        assert flat["prefix.shared_pages"] == 3
+        assert flat["prefix.hits"] == 1
+        assert flat["prefix.hit_rate"] == pytest.approx(0.5)
+        assert flat["serving.shared_pages"] == 3
+        assert flat["pages.pages_shared"] == 0   # no request in flight
+    finally:
+        eng.close()

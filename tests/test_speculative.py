@@ -668,9 +668,10 @@ def test_speculative_metrics_rows_append_after_golden_order():
     assert snap["tokens_out"] == 9
     keys = list(snap)
     # the PR-10 block sits immediately before the PR-11 step-timeline
-    # keys (append-only: each PR's rows land AFTER every earlier block)
-    assert keys[-8:-4] == ["draft_tokens", "accepted_tokens",
-                           "acceptance_rate", "verify_steps"]
+    # and PR-12 prefix-cache keys (append-only: each PR's rows land
+    # AFTER every earlier block)
+    assert keys[-13:-9] == ["draft_tokens", "accepted_tokens",
+                            "acceptance_rate", "verify_steps"]
 
 
 def test_page_pool_owner_tagging_unit():
