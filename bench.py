@@ -1615,17 +1615,21 @@ def run_chaos_bench(args):
     from bigdl_tpu.dataset import DataSet, FunctionTransformer, \
         SampleToMiniBatch
     from bigdl_tpu.dataset.sample import Sample
-    from bigdl_tpu.faults import InjectedFault, StallError
+    from bigdl_tpu.faults import InjectedFault, RetryPolicy, StallError
     from bigdl_tpu.nn.layers.attention import Transformer
     from bigdl_tpu.serving import (
         DeadlineExceeded,
         GenerationEngine,
         Overloaded,
         PagedDecodeKernels,
+        RemoteReplica,
+        ReplicaServer,
         ReplicaSet,
         ReplicaUnavailable,
         ServingMetrics,
         StreamCancelled,
+        TransportError,
+        start_replica_process,
     )
 
     from bigdl_tpu.obs import flight_recorder
@@ -1948,6 +1952,128 @@ def run_chaos_bench(args):
             f"{pfx_engine.pages_in_use}) — refcounts must release and "
             f"shared_pages drain to 0")
 
+    # ------------------------------------------------- network leg (PR 14) ----
+    # The cross-process fabric under its own fault sites plus one REAL
+    # SIGKILL. Part one: a hedged ReplicaSet mixing an in-process engine
+    # with a RemoteReplica hosting the SAME engine build behind an
+    # in-thread ReplicaServer serves a wave while rpc.connect /
+    # rpc.send / rpc.recv_delay fire on schedule — the front door
+    # stays taxonomy-only, responses over the wire are bit-identical
+    # to in-process ones, and both engines' KV pages drain through the
+    # wire's close. Part two: a child process is SIGKILLed mid-traffic
+    # and rejoins via revive(), with the child's OWN injector history
+    # reconciled against its flight recorder over the fault RPCs.
+    net_engine = build_engine()
+    net_server = ReplicaServer(net_engine, name="net")
+    faults.arm("rpc.connect", nth=1, times=1, exc=ConnectionError)
+    net_remote = RemoteReplica(
+        (net_server.host, net_server.port), name="net",
+        connect_policy=RetryPolicy(max_attempts=4, base_delay=0.02,
+                                   jitter=0.0,
+                                   transient=(OSError, ConnectionError)))
+    local_engine = build_engine()
+    nset = ReplicaSet([local_engine, net_remote], max_failures=8,
+                      hedge=True, hedge_delay=0.05, name="net")
+    # wire-vs-process bit-identity before any scheduled failure: the
+    # same prompt through the remote proxy and the local twin engine
+    # (this first call also dials the connection, through the armed
+    # rpc.connect fault — the RetryPolicy must have healed it)
+    ident_prompt = rs.randint(1, 60, (max_prompt,)).tolist()
+    over_wire = list(net_remote.predict(ident_prompt, timeout=60,
+                                        max_new_tokens=6))
+    in_proc = list(local_engine.generate(ident_prompt, max_new_tokens=6,
+                                         timeout=60))
+    if net_remote._policy.snapshot()["retries"] < 1:
+        violations.append("net: the injected connect fault never forced "
+                          "a policy-paced reconnect")
+    if over_wire != in_proc:
+        violations.append(
+            f"net: remote responses diverge from the single-process run "
+            f"({over_wire} != {in_proc})")
+    faults.arm("rpc.send", nth=2, times=2, exc=OSError)
+    faults.arm("rpc.recv_delay", rate=0.25, seed=seed + 3, times=3,
+               latency=0.02)
+    net_outcomes = {"ok": 0, "deadline": 0, "transport": 0, "api": 0}
+    net_bad = []
+    for i in range(16):
+        plen = int(rs.randint(1, max_prompt + 1))
+        prompt = rs.randint(1, 60, (plen,)).tolist()
+        kw = dict(max_new_tokens=int(rs.randint(2, 8)))
+        if i % 5 == 3:
+            kw["deadline"] = 0.004  # expiry is an API error over the wire
+        try:
+            nset.submit(prompt, **kw).result(timeout=60)
+            net_outcomes["ok"] += 1
+        except DeadlineExceeded:
+            net_outcomes["deadline"] += 1
+        except TransportError:
+            # taxonomy: a response leg lost mid-flight indicts the
+            # replica (eviction accrual), never the caller's API
+            net_outcomes["transport"] += 1
+        except (Overloaded, ReplicaUnavailable, StreamCancelled,
+                InjectedFault):
+            net_outcomes["api"] += 1
+        except Exception as e:  # non-taxonomy escape = violation
+            net_bad.append(repr(e))
+    if net_bad:
+        violations.append(f"net: non-API errors escaped the fabric: "
+                          f"{net_bad[:3]}")
+    if net_outcomes["ok"] < 8:
+        violations.append(f"net: too few successes under rpc faults "
+                          f"({net_outcomes})")
+    net_transport = net_remote.snapshot()
+    net_remote_pages = net_remote.remote_snapshot().get("pages_in_use")
+    net_hedges = {"launched": nset.hedges_launched, "won": nset.hedges_won}
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
+    faults.reset()
+    nset.close()   # crosses the wire: the remote close drains the server
+    net_server.wait_closed(timeout=10)
+    net_engine.close()
+    if net_engine.pages_in_use or local_engine.pages_in_use \
+            or net_remote_pages:
+        violations.append(
+            f"net: KV pages leaked across the wire (remote_gauge="
+            f"{net_remote_pages}, remote_after={net_engine.pages_in_use}, "
+            f"local={local_engine.pages_in_use})")
+
+    net_child_fired = net_child_recorded = 0
+    sigkill_ok = revive_ok = False
+    child = start_replica_process("bigdl_tpu.serving.remote:toy_backend",
+                                  name="netchild")
+    try:
+        # child-side reconciliation over the fault RPCs: a latency-only
+        # spec on the server's rpc.peer_kill site fires (sleeps) without
+        # killing, and the child's injector history must match its own
+        # flight recorder
+        child.arm_fault("rpc.peer_kill", nth=1, times=1, latency=0.005)
+        child.predict([1, 2], timeout=30)
+        net_child_fired = sum(v["fired"]
+                              for v in child.fault_snapshot().values())
+        net_child_recorded = child.recorder_count("fault.fired")
+        if net_child_fired < 1 or net_child_fired != net_child_recorded:
+            violations.append(
+                f"net: child injector/recorder disagree "
+                f"(fired={net_child_fired}, "
+                f"recorded={net_child_recorded})")
+        child.kill()   # the REAL SIGKILL, mid-serving
+        try:
+            child.predict([3], timeout=10)
+            violations.append("net: a SIGKILLed child answered a request")
+        except TransportError:
+            sigkill_ok = True
+        except Exception as e:
+            violations.append(
+                f"net: SIGKILL surfaced a non-taxonomy error {e!r}")
+        try:
+            child.revive(timeout=20)
+            revive_ok = list(child.predict([4, 5], timeout=30)) == [8, 10]
+        except Exception as e:
+            violations.append(f"net: killed child failed to rejoin: {e!r}")
+        if not revive_ok:
+            violations.append("net: revived child served wrong bits")
+    finally:
+        child.close(drain=False, timeout=5)
+
     # ----------------------------------------------------------- drain ----
     deadline = time.monotonic() + 15
     leftover = own_threads()
@@ -1996,6 +2122,14 @@ def run_chaos_bench(args):
         "prefix_attach_fault_failed_streams": pfx_injected,
         "prefix_hits": pfx_snap["prefix_hits"],
         "prefix_shared_pages_after_fault": pfx_shared_after,
+        "net_outcomes": net_outcomes,
+        "net_transport": net_transport,
+        "net_hedges": net_hedges,
+        "net_remote_pages_gauge": net_remote_pages,
+        "net_child_faults_fired": net_child_fired,
+        "net_child_faults_recorded": net_child_recorded,
+        "net_sigkill_transport_error": sigkill_ok,
+        "net_sigkill_rejoined": revive_ok,
         "recorder_fault_events": fired_recorded,
         "recorder_fault_expected": fired_expected,
         "threads_leftover": leftover,

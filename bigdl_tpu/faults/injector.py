@@ -68,6 +68,17 @@ SITES: Dict[str, str] = {
                      "once per round (ctx: engine=)",
     "feed.producer": "SocketFeedDataSet producer reader, once per frame "
                      "(key = frame index)",
+    "rpc.connect": "RemoteReplica client connect attempt "
+                   "(ctx: endpoint=)",
+    "rpc.send": "RemoteReplica client, once per request frame sent "
+                "(key = request index, ctx: endpoint=, method=)",
+    "rpc.recv_delay": "RemoteReplica client, once per response frame "
+                      "received — latency-oriented (arm with latency=) "
+                      "(ctx: endpoint=)",
+    "rpc.peer_kill": "ReplicaServer, once per handled request BEFORE "
+                     "dispatch; an injected fault here hard-exits the "
+                     "server process (the SIGKILL shape, in-band and "
+                     "seeded) (key = request index)",
 }
 
 

@@ -46,6 +46,15 @@ per KV byte on top of paging's win, with compile-once, donation,
 sharding, and hot-reload contracts intact (see README "Quantized
 serving").
 
+The cross-process fabric (PR 14) extends the same front door over
+process boundaries: :class:`RemoteReplica` proxies any backend hosted
+in a child process behind a length-prefixed msgpack/json socket wire
+(``serving.rpc``) — deadlines propagate in the request header, the
+error taxonomy round-trips intact, a connection-level circuit breaker
+feeds the ReplicaSet's eviction, and ``ReplicaSet(hedge=True)`` adds
+p99-delayed tail-latency hedging with request-id idempotency (see
+README "Running a multi-process fleet").
+
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """
@@ -64,13 +73,20 @@ from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
+    RemoteError,
     ReplicaUnavailable,
     ServingError,
     StreamCancelled,
+    TransportError,
     UnknownModel,
 )
 from bigdl_tpu.serving.hot_reload import CheckpointWatcher, watch_checkpoints
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.remote import (
+    RemoteReplica,
+    ReplicaServer,
+    start_replica_process,
+)
 from bigdl_tpu.serving.replica import ReplicaSet
 from bigdl_tpu.serving.router import ModelRouter
 from bigdl_tpu.serving.service import InferenceService
@@ -88,13 +104,18 @@ __all__ = [
     "PagePool",
     "PagedDecodeKernels",
     "PrefixCache",
+    "RemoteError",
+    "RemoteReplica",
+    "ReplicaServer",
     "ReplicaSet",
     "ReplicaUnavailable",
     "ServingError",
     "ServingMetrics",
     "SpeculativeKernels",
     "StreamCancelled",
+    "TransportError",
     "UnknownModel",
+    "start_replica_process",
     "bucket_sizes_for",
     "static_generate",
     "watch_checkpoints",

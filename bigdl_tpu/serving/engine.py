@@ -868,6 +868,7 @@ class GenerationEngine:
                  quantize: Optional[str] = None,
                  speculate: Optional[tuple] = None,
                  prefix_cache: bool = False,
+                 cache_aware_admission: bool = False,
                  tracer=None,
                  timeline_capacity: int = 512,
                  profile_dir: Optional[str] = None,
@@ -945,6 +946,18 @@ class GenerationEngine:
         # so a page-blocked FIFO head does not re-walk the whole index
         # every scheduler iteration
         self._evict_stale = False
+        # cache-aware admission (PR 14): when the FIFO head is
+        # page-blocked, admit a LATER pending request that fits —
+        # preferring resident prefixes (they allocate fewer fresh
+        # pages) — instead of idling free pages behind the head. The
+        # head's wait stays bounded: at most `_bypass_limit` bypasses
+        # per blocked head, then strict FIFO resumes (fairness is
+        # test-enforced). Off by default: it is a scheduling-order
+        # change, never an output change.
+        self.cache_aware_admission = bool(cache_aware_admission)
+        self._bypass_limit = 4
+        self._head_bypasses = 0   # consecutive bypasses of the current head
+        self.admission_bypasses = 0  # total (snapshot counter)
         if speculate is not None:
             try:
                 self.draft_model, draft_params, self.spec_k = speculate
@@ -1339,6 +1352,7 @@ class GenerationEngine:
             with core.cond:
                 if not core.pending or not core.free:
                     break
+                take = 0
                 if self.paged:
                     need_alloc, probes = self._admit_need(core.pending[0])
                     if not self._pool.can_reserve(need_alloc) and \
@@ -1346,10 +1360,23 @@ class GenerationEngine:
                         # page pressure: evict unreferenced cached
                         # prefixes (LRU) first; only when the cache
                         # cannot cover the shortfall does the FIFO
-                        # head-of-line wait trigger (delay, never
-                        # reorder)
-                        break
-                req = core.pending.popleft()
+                        # head-of-line wait trigger — a delay, never a
+                        # reorder, unless cache-aware admission is on
+                        # and a LATER pending request fits as-is (then
+                        # a bounded bypass keeps the pool busy while
+                        # the head waits)
+                        bypass = self._pick_bypass()
+                        if bypass is None:
+                            break
+                        take = bypass
+                if take == 0:
+                    self._head_bypasses = 0
+                    req = core.pending.popleft()
+                else:
+                    self._head_bypasses += 1
+                    self.admission_bypasses += 1
+                    req = core.pending[take]
+                    del core.pending[take]
                 depth = len(core.pending)
             self.metrics.set_queue_depth(depth)
             if self.paged:
@@ -1732,6 +1759,11 @@ class GenerationEngine:
                 if self._dprefix is not None:
                     self._dprefix.publish(st.req.prompt, st.dpage_row)
                 self._evict_stale = False
+                # publish-time dedup (PR 14): concurrent same-prefix
+                # prefills that all missed the index each wrote their
+                # own physical copies of these now-canonical pages —
+                # repoint still-active duplicates and free the copies
+                self._dedup_after_publish()
             self._pool.release(st.pages or ())
             st.pages = None
             self._page_map[slot] = self._pool.trash
@@ -1745,6 +1777,86 @@ class GenerationEngine:
             self._keys[slot] = 0
             self._evict_stale = False   # released pages: re-scan is live
             self._report_pages()
+
+    def _dedup_after_publish(self) -> None:
+        """Repoint every still-active decode slot whose full prompt
+        pages now have canonical cached twins (same chunk chain in the
+        index, different physical page) at the cached pages, releasing
+        its private duplicates. Bit-identity is free: a FULL prompt
+        page is a pure function of ``(params, its page-aligned token
+        prefix)``, and a decode slot only ever writes at positions
+        ``>= len(prompt)`` — pages past index ``len(prompt) //
+        page_size``, never the repointed ones. Loop-thread only, like
+        every pool/index mutation."""
+        core = self._core
+        with core.cond:
+            slots = [(s, st) for s, st in core.active.items()
+                     if st.phase == "decode" and st.pages]
+        for slot, st in slots:
+            if st.cache_version != self._prefix.version:
+                continue
+            n_full = len(st.req.prompt) // self.page_size
+            if not n_full:
+                continue
+            canon = self._prefix.match_pages(st.req.prompt, n_full)
+            self._dedup_row(self._prefix, st.pages, st.page_row,
+                            self._page_map[slot], canon)
+            if self._dprefix is not None and st.draft_pages:
+                dcanon = self._dprefix.match_pages(st.req.prompt, n_full)
+                self._dedup_row(self._dprefix, st.draft_pages,
+                                st.dpage_row, self._dpage_map[slot],
+                                dcanon)
+
+    def _dedup_row(self, cache: PrefixCache, pages: List[int], row,
+                   map_row, canon: List[int]) -> None:
+        swapped = 0
+        for i, page in enumerate(canon):
+            if i >= len(pages) or pages[i] == page:
+                continue
+            # order matters: take the cached page's reference BEFORE
+            # dropping the duplicate's, the same never-graze-the-free-
+            # heap discipline as publish/attach. BOTH rows must repoint:
+            # st.page_row feeds publish at retirement, but the decode
+            # kernels read the engine's live _page_map row (a separate
+            # array — _admit_paged copies values in), and a decoding
+            # slot left reading the released duplicate would see the
+            # page's NEXT owner overwrite it
+            self._pool.share([page])
+            self._pool.release([pages[i]])
+            pages[i] = page
+            row[i] = page
+            map_row[i] = page
+            swapped += 1
+        if swapped:
+            cache.deduped_pages += swapped
+            self._evict_stale = False  # freed pages: re-scan is live
+
+    def _pick_bypass(self) -> Optional[int]:
+        """Cache-aware admission (PR 14): index into ``core.pending``
+        of a later request to admit while the page-blocked FIFO head
+        waits, or ``None`` (strict FIFO wait). Caller holds the core
+        lock. A candidate must fit the pool AS-IS — no eviction runs
+        on its behalf, freed pages belong to the head. Among fitting
+        candidates the longest resident prefix wins (it allocates the
+        fewest fresh pages and strictly extends the pool's runway);
+        FIFO position breaks ties. At most ``_bypass_limit``
+        consecutive bypasses per blocked head, so the head's wait is
+        bounded by construction."""
+        if (not self.cache_aware_admission
+                or self._head_bypasses >= self._bypass_limit):
+            return None
+        best: Optional[Tuple[int, int]] = None   # (cached_len, index)
+        pending = self._core.pending
+        for j in range(1, len(pending)):
+            need, _ = self._admit_need(pending[j])
+            if not self._pool.can_reserve(need):
+                continue
+            cached = 0
+            if self._prefix is not None:
+                cached, _ = self._prefix_probe(pending[j])
+            if best is None or cached > best[0]:
+                best = (cached, j)
+        return None if best is None else best[1]
 
     def _admit(self, req: _GenRequest) -> None:
         now = time.monotonic()

@@ -74,3 +74,27 @@ class DeadlineExceeded(ServingError):
             f"waiting {waited_s * 1e3:.1f} ms")
         self.waited_s = waited_s
         self.deadline_s = deadline_s
+
+
+class TransportError(ServingError):
+    """The RPC transport to a remote replica failed: connect refused, a
+    send/recv died mid-frame, the peer vanished, or the connection-level
+    circuit breaker is open. This is an ENGINE error in the front-door
+    taxonomy — it indicts the replica, feeds the ReplicaSet's
+    consecutive-failure eviction, and traffic fails over to siblings."""
+
+    def __init__(self, message: str, *, endpoint: "str | None" = None):
+        where = f" ({endpoint})" if endpoint else ""
+        super().__init__(f"rpc transport failure{where}: {message}")
+        self.endpoint = endpoint
+
+
+class RemoteError(ServingError):
+    """A remote backend raised an exception the wire codec could not
+    reconstruct as its original type (an unknown class, or one whose
+    constructor rejects the recorded args). The remote class name and
+    message are preserved so the taxonomy loss is at least legible."""
+
+    def __init__(self, remote_type: str, message: str):
+        super().__init__(f"remote {remote_type}: {message}")
+        self.remote_type = remote_type

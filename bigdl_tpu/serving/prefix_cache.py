@@ -86,6 +86,7 @@ class PrefixCache:
         self.hit_tokens = 0      # prompt tokens served from the cache
         self.published_pages = 0
         self.evicted_pages = 0
+        self.deduped_pages = 0   # duplicate physicals retired at publish
 
     # ------------------------------------------------------- queries ----
 
@@ -120,6 +121,24 @@ class PrefixCache:
             for nd in nodes:
                 nd.stamp = self._clock
         return len(pages) * ps, pages, nodes
+
+    def match_pages(self, prompt: Sequence[int], limit: int) -> List[int]:
+        """Canonical cached page ids for the first ``limit`` full chunks
+        of ``prompt`` (may return fewer — the walk stops at the first
+        unindexed chunk). Unlike :meth:`lookup` this is a PURE reader:
+        no LRU touch, no tail-token clamp — it serves publish-time
+        dedup, not admission."""
+        ps = self.page_size
+        node = self._root
+        pages: List[int] = []
+        for i in range(limit):
+            child = node.children.get(
+                tuple(int(t) for t in prompt[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            node = child
+            pages.append(node.page)
+        return pages
 
     def record_probe(self, hit: bool, n_tokens: int = 0) -> None:
         """Count one admission's probe outcome (the engine calls this
@@ -228,5 +247,6 @@ class PrefixCache:
             "hit_tokens": self.hit_tokens,
             "published_pages": self.published_pages,
             "evicted_pages": self.evicted_pages,
+            "deduped_pages": self.deduped_pages,
             "version": self.version,
         }

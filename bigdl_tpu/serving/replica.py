@@ -50,7 +50,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import CancelledError
+import uuid
+from concurrent.futures import CancelledError, Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from bigdl_tpu import faults
@@ -72,6 +73,18 @@ log = logging.getLogger("bigdl_tpu.serving")
 # would fail identically on every sibling
 _CLIENT_ERRORS = (Overloaded, DeadlineExceeded, StreamCancelled,
                   UnknownModel, ValueError, TypeError, CancelledError)
+
+
+class _HedgedHandle(Future):
+    """Future-shaped first-wins wrapper over a primary dispatch and an
+    optional tail-latency hedge. ``result()`` is the winner's result —
+    for generation backends that is the WHOLE token list (the wrapper
+    is not an iterator: with two candidate streams there is no single
+    token sequence to stream until one wins)."""
+
+    def __init__(self, request_id: str):
+        super().__init__()
+        self.request_id = request_id
 
 
 class _Replica:
@@ -114,6 +127,8 @@ class ReplicaSet:
                  probe: Optional[Callable[[Any], Any]] = None,
                  probe_interval: float = 2.0,
                  probe_backoff: Optional[RetryPolicy] = None,
+                 hedge: bool = False,
+                 hedge_delay: Optional[float] = None,
                  name: str = "replicas"):
         replicas = list(replicas)
         if not replicas:
@@ -122,6 +137,14 @@ class ReplicaSet:
             raise ValueError("max_failures must be >= 1")
         self.name = name
         self.max_failures = int(max_failures)
+        # tail-latency hedging (PR 14): when on, submit() returns a
+        # future-shaped first-wins wrapper and a straggling primary is
+        # re-dispatched to a second healthy replica after hedge_delay
+        # (default: the live p99 latency), idempotent by request id
+        self.hedge = bool(hedge)
+        self.hedge_delay = hedge_delay
+        self.hedges_launched = 0
+        self.hedges_won = 0
         self._cond = threading.Condition()
         self._replicas = [_Replica(b, i) for i, b in enumerate(replicas)]
         if metrics is None:
@@ -185,11 +208,29 @@ class ReplicaSet:
         ``submit`` returns). An :class:`Overloaded` replica is skipped; a
         replica that fails at submission is marked and skipped; raises
         :class:`Overloaded` only when every placeable replica is
-        saturated, :class:`ReplicaUnavailable` when none is healthy."""
+        saturated, :class:`ReplicaUnavailable` when none is healthy.
+
+        With ``hedge=True`` (and ≥ 2 replicas available) the return is a
+        future-shaped first-wins wrapper instead: if the primary has not
+        settled after the hedge delay, the same request is re-dispatched
+        to a second replica and whichever finishes first wins."""
         with self._cond:
             if self._closed:
                 raise RuntimeError("replica set is closed")
-        tried: List[_Replica] = []
+        if self.hedge and len(self._replicas) > 1:
+            return self._submit_hedged(x, kwargs)
+        _, handle = self._submit_once(x, kwargs)
+        return handle
+
+    def _submit_once(self, x, kwargs: Dict[str, Any],
+                     tried: Optional[List[_Replica]] = None,
+                     rid: Optional[str] = None):
+        """One placement pass over the failover loop; returns
+        ``(replica, handle)``. ``tried`` seeds the exclusion list (the
+        hedge leg excludes the primary); ``rid`` is forwarded as
+        ``request_id=`` to backends that advertise
+        ``accepts_request_id`` (the RemoteReplica idempotency key)."""
+        tried = list(tried or [])
         overload: Optional[Overloaded] = None
         while True:
             r = self._pick(tried)
@@ -198,12 +239,16 @@ class ReplicaSet:
                     raise overload
                 raise ReplicaUnavailable(
                     self.name, [rr.name for rr in self._replicas])
+            kw = kwargs
+            if rid is not None and getattr(r.backend, "accepts_request_id",
+                                           False):
+                kw = dict(kwargs, request_id=rid)
             try:
                 # fault site INSIDE the try: an armed failure routes
                 # through the same classification as a real backend's
                 # (client errors re-raise, engine errors mark + fail over)
                 faults.fire("replica.submit", replica=r.backend, index=r.index)
-                handle = r.backend.submit(x, **kwargs)
+                handle = r.backend.submit(x, **kw)
             except Overloaded as e:
                 overload = e  # healthy backpressure, not a health event
                 tried.append(r)
@@ -220,7 +265,131 @@ class ReplicaSet:
                 # the context rides the handle across the layering
                 tr.annotate(replica=r.name, replica_set=self.name)
             self._track(r, handle)
-            return handle
+            return r, handle
+
+    # -------------------------------------------------------- hedging ----
+
+    def _hedge_delay_s(self) -> float:
+        """How long to give the primary before launching the hedge:
+        the configured ``hedge_delay``, else the live p99 latency (the
+        canonical tail-hedging delay — only genuine stragglers pay the
+        duplicate dispatch), else 50 ms before any latency history."""
+        if self.hedge_delay is not None:
+            return float(self.hedge_delay)
+        lat = self.metrics.snapshot().get("latency_ms") or {}
+        p99 = lat.get("p99") if isinstance(lat, dict) else None
+        if p99:
+            return float(p99) / 1e3
+        return 0.05
+
+    def _submit_hedged(self, x, kwargs: Dict[str, Any]) -> _HedgedHandle:
+        """First-wins dispatch: place on the primary now, and if it has
+        not settled after :meth:`_hedge_delay_s`, place the SAME request
+        (same generated request id — remote backends dedupe on it) on a
+        second replica. The loser is cancelled. An engine error on one
+        leg while the other is still outstanding is absorbed — the
+        wrapper fails only when no leg can still win (client errors
+        settle immediately: they would fail identically everywhere)."""
+        rid = uuid.uuid4().hex
+        r0, h0 = self._submit_once(x, kwargs, rid=rid)
+        wrapper = _HedgedHandle(rid)
+        lock = threading.Lock()
+        state = {"settled": False, "outstanding": 1, "hedge_pending": True,
+                 "handles": [(r0, h0)], "last_err": None}
+
+        def settle_with(r: _Replica, h, err: Optional[BaseException],
+                        is_hedge: bool) -> None:
+            timer.cancel()
+            if err is None:
+                try:
+                    wrapper.set_result(h.result(timeout=0))
+                except BaseException as e:  # result/error raced: fail legibly
+                    wrapper.set_exception(e)
+            else:
+                wrapper.set_exception(err)
+            if is_hedge and err is None:
+                with self._cond:
+                    self.hedges_won += 1
+                win = getattr(r.backend, "record_hedge_win", None)
+                if win is not None:
+                    win()
+                record_event("replica.hedge_won", set=self.name,
+                             replica=r.name, request=rid)
+            with lock:
+                losers = [lh for _, lh in state["handles"] if lh is not h]
+            for lh in losers:
+                try:
+                    lh.cancel()
+                except Exception:
+                    pass
+
+        def on_done(r: _Replica, h, is_hedge: bool) -> None:
+            err = self._handle_error(h)
+            with lock:
+                if state["settled"]:
+                    return
+                state["outstanding"] -= 1
+                if err is not None and not isinstance(err, _CLIENT_ERRORS) \
+                        and (state["outstanding"] > 0
+                             or state["hedge_pending"]):
+                    # the other leg (or the not-yet-launched hedge) can
+                    # still win; remember the error in case it cannot
+                    state["last_err"] = err
+                    return
+                state["settled"] = True
+            settle_with(r, h, err, is_hedge)
+
+        def launch() -> None:
+            with lock:
+                state["hedge_pending"] = False
+                if state["settled"]:
+                    return
+            with self._cond:
+                if self._closed:
+                    return
+            try:
+                r1, h1 = self._submit_once(x, kwargs, tried=[r0], rid=rid)
+            except (ReplicaUnavailable, Overloaded):
+                # no second replica to hedge onto: primary-only. If the
+                # primary already failed while we held the pending flag,
+                # nothing else can win — fail the wrapper now
+                with lock:
+                    if state["settled"] or state["outstanding"] > 0:
+                        return
+                    state["settled"] = True
+                    err = state["last_err"]
+                wrapper.set_exception(
+                    err or ReplicaUnavailable(
+                        self.name, [rr.name for rr in self._replicas]))
+                return
+            except _CLIENT_ERRORS:
+                return  # primary still owns the request
+            with lock:
+                if state["settled"]:
+                    state["handles"].append((r1, h1))
+                    late = True
+                else:
+                    state["outstanding"] += 1
+                    state["handles"].append((r1, h1))
+                    late = False
+            if late:
+                try:
+                    h1.cancel()
+                except Exception:
+                    pass
+                return
+            with self._cond:
+                self.hedges_launched += 1
+            record_event("replica.hedge_launched", set=self.name,
+                         replica=r1.name, request=rid)
+            h1.add_done_callback(lambda h: on_done(r1, h, True))
+
+        timer = threading.Timer(self._hedge_delay_s(), launch)
+        timer.name = "bigdl-serving-hedge"
+        timer.daemon = True
+        timer.start()
+        h0.add_done_callback(lambda h: on_done(r0, h, False))
+        return wrapper
 
     def predict(self, x, timeout: Optional[float] = None, **kwargs):
         """Blocking convenience: ``submit(...).result(timeout)``."""
@@ -539,6 +708,9 @@ class ReplicaSet:
             states = [(r.name, r.healthy, r.draining, r.inflight, r.served,
                        r.failed, r.failures, r.backend)
                       for r in self._replicas]
+            if self.hedge:
+                out["hedging"] = {"launched": self.hedges_launched,
+                                  "won": self.hedges_won}
         for name, healthy, draining, inflight, served, failed, fails, b in \
                 states:
             entry = {"healthy": healthy, "draining": draining,
@@ -547,6 +719,12 @@ class ReplicaSet:
             m = getattr(b, "metrics", None)
             if m is not None and m is not self.metrics:
                 entry["metrics"] = m.snapshot()
+            # remote replicas carry their transport gauges (reconnects,
+            # deadline misses, hedge wins, breaker state) — purely local
+            # reads, never a network call from inside snapshot()
+            t = getattr(b, "transport_snapshot", None)
+            if t is not None:
+                entry["transport"] = t()
             out["replicas"][name] = entry
         return out
 

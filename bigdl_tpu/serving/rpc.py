@@ -1,0 +1,276 @@
+"""Length-prefixed socket wire format for the cross-process serving
+fabric (PR 14).
+
+One frame carries one message — a JSON-able header tree plus zero or
+more binary segments the header references by index:
+
+``uint8 fmt | uint32 header_len | header | uint32 nseg | (uint64 len + bytes)*``
+
+``fmt`` selects the header codec: ``1`` = msgpack when the baked-in
+wheel is importable, ``0`` = json otherwise (a msgpack client can talk
+to a json server and vice versa — the receiver honours the frame's own
+byte, so mixed fleets never negotiate). All integers are big-endian,
+the same ``struct`` framing discipline as
+:class:`~bigdl_tpu.dataset.feeder.SocketFeedDataSet`.
+
+The header tree is the uniform encoding of an arbitrary payload pytree:
+
+- numpy arrays (and anything ``__array__``-able: jax arrays, scalars
+  with dtype) become ``{"__a__": i}`` referencing segment ``i``, an
+  ``.npy`` blob (``allow_pickle=False`` both ways — the wire never
+  executes pickle), so tensors round-trip BIT-identically;
+- raw ``bytes`` become ``{"__b__": i}``;
+- tuples become ``{"__t__": [...]}`` (json would flatten them to
+  lists, and pytree structure is part of the serving signature);
+- EVERY dict becomes ``{"__m__": [[k, v], ...]}`` — uniform, so user
+  dicts can never collide with the marker keys and non-string keys
+  survive json;
+- ``None``/bool/int/float/str pass through, lists recurse, numpy
+  scalars decay to Python scalars.
+
+Exceptions cross the wire as ``{"__exc__": ...}`` records holding the
+class name, module, and the constructor args needed to REBUILD the
+original type: the serving taxonomy (:class:`Overloaded`,
+:class:`DeadlineExceeded`, ...), :class:`~bigdl_tpu.faults.InjectedFault`,
+and plain builtins (``ValueError`` et al.) all reconstruct exactly, so
+the front-door error contract survives process boundaries. Anything
+unknown (or whose constructor rejects the recorded args) degrades to
+:class:`~bigdl_tpu.serving.errors.RemoteError` — legible, never lossy
+about the remote class name, never a pickle."""
+
+from __future__ import annotations
+
+import builtins
+import io
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    RemoteError,
+    ReplicaUnavailable,
+    ServingError,
+    StreamCancelled,
+    TransportError,
+    UnknownModel,
+)
+
+try:  # baked into the image; json is the always-there fallback
+    import msgpack as _msgpack
+except ImportError:  # pragma: no cover - exercised via _FMT_JSON paths
+    _msgpack = None
+import json as _json
+
+MAGIC = b"BTRP\x01"          # handshake: 4-byte tag + wire version
+_FMT_JSON = 0
+_FMT_MSGPACK = 1
+_U8 = struct.Struct(">B")
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+MAX_HEADER = 64 << 20        # a corrupt length prefix fails fast,
+MAX_SEGMENT = 1 << 32        # not as a multi-GB allocation
+
+
+# ------------------------------------------------------------ payloads ----
+
+def _encode(obj, segments: List[bytes]):
+    """Payload tree -> json/msgpack-safe header tree + binary segments."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        segments.append(bytes(obj))
+        return {"__b__": len(segments) - 1}
+    if isinstance(obj, np.ndarray) or hasattr(obj, "__array__"):
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(obj), allow_pickle=False)
+        segments.append(buf.getvalue())
+        return {"__a__": len(segments) - 1}
+    if isinstance(obj, tuple):
+        return {"__t__": [_encode(v, segments) for v in obj]}
+    if isinstance(obj, list):
+        return [_encode(v, segments) for v in obj]
+    if isinstance(obj, dict):
+        return {"__m__": [[_encode(k, segments), _encode(v, segments)]
+                          for k, v in obj.items()]}
+    if isinstance(obj, BaseException):
+        return {"__exc__": _encode_exception(obj, segments)}
+    raise TypeError(f"cannot encode {type(obj).__name__} for the rpc wire")
+
+
+def _decode(obj, segments: List[bytes]):
+    if isinstance(obj, list):
+        return [_decode(v, segments) for v in obj]
+    if isinstance(obj, dict):
+        if "__a__" in obj:
+            buf = io.BytesIO(segments[obj["__a__"]])
+            return np.load(buf, allow_pickle=False)
+        if "__b__" in obj:
+            return segments[obj["__b__"]]
+        if "__t__" in obj:
+            return tuple(_decode(v, segments) for v in obj["__t__"])
+        if "__m__" in obj:
+            return {_decode(k, segments): _decode(v, segments)
+                    for k, v in obj["__m__"]}
+        if "__exc__" in obj:
+            return decode_exception(obj["__exc__"], segments)
+    return obj
+
+
+# ---------------------------------------------------------- exceptions ----
+
+# taxonomy classes whose __init__ signatures differ from their
+# formatted-message args: record the REAL constructor args so the
+# rebuilt instance carries the structured attributes, not just a string
+_EXC_CTOR_ARGS = {
+    "Overloaded": lambda e: (e.queue_depth, e.max_queue, e.model),
+    "UnknownModel": lambda e: (e.name, e.available),
+    "ReplicaUnavailable": lambda e: (e.name, e.replicas),
+    "DeadlineExceeded": lambda e: (e.waited_s, e.deadline_s),
+    "TransportError": lambda e: (str(e),),
+    "RemoteError": lambda e: (e.remote_type, str(e)),
+    "InjectedFault": lambda e: (e.site, e.call_index),
+}
+
+
+def _known_classes() -> Dict[str, type]:
+    from bigdl_tpu.faults import InjectedFault, StallError
+
+    known = {c.__name__: c for c in (
+        ServingError, Overloaded, UnknownModel, ReplicaUnavailable,
+        StreamCancelled, DeadlineExceeded, RemoteError, InjectedFault)}
+    known["StallError"] = StallError
+    return known
+
+
+def _encode_exception(exc: BaseException, segments: List[bytes]) -> dict:
+    name = type(exc).__name__
+    extract = _EXC_CTOR_ARGS.get(name)
+    if extract is not None:
+        try:
+            args = extract(exc)
+        except AttributeError:
+            extract, args = None, None
+    if extract is None:
+        args = exc.args
+    try:
+        enc_args = _encode(list(args), segments)
+    except TypeError:
+        enc_args = [str(exc)]
+    return {"cls": name, "module": type(exc).__module__,
+            "args": enc_args, "msg": str(exc)}
+
+
+def decode_exception(rec: dict, segments: Optional[List[bytes]] = None
+                     ) -> BaseException:
+    """Rebuild a wire exception record as its original type where the
+    type is trusted (serving taxonomy, InjectedFault/StallError, builtin
+    exceptions); otherwise as :class:`RemoteError`. TransportError is
+    deliberately NOT rebuilt as itself: a transport failure reported BY
+    the peer is not a failure OF this hop's transport."""
+    cls_name = rec.get("cls", "Exception")
+    args = _decode(rec.get("args", []), segments or [])
+    if not isinstance(args, list):
+        args = [args]
+    cls = None
+    if cls_name != "TransportError":
+        cls = _known_classes().get(cls_name)
+    if cls is None and rec.get("module") == "builtins":
+        cand = getattr(builtins, cls_name, None)
+        if isinstance(cand, type) and issubclass(cand, Exception):
+            cls = cand
+    if cls is not None:
+        try:
+            return cls(*args)
+        except Exception:
+            pass
+    return RemoteError(cls_name, rec.get("msg", ""))
+
+
+def encode_exception(exc: BaseException) -> Tuple[dict, List[bytes]]:
+    segments: List[bytes] = []
+    return _encode_exception(exc, segments), segments
+
+
+# -------------------------------------------------------------- frames ----
+
+def pack_frame(tree: Any) -> bytes:
+    """One message -> one length-prefixed byte string (ready for
+    ``sendall``, or for the server's idempotency cache to replay)."""
+    segments: List[bytes] = []
+    header = _encode(tree, segments)
+    if _msgpack is not None:
+        fmt, raw = _FMT_MSGPACK, _msgpack.packb(header, use_bin_type=True)
+    else:
+        fmt, raw = _FMT_JSON, _json.dumps(header).encode("utf-8")
+    parts = [_U8.pack(fmt), _U32.pack(len(raw)), raw,
+             _U32.pack(len(segments))]
+    for seg in segments:
+        parts.append(_U64.pack(len(seg)))
+        parts.append(seg)
+    return b"".join(parts)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes or raise ConnectionError (same discipline as
+    the feeder: a short read mid-frame is a dead peer, not data)."""
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    """Read one frame and decode it back to the payload tree. Raises
+    ``ConnectionError`` on EOF/short reads and ``TransportError`` on a
+    malformed frame (bad codec byte, absurd lengths)."""
+    fmt = _U8.unpack(_recv_exact(sock, 1))[0]
+    hlen = _U32.unpack(_recv_exact(sock, 4))[0]
+    if hlen > MAX_HEADER:
+        raise TransportError(f"header length {hlen} exceeds {MAX_HEADER}")
+    raw = _recv_exact(sock, hlen)
+    if fmt == _FMT_MSGPACK:
+        if _msgpack is None:
+            raise TransportError("peer sent msgpack but msgpack is not "
+                                 "importable here")
+        header = _msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    elif fmt == _FMT_JSON:
+        header = _json.loads(raw.decode("utf-8"))
+    else:
+        raise TransportError(f"unknown wire codec byte {fmt}")
+    nseg = _U32.unpack(_recv_exact(sock, 4))[0]
+    segments: List[bytes] = []
+    for _ in range(nseg):
+        slen = _U64.unpack(_recv_exact(sock, 8))[0]
+        if slen > MAX_SEGMENT:
+            raise TransportError(f"segment length {slen} exceeds "
+                                 f"{MAX_SEGMENT}")
+        segments.append(_recv_exact(sock, slen))
+    return _decode(header, segments)
+
+
+def send_frame(sock: socket.socket, tree: Any) -> None:
+    sock.sendall(pack_frame(tree))
+
+
+def client_handshake(sock: socket.socket) -> None:
+    sock.sendall(MAGIC)
+    echo = _recv_exact(sock, len(MAGIC))
+    if echo != MAGIC:
+        raise TransportError(f"bad handshake echo {echo!r}")
+
+
+def server_handshake(sock: socket.socket) -> None:
+    tag = _recv_exact(sock, len(MAGIC))
+    if tag != MAGIC:
+        raise TransportError(f"bad handshake tag {tag!r}")
+    sock.sendall(MAGIC)

@@ -272,6 +272,82 @@ def test_backoff_cap_never_undercuts_a_large_base_interval():
     assert rs._probe_policy.backoff(9) >= 60.0 * 0.95
 
 
+def test_zero_retry_policy_runs_once_and_counts_exhaustion():
+    """max_attempts=1 is the NO-retry policy: one try, an empty delay
+    schedule, and a transient failure re-raises immediately — counted
+    as an exhaustion (the budget ran out), never as a heal."""
+    p = RetryPolicy(max_attempts=1, base_delay=0.0, jitter=0.0)
+    assert p.delays() == []
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        p.call(flaky, sleep=slept.append)
+    assert len(calls) == 1 and slept == []
+    snap = p.snapshot()
+    assert snap == {"retries": 0, "exhaustions": 1, "max_attempts": 1}
+    # a success is just a success: no counter moves
+    assert p.call(lambda: "ok", sleep=slept.append) == "ok"
+    assert p.snapshot()["exhaustions"] == 1
+
+
+def test_backoff_clamps_when_base_exceeds_cap():
+    """base_delay above max_delay clamps to max_delay from attempt 0
+    (the cap is a ceiling, not a schedule point), and base_delay=0 is
+    an immediate-retry schedule whatever the attempt number."""
+    p = RetryPolicy(max_attempts=4, base_delay=10.0, max_delay=1.0,
+                    multiplier=2.0, jitter=0.0)
+    assert [p.backoff(i) for i in range(4)] == [1.0] * 4
+    z = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.3, seed=9)
+    assert [z.backoff(i) for i in range(4)] == [0.0] * 4
+
+
+def test_jitter_schedule_identical_across_instances_with_same_seed():
+    """Jitter is a pure function of (seed, attempt): two policy
+    INSTANCES built with the same seed produce the same schedule, and
+    a full call() sleeps exactly that schedule — reproducible chaos
+    runs depend on this."""
+    mk = lambda s: RetryPolicy(max_attempts=5, base_delay=0.02,
+                               multiplier=3.0, jitter=0.5, seed=s)
+    a, b = mk(11), mk(11)
+    assert a.delays() == b.delays()
+    slept = []
+    with pytest.raises(OSError):
+        a.call(lambda: (_ for _ in ()).throw(OSError("x")),
+               sleep=slept.append)
+    assert slept == b.delays()
+    assert mk(12).delays() != a.delays()
+
+
+def test_base_exceptions_pass_through_even_when_classify_says_retry():
+    """KeyboardInterrupt/SystemExit are never retried — they pass
+    straight through the filter even when `classify` (or `transient`)
+    would claim them, and neither counter moves."""
+    calls = []
+
+    def interrupted():
+        calls.append(1)
+        raise KeyboardInterrupt()
+
+    p = RetryPolicy(max_attempts=5, base_delay=0.0, jitter=0.0,
+                    classify=lambda e: True)
+    with pytest.raises(KeyboardInterrupt):
+        p.call(interrupted, sleep=lambda s: None)
+    assert len(calls) == 1
+    assert p.snapshot()["retries"] == 0
+    assert p.snapshot()["exhaustions"] == 0
+    q = RetryPolicy(max_attempts=5, base_delay=0.0, jitter=0.0,
+                    transient=(BaseException,))
+    calls.clear()
+    with pytest.raises(SystemExit):
+        q.call(lambda: (_ for _ in ()).throw(SystemExit(2)),
+               sleep=lambda s: None)
+    assert len(calls) == 0 and q.snapshot()["retries"] == 0
+
+
 def test_rearm_without_disarm_keeps_history_counts():
     """Re-arming an armed site (chaos harnesses swap plans mid-soak)
     must fold the old spec's counters into history — snapshot() is how

@@ -505,6 +505,150 @@ class TestPrefixEngineBehaviour:
                              prefix_cache=True)
 
 
+# ------------------------------------------- publish-time dedup (PR 14) ----
+
+
+class TestPublishTimeDedup:
+    def test_concurrent_same_prefix_requests_converge_after_publish(self, lm):
+        """Satellite: two requests racing the SAME cold prefix each
+        prefill their own physical pages (neither could hit — the index
+        was empty). When the first retires and publishes, the engine
+        repoints the survivor's full-prompt pages at the canonical
+        cached copies and releases the duplicates (share-before-release),
+        so pool residency converges to one physical copy per chunk —
+        and the swap is invisible to decode: bits stay identical."""
+        prompts = [PREFIX + [3, 4], PREFIX + [9, 11]]
+
+        ref = make_engine(lm, max_slots=2)
+        s = [ref.submit(prompts[0], max_new_tokens=2),
+             ref.submit(prompts[1], max_new_tokens=24)]
+        want = [st.result(timeout=60) for st in s]
+        ref.close()
+
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        s = [eng.submit(prompts[0], max_new_tokens=2),
+             eng.submit(prompts[1], max_new_tokens=24)]
+        got = [st.result(timeout=60) for st in s]
+        assert got == want
+        # the short request retired first and published its 3 prompt
+        # pages; the long one was mid-decode holding DUPLICATES of the
+        # same chunks — all 3 were swapped to the canonical pages
+        assert eng._prefix.snapshot()["deduped_pages"] == 3
+        # after both retire only the canonical copies remain reserved
+        assert eng._pool.in_use == 3
+        assert eng.shared_pages == 3
+        eng.close()
+        assert eng._pool.in_use == 0 and eng.shared_pages == 0
+
+    def test_dedup_skips_when_no_duplicates_exist(self, lm):
+        """Sequential replay attaches the cached pages outright — there
+        is nothing to dedup, and the counter says so."""
+        eng = make_engine(lm, max_slots=2, prefix_cache=True)
+        eng.generate(PREFIX + [1, 2], max_new_tokens=3, timeout=30)
+        eng.generate(PREFIX + [5, 6], max_new_tokens=3, timeout=30)
+        assert eng._prefix.snapshot()["deduped_pages"] == 0
+        eng.close()
+
+    def test_match_pages_is_a_pure_reader(self):
+        """The dedup helper must not disturb LRU order (eviction policy
+        is admission-driven, not publish-driven) and, unlike lookup(),
+        reads THROUGH the final full page — publish-time dedup wants
+        every full prompt chunk, tail clamp included."""
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        prompt = list(range(1, 13))              # 12 tokens = 3 pages
+        pages = pool.alloc(3)
+        cache.publish(prompt, pages)
+        nodes = []
+        node = cache._root
+        while node.children:
+            node = next(iter(node.children.values()))
+            nodes.append(node)
+        stamps = [nd.stamp for nd in nodes]
+        assert cache.match_pages(prompt, 3) == pages    # all 3, no clamp
+        assert cache.match_pages(prompt, 2) == pages[:2]
+        assert cache.match_pages([9] * 12, 3) == []
+        assert [nd.stamp for nd in nodes] == stamps     # LRU untouched
+        assert cache.lookup(prompt)[0] == 8             # lookup DOES clamp
+
+
+# ---------------------------------------- cache-aware admission (PR 14) ----
+
+
+class TestCacheAwareAdmission:
+    def _seed_and_submit(self, lm, **eng_kw):
+        """Tight-pool scenario: a 3-page prefix is cached, a running hog
+        holds most of the pool, the FIFO head needs more pages than
+        eviction could free, and a small cached-prefix request sits
+        behind it needing a single fresh page."""
+        eng = make_engine(lm, max_slots=2, num_pages=14, prefix_cache=True,
+                          **eng_kw)
+        eng.generate(PREFIX + [1, 2], max_new_tokens=2, timeout=30)
+        hog = eng.submit([11] * 8, max_new_tokens=30)    # 10 of 14 pages
+        big = eng.submit([12] * 8, max_new_tokens=30)    # blocked head
+        small = eng.submit(PREFIX + [5, 6], max_new_tokens=2)
+        return eng, hog, big, small
+
+    def test_bypass_admits_cached_small_past_blocked_head(self, lm):
+        eng, hog, big, small = self._seed_and_submit(
+            lm, cache_aware_admission=True)
+        out_small = small.result(timeout=30)
+        # the small request finished on pages the head could never have
+        # used, while the head was still waiting for the hog's pages
+        assert not big.done
+        assert eng.admission_bypasses >= 1
+        out_hog = hog.result(timeout=60)
+        out_big = big.result(timeout=60)
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert eng._pool.in_use == 0
+        # bypass changed SCHEDULING only — outputs match plain FIFO
+        ref = make_engine(lm, max_slots=2)
+        assert out_hog == ref.generate([11] * 8, max_new_tokens=30,
+                                       timeout=60)
+        assert out_big == ref.generate([12] * 8, max_new_tokens=30,
+                                       timeout=60)
+        want_small = ref.generate(PREFIX + [5, 6], max_new_tokens=2,
+                                  timeout=60)
+        ref.close()
+        assert out_small == want_small
+        # note: the small may well MISS at admission — the blocked
+        # head's eviction pass is allowed to drain the cache first;
+        # the bypass criterion is "fits as-is", resident prefix is
+        # only the preference among fitters
+
+    def test_fifo_fairness_bound_is_enforced(self, lm):
+        """The head can be bypassed at most ``_bypass_limit`` times in a
+        row — a stream of cache-friendly small requests cannot starve
+        it. Test-enforced: with SIX bypassable candidates queued, total
+        bypasses never exceed the bound, and the head completes."""
+        eng = make_engine(lm, max_slots=2, num_pages=14, prefix_cache=True,
+                          cache_aware_admission=True)
+        assert eng._bypass_limit == 4
+        eng.generate(PREFIX + [1, 2], max_new_tokens=2, timeout=30)
+        hog = eng.submit([11] * 8, max_new_tokens=30)
+        big = eng.submit([12] * 8, max_new_tokens=30)
+        smalls = [eng.submit(PREFIX + [5, 6 + i], max_new_tokens=2)
+                  for i in range(6)]
+        outs = [s.result(timeout=60) for s in smalls]
+        assert all(len(o) == 2 for o in outs)
+        assert len(big.result(timeout=60)) == 30
+        assert len(hog.result(timeout=60)) == 30
+        assert 1 <= eng.admission_bypasses <= eng._bypass_limit
+        eng.close()
+        assert eng._pool.in_use == 0
+
+    def test_off_by_default_stays_strict_fifo(self, lm):
+        eng, hog, big, small = self._seed_and_submit(lm)
+        assert eng.cache_aware_admission is False
+        small.result(timeout=60)
+        hog.result(timeout=60)
+        big.result(timeout=60)
+        assert eng.admission_bypasses == 0
+        eng.close()
+        assert eng._pool.in_use == 0
+
+
 # -------------------------------------------------------------- metrics ----
 
 

@@ -1,0 +1,635 @@
+"""Cross-process serving fabric (PR 14): rpc wire + RemoteReplica.
+
+The load-bearing properties, per the subsystem contract:
+
+- the wire round-trips arbitrary payload pytrees BIT-identically
+  (numpy arrays with dtype, tuples vs lists, bytes, non-string dict
+  keys) and the serving error taxonomy crosses intact — a remote
+  ``Overloaded`` is an ``Overloaded`` here, attributes included; only
+  unknown types degrade (legibly) to ``RemoteError``, and a peer's
+  ``TransportError`` is never rebuilt as THIS hop's;
+- deadlines propagate: the remaining budget rides the header, an
+  expired request is abandoned before the backend sees it, a 50 ms
+  deadline against a slow remote fails with ``DeadlineExceeded``
+  within budget, and the server keeps no zombie in-flight entry;
+- idempotency by request id: a duplicate submit (hedge/retry) never
+  re-executes — the server answers from its in-flight table or the
+  bounded response cache;
+- the connection-level circuit breaker opens after consecutive
+  transport failures, fast-fails while open, half-opens for probes,
+  and FEEDS the ReplicaSet's consecutive-failure eviction (a
+  ``TransportError`` is an engine error, never a client error);
+- ``ReplicaSet(hedge=True)`` re-dispatches a straggling request to a
+  second replica after the hedge delay, first wins, same request id
+  (the remote dedupes), and an engine error on one leg is absorbed
+  while the other can still win;
+- the real 2-process story (SIGKILL mid-stream, probe-driven rejoin,
+  bit-identity vs single-process) runs in the ``slow`` tier and the
+  bench chaos network leg.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.faults import InjectedFault, RetryPolicy, StallError
+from bigdl_tpu.serving import rpc
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    RemoteError,
+    ReplicaUnavailable,
+    StreamCancelled,
+    TransportError,
+    UnknownModel,
+)
+from bigdl_tpu.serving.remote import (
+    RemoteReplica,
+    ReplicaServer,
+    ToyBackend,
+    start_replica_process,
+)
+from bigdl_tpu.serving.replica import ReplicaSet
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def wait_until(cond, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+def make_pair(backend=None, **client_kw):
+    """In-thread server + connected client (fast path for transport
+    semantics; the child-process variants live in the slow tier)."""
+    srv = ReplicaServer(backend or ToyBackend(), name="t")
+    client_kw.setdefault("connect_policy",
+                         RetryPolicy(max_attempts=2, base_delay=0.01,
+                                     jitter=0.0,
+                                     transient=(OSError, ConnectionError)))
+    cli = RemoteReplica((srv.host, srv.port), **client_kw)
+    return srv, cli
+
+
+# ------------------------------------------------------------- codec ----
+
+
+def test_frame_round_trips_payload_trees_bit_identically():
+    payload = {
+        "f32": np.arange(6, dtype=np.float32).reshape(2, 3) / 7,
+        "i8": np.array([-3, 0, 127], np.int8),
+        "bf": np.float64(3.5),
+        "tup": (1, (2.5, "x"), [3, None]),
+        "raw": b"\x00\xffbytes",
+        7: "non-string key",
+        "nested": {"deep": {"arr": np.array([True, False])}},
+        "empty": [],
+    }
+    a, b = socket.socketpair()
+    try:
+        t = threading.Thread(target=rpc.send_frame, args=(a, payload))
+        t.start()
+        out = rpc.recv_frame(b)
+        t.join()
+    finally:
+        a.close()
+        b.close()
+    assert set(out) == set(payload)
+    np.testing.assert_array_equal(out["f32"], payload["f32"])
+    assert out["f32"].dtype == np.float32
+    assert out["i8"].dtype == np.int8
+    assert out["bf"] == 3.5
+    assert out["tup"] == (1, (2.5, "x"), [3, None])
+    assert isinstance(out["tup"], tuple) and isinstance(out["tup"][2], list)
+    assert out["raw"] == b"\x00\xffbytes"
+    assert out[7] == "non-string key"
+    np.testing.assert_array_equal(out["nested"]["deep"]["arr"],
+                                  [True, False])
+
+
+def test_malformed_frames_fail_fast_not_as_allocation():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x07")                      # unknown codec byte
+        a.sendall((0).to_bytes(4, "big"))
+        with pytest.raises(TransportError, match="codec"):
+            rpc.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00" + (rpc.MAX_HEADER + 1).to_bytes(4, "big"))
+        with pytest.raises(TransportError, match="header length"):
+            rpc.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_exception_taxonomy_round_trips_with_attributes():
+    cases = [
+        Overloaded(9, 8, "m"),
+        UnknownModel("gone", ["a", "b"]),
+        ReplicaUnavailable("fleet", ["r0", "r1"]),
+        DeadlineExceeded(0.2, 0.05),
+        StreamCancelled("consumer cancelled"),
+        InjectedFault("rpc.send", 3),
+        StallError("decode wedged"),
+        ValueError("bad prompt"),
+        TypeError("bad kwargs"),
+    ]
+    for exc in cases:
+        rec, segs = rpc.encode_exception(exc)
+        back = rpc.decode_exception(rec, segs)
+        assert type(back) is type(exc), (exc, back)
+        assert str(back) == str(exc)
+    ov = rpc.decode_exception(*rpc.encode_exception(Overloaded(9, 8, "m")))
+    assert (ov.queue_depth, ov.max_queue, ov.model) == (9, 8, "m")
+    de = rpc.decode_exception(*rpc.encode_exception(
+        DeadlineExceeded(0.2, 0.05)))
+    assert (de.waited_s, de.deadline_s) == (0.2, 0.05)
+    inj = rpc.decode_exception(*rpc.encode_exception(
+        InjectedFault("rpc.send", 3)))
+    assert (inj.site, inj.call_index) == ("rpc.send", 3)
+
+
+def test_unknown_and_transport_exceptions_degrade_to_remote_error():
+    class Weird(Exception):
+        pass
+
+    back = rpc.decode_exception(*rpc.encode_exception(Weird("odd")))
+    assert isinstance(back, RemoteError)
+    assert back.remote_type == "Weird" and "odd" in str(back)
+    # a peer's TransportError is a failure of ITS transport, not this
+    # hop's — rebuilding it as TransportError would trip this client's
+    # breaker for a remote-side condition
+    back = rpc.decode_exception(*rpc.encode_exception(
+        TransportError("peer lost its own upstream")))
+    assert isinstance(back, RemoteError) and not isinstance(
+        back, TransportError)
+    assert back.remote_type == "TransportError"
+
+
+# ------------------------------------------------- request semantics ----
+
+
+def test_remote_submit_predict_reload_warmup_round_trip():
+    be = ToyBackend()
+    srv, cli = make_pair(be)
+    try:
+        x = np.arange(5, dtype=np.float32)
+        out = cli.submit(x).result(timeout=5)
+        np.testing.assert_array_equal(out, x * 2)
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(cli.predict([1, 2, 3], timeout=5),
+                                      [2, 4, 6])
+        assert cli.ping() == "pong"
+        cli.reload({"w": np.ones(2)})
+        cli.warmup(4, mode="full")
+        assert (be.calls, be.reloads, be.warmups) == (2, 1, 1)
+        snap = cli.remote_snapshot()
+        assert snap["served"] == 2 and snap["inflight"] == 0
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_remote_engine_error_crosses_as_its_own_type():
+    class Rejecting:
+        def submit(self, x, **kw):
+            raise Overloaded(5, 4, "toy")
+
+        def close(self, drain=True, timeout=None):
+            pass
+
+    srv, cli = make_pair(Rejecting())
+    try:
+        with pytest.raises(Overloaded) as ei:
+            cli.predict([1], timeout=5)
+        assert ei.value.queue_depth == 5 and ei.value.model == "toy"
+        # a CLIENT error from the remote never indicts the transport
+        assert cli.breaker_state == "closed"
+        assert cli.snapshot()["breaker"]["consecutive_failures"] == 0
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_deadline_propagates_and_server_abandons_expired_work():
+    """The acceptance gate: a 50 ms deadline against a delayed remote
+    fails with DeadlineExceeded well within budget, and the server ends
+    with NO zombie in-flight entry."""
+    be = ToyBackend(delay=0.4)
+    srv, cli = make_pair(be)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            cli.submit([1, 2], deadline=0.05).result(timeout=5)
+        waited = time.monotonic() - t0
+        assert waited < 1.0, f"deadline answer took {waited:.3f}s"
+        assert cli.snapshot()["rpc_deadline_exceeded"] >= 1
+        assert wait_until(lambda: srv.inflight == 0)
+        assert cli.snapshot()["inflight"] == 0   # no zombie either side
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_already_expired_deadline_never_reaches_the_backend():
+    be = ToyBackend()
+    srv, cli = make_pair(be)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            cli.submit([1], deadline=-0.01).result(timeout=5)
+        assert be.calls == 0
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_deadline_backstop_fires_when_the_remote_is_wedged():
+    class BlackHole:
+        def submit(self, x, **kw):
+            from concurrent.futures import Future
+
+            return Future()   # never resolves
+
+        def close(self, drain=True, timeout=None):
+            pass
+
+    srv, cli = make_pair(BlackHole(), deadline_grace=0.05)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            cli.submit([1], deadline=0.05).result(timeout=5)
+        assert time.monotonic() - t0 < 2.0
+        assert cli.snapshot()["inflight"] == 0   # popped, not zombie
+        assert cli.snapshot()["rpc_deadline_exceeded"] == 1
+    finally:
+        cli.close(drain=False, timeout=1.0)
+        srv.close(drain=False)
+
+
+def test_duplicate_request_ids_attach_never_reexecute():
+    be = ToyBackend(delay=0.15)
+    srv, cli = make_pair(be)
+    try:
+        h1 = cli.submit([5], request_id="fixed")
+        h2 = cli.submit([5], request_id="fixed")   # same client: attach
+        np.testing.assert_array_equal(h1.result(timeout=5), [10])
+        np.testing.assert_array_equal(h2.result(timeout=5), [10])
+        assert be.calls == 1
+        # a SECOND connection replaying the id is answered from the
+        # server's response cache — the hedge/retry shape
+        cli2 = RemoteReplica((srv.host, srv.port), name="retry")
+        try:
+            np.testing.assert_array_equal(
+                cli2.submit([5], request_id="fixed").result(timeout=5),
+                [10])
+        finally:
+            cli2.close()
+        assert be.calls == 1
+        # the same-client duplicate attached locally (never re-sent);
+        # only the cross-connection replay reached the server's table
+        assert srv.duplicates == 1
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+# ------------------------------------------ breaker / reconnect / faults --
+
+
+def test_connect_retries_are_policy_paced_and_observable():
+    srv, cli = make_pair()
+    try:
+        faults.arm("rpc.connect", nth=1, exc=ConnectionError)
+        assert cli.ping() == "pong"   # first attempt injected, retried
+        assert cli._policy.snapshot()["retries"] == 1
+        assert cli.snapshot()["rpc_connects"] == 1
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_send_fault_raises_transport_error_and_marks_breaker():
+    srv, cli = make_pair()
+    try:
+        assert cli.ping() == "pong"
+        faults.arm("rpc.send", nth=1, exc=OSError)
+        with pytest.raises(TransportError):
+            cli.submit([1])
+        assert cli.snapshot()["breaker"]["consecutive_failures"] == 1
+        faults.disarm("rpc.send")
+        np.testing.assert_array_equal(cli.predict([2], timeout=5), [4])
+        assert cli.snapshot()["breaker"]["consecutive_failures"] == 0
+        assert cli.snapshot()["rpc_reconnects"] == 1
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+def test_breaker_opens_fast_fails_and_half_opens_for_probes():
+    srv, cli = make_pair(ToyBackend(delay=0.5),
+                         breaker_threshold=2, breaker_cooldown=30.0)
+    port = srv.port
+    try:
+        h = cli.submit([1], deadline=None)
+        srv.abort()                    # the peer dies without drain
+        with pytest.raises(TransportError):
+            h.result(timeout=5)
+        for _ in range(2):             # two failed reconnects -> open
+            with pytest.raises(TransportError):
+                cli.ping(timeout=2) if False else cli.submit([1])
+        assert cli.breaker_state == "open"
+        assert cli.snapshot()["breaker"]["trips"] == 1
+        t0 = time.monotonic()
+        with pytest.raises(TransportError, match="breaker"):
+            cli.submit([2])
+        assert time.monotonic() - t0 < 0.05   # fast-fail, no dial
+        # a new server takes the port; the PROBE half-opens and heals
+        srv2 = ReplicaServer(ToyBackend(), port=port)
+        try:
+            assert cli.ping(timeout=5) == "pong"
+            assert cli.breaker_state == "closed"
+            np.testing.assert_array_equal(cli.predict([3], timeout=5), [6])
+            assert cli.snapshot()["rpc_reconnects"] >= 1
+        finally:
+            cli.close()
+            srv2.close(drain=False)
+    finally:
+        srv.close(drain=False)
+
+
+def test_peer_kill_site_drops_the_connection_mid_request():
+    srv, cli = make_pair()
+    try:
+        assert cli.ping() == "pong"
+        faults.arm("rpc.peer_kill", nth=1, times=1)
+        with pytest.raises(TransportError):
+            cli.predict([1], timeout=5)
+        assert srv._aborted
+    finally:
+        cli.close(drain=False, timeout=1.0)
+        srv.close(drain=False)
+
+
+def test_recv_delay_site_injects_tail_latency_not_failure():
+    srv, cli = make_pair()
+    try:
+        np.testing.assert_array_equal(cli.predict([1], timeout=5), [2])
+        faults.arm("rpc.recv_delay", nth=1, latency=0.15)
+        t0 = time.monotonic()
+        np.testing.assert_array_equal(cli.predict([2], timeout=5), [4])
+        assert time.monotonic() - t0 >= 0.14
+        assert cli.breaker_state == "closed"
+    finally:
+        cli.close()
+        srv.close(drain=False)
+
+
+# ------------------------------------------------ ReplicaSet over rpc ----
+
+
+def test_transport_errors_evict_and_probe_rejoins_via_ping():
+    """The breaker feeds the EXISTING eviction: a dead remote's
+    TransportErrors quarantine it, traffic fails over to the healthy
+    sibling, and a ping probe rejoins it once a server is back."""
+    srv0, cli0 = make_pair(ToyBackend())
+    srv1, cli1 = make_pair(ToyBackend())
+    port0 = srv0.port
+    rs = ReplicaSet([cli0, cli1], max_failures=2, probe_interval=0,
+                    probe=lambda b: b.ping(timeout=2), name="fleet")
+    try:
+        np.testing.assert_array_equal(rs.predict([1], timeout=5), [2])
+        srv0.abort()
+        # the transition window may surface ONE in-flight TransportError
+        # (a send that landed in the kernel buffer before the peer died
+        # fails at the response leg, past the submit-time failover); it
+        # still counts toward eviction, and everything after fails over
+        transition_errors = 0
+        for _ in range(6):
+            try:
+                np.testing.assert_array_equal(rs.predict([2], timeout=5),
+                                              [4])
+            except TransportError:
+                transition_errors += 1
+        assert transition_errors <= 2
+        assert wait_until(lambda: rs.healthy_replicas == ["r1"])
+        snap = rs.snapshot()
+        assert snap["replicas"]["r0"]["transport"]["breaker"]["state"] \
+            in ("open", "closed")
+        assert rs.probe_once() == 0          # still dead: stays out
+        srv2 = ReplicaServer(ToyBackend(), port=port0)
+        try:
+            assert wait_until(lambda: rs.probe_once() == 1, timeout=10)
+            assert sorted(rs.healthy_replicas) == ["r0", "r1"]
+            for _ in range(4):
+                np.testing.assert_array_equal(rs.predict([3], timeout=5),
+                                              [6])
+        finally:
+            rs.close(drain=False)
+            srv2.close(drain=False)
+    finally:
+        srv0.close(drain=False)
+        srv1.close(drain=False)
+
+
+def test_hedge_launches_after_delay_first_wins_same_request_id():
+    slow, fast = ToyBackend(delay=0.5), ToyBackend(delay=0.01)
+    srv0, cli0 = make_pair(slow)
+    srv1, cli1 = make_pair(fast)
+    rs = ReplicaSet([cli0, cli1], hedge=True, hedge_delay=0.05,
+                    name="hedged")
+    try:
+        h = rs.submit(np.arange(3))
+        np.testing.assert_array_equal(h.result(timeout=5), np.arange(3) * 2)
+        assert wait_until(lambda: rs.hedges_won == 1)
+        assert rs.hedges_launched == 1
+        assert cli1.snapshot()["rpc_hedges_won"] == 1
+        snap = rs.snapshot()
+        assert snap["hedging"] == {"launched": 1, "won": 1}
+        # ONE request id on both wires: the winner's id matches the
+        # handle's, and a shared server would have deduped
+        assert len(h.request_id) == 32
+    finally:
+        rs.close(drain=False)
+        srv0.close(drain=False)
+        srv1.close(drain=False)
+
+
+def test_hedge_not_launched_when_primary_is_fast():
+    a, b = ToyBackend(delay=0.0), ToyBackend(delay=0.0)
+    rs = ReplicaSet([a, b], hedge=True, hedge_delay=0.5, name="fastpath")
+    try:
+        h = rs.submit(np.arange(2))
+        np.testing.assert_array_equal(h.result(timeout=5), np.arange(2) * 2)
+        time.sleep(0.1)
+        assert rs.hedges_launched == 0
+        assert rs.snapshot()["hedging"] == {"launched": 0, "won": 0}
+    finally:
+        rs.close(drain=False)
+
+
+def test_hedge_client_error_settles_immediately_without_second_leg():
+    class DeadlineBackend(ToyBackend):
+        def submit(self, x, **kw):
+            from concurrent.futures import Future
+
+            self.calls += 1
+            f = Future()
+            f.set_exception(DeadlineExceeded(0.1, 0.05))
+            return f
+
+    a, b = DeadlineBackend(), ToyBackend()
+    rs = ReplicaSet([a, b], hedge=True, hedge_delay=5.0, name="clienterr")
+    try:
+        with pytest.raises(DeadlineExceeded):
+            rs.submit([1]).result(timeout=5)
+        time.sleep(0.05)
+        assert rs.hedges_launched == 0   # a client error fails everywhere
+        assert b.calls == 0
+    finally:
+        rs.close(drain=False)
+
+
+def test_hedge_engine_error_on_both_legs_fails_with_the_last_error():
+    class Boom(ToyBackend):
+        def submit(self, x, **kw):
+            from concurrent.futures import Future
+
+            self.calls += 1
+            f = Future()
+            f.set_exception(RuntimeError("boom"))
+            return f
+
+    a, b = Boom(), Boom()
+    rs = ReplicaSet([a, b], hedge=True, hedge_delay=0.02,
+                    max_failures=10, name="bothfail")
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            rs.submit([1]).result(timeout=5)
+        assert a.calls + b.calls == 2
+    finally:
+        rs.close(drain=False)
+
+
+def test_drain_close_waits_for_inflight_responses():
+    be = ToyBackend(delay=0.2)
+    srv, cli = make_pair(be)
+    h = cli.submit([7])
+    cli.close(drain=True, timeout=5)
+    np.testing.assert_array_equal(h.result(timeout=1), [14])
+    srv.close(drain=False)
+    assert srv.served == 1
+
+
+def test_thread_hygiene_after_full_lifecycle():
+    srv, cli = make_pair()
+    np.testing.assert_array_equal(cli.predict([1], timeout=5), [2])
+    cli.close()
+    srv.close(drain=False)
+    assert wait_until(lambda: not [
+        t.name for t in threading.enumerate()
+        if t.name.startswith("bigdl-rpc")]), [
+            t.name for t in threading.enumerate()
+            if t.name.startswith("bigdl-rpc")]
+
+
+# ------------------------------------------------- child process (slow) --
+
+
+@pytest.mark.slow
+def test_child_process_sigkill_failover_and_probe_rejoin():
+    """The headline demo as a test: a mixed fleet (in-process ToyBackend
+    + RemoteReplica child) keeps serving while the child is SIGKILLed
+    mid-stream; only taxonomy errors surface at the front door; the
+    child rejoins via the revive probe; responses are bit-identical to
+    single-process."""
+    local = ToyBackend()
+    remote = start_replica_process(
+        "bigdl_tpu.serving.remote:toy_backend", name="child",
+        breaker_cooldown=0.2)
+
+    def probe(b):
+        if hasattr(b, "revive"):
+            return b.revive(timeout=10)
+        return None
+
+    rs = ReplicaSet([remote, local], max_failures=2, probe_interval=0.1,
+                    probe=probe, name="mixed")
+    try:
+        ref = ToyBackend()
+        xs = [np.arange(i + 1, dtype=np.float32) for i in range(8)]
+        outs = [rs.predict(x, timeout=10) for x in xs]
+        refs = [ref.submit(x).result(5) for x in xs]
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(o, r)   # bit-identical
+
+        remote.kill()
+        assert remote.process_alive is False
+        front_door_errors = []
+        for x in xs:
+            try:
+                rs.predict(x, timeout=10)
+            except Exception as e:   # noqa: BLE001 - asserting taxonomy
+                front_door_errors.append(e)
+        # the front door NEVER sees a non-taxonomy error: submit-time
+        # failover absorbs the dead replica; at most the one request
+        # whose response leg was in flight at kill time surfaces a
+        # (taxonomy) TransportError
+        assert all(isinstance(e, TransportError) for e in
+                   front_door_errors), front_door_errors
+        assert len(front_door_errors) <= 1
+        assert wait_until(lambda: "r1" in rs.healthy_replicas)
+
+        # the prober's revive() respawns the child and rejoins it
+        assert wait_until(
+            lambda: sorted(rs.healthy_replicas) == ["r0", "r1"],
+            timeout=30)
+        assert remote.process_alive is True
+        assert remote.snapshot()["rpc_reconnects"] >= 0
+        out = rs.predict(np.arange(4), timeout=10)
+        np.testing.assert_array_equal(out, np.arange(4) * 2)
+    finally:
+        rs.close(drain=False, timeout=5)
+
+
+@pytest.mark.slow
+def test_child_process_deadline_and_peer_kill_fault_site():
+    """Deadline propagation against a REAL process (50 ms budget, slow
+    backend), then the seeded in-band SIGKILL: an armed rpc.peer_kill
+    in the child hard-exits it; the client sees only TransportError and
+    revive() restarts serving."""
+    remote = start_replica_process(
+        "bigdl_tpu.serving.remote:slow_toy_backend", name="slowchild")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            remote.submit([1], deadline=0.05).result(timeout=10)
+        assert time.monotonic() - t0 < 2.0
+        assert remote.remote_snapshot()["inflight"] == 0  # no zombie
+
+        remote.arm_fault("rpc.peer_kill", nth=1, times=1)
+        with pytest.raises(TransportError):
+            remote.predict([1], timeout=10)
+        assert wait_until(lambda: remote.process_alive is False)
+        assert remote.revive(timeout=15) == "pong"
+        assert remote.process_alive is True
+        np.testing.assert_array_equal(remote.predict([2], timeout=10), [4])
+    finally:
+        remote.close(drain=False, timeout=5)
