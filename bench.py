@@ -880,6 +880,101 @@ def run_generation_bench(args):
             "prefix_mismatches": pfx_mismatches,
         }
 
+    # disaggregation column (PR 15): the prompt-heavy interference
+    # replay disaggregation exists for — a 1:1 short:long prompt mix
+    # (long prompts chunk-prefill) through a monolithic engine vs the
+    # DisaggregatedEngine at the SAME modeled costs. The monolithic
+    # loop runs admitted prompt chunks BETWEEN decode steps, so every
+    # in-flight stream's next token pays ~(step + chunking_slots x
+    # chunk); the decode role never runs a prompt kernel, so its
+    # inter-token latency stays ~step whatever the admission traffic.
+    # The prompt cost is 2x the step cost (a chunk of prompt tokens is
+    # strictly more work than one decode token), which is what makes
+    # the mix "prompt-heavy" — the interference term dominates.
+    # Gates under --smoke: decode ITL p99 <= 0.7x monolithic at equal
+    # costs, ZERO output mismatches (the handoff must be bit-exact),
+    # and both role pools drained.
+    disagg_fields = {}
+    disagg_metrics = None
+    if args.disaggregate:
+        from bigdl_tpu.serving import DisaggregatedEngine
+
+        dz_requests = args.requests or (16 if smoke else 32)
+        dz_step_ms = args.step_cost_ms if args.step_cost_ms else 4.0
+        dz_prompt_ms = 2 * dz_step_ms
+        dz_chunk = page_size
+        dz_short, dz_long = 6, (5 * page_size) // 2   # 1 vs 3 chunks
+        dz_new = 24
+        hi = 200 if not on_tpu else 8000
+        dz_rs = np.random.RandomState(4)
+        dz_reqs = [dz_rs.randint(
+            1, hi, (dz_long if i % 2 else dz_short,)).tolist()
+            for i in range(dz_requests)]
+        dz_kw = dict(max_slots=slots, max_len=max(max_len, dz_long + dz_new),
+                     max_prompt_len=3 * page_size,
+                     max_queue=max(64, 2 * dz_requests),
+                     page_size=page_size, prefill_chunk=dz_chunk, seed=0,
+                     cache_dtype=kv_dtype, quantize=quantize)
+
+        dz_mono = GenerationEngine(
+            model, params,
+            kernels=_FixedCostKernels(kernels, dz_step_ms / 1e3,
+                                      dz_prompt_ms / 1e3),
+            metrics=ServingMetrics(), **dz_kw)
+        dz_mono.warmup()
+        t0 = time.perf_counter()
+        ms = [dz_mono.submit(p, max_new_tokens=dz_new, **sample_spec)
+              for p in dz_reqs]
+        dz_mono_outs = [s.result(timeout=600) for s in ms]
+        dz_mono_wall = time.perf_counter() - t0
+        dz_mono_snap = dz_mono.metrics.snapshot()
+        dz_mono.close()
+
+        dz = DisaggregatedEngine(
+            model, params,
+            prefill_overrides={"kernels": _FixedCostKernels(
+                kernels, 0.0, dz_prompt_ms / 1e3)},
+            decode_overrides={"kernels": _FixedCostKernels(
+                kernels, dz_step_ms / 1e3, 0.0)},
+            metrics=ServingMetrics(), **dz_kw)
+        dz.warmup()
+        t0 = time.perf_counter()
+        ds = [dz.submit(p, max_new_tokens=dz_new, **sample_spec)
+              for p in dz_reqs]
+        dz_outs = [s.result(timeout=600) for s in ds]
+        dz_wall = time.perf_counter() - t0
+        dz_snap = dz.metrics.snapshot()
+        dz_pool = dz.decode_engine._pool.snapshot()
+        dz_drained = (dz.prefill_engine.pages_in_use == 0
+                      and dz.decode_engine.pages_in_use == 0)
+        disagg_metrics = dz.metrics
+        dz.close()
+
+        dz_mismatches = sum(1 for a, b in zip(dz_mono_outs, dz_outs)
+                            if a != b)
+        mono_itl = dz_mono_snap["itl_ms"] or {}
+        dz_itl = dz_snap["itl_ms"] or {}
+        disagg_fields = {
+            "disagg_requests": dz_requests,
+            "disagg_step_cost_ms": dz_step_ms,
+            "disagg_prompt_cost_ms": dz_prompt_ms,
+            "disagg_prefill_chunk": dz_chunk,
+            "mono_itl_p50_ms": mono_itl.get("p50"),
+            "mono_itl_p99_ms": mono_itl.get("p99"),
+            "disagg_itl_p50_ms": dz_itl.get("p50"),
+            "disagg_itl_p99_ms": dz_itl.get("p99"),
+            "disagg_itl_p99_vs_mono": (
+                round(dz_itl["p99"] / mono_itl["p99"], 3)
+                if dz_itl.get("p99") and mono_itl.get("p99") else None),
+            "disagg_handoffs": dz_pool["pages_adopted"]
+            + dz_pool["pages_adopt_shared"],
+            "disagg_pages_adopted": dz_pool["pages_adopted"],
+            "disagg_pages_drained": dz_drained,
+            "disagg_mismatches": dz_mismatches,
+            "mono_wall_s": round(dz_mono_wall, 3),
+            "disagg_wall_s": round(dz_wall, 3),
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -921,9 +1016,11 @@ def run_generation_bench(args):
         "step_cost_ms": step_cost_ms,
         "speculate": args.speculate,
         "prefix_cache": bool(args.prefix_cache),
+        "disaggregate": bool(args.disaggregate),
         **rep_fields,
         **spec_fields,
         **prefix_fields,
+        **disagg_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -934,6 +1031,7 @@ def run_generation_bench(args):
                               "pages": engine._pool,
                               "timeline": engine.timeline,
                               "prefix": prefix_cache_obj,
+                              "disagg": disagg_metrics,
                               "bench": result})
     print(json.dumps(result))
     if smoke:
@@ -1031,6 +1129,29 @@ def run_generation_bench(args):
                     "time-to-first-token)"
                     % (result["prefix_ttft_p50_on_ms"] or -1,
                        result["prefix_ttft_p50_off_ms"] or -1))
+        if args.disaggregate:
+            if result["disagg_mismatches"]:
+                raise SystemExit(
+                    "disagg smoke: %d request(s) decoded different tokens "
+                    "disaggregated vs monolithic — the handoff carries the "
+                    "first token and the post-prefill PRNG key; streams "
+                    "must be BIT-identical across the role split"
+                    % result["disagg_mismatches"])
+            if not result["disagg_pages_drained"]:
+                raise SystemExit(
+                    "disagg smoke: a role pool still holds pages after "
+                    "every stream resolved — export/adopt must keep the "
+                    "refcount/owner gauges byte-exact")
+            if (result["disagg_itl_p99_vs_mono"] is None
+                    or result["disagg_itl_p99_vs_mono"] > 0.7):
+                raise SystemExit(
+                    "disagg smoke: decode ITL p99 %.2f ms disaggregated vs "
+                    "%.2f ms monolithic (ratio %s, gate: <= 0.7x at equal "
+                    "modeled costs — a dedicated decode role must stop "
+                    "paying for its neighbours' prompt chunks)"
+                    % (result["disagg_itl_p99_ms"] or -1,
+                       result["mono_itl_p99_ms"] or -1,
+                       result["disagg_itl_p99_vs_mono"]))
 
 
 def run_lm_bench(args):
@@ -1597,6 +1718,11 @@ def run_chaos_bench(args):
       in-flight streams with the INJECTED error through the stream API
       (the engine's step contract) and BOTH models' page lanes drain to
       zero per owner;
+    - **disaggregation**: a fault mid page-handoff (adopt stage locally,
+      export stage armed in a child prefill worker over the fault RPCs)
+      fails only that stream with the injected error, BOTH role pools'
+      per-owner gauges drain to zero, and the fabric keeps serving the
+      monolithic engine's exact bits;
     - **drain**: KV pages return to zero on every engine, no
       /dev/shm segment leaks, and every bigdl-owned thread retires.
 
@@ -1619,6 +1745,7 @@ def run_chaos_bench(args):
     from bigdl_tpu.nn.layers.attention import Transformer
     from bigdl_tpu.serving import (
         DeadlineExceeded,
+        DisaggregatedEngine,
         GenerationEngine,
         Overloaded,
         PagedDecodeKernels,
@@ -1952,6 +2079,99 @@ def run_chaos_bench(args):
             f"{pfx_engine.pages_in_use}) — refcounts must release and "
             f"shared_pages drain to 0")
 
+    # -------------------------------------------- disaggregation leg (PR 15) ----
+    # A fault at the engine.page_handoff site (mid-handoff, after the
+    # prefill finished but before the decode role owns the pages) fails
+    # ONLY that stream with the injected error and drains BOTH role
+    # pools' per-owner gauges to zero — proven on the local path (adopt
+    # stage, parent injector) and the RPC path (export stage armed in
+    # the CHILD over the fault RPCs), with the fabric serving the same
+    # bits as a monolithic engine before and after each fault.
+    dz_ref = build_engine(step_cost_ms=0.0)
+    dz_prompt = rs.randint(1, 60, (6,)).tolist()
+    dz_want = dz_ref.generate(dz_prompt, max_new_tokens=5, timeout=60)
+    dz_ref.close()
+
+    dz = DisaggregatedEngine(
+        model, params, max_slots=slots, max_len=max_len,
+        max_prompt_len=max_prompt, max_queue=4 * n_requests,
+        kernels=kernels, page_size=8, seed=seed,
+        metrics=ServingMetrics())
+    dz.warmup()
+    dz_injected = 0
+    if dz.generate(dz_prompt, max_new_tokens=5, timeout=60) != dz_want:
+        violations.append("disagg: local handoff diverged from the "
+                          "monolithic bits")
+    faults.arm("engine.page_handoff", nth=1, times=1,
+               only=lambda key=None, **ctx: ctx.get("stage") == "adopt")
+    try:
+        dz.generate(dz_prompt, max_new_tokens=5, timeout=60)
+        violations.append("disagg: the adopt fault never failed a stream")
+    except InjectedFault:
+        dz_injected += 1
+    except Exception as e:
+        violations.append(f"disagg: non-API stream error {e!r}")
+    faults.disarm("engine.page_handoff")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
+    faults.reset()
+    if dz.generate(dz_prompt, max_new_tokens=5, timeout=60) != dz_want:
+        violations.append("disagg: post-fault local serving diverged")
+    dz_owner_gauges = (dz.prefill_engine._pool.snapshot()["by_owner"],
+                       dz.decode_engine._pool.snapshot()["by_owner"])
+    dz.close()
+    if dz.prefill_engine.pages_in_use or dz.decode_engine.pages_in_use \
+            or any(dz_owner_gauges):
+        violations.append(
+            f"disagg: pages leaked after the adopt fault (owner gauges "
+            f"prefill/decode = {dz_owner_gauges}) — a failed handoff "
+            f"must release both sides")
+
+    dz_child_fired = dz_child_recorded = 0
+    dz_remote_pages = None
+    dz_worker = start_replica_process(
+        "bigdl_tpu.serving.disagg:chaos_prefill_worker", name="dzprefill")
+    rdz = DisaggregatedEngine(
+        model, params, remote_prefill=dz_worker, max_slots=slots,
+        max_len=max_len, max_prompt_len=16, max_queue=4 * n_requests,
+        kernels=kernels, page_size=8, seed=seed,
+        metrics=ServingMetrics())
+    try:
+        rdz.decode_engine.warmup()
+        if rdz.generate(dz_prompt, max_new_tokens=5,
+                        timeout=120) != dz_want:
+            violations.append("disagg: RPC handoff diverged from the "
+                              "monolithic bits")
+        dz_worker.arm_fault("engine.page_handoff", nth=1, times=1)
+        try:
+            rdz.generate(dz_prompt, max_new_tokens=5, timeout=120)
+            violations.append("disagg: the remote export fault never "
+                              "failed a stream")
+        except InjectedFault:
+            dz_injected += 1
+        except Exception as e:
+            violations.append(f"disagg: non-API RPC stream error {e!r}")
+        # child-side reconciliation: the CHILD's injector history must
+        # match its own flight recorder (the fault fired over there)
+        dz_child_fired = sum(v["fired"]
+                             for v in dz_worker.fault_snapshot().values())
+        dz_child_recorded = dz_worker.recorder_count("fault.fired")
+        dz_worker.reset_faults()
+        if dz_child_fired != 1 or dz_child_fired != dz_child_recorded:
+            violations.append(
+                f"disagg: child injector/recorder disagree "
+                f"(fired={dz_child_fired}, recorded={dz_child_recorded})")
+        if rdz.generate(dz_prompt, max_new_tokens=5,
+                        timeout=120) != dz_want:
+            violations.append("disagg: post-fault RPC serving diverged")
+        dz_remote_pages = dz_worker.remote_snapshot().get("pages_in_use")
+        if dz_remote_pages or rdz.decode_engine._pool.in_use:
+            violations.append(
+                f"disagg: pages leaked across the wire (remote_gauge="
+                f"{dz_remote_pages}, decode="
+                f"{rdz.decode_engine._pool.in_use})")
+    finally:
+        rdz.close()
+
     # ------------------------------------------------- network leg (PR 14) ----
     # The cross-process fabric under its own fault sites plus one REAL
     # SIGKILL. Part one: a hedged ReplicaSet mixing an in-process engine
@@ -2122,6 +2342,10 @@ def run_chaos_bench(args):
         "prefix_attach_fault_failed_streams": pfx_injected,
         "prefix_hits": pfx_snap["prefix_hits"],
         "prefix_shared_pages_after_fault": pfx_shared_after,
+        "disagg_handoff_faults_failed_streams": dz_injected,
+        "disagg_child_faults_fired": dz_child_fired,
+        "disagg_child_faults_recorded": dz_child_recorded,
+        "disagg_remote_pages_gauge": dz_remote_pages,
         "net_outcomes": net_outcomes,
         "net_transport": net_transport,
         "net_hedges": net_hedges,
@@ -2146,6 +2370,7 @@ def run_chaos_bench(args):
     _write_metrics_out(args, {"serving": replicas[0].metrics,
                               "speculative": spec_engine.metrics,
                               "prefix": pfx_engine._prefix,
+                              "disagg": dz.metrics,
                               "bench": result})
     print(json.dumps(result))
     if violations:
@@ -2242,6 +2467,16 @@ def _parse_args(argv=None):
                          "prefill invocations, TTFT p50 <= 0.8x off, and "
                          "zero output mismatches (cache on/off must be "
                          "bit-identical)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="serving --generate: add the prefill/decode "
+                         "disaggregation column — the same prompt-heavy "
+                         "1:1 short:long mix through a monolithic engine "
+                         "vs a DisaggregatedEngine (dedicated prefill and "
+                         "decode roles, finished KV pages handed off "
+                         "between pools) at equal modeled step/prompt "
+                         "costs; --smoke gates decode ITL p99 <= 0.7x "
+                         "monolithic, zero output mismatches (the handoff "
+                         "must be bit-exact), and drained role pools")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

@@ -62,6 +62,12 @@ SITES: Dict[str, str] = {
                             "prefix caching on, after cached pages "
                             "attach + fresh pages reserve, before the "
                             "first prefill/decode step (ctx: engine=)",
+    "engine.page_handoff": "disaggregated page handoff, once per "
+                           "request per side — stage='export' on the "
+                           "prefill-role engine before the KV block "
+                           "gathers, stage='adopt' on the decode-role "
+                           "engine before its pool adopts the pages "
+                           "(ctx: engine=, stage=)",
     "engine.draft": "GenerationEngine speculative draft leg, once per "
                     "round before the k+1 draft steps (ctx: engine=)",
     "engine.verify": "GenerationEngine speculative target verify step, "

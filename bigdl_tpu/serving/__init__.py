@@ -55,11 +55,28 @@ feeds the ReplicaSet's eviction, and ``ReplicaSet(hedge=True)`` adds
 p99-delayed tail-latency hedging with request-id idempotency (see
 README "Running a multi-process fleet").
 
+Prefill/decode disaggregation (PR 15) splits the engine's roles:
+:class:`DisaggregatedEngine` fronts a dedicated prefill-role engine
+(only ``prefill``/``chunk`` kernels; its final chunk gathers the
+request's finished KV pages into a device block) and a dedicated
+decode-role engine (only ``decode``; admits exclusively via
+``submit_prefilled`` with pages materialized), so decode inter-token
+latency never pays for a neighbour's prompt. Same-process handoff is a
+jitted gather/scatter between pools (``PagePool.export_pages`` /
+``adopt_pages``); cross-process hosts a :class:`PrefillWorker` behind
+the RPC fabric. Streams are bit-identical to the monolithic engine
+(see README "Disaggregated prefill/decode").
+
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """
 
 from bigdl_tpu.serving.batcher import DynamicBatcher, bucket_sizes_for
+from bigdl_tpu.serving.disagg import (
+    DisaggregatedEngine,
+    PageBlockMover,
+    PrefillWorker,
+)
 from bigdl_tpu.serving.engine import (
     DecodeKernels,
     GenerationEngine,
@@ -95,8 +112,11 @@ __all__ = [
     "CheckpointWatcher",
     "DeadlineExceeded",
     "DecodeKernels",
+    "DisaggregatedEngine",
     "DynamicBatcher",
     "GenerationEngine",
+    "PageBlockMover",
+    "PrefillWorker",
     "GenerationStream",
     "InferenceService",
     "ModelRouter",

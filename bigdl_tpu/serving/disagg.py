@@ -1,0 +1,455 @@
+"""Prefill/decode disaggregation (PR 15): dedicated prefill engines
+hand finished KV pages to decode engines.
+
+The monolithic :class:`~bigdl_tpu.serving.engine.GenerationEngine`
+interleaves prefill chunks with decode steps inside one scheduler loop,
+so every long admitted prompt stalls every in-flight stream's next
+token by a full chunk cost. This module removes the interference the
+way production fleets do — by splitting the roles:
+
+- a **prefill engine** (``role="prefill"``) runs only the
+  ``prefill``/``chunk`` kernels. Its final prompt chunk, instead of
+  flipping the slot to decode, gathers the request's finished KV pages
+  into a device block and hands them off;
+- a **decode engine** (``role="decode"``) runs only the ``decode``
+  kernel and admits a request exclusively through
+  ``submit_prefilled`` — pages already materialized, scattered into
+  its own pool at adoption. Its inter-token latency therefore never
+  pays for a neighbour's prompt.
+
+:class:`DisaggregatedEngine` is the front door wiring the two: one
+``submit`` that looks exactly like the monolithic engine's and produces
+bit-identical streams (greedy and sampled, f32 and int8 KV, whole and
+chunked prompts — the handoff payload carries the first token and the
+POST-prefill PRNG key, so the decode side resumes the identical token
+stream). Same-process handoff is a device-to-device gather/scatter of
+owned page rows between the two pools (``PagePool.export_pages`` /
+``adopt_pages`` keep the refcount/owner gauges byte-exact, and shared
+prefix pages dedup to one copy on the decode side). Cross-process
+handoff hosts a :class:`PrefillWorker` behind the PR-14 RPC fabric —
+the KV block serializes over ``rpc.py`` npy frames through
+``RemoteReplica`` and the front door re-stamps the deadline from its
+own clock (monotonic time does not cross processes).
+
+Failure semantics are request-scoped on both sides of the handoff: a
+fault at the ``engine.page_handoff`` site (export or adopt stage) fails
+only that stream with the injected error and drains BOTH pools'
+per-owner gauges to zero — the chaos tier proves it for the local and
+the RPC path.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from bigdl_tpu.serving.engine import (
+    GenerationEngine,
+    GenerationStream,
+    _cache_sharding_tree,
+)
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+__all__ = [
+    "PageBlockMover",
+    "DisaggregatedEngine",
+    "PrefillWorker",
+    "chaos_lm",
+    "chaos_prefill_worker",
+]
+
+
+class PageBlockMover:
+    """The jitted gather/scatter pair moving one request's page rows
+    between role pools.
+
+    ``gather(cache, idx)`` is a pure read: row ``i`` of every cache
+    leaf's block is ``leaf[idx[i]]`` (the trash-padded tail rows gather
+    trash-page garbage that the scatter routes straight back to the
+    destination trash page — fixed shapes, no masking). ``scatter``
+    donates the destination cache, exactly like the decode step, so
+    adoption never reallocates pool buffers. Both work uniformly over
+    f32 ``(K, V)`` and int8 ``(K, V, Ks, Vs)`` leaves because every
+    pool is axis-0 page-indexed. ``gather_traces``/``scatter_traces``
+    count actual XLA traces — the per-role compile-once tests pin them
+    at one each.
+    """
+
+    def __init__(self, cache_sharding=None):
+        self.cache_sharding = cache_sharding
+        self.gather_traces = 0
+        self.scatter_traces = 0
+
+        def _gather(cache, idx):
+            self.gather_traces += 1
+            block = jax.tree_util.tree_map(lambda pool: pool[idx], cache)
+            if cache_sharding is not None:
+                block = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, block,
+                    _cache_sharding_tree(block, cache_sharding))
+            return block
+
+        def _scatter(cache, block, idx):
+            self.scatter_traces += 1
+            out = jax.tree_util.tree_map(
+                lambda pool, rows: pool.at[idx].set(rows), cache, block)
+            if cache_sharding is not None:
+                out = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, out,
+                    _cache_sharding_tree(out, cache_sharding))
+            return out
+
+        self._gather = jax.jit(_gather)
+        # donating the cache keeps adoption allocation-free; the block
+        # is NOT donated (the local path may still hold it when a
+        # retry-shaped caller re-dispatches)
+        self._scatter = jax.jit(_scatter, donate_argnums=(0,))
+
+    def gather(self, cache, idx):
+        return self._gather(cache, np.asarray(idx, np.int32))
+
+    def scatter(self, cache, block, idx):
+        return self._scatter(cache, block, np.asarray(idx, np.int32))
+
+
+class _FrontDoorStream(GenerationStream):
+    """The consumer-facing stream of a disaggregated request. It is
+    pushed by whichever role currently owns the request; ``cancel``
+    additionally forwards to the prefill-role inner stream so a
+    cancellation lands even before the handoff."""
+
+    def __init__(self):
+        super().__init__()
+        self._inner: Optional[GenerationStream] = None
+
+    def cancel(self) -> None:
+        super().cancel()
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+
+class DisaggregatedEngine:
+    """Front door over a dedicated prefill engine and a dedicated
+    decode engine: one monolithic-shaped ``submit``, bit-identical
+    streams, no prefill/decode interference.
+
+    ``**shared`` are :class:`GenerationEngine` kwargs applied to both
+    roles, with three keys redirected where they belong:
+    ``prefix_cache`` goes to the PREFILL role only (the radix index
+    lives with the engine that writes prompt pages; attach-by-reference
+    keeps working there), ``metrics`` goes to the DECODE role only (it
+    is the front-door-visible sink — ITL, served/failed — while the
+    prefill engine gets its own), and ``tracer`` rides with prefill
+    (where requests are born). ``prefill_overrides`` /
+    ``decode_overrides`` merge per-role on top (e.g. distinct modeled
+    kernels, pool sizes, or a role-local metrics sink).
+
+    Pass ``remote_prefill`` (a ``RemoteReplica`` hosting a
+    :class:`PrefillWorker`, e.g. from
+    ``start_replica_process("pkg.mod:worker_factory")``) instead of
+    building a local prefill engine: prompts then prefill in the child
+    process and pages arrive as npy frames over the PR-14 wire.
+    """
+
+    def __init__(self, model, params, *,
+                 remote_prefill=None,
+                 prefill_overrides: Optional[dict] = None,
+                 decode_overrides: Optional[dict] = None,
+                 **shared):
+        shared.pop("role", None)
+        metrics = shared.pop("metrics", None)
+        tracer = shared.pop("tracer", None)
+        prefix = bool(shared.pop("prefix_cache", False))
+        cam = bool(shared.pop("cache_aware_admission", False))
+
+        decode_kw = dict(shared)
+        decode_kw["metrics"] = metrics or ServingMetrics()
+        decode_kw.update(decode_overrides or {})
+        self._decode = GenerationEngine(model, params, role="decode",
+                                        **decode_kw)
+        self.metrics = self._decode.metrics
+
+        self._remote = remote_prefill
+        self._prefill: Optional[GenerationEngine] = None
+        if remote_prefill is None:
+            prefill_kw = dict(shared)
+            prefill_kw["prefix_cache"] = prefix
+            prefill_kw["cache_aware_admission"] = cam
+            prefill_kw["tracer"] = tracer
+            prefill_kw.update(prefill_overrides or {})
+            self._prefill = GenerationEngine(model, params, role="prefill",
+                                             **prefill_kw)
+            # the handoff consumer: runs ON the prefill loop thread
+            # while the pages are still owned
+            self._prefill._handoff_cb = self._on_handoff
+
+    # ------------------------------------------------------ lifecycle ----
+
+    def warmup(self) -> None:
+        if self._prefill is not None:
+            self._prefill.warmup()
+        elif self._remote is not None:
+            self._remote.warmup()
+        self._decode.warmup()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Prefill side first: its drain flushes every pending handoff
+        into the decode queue, which the decode drain then finishes."""
+        if self._prefill is not None:
+            self._prefill.close(drain=drain, timeout=timeout)
+        elif self._remote is not None:
+            self._remote.close(drain=drain, timeout=timeout)
+        self._decode.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "DisaggregatedEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------ front door ----
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0,
+               seed: Optional[int] = None) -> GenerationStream:
+        """Monolithic-shaped submit: route the prompt to the prefill
+        role, continue the returned stream on the decode role once the
+        pages hand off. The stream's tokens are bit-identical to a
+        monolithic engine's for the same request (test-enforced)."""
+        stream = _FrontDoorStream()
+        ctx = {
+            "stream": stream,
+            "deadline": (None if deadline is None
+                         else stream.t_submit + float(deadline)),
+            "dispatched": False,
+        }
+        if self._prefill is not None:
+            inner = self._prefill.submit(
+                prompt, max_new_tokens=max_new_tokens, deadline=deadline,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, tag=ctx)
+            stream._inner = inner
+            inner.add_done_callback(self._make_relay(ctx))
+        else:
+            fut = self._remote.submit(
+                np.asarray(prompt, np.int32), deadline=deadline,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed)
+            fut.add_done_callback(
+                lambda f: self._on_remote_done(ctx, f))
+        return stream
+
+    def generate(self, prompt: Sequence[int], *,
+                 timeout: Optional[float] = None, **kw) -> List[int]:
+        return self.submit(prompt, **kw).result(timeout)
+
+    # --------------------------------------------------------- handoff ----
+
+    def _on_handoff(self, payload: dict) -> None:
+        """Local handoff consumer (prefill loop thread, pages still
+        owned): gather the KV block device-to-device off the prefill
+        cache, then dispatch to the decode role. Raising here is the
+        contract for failure — the prefill engine aborts the handoff,
+        releases the pages and fails the inner stream."""
+        payload["block"] = self._prefill._mover.gather(
+            self._prefill._cache, payload["page_row"])
+        self._dispatch(payload, reraise=True)
+
+    def _on_remote_done(self, ctx: dict, fut) -> None:
+        stream: GenerationStream = ctx["stream"]
+        try:
+            payload = fut.result()
+        except BaseException as e:
+            stream._finish(e)
+            return
+        if payload.get("complete"):
+            # the request retired at its first token (mnt==1 / EOS /
+            # deadline check) — nothing to decode, the worker returned
+            # the finished tokens directly
+            now = time.monotonic()
+            for t in np.asarray(payload["tokens"]).reshape(-1):
+                stream._push(int(t), now)
+            stream._finish(None, now)
+            return
+        payload["tag"] = ctx
+        self._dispatch(payload, reraise=False)
+
+    def _dispatch(self, payload: dict, *, reraise: bool) -> None:
+        """Hand one prefilled payload to the decode role. ``reraise``
+        distinguishes the paths: locally the exception must propagate
+        into the prefill engine's abort path (pages are still charged
+        there); on the RPC path the worker already exported its pages,
+        so failing the front stream is the whole cleanup."""
+        ctx = payload.pop("tag")
+        ctx["dispatched"] = True
+        # the front door's clock owns the deadline: same-process this is
+        # a no-op re-stamp, cross-process it replaces the worker's
+        # meaningless monotonic value
+        payload["deadline"] = ctx["deadline"]
+        try:
+            self._decode.submit_prefilled(payload, stream=ctx["stream"])
+        except BaseException as e:
+            ctx["stream"]._finish(e)
+            if reraise:
+                raise
+
+    def _make_relay(self, ctx: dict):
+        """Done-callback on the prefill-role inner stream: forward a
+        prefill-phase failure (or a request that legitimately finished
+        AT its first token, so no handoff fired) to the front stream.
+        After a dispatch the decode role owns the stream and this is a
+        no-op — ``_finish`` is idempotent besides."""
+
+        def relay(inner: GenerationStream) -> None:
+            stream: GenerationStream = ctx["stream"]
+            if inner.error is not None:
+                stream._finish(inner.error)
+                return
+            if ctx["dispatched"]:
+                return
+            now = time.monotonic()
+            for t in inner.tokens:
+                stream._push(int(t), now)
+            stream._finish(None, now)
+
+        return relay
+
+    # -------------------------------------------------------- queries ----
+
+    @property
+    def prefill_engine(self) -> Optional[GenerationEngine]:
+        return self._prefill
+
+    @property
+    def decode_engine(self) -> GenerationEngine:
+        return self._decode
+
+    def snapshot(self) -> dict:
+        out: dict = {"decode": self._decode.metrics.snapshot(),
+                     "decode_pool": self._decode._pool.snapshot()}
+        if self._prefill is not None:
+            out["prefill"] = self._prefill.metrics.snapshot()
+            out["prefill_pool"] = self._prefill._pool.snapshot()
+        elif self._remote is not None:
+            out["prefill"] = self._remote.remote_snapshot()
+        return out
+
+
+class PrefillWorker:
+    """RPC-hostable backend wrapping a prefill-role engine: ``submit``
+    returns a Future that resolves with the handoff payload — the KV
+    block converted to host npy leaves so it crosses the wire — for the
+    client-side :class:`DisaggregatedEngine` to adopt. Satisfies the
+    ``ReplicaServer`` backend contract (``submit``/``reload``/
+    ``warmup``/``close`` plus the ``metrics``/``pages_in_use`` gauges
+    its snapshot probes)."""
+
+    def __init__(self, model, params, *, warm: bool = True, **engine_kw):
+        engine_kw.pop("role", None)
+        self.engine = GenerationEngine(model, params, role="prefill",
+                                       **engine_kw)
+        self.engine._handoff_cb = self._on_handoff
+        if warm:
+            self.engine.warmup()
+
+    # ----------------------------------------------- backend contract ----
+
+    def submit(self, x, deadline: Optional[float] = None,
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None,
+               **kw) -> Future:
+        fut: Future = Future()
+        ctx = {"future": fut}
+        inner = self.engine.submit(
+            [int(t) for t in np.asarray(x).reshape(-1)],
+            max_new_tokens=(None if max_new_tokens is None
+                            else int(max_new_tokens)),
+            deadline=deadline, temperature=float(temperature),
+            top_k=int(top_k), top_p=float(top_p),
+            seed=None if seed is None else int(seed), tag=ctx)
+
+        def relay(s: GenerationStream) -> None:
+            if fut.done():
+                return  # the handoff already resolved it
+            try:
+                if s.error is not None:
+                    fut.set_exception(s.error)
+                else:
+                    fut.set_result({"complete": True,
+                                    "tokens": np.asarray(s.tokens,
+                                                         np.int32)})
+            except Exception:
+                pass  # lost the race with the handoff resolution
+
+        inner.add_done_callback(relay)
+        return fut
+
+    def _on_handoff(self, payload: dict) -> None:
+        ctx = payload.pop("tag")
+        # np-ify ON the loop thread while the pages are owned: the
+        # export right after this may recycle them into another prompt
+        payload["block"] = jax.tree_util.tree_map(
+            np.asarray,
+            self.engine._mover.gather(self.engine._cache,
+                                      payload["page_row"]))
+        # monotonic clocks don't cross processes — the front door
+        # re-stamps from its own at dispatch
+        payload["deadline"] = None
+        fut: Future = ctx["future"]
+        if not fut.done():
+            try:
+                fut.set_result(payload)
+            except Exception:
+                pass
+
+    def reload(self, params, state=None) -> None:
+        self.engine.reload(params, state)
+
+    def warmup(self, *a, **kw) -> None:
+        pass  # warmed in the constructor, before the RPC port opens
+
+    def close(self, drain: bool = True, timeout=None) -> None:
+        self.engine.close(drain=drain, timeout=timeout)
+
+    # gauges the ReplicaServer snapshot probes
+    @property
+    def metrics(self) -> ServingMetrics:
+        return self.engine.metrics
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.engine.pages_in_use
+
+
+# ----------------------------------------------------- chaos factories ----
+
+
+def chaos_lm():
+    """Deterministic tiny LM both sides of a cross-process test build
+    independently (``jax.random.key(0)`` init — bit-identical params in
+    parent and child, nothing pickled)."""
+    from bigdl_tpu.nn.layers.attention import Transformer
+
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        filter_size=64, num_hidden_layers=1)
+    params, _ = model.init(jax.random.key(0))
+    return model, params
+
+
+def chaos_prefill_worker() -> PrefillWorker:
+    """Zero-arg factory for ``start_replica_process`` — hosts the
+    :func:`chaos_lm` prefill role for the RPC handoff tests and the
+    chaos bench leg."""
+    model, params = chaos_lm()
+    return PrefillWorker(model, params, max_slots=2, max_len=48,
+                         max_prompt_len=16, page_size=8, prefill_chunk=8)

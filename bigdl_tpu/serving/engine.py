@@ -78,6 +78,7 @@ import logging
 import queue as _queue
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -655,12 +656,13 @@ class GenerationStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "deadline", "stream",
-                 "temperature", "top_k", "top_p", "seed")
+                 "temperature", "top_k", "top_p", "seed", "tag", "handoff")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  deadline: Optional[float], stream: GenerationStream,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: Optional[int] = None):
+                 top_p: float = 1.0, seed: Optional[int] = None,
+                 tag: Any = None, handoff: Optional[dict] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
@@ -669,6 +671,8 @@ class _GenRequest:
         self.top_k = top_k
         self.top_p = top_p
         self.seed = seed
+        self.tag = tag            # opaque caller context, rides the handoff
+        self.handoff = handoff    # adopt payload (decode-role admission)
 
     @property
     def sampled(self) -> bool:
@@ -683,7 +687,7 @@ class _SlotState:
 
     __slots__ = ("req", "last_token", "position", "generated", "t_admit",
                  "phase", "pages", "page_row", "prefill_pos",
-                 "draft_pages", "dpage_row", "cache_version")
+                 "draft_pages", "dpage_row", "cache_version", "t_last")
 
     def __init__(self, req: _GenRequest, last_token: int, position: int,
                  generated: int, t_admit: float, phase: str = "decode",
@@ -703,6 +707,7 @@ class _SlotState:
         self.draft_pages = draft_pages    # draft-lane pages (speculative)
         self.dpage_row = dpage_row        # draft (ppn,) map row (spec)
         self.cache_version = 0            # prefix-index version at admit
+        self.t_last = 0.0                 # last token's push time (ITL)
 
 
 class _Core:
@@ -869,6 +874,7 @@ class GenerationEngine:
                  speculate: Optional[tuple] = None,
                  prefix_cache: bool = False,
                  cache_aware_admission: bool = False,
+                 role: str = "both",
                  tracer=None,
                  timeline_capacity: int = 512,
                  profile_dir: Optional[str] = None,
@@ -958,6 +964,26 @@ class GenerationEngine:
         self._bypass_limit = 4
         self._head_bypasses = 0   # consecutive bypasses of the current head
         self.admission_bypasses = 0  # total (snapshot counter)
+        # prefill/decode disaggregation (PR 15): role="prefill" runs ONLY
+        # the prefill/chunk kernels — the final chunk, instead of
+        # flipping the slot to decode, gathers the finished KV pages into
+        # a device block and invokes `_handoff_cb` (set by the
+        # DisaggregatedEngine front door) with the handoff payload; the
+        # slot's pages are then export_pages()d and the slot freed.
+        # role="decode" runs ONLY the decode kernel and admits via
+        # `submit_prefilled` — pages already materialized, scattered into
+        # its own pool at adoption. role="both" (default) is the
+        # monolithic engine, bit-identically untouched.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got {role!r}")
+        self.role = role
+        self._handoff_cb: Optional[Callable[[dict], None]] = None
+        # content-identity namespace for exported pages: unique per
+        # engine INSTANCE across processes (adopt-side dedup keys on it,
+        # and two prefill workers' page ids must never alias)
+        self.handoff_source = f"prefill-{uuid.uuid4().hex[:12]}"
+        self._mover = None
         if speculate is not None:
             try:
                 self.draft_model, draft_params, self.spec_k = speculate
@@ -1071,6 +1097,20 @@ class GenerationEngine:
                 "in the page pools with per-token scale pools; the dense "
                 "slot-lane path is the float PR-5 baseline, kept bitwise "
                 "untouched)")
+        if self.role != "both":
+            if not self.paged:
+                raise ValueError(
+                    "role='prefill'/'decode' needs the paged engine — the "
+                    "handoff moves physical KV pages between pools")
+            if self.speculative:
+                raise ValueError(
+                    "role='prefill'/'decode' excludes speculative decoding "
+                    "(draft-lane pages do not cross the handoff yet)")
+            if self.role == "decode" and self.prefix_caching:
+                raise ValueError(
+                    "the prefix index lives with the prefill role (pages "
+                    "are published where prompts are written); pass "
+                    "prefix_cache=True to the prefill engine instead")
         if self.paged:
             # chunked prefill lifts the prompt-length wall: anything that
             # leaves room for one generated token is admitted and chunked
@@ -1153,6 +1193,15 @@ class GenerationEngine:
                 self._prefix = PrefixCache(self._pool, name="target")
                 if self.speculative:
                     self._dprefix = PrefixCache(self._pool, name="draft")
+            if self.role != "both":
+                # gather (prefill export) / scatter (decode adopt) jits:
+                # one executable each per role, counted like the kernel
+                # triples (compile-once is test-pinned per role). Lazy
+                # import: disagg.py imports this module at its top.
+                from bigdl_tpu.serving.disagg import PageBlockMover
+
+                self._mover = PageBlockMover(
+                    cache_sharding=self._cache_sharding)
             self._report_pages()
         else:
             if self.prefix_caching:
@@ -1204,7 +1253,8 @@ class GenerationEngine:
                deadline: Optional[float] = None,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0,
-               seed: Optional[int] = None) -> GenerationStream:
+               seed: Optional[int] = None,
+               tag: Any = None) -> GenerationStream:
         """Enqueue one prompt (sequence of token ids). ``max_new_tokens``
         caps generation (default: whatever fits in ``max_len``);
         ``deadline`` is seconds from now — an expired request retires
@@ -1217,7 +1267,15 @@ class GenerationEngine:
         stream's PRNG seed defaults to a pure function of the engine
         seed and the prompt bytes, so sampled output — like greedy — is
         identical across runs and admission orderings; pass ``seed`` to
-        give byte-identical prompts distinct streams."""
+        give byte-identical prompts distinct streams.
+
+        ``tag`` is an opaque caller context that rides the request into
+        a prefill-role engine's handoff payload (the DisaggregatedEngine
+        threads its per-request routing state through it)."""
+        if self.role == "decode":
+            raise RuntimeError(
+                "a decode-role engine admits only prefilled requests "
+                "(pages already materialized) — use submit_prefilled()")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -1237,8 +1295,13 @@ class GenerationEngine:
         if mnt < 1:
             raise ValueError("no room to generate even one token")
         if self.paged:
-            need = self._lanes * self._pool.pages_for(
-                min(len(prompt) + mnt - 1, self.max_len))
+            # a prefill-role engine reserves prompt pages only — the
+            # generation budget is the DECODE pool's problem
+            need = self._lanes * (
+                self._pool.pages_for(len(prompt))
+                if self.role == "prefill"
+                else self._pool.pages_for(
+                    min(len(prompt) + mnt - 1, self.max_len)))
             if need > self.num_pages:
                 # a reservation the pool can NEVER satisfy would block the
                 # FIFO head forever (page pressure is allowed to delay, not
@@ -1258,7 +1321,8 @@ class GenerationEngine:
                           None if deadline is None else now + float(deadline),
                           stream, temperature=temperature, top_k=int(top_k),
                           top_p=float(top_p),
-                          seed=None if seed is None else int(seed))
+                          seed=None if seed is None else int(seed),
+                          tag=tag)
         core = self._core
         try:
             with core.cond:
@@ -1298,6 +1362,65 @@ class GenerationEngine:
                            deadline=deadline, temperature=temperature,
                            top_k=top_k, top_p=top_p,
                            seed=seed).result(timeout)
+
+    def submit_prefilled(self, payload: dict, *,
+                         stream: Optional[GenerationStream] = None
+                         ) -> GenerationStream:
+        """Enqueue a request whose prompt a PREFILL-role engine already
+        ran (decode-role engines only): ``payload`` is the handoff dict
+        that engine's ``_handoff_cb`` produced — prompt, first token,
+        post-prefill PRNG key, sampling params, the gathered KV block
+        and the page manifest. Admission adopts the prompt pages into
+        this engine's pool (shared prefixes dedup to one local copy),
+        scatters the block, pushes the first token, and decodes on —
+        the stream continues bit-identically to a monolithic engine's.
+
+        ``payload["deadline"]`` is ABSOLUTE ``time.monotonic()`` time:
+        meaningful same-process only, so a cross-process front door
+        re-stamps it from its own clock before dispatching here. Pass
+        ``stream`` to continue an existing consumer-facing stream (the
+        front door's); omitted, a fresh one is returned."""
+        if self.role != "decode":
+            raise RuntimeError(
+                "submit_prefilled() needs a role='decode' engine — "
+                "monolithic engines prefill their own prompts")
+        prompt = [int(t) for t in np.asarray(payload["prompt"]).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt in handoff payload")
+        mnt = int(payload["max_new_tokens"])
+        if mnt < 1:
+            raise ValueError("handoff payload has no generation budget")
+        need = self._pool.pages_for(min(len(prompt) + mnt - 1, self.max_len))
+        if need > self.num_pages:
+            raise ValueError(
+                f"request needs {need} KV pages but the decode pool holds "
+                f"{self.num_pages}; shrink the prompt/max_new_tokens or "
+                f"grow num_pages")
+        stream = stream or GenerationStream()
+        deadline = payload.get("deadline")
+        req = _GenRequest(prompt, mnt,
+                          None if deadline is None else float(deadline),
+                          stream,
+                          temperature=float(payload.get("temperature", 0.0)),
+                          top_k=int(payload.get("top_k", 0)),
+                          top_p=float(payload.get("top_p", 1.0)),
+                          handoff=payload)
+        core = self._core
+        with core.cond:
+            if self._failed is not None:
+                raise RuntimeError(
+                    "generation engine stopped after a step failure"
+                ) from self._failed
+            if core.closed:
+                raise RuntimeError("generation engine is closed")
+            if len(core.pending) >= self.max_queue:
+                self.metrics.record_rejected()
+                raise Overloaded(len(core.pending), self.max_queue)
+            core.pending.append(req)
+            depth = len(core.pending)
+            core.cond.notify_all()
+        self.metrics.set_queue_depth(depth)
+        return stream
 
     def _on_stall(self, err: StallError) -> None:
         """Watchdog callback (runs on the WATCHDOG thread): the loop is
@@ -1379,7 +1502,9 @@ class GenerationEngine:
                     del core.pending[take]
                 depth = len(core.pending)
             self.metrics.set_queue_depth(depth)
-            if self.paged:
+            if req.handoff is not None:
+                self._admit_prefilled(req)
+            elif self.paged:
                 self._admit_paged(req)
             else:
                 self._admit(req)
@@ -1462,7 +1587,11 @@ class GenerationEngine:
         # PER-LANE pages: rows written = prompt + generated - 1 (the
         # final token is returned but never written back before the slot
         # retires). A speculative slot reserves this many for EACH of
-        # its two lanes (`_lanes` — the draft writes the same positions)
+        # its two lanes (`_lanes` — the draft writes the same positions).
+        # A prefill-role slot writes prompt rows only — generation pages
+        # are reserved by the adopting decode pool.
+        if self.role == "prefill":
+            return self._pool.pages_for(len(req.prompt))
         return self._pool.pages_for(
             min(len(req.prompt) + req.max_new_tokens - 1, self.max_len))
 
@@ -1498,7 +1627,11 @@ class GenerationEngine:
         prefix pages are shared, not allocated), plus the probe result
         protecting the matched chains from eviction."""
         need = self._lanes * self._pages_needed(req)
-        if self._prefix is None:
+        if self._prefix is None or req.handoff is not None:
+            # handoff admissions never probe the prefix index (it lives
+            # with the prefill role); adopt-side dedup may still make
+            # some of `need` shares instead of allocs — gating on the
+            # full count is the conservative bound
             return need, None
         cached_len, probes = self._prefix_probe(req)
         return need - self._lanes * (cached_len // self.page_size), probes
@@ -1643,6 +1776,167 @@ class GenerationEngine:
             # refcount and leak zero shared pages (chaos-gated)
             faults.fire("engine.prefix_attach", engine=self)
 
+    def _admit_prefilled(self, req: _GenRequest) -> None:
+        """Decode-role admission: the prompt's KV rows arrive as a
+        gathered device block plus a page manifest instead of running
+        prefill here. Adopt the pages (shared prefixes dedup to one
+        local copy), scatter the block into this pool's cache, arm the
+        slot exactly as a monolithic final chunk would — same last
+        token, position, sampling params and post-prefill PRNG key, so
+        the decode continuation is bit-identical — and push the first
+        token. A failure between adopt and scatter is REQUEST-scoped:
+        the cache is untouched until the scatter lands, so only this
+        stream fails and its pages release; the engine keeps serving."""
+        now = time.monotonic()
+        why = self._retire_why(None, req, now)
+        if why is not None:
+            self._finish_request(req, why, now, queue_wait=None)
+            return
+        payload = req.handoff
+        core = self._core
+        with core.cond:
+            core.free.sort()
+            slot = core.free.pop(0)
+        meta = np.asarray(payload["page_meta"]).reshape(-1, 3)
+        need = self._pages_needed(req)
+        k_p = len(meta)
+        pages: List[int] = []
+        try:
+            # fault site: between the prefill engine's export and this
+            # pool's adopt — the chaos gate proves a mid-handoff fault
+            # drains BOTH pools' per-owner gauges to zero
+            faults.fire("engine.page_handoff", engine=self, stage="adopt")
+            pages = self._pool.adopt_pages(
+                [(int(m[0]), int(m[1]), bool(m[2])) for m in meta],
+                source=str(payload["source"]), owner="target")
+            pages = pages + self._pool.alloc(need - k_p, owner="target")
+            row = np.full((self._pool.pages_per_slot,), self._pool.trash,
+                          np.int32)
+            row[:len(pages)] = pages
+            idx = np.full((self._pool.pages_per_slot,), self._pool.trash,
+                          np.int32)
+            idx[:k_p] = pages[:k_p]
+            # identity for committed arrays (the local gather's output,
+            # wherever it is sharded), an upload for the RPC path's np
+            # leaves — both land as ONE committed executable signature
+            block = jax.device_put(payload["block"])
+            self._cache = self._mover.scatter(self._cache, block, idx)
+        except BaseException as e:
+            self._pool.release(pages)
+            with core.cond:
+                core.free.append(slot)
+            self._report_pages()
+            self.metrics.record_failed()
+            req.stream._finish(e, time.monotonic())
+            return
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+        self._keys[slot] = np.asarray(payload["key"], np.uint32)
+        self._page_map[slot] = row
+        tok = int(payload["first_token"])
+        now = time.monotonic()
+        st = _SlotState(req, tok, len(req.prompt), 1, now, phase="decode",
+                        pages=pages, page_row=row)
+        st.t_last = now
+        with core.cond:
+            core.active[slot] = st
+        self._report_pages()
+        req.stream._push(tok, now)
+        why = self._retire_why(st, req, now)
+        if why is not None:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
+
+    def _handoff_payload(self, slot: int, st: _SlotState,
+                         tok: int) -> dict:
+        """Everything a decode-role engine needs to continue ``st``'s
+        stream bit-identically: the first token, the POST-prefill PRNG
+        key (sampled token i draws from split i whatever engine holds
+        the slot), sampling params, and the page manifest —
+        ``(page id, write generation, shareable)`` rows naming each
+        prompt page's content under this engine's ``handoff_source``
+        namespace (full prompt pages are shareable; the partial tail
+        page keeps taking decode writes and always fresh-copies). The
+        KV block itself is gathered by the handoff callback while the
+        pages are still owned. np-typed throughout so the payload
+        crosses rpc.py npy frames unchanged."""
+        req = st.req
+        ps = self.page_size
+        plen = len(req.prompt)
+        meta = np.asarray(
+            [(int(p), self._pool.generation(p), int((i + 1) * ps <= plen))
+             for i, p in enumerate(st.pages)], np.int64).reshape(-1, 3)
+        return {
+            "prompt": np.asarray(req.prompt, np.int32),
+            "first_token": int(tok),
+            "key": self._keys[slot].copy(),
+            "plen": plen,
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "deadline": req.deadline,
+            "page_row": st.page_row.copy(),
+            "page_meta": meta,
+            "source": self.handoff_source,
+            "tag": req.tag,
+        }
+
+    def _handoff_slot(self, slot: int, st: _SlotState) -> None:
+        """Retire a prefill-role slot whose pages were handed off:
+        publish the full prompt pages to the prefix index (it lives with
+        THIS role — the next same-prefix prompt attaches by reference
+        and skips its covered chunks), then export the request's
+        references and free the slot. Mirrors ``_release_slot`` except
+        the pages leave through ``export_pages`` accounting."""
+        core = self._core
+        with core.cond:
+            core.active.pop(slot, None)
+            core.free.append(slot)
+        if (self._prefix is not None and st.pages
+                and st.cache_version == self._prefix.version):
+            self._prefix.publish(st.req.prompt, st.page_row)
+            self._evict_stale = False
+            self._dedup_after_publish()
+        self._pool.export_pages(st.pages or ())
+        st.pages = None
+        self._page_map[slot] = self._pool.trash
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._keys[slot] = 0
+        self._evict_stale = False
+        self._report_pages()
+
+    def _abort_handoff(self, slot: int, st: _SlotState,
+                       err: BaseException) -> None:
+        """A handoff failed before its pages left this pool: release
+        them (no publish — the stream is failing, nothing should newly
+        enter the index off its back), free the slot, fail the stream
+        with the error. REQUEST-scoped on purpose: the gather is a pure
+        read, the cache was never touched, so the engine keeps serving
+        its other slots."""
+        core = self._core
+        with core.cond:
+            core.active.pop(slot, None)
+            core.free.append(slot)
+        self._pool.release(st.pages or ())
+        st.pages = None
+        self._page_map[slot] = self._pool.trash
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._keys[slot] = 0
+        self._evict_stale = False
+        self._report_pages()
+        self.metrics.record_failed()
+        now = time.monotonic()
+        st.req.stream._finish(err, now)
+        tr = st.req.stream.trace
+        if tr is not None:
+            tr.finish(outcome="failed", tokens=st.generated)
+
     def _prefill_chunk_once(self, slot: int, st: _SlotState) -> None:
         """Advance one prompt chunk for a prefilling slot. Non-final
         chunks are always exactly ``prefill_chunk`` tokens (one compiled
@@ -1728,10 +2022,35 @@ class GenerationEngine:
         st.last_token = tok
         st.position = len(prompt)
         st.generated = 1
+        st.t_last = now
         why = self._retire_why(st, req, now)
         if why is not None:
             self._release_slot(slot, st)
             self._finish_slot(st, why, now)
+            return
+        if self.role == "prefill":
+            # the whole prompt is written (phase just flipped) and the
+            # request still wants tokens: hand the finished pages to the
+            # decode role instead of decoding here. The callback gathers
+            # the block from this cache ON THIS THREAD while the pages
+            # are still owned, then routes it; only after it returns do
+            # the pages export and the slot free. A fault or callback
+            # failure is request-scoped — pages release, stream fails,
+            # the engine keeps prefilling its other slots.
+            try:
+                faults.fire("engine.page_handoff", engine=self,
+                            stage="export")
+                cb = self._handoff_cb
+                if cb is None:
+                    raise RuntimeError(
+                        "prefill-role engine has no handoff consumer "
+                        "(set by DisaggregatedEngine / PrefillWorker)")
+                cb(self._handoff_payload(slot, st, tok))
+            except BaseException as e:
+                self._abort_handoff(slot, st, e)
+                return
+            self._handoff_slot(slot, st)
+            self._finish_slot(st, "done", now)
 
     def _release_slot(self, slot: int, st: _SlotState) -> None:
         """Return a slot (and, paged, its pages + step-input rows) to the
@@ -1888,6 +2207,7 @@ class GenerationEngine:
             tr.event("first_token")
         req.stream._push(tok, now)
         st = _SlotState(req, tok, n, 1, now)
+        st.t_last = now
         why = self._retire_why(st, req, now)
         if why is None:
             with core.cond:
@@ -1930,6 +2250,11 @@ class GenerationEngine:
             tr = st.req.stream.trace
             if tr is not None:
                 tr.tick("decode")
+            if st.t_last:
+                # gap since this stream's previous token — the decode
+                # stall gauge prefill interference inflates (PR 15)
+                self.metrics.record_itl(now - st.t_last)
+            st.t_last = now
             st.req.stream._push(tok, now)
             why = self._retire_why(st, st.req, now)
             if why is not None:
@@ -2011,6 +2336,11 @@ class GenerationEngine:
             tr = st.req.stream.trace
             if tr is not None:
                 tr.tick("verify_round")
+            if pushed and st.t_last:
+                # one amortized sample per emitted token: the round's
+                # wall gap spread over everything it pushed
+                self.metrics.record_itl((now - st.t_last) / pushed, pushed)
+            st.t_last = now
             st.last_token = int(outs[slot, pushed - 1])
             st.position += pushed
             st.generated += pushed
@@ -2124,23 +2454,51 @@ class GenerationEngine:
             jax.block_until_ready(self._dcache)
         elif self.paged:
             # every write below routes to the trash page (the map rows
-            # are parked there), so warmup garbage can never surface
+            # are parked there), so warmup garbage can never surface.
+            # Role-split engines warm ONLY their role's kernels: the
+            # compile-once contract is per role (a prefill engine never
+            # traces decode and vice versa — trace-counter-pinned).
             trash_row = np.full((self._pool.pages_per_slot,),
                                 self._pool.trash, np.int32)
-            _, self._keys, self._cache = self.kernels.decode(
-                self._params, self._cache, zeros, zeros, self._page_map,
-                self._temps, self._top_ks, self._top_ps, self._keys)
-            self._keys = np.asarray(self._keys)
-            if self.max_prompt_len > self.prefill_chunk:
-                self._cache = self.kernels.chunk(
-                    self._params, self._cache, trash_row,
-                    np.full((self.prefill_chunk,), self.pad_id, np.int32),
-                    0, self.prefill_chunk, self._pool.trash)
-            for bucket in self.prompt_buckets:
-                _, _, self._cache = self.kernels.prefill(
-                    self._params, self._cache, trash_row,
-                    np.full((bucket,), self.pad_id, np.int32), 0, bucket,
-                    self._pool.trash)
+            if self.role != "prefill":
+                _, self._keys, self._cache = self.kernels.decode(
+                    self._params, self._cache, zeros, zeros,
+                    self._page_map, self._temps, self._top_ks,
+                    self._top_ps, self._keys)
+                self._keys = np.asarray(self._keys)
+            if self.role != "decode":
+                if self.max_prompt_len > self.prefill_chunk:
+                    self._cache = self.kernels.chunk(
+                        self._params, self._cache, trash_row,
+                        np.full((self.prefill_chunk,), self.pad_id,
+                                np.int32),
+                        0, self.prefill_chunk, self._pool.trash)
+                for bucket in self.prompt_buckets:
+                    _, _, self._cache = self.kernels.prefill(
+                        self._params, self._cache, trash_row,
+                        np.full((bucket,), self.pad_id, np.int32), 0,
+                        bucket, self._pool.trash)
+            if self.role == "prefill":
+                # the export gather (pure read off the trash rows)
+                jax.block_until_ready(
+                    self._mover.gather(self._cache, trash_row))
+            elif self.role == "decode":
+                # the adopt scatter: a zero block routed to the trash
+                # page, placed exactly as runtime blocks are (the
+                # device_put the adopt path applies) so ONE executable
+                # serves warmup and traffic
+                block = jax.tree_util.tree_map(
+                    lambda leaf: np.zeros(
+                        (self._pool.pages_per_slot,) + leaf.shape[1:],
+                        leaf.dtype), self._cache)
+                if self._cache_sharding is not None:
+                    block = jax.device_put(
+                        block,
+                        _cache_sharding_tree(block, self._cache_sharding))
+                else:
+                    block = jax.device_put(block)
+                self._cache = self._mover.scatter(self._cache, block,
+                                                  trash_row)
             # warmup consumed one split per slot key: re-arm the zeros so
             # the first real admission starts from its request seed (it
             # overwrites the row anyway; this keeps the invariant obvious)
@@ -2263,6 +2621,14 @@ class GenerationEngine:
     @property
     def verify_compilations(self) -> int:
         return getattr(self.kernels, "verify_traces", 0)
+
+    @property
+    def handoff_gather_compilations(self) -> int:
+        return self._mover.gather_traces if self._mover is not None else 0
+
+    @property
+    def handoff_scatter_compilations(self) -> int:
+        return self._mover.scatter_traces if self._mover is not None else 0
 
     @property
     def pages_in_use(self) -> int:

@@ -121,6 +121,13 @@ class ServingMetrics:
         self.prefix_misses = 0       # admissions with no cached prefix
         self.shared_pages = 0        # pages the prefix index holds (gauge)
         self.prefill_chunks_skipped = 0  # chunk/prefill calls not executed
+        # inter-token latency (PR 15): gap between consecutive tokens of
+        # ONE stream, one sample per decode token. TTFT covers the first
+        # token; this is the decode-stall gauge — the number prefill
+        # interference inflates and disaggregation exists to protect.
+        # Empty for a non-generating service — snapshot/table keep the
+        # earlier shapes (same append-only golden contract as above).
+        self._itl = _Reservoir(reservoir_size)          # seconds per gap
 
     # ------------------------------------------------------- mutators ----
 
@@ -182,6 +189,15 @@ class ServingMetrics:
         with self._lock:
             if duration_s > 0:
                 self._stream_rate.add(n_tokens / duration_s)
+
+    def record_itl(self, gap_s: float, n: int = 1) -> None:
+        """``n`` decode tokens of one stream arrived ``gap_s`` after the
+        stream's previous token each (n > 1 = a speculative round's
+        amortized per-token gap). One sample per generated token past
+        the first — the first token's wait is TTFT, not ITL."""
+        with self._lock:
+            for _ in range(int(n)):
+                self._itl.add(gap_s)
 
     def record_reload(self) -> None:
         with self._lock:
@@ -398,6 +414,13 @@ class ServingMetrics:
                     if self.prefix_hits + self.prefix_misses else 0.0),
                 "shared_pages": self.shared_pages,
                 "prefill_chunks_skipped": self.prefill_chunks_skipped,
+                # inter-token-latency fields (PR 15): appended after
+                # every earlier key, never reordered
+                "itl_ms": None if (g := self._itl.percentiles(
+                    self.LATENCY_QS)) is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, g)},
+                "itl_samples": self._itl.seen,
             }
 
     def format_table(self) -> str:
@@ -496,4 +519,12 @@ class ServingMetrics:
             row("prefix_hit_rate", f"{s['prefix_hit_rate'] * 100:.1f}%")
             row("shared_pages", s["shared_pages"])
             row("prefill_chunks_skipped", s["prefill_chunks_skipped"])
+        # inter-token-latency rows: appended strictly after the prefix
+        # block and only when decode gaps were actually sampled — every
+        # earlier table stays a byte-identical strict prefix
+        # (append-only golden contract, test-enforced)
+        if s["itl_samples"]:
+            for q, v in s["itl_ms"].items():
+                row(f"itl_{q}(ms)", f"{v:.3f}")
+            row("itl_samples", s["itl_samples"])
         return "\n".join(lines)

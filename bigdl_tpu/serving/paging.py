@@ -34,12 +34,27 @@ Policy notes:
   ``in_use`` and the per-owner gauges count DISTINCT pages (a page
   shared by three requests is charged once, to its original alloc
   owner), which keeps every drain invariant byte-exact under sharing.
+- **cross-pool handoff (PR 15).** Prefill/decode disaggregation moves
+  finished prompt pages between two pools. :meth:`export_pages` is the
+  sending side: it drops the exporting request's references (a prefix
+  cache holding its own reference keeps the page alive for the NEXT
+  request) and counts the handoff. :meth:`adopt_pages` is the receiving
+  side: each source page is identified by ``(source tag, page id, write
+  generation)`` — the generation bumps on every ``alloc``, so a source
+  page id that was freed and refilled with different tokens can never
+  alias a stale import. The first adoption of an identity allocates a
+  fresh local page (the caller copies the rows in); a repeat adoption
+  while that local page is still live just :meth:`share`\\ s it, which
+  is how shared prefix pages cross the handoff WITHOUT being charged
+  twice in ``in_use``. The import index is unwound eagerly when the
+  local page's last reference goes, so it can never point at a freed
+  or recycled page.
 """
 
 from __future__ import annotations
 
 import heapq
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np  # module-level on purpose: page_bytes sits on the
 # hot metrics path (one call per kv_bytes_in_use gauge read) — a
@@ -103,6 +118,19 @@ class PagePool:
         # 1; share() adds; release() subtracts and frees at zero — the
         # prefix cache's read-only page sharing rides on this.
         self._refs: Dict[int, int] = {}
+        # write generation per page id: bumped on every alloc. Part of
+        # the cross-pool page identity — a freed-and-refilled page gets
+        # a new generation, so adopt-side dedup can never match stale
+        # content under a recycled id.
+        self._generation: Dict[int, int] = {}
+        # adopt-side import index: (source tag, source page, source
+        # generation) -> local page, plus the reverse map release()
+        # uses to unwind entries the moment the local page frees.
+        self._imports: Dict[Tuple[str, int, int], int] = {}
+        self._import_by_dst: Dict[int, Tuple[str, int, int]] = {}
+        self.pages_exported = 0      # pages handed to another pool
+        self.pages_adopted = 0       # fresh local pages from adoption
+        self.pages_adopt_shared = 0  # adoptions served by a live import
 
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` KV rows (>= 1)."""
@@ -125,6 +153,7 @@ class PagePool:
         self.in_use += n
         for p in pages:
             self._refs[p] = 1
+            self._generation[p] = self._generation.get(p, 0) + 1
         if owner is not None:
             for p in pages:
                 self._page_owner[p] = owner
@@ -167,6 +196,60 @@ class PagePool:
             owner = self._page_owner.pop(p, None)
             if owner is not None:
                 self._owner_counts[owner] -= 1
+            key = self._import_by_dst.pop(p, None)
+            if key is not None:
+                del self._imports[key]
+
+    def generation(self, page: int) -> int:
+        """Write generation of ``page`` (0 = never allocated). Bumped on
+        every :meth:`alloc`, so ``(pool tag, page id, generation)``
+        names the page's CONTENT, not just its slot — the identity
+        :meth:`adopt_pages` dedups on across a role handoff."""
+        return self._generation.get(int(page), 0)
+
+    def export_pages(self, pages: Sequence[int]) -> None:
+        """Hand ``pages`` to another pool: the exporting request's rows
+        have already been gathered device-side, so its references are
+        dropped exactly like :meth:`release` — a prefix cache that also
+        references a page keeps it alive for the next attach; everything
+        else returns to the free heap. Only the ``pages_exported``
+        counter distinguishes a handoff from a plain retirement."""
+        self.pages_exported += len(pages)
+        self.release(pages)
+
+    def adopt_pages(self, meta: Sequence[Tuple[int, int, int]], *,
+                    source: str, owner: Optional[str] = None) -> List[int]:
+        """Receive exported pages described by ``meta`` rows of
+        ``(source page id, source write generation, shareable)`` and
+        return the local page per row, in order. A ``shareable`` row
+        (a FULL prompt page — partial tail pages keep taking decode
+        writes and are never dedupable) first probes the import index:
+        a live hit is :meth:`share`\\ d — charged once in ``in_use``, to
+        its original adopter — which is how a prefix shared by N
+        requests crosses the handoff as ONE local page. Misses (and
+        non-shareable rows) allocate fresh pages for the caller to
+        scatter the rows into; scattering a dedup hit again is benign
+        by construction — pages are pure functions of their tokens, so
+        the rewrite is bit-identical. Callers gate admission on
+        :meth:`can_reserve` for the FULL page count, so the partial
+        allocation inside cannot fail mid-way."""
+        out: List[int] = []
+        for src_page, src_gen, shareable in meta:
+            key = (str(source), int(src_page), int(src_gen))
+            dst = self._imports.get(key) if shareable else None
+            if dst is not None:
+                # eager unwind at free keeps the index live-only, so a
+                # hit is always a reserved page holding matching rows
+                self.share([dst])
+                self.pages_adopt_shared += 1
+            else:
+                dst = self.alloc(1, owner=owner)[0]
+                self.pages_adopted += 1
+                if shareable:
+                    self._imports[key] = dst
+                    self._import_by_dst[dst] = key
+            out.append(dst)
+        return out
 
     def refcount(self, page: int) -> int:
         """Live references on ``page`` (0 = free). The prefix cache's
@@ -195,6 +278,10 @@ class PagePool:
             # pages currently multi-referenced (prefix-cache sharing);
             # appended after every earlier key (append-only contract)
             "pages_shared": sum(1 for r in self._refs.values() if r >= 2),
+            # PR 15 disaggregation handoff counters (append-only)
+            "pages_exported": self.pages_exported,
+            "pages_adopted": self.pages_adopted,
+            "pages_adopt_shared": self.pages_adopt_shared,
         }
 
     @property

@@ -1,0 +1,442 @@
+"""Prefill/decode disaggregation (PR 15): role-split engines, page
+handoff, bit-identity, accounting, faults, and the ITL gauge.
+
+The load-bearing properties, per the subsystem contract:
+
+- a DisaggregatedEngine's streams are BIT-identical to the monolithic
+  engine's across {f32, int8 KV} x {tp1, tp2} x {whole, chunked
+  prompts} x admission orders, greedy and sampled (the handoff carries
+  the first token and the POST-prefill PRNG key);
+- ``PagePool.export_pages`` / ``adopt_pages`` keep refcount/owner
+  gauges byte-exact, and a prefix page shared by N concurrent requests
+  crosses the handoff as ONE decode-side page (no double-charge);
+- compile-once holds PER ROLE: the prefill engine never traces the
+  decode kernel (and vice versa), and the handoff gather/scatter each
+  trace exactly once including warmup;
+- a fault at ``engine.page_handoff`` (either stage, local or RPC path)
+  fails only that stream with the injected error and drains BOTH
+  pools' per-owner gauges to zero;
+- ``ServingMetrics`` grows the ITL reservoir strictly after the PR-12
+  prefix block (append-only golden contract).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.faults import InjectedFault
+from bigdl_tpu.serving import (
+    DisaggregatedEngine,
+    GenerationEngine,
+    PagePool,
+    PrefillWorker,
+    ServingMetrics,
+    StreamCancelled,
+)
+from bigdl_tpu.serving.disagg import chaos_lm
+
+MAXLEN, MAXPROMPT, PAGE, CHUNK = 48, 16, 8, 8
+
+# whole (< one chunk) and chunked prompts, greedy and sampled — one
+# workload exercising every handoff shape
+REQS = [
+    ([1, 2, 3], dict(temperature=0.9, top_k=8, seed=7)),
+    ([5, 6, 7, 8, 9, 10, 11, 12, 13], dict()),
+    ([2, 4], dict()),
+    ([9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4, 5, 6, 7], dict(temperature=1.1,
+                                                         seed=3)),
+]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return chaos_lm()
+
+
+def _engine_kw(**over):
+    kw = dict(max_slots=4, max_len=MAXLEN, max_prompt_len=MAXPROMPT,
+              page_size=PAGE, prefill_chunk=CHUNK)
+    kw.update(over)
+    return kw
+
+
+def _run(engine, reqs, mnt=8, timeout=120):
+    streams = [engine.submit(p, max_new_tokens=mnt, **kw) for p, kw in reqs]
+    return [s.result(timeout) for s in streams]
+
+
+# ------------------------------------------------- pool accounting ----
+
+
+class TestExportAdopt:
+    def test_export_release_and_owner_gauges(self):
+        pool = PagePool(8, 4, 32)
+        pages = pool.alloc(3, owner="target")
+        assert pool.in_use == 3
+        pool.export_pages(pages)
+        assert pool.in_use == 0
+        assert pool.snapshot()["pages_exported"] == 3
+        assert pool.snapshot()["by_owner"] == {}
+
+    def test_export_keeps_shared_reference_alive(self):
+        """An exported page another holder (the prefix index) still
+        references stays reserved — export drops the REQUEST's ref."""
+        pool = PagePool(8, 4, 32)
+        (p,) = pool.alloc(1, owner="target")
+        pool.share([p])
+        pool.export_pages([p])
+        assert pool.in_use == 1      # the index's reference survives
+        pool.release([p])
+        assert pool.in_use == 0
+
+    def test_adopt_fresh_and_dedup(self):
+        src = PagePool(8, 4, 32)
+        dst = PagePool(8, 4, 32)
+        a = src.alloc(2, owner="target")
+        meta = [(a[0], src.generation(a[0]), True),
+                (a[1], src.generation(a[1]), False)]
+        first = dst.adopt_pages(meta, source="src", owner="target")
+        assert dst.in_use == 2
+        assert dst.snapshot()["pages_adopted"] == 2
+        # same content again while the first holder lives: the
+        # shareable row dedups to the SAME local page, charged once;
+        # the non-shareable tail always fresh-copies
+        second = dst.adopt_pages(meta, source="src", owner="target")
+        assert second[0] == first[0] and second[1] != first[1]
+        assert dst.in_use == 3
+        assert dst.snapshot()["pages_adopt_shared"] == 1
+        assert dst.snapshot()["by_owner"]["target"] == 3
+
+    def test_adopt_import_index_unwinds_at_free(self):
+        src = PagePool(8, 4, 32)
+        dst = PagePool(8, 4, 32)
+        (p,) = src.alloc(1, owner="target")
+        meta = [(p, src.generation(p), True)]
+        first = dst.adopt_pages(meta, source="src")
+        dst.release(first)
+        assert dst.in_use == 0
+        # the import entry died with its page: the next adopt of the
+        # same content key must NOT hand back the recycled page id
+        again = dst.adopt_pages(meta, source="src")
+        assert dst.snapshot()["pages_adopt_shared"] == 0
+        assert dst.snapshot()["pages_adopted"] == 2
+        dst.release(again)
+
+    def test_adopt_generation_names_content_not_slot(self):
+        """Re-allocating a source page id bumps its generation, so the
+        stale import key can never alias the new content."""
+        src = PagePool(8, 4, 32)
+        dst = PagePool(8, 4, 32)
+        (p,) = src.alloc(1)
+        g1 = src.generation(p)
+        live = dst.adopt_pages([(p, g1, True)], source="src")
+        src.release([p])
+        (p2,) = src.alloc(1)          # smallest-id-first: same slot
+        assert p2 == p and src.generation(p2) == g1 + 1
+        fresh = dst.adopt_pages([(p2, src.generation(p2), True)],
+                                source="src")
+        assert fresh[0] != live[0]
+        assert dst.snapshot()["pages_adopt_shared"] == 0
+
+    def test_dedup_scoped_by_source(self):
+        """Two prefill engines' page ids must never alias: the content
+        key includes the exporter's namespace tag."""
+        dst = PagePool(8, 4, 32)
+        a = dst.adopt_pages([(0, 1, True)], source="prefill-a")
+        b = dst.adopt_pages([(0, 1, True)], source="prefill-b")
+        assert a[0] != b[0] and dst.in_use == 2
+
+
+# ------------------------------------------------- bit-identity matrix ----
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+    @pytest.mark.parametrize("tp", [1, 2])
+    def test_matrix(self, lm, cache_dtype, tp):
+        """{f32, int8 KV} x {tp1, tp2}, whole and chunked prompts,
+        greedy and sampled, both admission orders — every stream equals
+        the monolithic engine's, token for token."""
+        model, params = lm
+        kw = _engine_kw(cache_dtype=cache_dtype)
+        if tp == 2:
+            from bigdl_tpu.parallel import serving_meshes
+
+            kw["mesh"] = serving_meshes(1, tp)[0]
+        mono = GenerationEngine(model, params, **kw)
+        mono.warmup()
+        want = _run(mono, REQS)
+        mono.close()
+
+        dis = DisaggregatedEngine(model, params, **kw)
+        dis.warmup()
+        got = _run(dis, REQS)
+        got_rev = _run(dis, list(reversed(REQS)))[::-1]
+        assert got == want
+        assert got_rev == want
+        # the handoff executables traced once each (warmup included)
+        assert dis.prefill_engine.handoff_gather_compilations == 1
+        assert dis.decode_engine.handoff_scatter_compilations == 1
+        dis.close()
+        assert dis.prefill_engine._pool.in_use == 0
+        assert dis.decode_engine._pool.in_use == 0
+
+    def test_first_token_retirements_need_no_decode(self, lm):
+        """mnt==1 (and EOS-at-first-token) retires ON the prefill role:
+        the front stream still delivers the monolithic answer."""
+        model, params = lm
+        mono = GenerationEngine(model, params, **_engine_kw())
+        mono.warmup()
+        want = [mono.submit(p, max_new_tokens=1).result(60)
+                for p, _ in REQS[:2]]
+        mono.close()
+        dis = DisaggregatedEngine(model, params, **_engine_kw())
+        dis.warmup()
+        got = [dis.submit(p, max_new_tokens=1).result(60)
+               for p, _ in REQS[:2]]
+        assert got == want
+        # nothing crossed to the decode role
+        assert dis.decode_engine._pool.snapshot()["pages_adopted"] == 0
+        dis.close()
+
+
+# ------------------------------------------------ role contracts ----
+
+
+class TestRoles:
+    def test_compile_once_per_role(self, lm):
+        """The disaggregation claim at the compiler level: the prefill
+        engine NEVER traces the decode kernel, the decode engine never
+        traces prefill/chunk, and the mover pair traces once each."""
+        model, params = lm
+        dis = DisaggregatedEngine(model, params, **_engine_kw())
+        dis.warmup()
+        _run(dis, REQS)
+        pe, de = dis.prefill_engine, dis.decode_engine
+        assert pe.decode_compilations == 0
+        assert pe.prefill_compilations == len(pe.prompt_buckets)
+        assert pe.chunk_compilations == 1
+        assert pe.handoff_gather_compilations == 1
+        assert pe.handoff_scatter_compilations == 0
+        assert de.decode_compilations == 1
+        assert de.prefill_compilations == 0
+        assert de.chunk_compilations == 0
+        assert de.handoff_gather_compilations == 0
+        assert de.handoff_scatter_compilations == 1
+        dis.close()
+
+    def test_role_validation(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="role"):
+            GenerationEngine(model, params, role="prefll",
+                             **_engine_kw())
+        with pytest.raises(ValueError, match="paged"):
+            GenerationEngine(model, params, role="prefill",
+                             max_slots=2, max_len=MAXLEN, page_size=0)
+        with pytest.raises(ValueError, match="prefix"):
+            GenerationEngine(model, params, role="decode",
+                             prefix_cache=True, **_engine_kw())
+        eng = GenerationEngine(model, params, role="decode",
+                               **_engine_kw())
+        with pytest.raises(RuntimeError, match="submit_prefilled"):
+            eng.submit([1, 2, 3])
+        eng.close()
+        mono = GenerationEngine(model, params, **_engine_kw())
+        with pytest.raises(RuntimeError, match="role='decode'"):
+            mono.submit_prefilled({"prompt": [1], "max_new_tokens": 1})
+        mono.close()
+
+    def test_cancel_before_decode(self, lm):
+        """The front stream's cancel reaches whichever role holds the
+        request; tokens so far stay readable."""
+        model, params = lm
+        faults.reset()
+        dis = DisaggregatedEngine(model, params, **_engine_kw())
+        dis.warmup()
+        # throttle decode steps so the cancel deterministically lands
+        # mid-generation (latency-only arm: sleep, never raise)
+        faults.arm("engine.decode", latency=0.02)
+        try:
+            s = dis.submit([1, 2, 3], max_new_tokens=32)
+            while not s.tokens:
+                time.sleep(0.002)
+            s.cancel()
+            with pytest.raises(StreamCancelled):
+                s.result(60)
+            assert 1 <= len(s.tokens) < 32
+        finally:
+            faults.reset()
+        dis.close()
+        assert dis.prefill_engine._pool.in_use == 0
+        assert dis.decode_engine._pool.in_use == 0
+
+
+# ------------------------------------------------ prefix + handoff ----
+
+
+class TestPrefixAcrossHandoff:
+    def test_shared_prefix_crosses_as_one_page(self, lm):
+        """The index lives with the prefill role (attach-by-reference
+        still skips covered chunks); a full prefix page referenced by
+        two concurrent streams adopts ONCE on the decode side."""
+        model, params = lm
+        dis = DisaggregatedEngine(model, params, prefix_cache=True,
+                                  **_engine_kw())
+        dis.warmup()
+        prompt = [7, 3, 9, 1, 5, 2, 8, 4, 6]   # 2 pages, first full
+        a = dis.submit(prompt, max_new_tokens=30)
+        while not a.tokens:          # handoff done, a is decoding
+            time.sleep(0.002)
+        b = dis.submit(prompt, max_new_tokens=30)
+        ra, rb = a.result(120), b.result(120)
+        assert ra == rb
+        pm = dis.prefill_engine.metrics.snapshot()
+        assert pm["prefix_hits"] == 1
+        assert pm["prefill_chunks_skipped"] >= 1
+        dsnap = dis.decode_engine._pool.snapshot()
+        assert dsnap["pages_adopt_shared"] == 1
+        assert dsnap["pages_adopted"] == 3   # 4 page rows, one shared
+        dis.close()
+        assert dis.prefill_engine._pool.in_use == 0
+        assert dis.decode_engine._pool.in_use == 0
+
+
+# ------------------------------------------------------- fault tier ----
+
+
+class TestHandoffFaults:
+    @pytest.mark.parametrize("stage", ["export", "adopt"])
+    def test_fault_is_request_scoped_and_drains(self, lm, stage):
+        """A fault mid-handoff (either side of the boundary) fails THAT
+        stream with the injected error; neighbours finish; both pools'
+        per-owner gauges drain to zero."""
+        model, params = lm
+        faults.reset()
+        dis = DisaggregatedEngine(model, params, **_engine_kw())
+        dis.warmup()
+        faults.arm("engine.page_handoff", nth=2, times=1,
+                   only=lambda key=None, **ctx: ctx.get("stage") == stage)
+        try:
+            streams = [dis.submit(p, max_new_tokens=8)
+                       for p, _ in REQS[:3]]
+            outcomes = []
+            for s in streams:
+                try:
+                    outcomes.append(("ok", len(s.result(120))))
+                except BaseException as e:
+                    outcomes.append((type(e).__name__, None))
+            kinds = [k for k, _ in outcomes]
+            assert kinds.count("InjectedFault") == 1
+            assert kinds.count("ok") == 2
+            spec = faults.snapshot()["engine.page_handoff"]
+            assert spec["fired"] == 1
+        finally:
+            faults.reset()
+        pe, de = dis.prefill_engine, dis.decode_engine
+        assert pe._pool.in_use == 0 and de._pool.in_use == 0
+        assert pe._pool.snapshot()["by_owner"] == {}
+        assert de._pool.snapshot()["by_owner"] == {}
+        dis.close()
+
+
+# --------------------------------------------------------- RPC path ----
+
+
+@pytest.mark.slow
+class TestRpcHandoff:
+    def test_remote_prefill_bit_identity_and_fault(self, lm):
+        """One child process hosts the prefill role: streams match the
+        monolithic engine bit-for-bit over npy frames; an export-stage
+        fault armed in the CHILD round-trips as InjectedFault on the
+        front stream; neither side leaks pages."""
+        from bigdl_tpu.serving import start_replica_process
+
+        model, params = lm
+        mono = GenerationEngine(model, params, **_engine_kw(max_slots=2))
+        mono.warmup()
+        want = _run(mono, REQS[:3], mnt=6)
+        want1 = mono.submit(REQS[0][0], max_new_tokens=1).result(60)
+        mono.close()
+
+        remote = start_replica_process(
+            "bigdl_tpu.serving.disagg:chaos_prefill_worker")
+        dis = DisaggregatedEngine(model, params, remote_prefill=remote,
+                                  **_engine_kw(max_slots=2))
+        dis.decode_engine.warmup()
+        try:
+            got = _run(dis, REQS[:3], mnt=6)
+            assert got == want
+            # mnt==1 completes inside the worker, no decode involved
+            assert (dis.submit(REQS[0][0], max_new_tokens=1).result(60)
+                    == want1)
+            # chaos: the CHILD's injector fails the export stage
+            remote.arm_fault("engine.page_handoff", nth=1, times=1)
+            s = dis.submit([3, 1, 4, 1, 5], max_new_tokens=6)
+            with pytest.raises(InjectedFault):
+                s.result(120)
+            assert remote.fault_snapshot()[
+                "engine.page_handoff"]["fired"] == 1
+            remote.reset_faults()
+            # the worker's pool drained despite the fault; decode too
+            assert remote.remote_snapshot()["pages_in_use"] == 0
+            assert dis.decode_engine._pool.in_use == 0
+            # and the fabric still serves
+            assert _run(dis, REQS[:1], mnt=6) == want[:1]
+        finally:
+            dis.close()
+
+
+# ----------------------------------------------------------- metrics ----
+
+
+class TestItlMetrics:
+    def test_reservoir_and_golden_order(self):
+        """PR-15 golden contract: the ITL rows render strictly AFTER
+        the PR-12 prefix block — append-only, never reordered — and
+        only once samples exist."""
+        m = ServingMetrics()
+        m.record_served(0.010, 0.004)
+        m.record_prefill(5, 8, 0.002)
+        m.record_decode_step(3, 4)
+        m.record_verify_step(8, 5, 5)
+        m.record_engine_step(0.002, 0.006)
+        m.record_prefix_probe(True, 3)
+        pre_lines = m.format_table().splitlines()
+        snap0 = m.snapshot()
+        assert snap0["itl_ms"] is None and snap0["itl_samples"] == 0
+
+        for gap in (0.004, 0.006, 0.008):
+            m.record_itl(gap)
+        m.record_itl(0.005, 2)       # amortized speculative rounds
+        full_lines = m.format_table().splitlines()
+        assert full_lines[:len(pre_lines)] == pre_lines
+        extra = [ln.split()[0] for ln in full_lines[len(pre_lines):]]
+        assert extra == ["itl_p50(ms)", "itl_p95(ms)", "itl_p99(ms)",
+                         "itl_samples"]
+        snap = m.snapshot()
+        assert list(snap)[-2:] == ["itl_ms", "itl_samples"]
+        assert snap["itl_samples"] == 5
+        assert set(snap["itl_ms"]) == {"p50", "p95", "p99"}
+        assert snap["itl_ms"]["p50"] == pytest.approx(5.0, abs=1.0)
+
+    def test_engine_records_itl_per_decode_token(self, lm):
+        """Every decode token after a slot's first contributes one ITL
+        sample — on the monolithic engine and on the decode role."""
+        model, params = lm
+        mono = GenerationEngine(model, params, **_engine_kw())
+        mono.warmup()
+        mono.submit([1, 2, 3], max_new_tokens=6).result(60)
+        assert mono.metrics.snapshot()["itl_samples"] == 5
+        mono.close()
+
+        dis = DisaggregatedEngine(model, params, **_engine_kw())
+        dis.warmup()
+        _run(dis, REQS[:2], mnt=6)
+        # front-door metrics == decode engine's; 2 streams x 5 gaps
+        assert dis.metrics is dis.decode_engine.metrics
+        assert dis.metrics.snapshot()["itl_samples"] == 10
+        # the prefill role never decodes, so it never records ITL
+        assert dis.prefill_engine.metrics.snapshot()["itl_samples"] == 0
+        dis.close()
