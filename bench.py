@@ -2381,10 +2381,404 @@ def run_chaos_bench(args):
         raise SystemExit("chaos soak FAILED:\n  - " + "\n  - ".join(violations))
 
 
+def run_fleet_bench(args):
+    """Elastic-fleet benchmark (``--mode fleet``): an OPEN-LOOP load
+    harness over the PR-16 autoscaler — Poisson arrivals on an absolute
+    schedule (a diurnal ramp, a 3x burst storm, a cool-down), offered
+    to a static 1-prefill/1-decode :class:`DisaggregatedFleet` and then
+    to the SAME minimum-size fleet with an :class:`AutoscaleController`
+    steering per-role :class:`EnginePool` knobs. Open-loop means the
+    dispatcher never waits for completions: when the fleet falls
+    behind, requests keep landing — queues grow, TTFT blows the budget,
+    the bounded queue sheds ``Overloaded`` — exactly the regime a
+    closed-loop (concurrency-limited) client can never produce, and the
+    regime autoscaling exists for.
+
+    SLO attainment is the fraction of OFFERED requests that complete
+    with TTFT <= ``--fleet-ttft-slo-ms`` AND mean ITL <=
+    ``--fleet-itl-slo-ms``; a shed or failed request is a miss by
+    definition. Kernel costs are modeled (``_FixedCostKernels``: the
+    prompt chunk costs on prefill members, the decode step costs on
+    decode members — the PR-15 disagg column's pricing), so member
+    capacity is arithmetic: a decode member sustains ~slots /
+    (new_tokens * step_cost) rps, a prefill member ~1 / (chunks *
+    prompt_cost) rps, and the burst is sized to exceed the static
+    fleet's capacity while staying inside the autoscaled maxima.
+
+    Mid-burst, the harness SIGKILLs a decode member in effigy (an
+    armed ``engine.decode`` fault — the in-process equivalent of a
+    dead child) and the controller's heal pass must replace it with
+    the front door only ever raising ``Overloaded`` /
+    ``ReplicaUnavailable``.
+
+    ``--smoke`` shrinks the phases and gates (the CI step): autoscaled
+    burst attainment strictly above static, zero pages stranded on
+    either fleet, zero non-taxonomy front-door errors, >= 1 heal,
+    asymmetric per-role scaling visible in the captured size history,
+    and every bigdl thread / child process retired."""
+    import multiprocessing
+    import threading
+
+    from bigdl_tpu import faults
+    from bigdl_tpu.nn.layers.attention import Transformer
+    from bigdl_tpu.serving import (
+        AutoscaleController,
+        DisaggregatedFleet,
+        EnginePool,
+        GenerationEngine,
+        Overloaded,
+        PagedDecodeKernels,
+        ReplicaUnavailable,
+        ScalingPolicy,
+        ServingMetrics,
+    )
+    from bigdl_tpu.serving.autoscale import above, all_of, any_of, below
+
+    t_start = time.perf_counter()
+    smoke = args.smoke
+    seed = args.fleet_seed
+
+    # ---- modeled costs and workload shape (capacity is arithmetic) ----
+    step_ms = args.step_cost_ms if args.step_cost_ms else 4.0
+    prompt_ms = 2.5 * step_ms              # per prompt chunk
+    page = 8
+    slots = 4
+    chunks = 3
+    prompt_len = chunks * page             # 24 tokens, 3 chunks
+    new_tokens = 24
+    max_len = prompt_len + new_tokens
+    # per-member capacity: decode ~ slots/(new*step) ~ 41 rps,
+    # prefill ~ 1/(chunks*prompt) ~ 33 rps at the defaults
+    decode_cap = slots / (new_tokens * step_ms / 1e3)
+    prefill_cap = 1.0 / (chunks * prompt_ms / 1e3)
+
+    base_rps = args.fleet_base_rps or 16.0
+    burst_x = args.fleet_burst_x
+    if smoke:
+        ramp_s, burst_s, cool_s = 5.0, 8.0, 6.0
+    else:
+        ramp_s, burst_s, cool_s = 10.0, 16.0, 10.0
+    total_s = ramp_s + burst_s + cool_s
+    ttft_slo_ms = args.fleet_ttft_slo_ms
+    itl_slo_ms = args.fleet_itl_slo_ms
+
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        filter_size=64, num_hidden_layers=1)
+    params, _ = model.init(jax.random.key(0))
+    kernels = PagedDecodeKernels(model)   # ONE compiled triple: every
+    # member (and every mid-burst scale-up / heal) shares it, so a
+    # dynamic spawn compiles nothing
+    prefill_k = _FixedCostKernels(kernels, 0.0, prompt_ms / 1e3)
+    decode_k = _FixedCostKernels(kernels, step_ms / 1e3, 0.0)
+    eng_kw = dict(max_slots=slots, max_len=max_len,
+                  max_prompt_len=prompt_len, page_size=page,
+                  prefill_chunk=page, max_queue=32)
+
+    def make_role(role):
+        k = prefill_k if role == "prefill" else decode_k
+        def make():
+            return GenerationEngine(
+                model, params, role=role, kernels=k,
+                metrics=ServingMetrics(recent_window_s=3.0), **eng_kw)
+        return make
+
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(1, 64, (prompt_len,)).tolist()
+               for _ in range(32)]
+
+    def rate_at(t):
+        if t < ramp_s:                      # diurnal ramp into the day
+            return base_rps * (0.3 + 0.7 * t / ramp_s)
+        if t < ramp_s + burst_s:            # the 3x storm
+            return base_rps * burst_x
+        return base_rps                     # evening steady state
+
+    def phase_of(t):
+        if t < ramp_s:
+            return "ramp"
+        if t < ramp_s + burst_s:
+            return "burst"
+        return "cool"
+
+    def build_schedule():
+        # same seed for both legs: bit-identical offered traces
+        srs = np.random.RandomState(seed + 1)
+        t, out = 0.0, []
+        while True:
+            t += srs.exponential(1.0 / rate_at(t))
+            if t >= total_s:
+                return out
+            out.append(t)
+
+    schedule = build_schedule()
+    allowed_drops = (Overloaded, ReplicaUnavailable)
+
+    def run_leg(fleet, events=()):
+        """Dispatch the schedule open-loop, then harvest. ``events``
+        is [(t_offset, fn)] fired by the dispatcher as the clock passes
+        each offset (the chaos kill rides here)."""
+        evq, ei = sorted(events, key=lambda e: e[0]), 0
+        pending = []
+        t0 = time.perf_counter()
+        for i, at in enumerate(schedule):
+            while ei < len(evq) and evq[ei][0] <= at:
+                evq[ei][1]()
+                ei += 1
+            delay = t0 + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            rec = {"phase": phase_of(at)}
+            try:
+                s = fleet.submit(prompts[i % len(prompts)],
+                                 max_new_tokens=new_tokens)
+            except allowed_drops as e:
+                rec["outcome"] = ("overloaded" if isinstance(e, Overloaded)
+                                  else "unavailable")
+                pending.append((rec, None))
+                continue
+            except Exception as e:         # taxonomy violation — gated
+                rec["outcome"] = f"BAD:{type(e).__name__}"
+                pending.append((rec, None))
+                continue
+            pending.append((rec, s))
+        records = []
+        for rec, s in pending:
+            if s is not None:
+                try:
+                    s.result(timeout=120)
+                except allowed_drops as e:
+                    rec["outcome"] = ("overloaded"
+                                      if isinstance(e, Overloaded)
+                                      else "unavailable")
+                except Exception as e:
+                    rec["outcome"] = f"BAD:{type(e).__name__}"
+                else:
+                    rec["outcome"] = "ok"
+                    rec["ttft_ms"] = (s.t_first - s.t_submit) * 1e3
+                    n = len(s.tokens)
+                    rec["itl_ms"] = ((s.t_done - s.t_first) / (n - 1) * 1e3
+                                     if n > 1 else 0.0)
+            records.append(rec)
+        # retirement runs between decode steps; give the loops a beat
+        # to hand every page back before the stranding check
+        deadline = time.monotonic() + 10
+        while fleet.pages_in_use() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        return records, fleet.pages_in_use()
+
+    def met(rec):
+        return (rec["outcome"] == "ok"
+                and rec.get("ttft_ms", 1e9) <= ttft_slo_ms
+                and rec.get("itl_ms", 1e9) <= itl_slo_ms)
+
+    def attainment(records, phase=None):
+        rel = [r for r in records
+               if phase is None or r["phase"] == phase]
+        if not rel:
+            return None
+        return round(sum(1 for r in rel if met(r)) / len(rel), 4)
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)), 2) if vals else None
+
+    def leg_fields(tag, records):
+        ttfts = [r["ttft_ms"] for r in records
+                 if r["phase"] == "burst" and "ttft_ms" in r]
+        outcomes = {}
+        for r in records:
+            outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+        return {
+            f"{tag}_attainment": attainment(records),
+            f"{tag}_attainment_ramp": attainment(records, "ramp"),
+            f"{tag}_attainment_burst": attainment(records, "burst"),
+            f"{tag}_attainment_cool": attainment(records, "cool"),
+            f"{tag}_burst_ttft_p50_ms": pct(ttfts, 50),
+            f"{tag}_burst_ttft_p99_ms": pct(ttfts, 99),
+            f"{tag}_outcomes": outcomes,
+        }
+
+    def own_threads():
+        return sorted(t.name for t in threading.enumerate()
+                      if t.name.startswith("bigdl-") and t.is_alive())
+
+    # ------------------------------------------------------ static leg ----
+    # the same-resource baseline: the autoscaled fleet's MINIMUM sizes,
+    # pinned — what you provision when you pay for the valley
+    faults.default().reset()
+    static_fleet = DisaggregatedFleet(
+        make_role("prefill"), make_role("decode"),
+        n_prefill=1, n_decode=1, name="fleet_static", warm=True)
+    static_records, static_pages = run_leg(static_fleet)
+    static_fleet.close()
+
+    # -------------------------------------------------- autoscaled leg ----
+    faults.default().reset()
+    from bigdl_tpu.obs import MetricsRegistry
+
+    fleet = DisaggregatedFleet(
+        make_role("prefill"), make_role("decode"),
+        n_prefill=1, n_decode=1, name="fleet", warm=True)
+    reg = MetricsRegistry()
+    reg.register("fleet", fleet)
+    ctrl = AutoscaleController({
+        "fleet.prefill": (
+            EnginePool(fleet, "prefill", drain_timeout=10.0),
+            ScalingPolicy(
+                min_replicas=1, max_replicas=2,
+                up_when=above("fleet.prefill.queue_depth", 3),
+                down_when=below("fleet.prefill.queue_depth", 1),
+                breach_up=2, breach_down=8,
+                cooldown_up_s=1.0, cooldown_down_s=5.0)),
+        "fleet.decode": (
+            EnginePool(fleet, "decode", drain_timeout=10.0),
+            ScalingPolicy(
+                min_replicas=1, max_replicas=3,
+                up_when=any_of(
+                    above("fleet.decode.queue_depth", 2),
+                    above("fleet.decode.page_occupancy", 0.85),
+                    above("fleet.decode.itl_recent_p99_ms", itl_slo_ms)),
+                down_when=all_of(
+                    below("fleet.decode.queue_depth", 1),
+                    below("fleet.decode.page_occupancy", 0.5)),
+                breach_up=2, breach_down=8,
+                cooldown_up_s=1.0, cooldown_down_s=5.0)),
+    }, registry=reg, interval_s=0.25)
+
+    heal_spec = {"spec": None}
+
+    def kill_one_decode():
+        # the chaos leg: a decode member dies mid-storm; the heal pass
+        # must replace it while the front door stays inside the taxonomy
+        with fleet._cond:
+            serving = [m for m in fleet._members["decode"]
+                       if m.healthy and not m.draining and not m.warming]
+        if serving:
+            victim = serving[0].engine
+            heal_spec["spec"] = faults.default().arm(
+                "engine.decode", times=1,
+                only=lambda engine=None, **kw: engine is victim)
+
+    t0_mono = time.monotonic()
+    ctrl.start()
+    auto_records, auto_pages = run_leg(
+        fleet, events=[(ramp_s + 0.3 * burst_s, kill_one_decode)])
+    ctrl.stop()
+    ctrl_snap = ctrl.snapshot()
+    fleet.close()
+    faults.default().reset()
+
+    # ------------------------------------------------------- evidence ----
+    sizes = [(round(t - t0_mono, 2), s["fleet.prefill"], s["fleet.decode"])
+             for t, s in ctrl.size_history]
+    peak_prefill = max((p for _, p, _ in sizes), default=1)
+    peak_decode = max((d for _, _, d in sizes), default=1)
+    asymmetric = any(p != d for _, p, d in sizes)
+    pool_snaps = ctrl_snap["pools"]
+    heals = pool_snaps["fleet.decode"]["heals"] \
+        + pool_snaps["fleet.prefill"]["heals"]
+    scale_ups = pool_snaps["fleet.decode"]["scale_ups"] \
+        + pool_snaps["fleet.prefill"]["scale_ups"]
+    scale_downs = pool_snaps["fleet.decode"]["scale_downs"] \
+        + pool_snaps["fleet.prefill"]["scale_downs"]
+    bad_errors = [r["outcome"] for r in static_records + auto_records
+                  if r["outcome"].startswith("BAD:")]
+
+    deadline = time.monotonic() + 15
+    leftover = own_threads()
+    while leftover and time.monotonic() < deadline:
+        time.sleep(0.1)
+        leftover = own_threads()
+    children = [p.name for p in multiprocessing.active_children()]
+
+    static_att = leg_fields("static", static_records)
+    auto_att = leg_fields("autoscaled", auto_records)
+    s_burst = static_att["static_attainment_burst"]
+    a_burst = auto_att["autoscaled_attainment_burst"]
+
+    violations = []
+    if smoke:
+        if s_burst is None or a_burst is None or a_burst <= s_burst:
+            violations.append(
+                f"autoscaled burst attainment {a_burst} must be strictly "
+                f"above static {s_burst} — elasticity bought nothing")
+        if static_pages or auto_pages:
+            violations.append(
+                f"stranded KV pages: static={static_pages} "
+                f"autoscaled={auto_pages}")
+        if bad_errors:
+            violations.append(
+                f"front door leaked non-taxonomy errors: {bad_errors[:5]}")
+        if heals < 1 or not heal_spec["spec"] \
+                or heal_spec["spec"].fired < 1:
+            violations.append(
+                "chaos leg: the killed decode member was never healed "
+                f"(heals={heals}, fault_fired="
+                f"{heal_spec['spec'].fired if heal_spec['spec'] else 0})")
+        if scale_ups < 1 or not asymmetric \
+                or (peak_prefill <= 1 and peak_decode <= 1):
+            violations.append(
+                f"asymmetric scaling not observed (ups={scale_ups}, "
+                f"peak prefill={peak_prefill}, decode={peak_decode})")
+        if leftover:
+            violations.append(f"leaked bigdl threads: {leftover}")
+        if children:
+            violations.append(f"leaked child processes: {children}")
+
+    result = {
+        "metric": "fleet_burst_slo_attainment",
+        "value": a_burst,
+        "unit": "fraction",
+        "vs_baseline": None,
+        "static_burst_slo_attainment": s_burst,
+        **static_att,
+        **auto_att,
+        "offered_requests": len(schedule),
+        "base_rps": base_rps,
+        "burst_x": burst_x,
+        "phase_seconds": [ramp_s, burst_s, cool_s],
+        "ttft_slo_ms": ttft_slo_ms,
+        "itl_slo_ms": itl_slo_ms,
+        "step_cost_ms": step_ms,
+        "prompt_cost_ms": prompt_ms,
+        "prefill_member_capacity_rps": round(prefill_cap, 1),
+        "decode_member_capacity_rps": round(decode_cap, 1),
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+        "bounced_downs": pool_snaps["fleet.decode"]["bounced_downs"]
+        + pool_snaps["fleet.prefill"]["bounced_downs"],
+        "heals": heals,
+        "heal_fault_fired": (heal_spec["spec"].fired
+                             if heal_spec["spec"] else 0),
+        "peak_prefill_members": peak_prefill,
+        "peak_decode_members": peak_decode,
+        "asymmetric_scaling_observed": asymmetric,
+        "pool_size_history": sizes,
+        "pages_stranded_static": static_pages,
+        "pages_stranded_autoscaled": auto_pages,
+        "non_taxonomy_errors": len(bad_errors),
+        "violations": violations,
+        "seed": seed,
+        "smoke": smoke,
+        "duration_s": round(time.perf_counter() - t_start, 1),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timing": "open-loop Poisson offered load on an absolute "
+                  "schedule; attainment counts every offered request",
+    }
+    _write_metrics_out(args, {"fleet": fleet,
+                              "fleet_static": static_fleet,
+                              "autoscale": ctrl,
+                              "bench": result})
+    print(json.dumps(result))
+    if violations:
+        raise SystemExit("fleet smoke FAILED:\n  - "
+                         + "\n  - ".join(violations))
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("train", "serving", "checkpoint",
-                                       "pipeline", "chaos", "lm"),
+                                       "pipeline", "chaos", "lm", "fleet"),
                     default="train",
                     help="train = supervised ResNet-50 throughput (default); "
                          "serving = dynamic-batching requests/sec + latency "
@@ -2401,7 +2795,13 @@ def _parse_args(argv=None):
                          "lm = transformer forward/decode tokens/sec + "
                          "empirical MFU (the MXU-heavy workload the MFU "
                          "north star describes), with a --quantize int8 "
-                         "A/B leg")
+                         "A/B leg; "
+                         "fleet = open-loop Poisson load (diurnal ramp + "
+                         "3x burst storm) against an SLO-driven autoscaled "
+                         "DisaggregatedFleet vs the same-resource static "
+                         "fleet — reports SLO attainment vs offered load, "
+                         "with a mid-burst chaos kill + heal (runs "
+                         "directly, no supervisor)")
     ap.add_argument("--concurrency", type=int, default=32,
                     help="serving: concurrent client threads")
     ap.add_argument("--requests", type=int, default=0,
@@ -2499,6 +2899,23 @@ def _parse_args(argv=None):
     ap.add_argument("--chaos-requests", type=int, default=0,
                     help="chaos: serving requests in the fault wave "
                          "(0 = auto)")
+    ap.add_argument("--fleet-base-rps", type=float, default=0.0,
+                    help="fleet: steady offered arrival rate in req/s "
+                         "(0 = auto: 16 — the burst is --fleet-burst-x "
+                         "times this, sized past one member's modeled "
+                         "capacity)")
+    ap.add_argument("--fleet-burst-x", type=float, default=3.0,
+                    help="fleet: burst-storm multiplier over the base "
+                         "rate")
+    ap.add_argument("--fleet-ttft-slo-ms", type=float, default=750.0,
+                    help="fleet: per-request TTFT budget for SLO "
+                         "attainment")
+    ap.add_argument("--fleet-itl-slo-ms", type=float, default=50.0,
+                    help="fleet: per-request mean inter-token-latency "
+                         "budget for SLO attainment")
+    ap.add_argument("--fleet-seed", type=int, default=7,
+                    help="fleet: arrival-schedule seed (both legs replay "
+                         "the identical offered trace)")
     ap.add_argument("--ckpt-iters", type=int, default=20,
                     help="checkpoint: timed steps per loop")
     ap.add_argument("--ckpt-save-every", type=int, default=5,
@@ -2902,6 +3319,10 @@ def main():
         # differential step timing cancels dispatch overhead like the
         # train mode; small enough to run without the supervisor
         run_lm_bench(args)
+    elif args.mode == "fleet":
+        # open-loop wall-clock SLO attainment; nothing differential to
+        # supervise and the schedule is absolute-time, so in-process
+        run_fleet_bench(args)
     elif args.worker:
         run_bench(args)
     else:

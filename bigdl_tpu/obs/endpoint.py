@@ -52,14 +52,27 @@ def engine_health(engine) -> HealthCheck:
 
 def replica_health(rset) -> HealthCheck:
     """Health adapter for a :class:`ReplicaSet`: healthy while at least
-    one replica is placeable; ``degraded`` flags a partial eviction (the
-    set still serves, a fleet autoscaler wants to know anyway)."""
+    one replica is placeable; ``degraded`` flags a QUARANTINE (a member
+    of the serving rotation evicted for failures — the set still
+    serves, but a fleet autoscaler wants to know).
+
+    Membership is read LIVE, never assumed fixed: a fleet the
+    autoscaler deliberately scaled down reports ``ok`` (the departed
+    replica left the rotation, it did not fail out of it), and a fleet
+    mid-scale-up does not flap — a WARMING replica (added to the set
+    but still compiling, not yet placeable) counts in ``total`` without
+    counting against health until it activates."""
 
     def check() -> Dict[str, Any]:
         healthy = rset.healthy_replicas
         total = rset.n_replicas
-        return {"ok": bool(healthy), "healthy": healthy,
-                "total": total, "degraded": len(healthy) < total}
+        warming = len(getattr(rset, "warming_replicas", ()))
+        # degraded = members of the serving rotation that FAILED out of
+        # it; warming members are expected to be unplaceable, so only
+        # the (total - warming) in-rotation count sets the bar
+        quarantined = max(0, total - warming - len(healthy))
+        return {"ok": bool(healthy), "healthy": healthy, "total": total,
+                "warming": warming, "degraded": quarantined > 0}
 
     return check
 

@@ -58,12 +58,16 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._sources: "OrderedDict[str, Source]" = OrderedDict()
 
-    def register(self, name: str, source: Source) -> "MetricsRegistry":
+    def register(self, name: str, source: Source, *,
+                 replace: bool = False) -> "MetricsRegistry":
         """Add ``source`` under ``name`` (the key prefix). Components
         register ONCE, at wiring time; re-registering a taken name
         raises — two sources silently shadowing each other is exactly
-        the ad-hoc-dict mess this registry exists to end. Returns self
-        for chaining."""
+        the ad-hoc-dict mess this registry exists to end. The exception
+        is DYNAMIC fleet membership (the autoscaler scales a replica
+        down and later scales a new one up under the same slot name):
+        ``replace=True`` swaps the source idempotently, keeping its
+        position in the key order. Returns self for chaining."""
         if not _NAME_RE.match(name or ""):
             raise ValueError(
                 f"source name {name!r} must match {_NAME_RE.pattern}")
@@ -73,15 +77,19 @@ class MetricsRegistry:
                 f"source {name!r} must be a callable, a dict, or expose "
                 f"snapshot(); got {type(source).__name__}")
         with self._lock:
-            if name in self._sources:
+            if name in self._sources and not replace:
                 raise ValueError(f"metric source '{name}' already "
                                  f"registered")
             self._sources[name] = source
         return self
 
-    def unregister(self, name: str) -> None:
+    def unregister(self, name: str) -> bool:
+        """Drop a source (a scaled-down or dead replica must not leave
+        a dead entry that every ``collect()`` drags around — or worse,
+        degrades into a ``collect_error`` gauge — forever). Idempotent:
+        returns whether the name was actually registered."""
         with self._lock:
-            self._sources.pop(name, None)
+            return self._sources.pop(name, None) is not None
 
     def names(self) -> List[str]:
         with self._lock:

@@ -71,6 +71,13 @@ the RPC fabric. Streams are bit-identical to the monolithic engine
 over :class:`InferenceService`.
 """
 
+from bigdl_tpu.serving.autoscale import (
+    AutoscaleController,
+    DisaggregatedFleet,
+    EnginePool,
+    ReplicaPool,
+    ScalingPolicy,
+)
 from bigdl_tpu.serving.batcher import DynamicBatcher, bucket_sizes_for
 from bigdl_tpu.serving.disagg import (
     DisaggregatedEngine,
@@ -109,11 +116,14 @@ from bigdl_tpu.serving.router import ModelRouter
 from bigdl_tpu.serving.service import InferenceService
 
 __all__ = [
+    "AutoscaleController",
     "CheckpointWatcher",
     "DeadlineExceeded",
     "DecodeKernels",
     "DisaggregatedEngine",
+    "DisaggregatedFleet",
     "DynamicBatcher",
+    "EnginePool",
     "GenerationEngine",
     "PageBlockMover",
     "PrefillWorker",
@@ -126,9 +136,11 @@ __all__ = [
     "PrefixCache",
     "RemoteError",
     "RemoteReplica",
+    "ReplicaPool",
     "ReplicaServer",
     "ReplicaSet",
     "ReplicaUnavailable",
+    "ScalingPolicy",
     "ServingError",
     "ServingMetrics",
     "SpeculativeKernels",

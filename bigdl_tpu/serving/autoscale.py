@@ -1,0 +1,1104 @@
+"""Elastic fleet (PR 16): SLO-driven autoscaling over the serving
+fabric, with per-role scaling of the disaggregated prefill/decode
+pools.
+
+Every prerequisite already exists in the stack — PR-11 gauges
+(``MetricsRegistry.collect()``), PR-14 process spawning
+(``start_replica_process``), PR-7 drain machinery (ReplicaSet draining
+rolling reloads), PR-15 roles (prefill/decode engines) — but nothing
+closes the loop: fleet size is fixed at wiring time, exactly like the
+reference BigDL's static Spark executor allocation. This module is the
+missing control plane:
+
+- **Rules** (:func:`above` / :func:`below` / :func:`all_of` /
+  :func:`any_of`) — tiny predicates over one flat metrics sample, the
+  vocabulary scaling policies are written in. A missing key means the
+  signal has no data (an idle reservoir window): :func:`above` reads
+  that as "no breach" and :func:`below` as "quiet" by default, so an
+  idle fleet scales down and never flaps up.
+- **:class:`ScalingPolicy`** — per-pool bounds plus hysteresis: a
+  scale-up needs ``breach_up`` CONSECUTIVE breaching polls and respects
+  ``cooldown_up_s`` since the last scale-up; scale-down is deliberately
+  stickier (``breach_down`` polls, ``cooldown_down_s`` since the last
+  action in EITHER direction — growing and immediately shrinking is the
+  classic flap).
+- **Pools** — what the controller grows and shrinks. :class:`ReplicaPool`
+  wraps a :class:`~bigdl_tpu.serving.replica.ReplicaSet` and a backend
+  factory (an in-process engine builder or a
+  ``start_replica_process`` closure): scale-up builds a backend, adds
+  it WARMING (visible, unplaceable), warms it, then activates; scale-
+  down drains the least-loaded member through the PR-7 gate (a busy
+  member bounces the scale-down rather than failing a stream — the
+  fleet never drops below N-1 serving). :class:`EnginePool` adapts one
+  role of a :class:`DisaggregatedFleet` to the same protocol.
+- **:class:`DisaggregatedFleet`** — the PR-15 front door generalised
+  from 1 prefill + 1 decode engine to N + M: least-loaded placement
+  across the prefill pool, per-request KV handoff to the least-loaded
+  decode member, member death contained to ``ReplicaUnavailable`` on
+  the affected streams. Prefill and decode pools scale INDEPENDENTLY —
+  the canonical production win of disaggregation (prompt-heavy traffic
+  grows the prefill pool on TTFT/queue pressure while the decode pool
+  idles, and vice versa for long-generation traffic).
+- **:class:`AutoscaleController`** — the poll loop: each tick heals
+  dead members (a SIGKILLed replica is replaced, not mourned), samples
+  the registry once, evaluates every pool's policy against it, and
+  applies at most one membership change per pool per tick. Determinism
+  for tests: ``poll_once(now=...)`` with an injected clock drives the
+  whole state machine without threads or sleeps.
+
+The controller never touches engine internals — it reads the same
+``/metrics`` surface an external operator would and acts through the
+same membership API, so everything it does is reproducible by hand.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from bigdl_tpu.serving.engine import GenerationStream
+from bigdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    Overloaded,
+    ReplicaUnavailable,
+    StreamCancelled,
+    UnknownModel,
+)
+
+log = logging.getLogger("bigdl_tpu.serving.autoscale")
+
+from bigdl_tpu.obs.recorder import record_event
+
+__all__ = [
+    "above",
+    "below",
+    "all_of",
+    "any_of",
+    "ScalingPolicy",
+    "ReplicaPool",
+    "EnginePool",
+    "DisaggregatedFleet",
+    "AutoscaleController",
+]
+
+#: Request-scoped failures a fleet member may surface to a caller
+#: as-is; anything else from a member means the MEMBER broke, and the
+#: front door translates it to :class:`ReplicaUnavailable`.
+_CLIENT_ERRORS = (Overloaded, DeadlineExceeded, StreamCancelled,
+                  UnknownModel, ValueError, TypeError)
+
+Rule = Callable[[Dict[str, Any]], bool]
+
+
+# ------------------------------------------------------------- rules ----
+
+
+def _lookup(sample: Dict[str, Any], key: str) -> Optional[float]:
+    """Resolve ``key`` in a metrics sample: flat dot-joined hit first
+    (the ``MetricsRegistry.collect()`` shape), else a dot-path descent
+    into nested dicts (a raw ``snapshot()``). Non-numeric and missing
+    both resolve to None — "no data", which each rule interprets."""
+    if key in sample:
+        v = sample[key]
+    else:
+        v: Any = sample
+        for part in key.split("."):
+            if not isinstance(v, dict) or part not in v:
+                return None
+            v = v[part]
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def above(key: str, threshold: float, *, missing: bool = False) -> Rule:
+    """True when ``sample[key] > threshold``. A missing/idle signal is
+    NOT a breach by default (an empty reservoir window must not grow
+    the fleet)."""
+
+    def rule(sample: Dict[str, Any]) -> bool:
+        v = _lookup(sample, key)
+        return missing if v is None else v > threshold
+
+    rule.describe = f"{key} > {threshold:g}"  # type: ignore[attr-defined]
+    return rule
+
+
+def below(key: str, threshold: float, *, missing: bool = True) -> Rule:
+    """True when ``sample[key] < threshold``. A missing/idle signal IS
+    quiet by default (no recent latency samples = no load = eligible
+    for scale-down)."""
+
+    def rule(sample: Dict[str, Any]) -> bool:
+        v = _lookup(sample, key)
+        return missing if v is None else v < threshold
+
+    rule.describe = f"{key} < {threshold:g}"  # type: ignore[attr-defined]
+    return rule
+
+
+def _combine(rules: Sequence[Rule], op: str) -> Rule:
+    fn = all if op == "and" else any
+
+    def rule(sample: Dict[str, Any]) -> bool:
+        return fn(r(sample) for r in rules)
+
+    joiner = f" {op} "
+    rule.describe = "(" + joiner.join(  # type: ignore[attr-defined]
+        getattr(r, "describe", "<rule>") for r in rules) + ")"
+    return rule
+
+
+def all_of(*rules: Rule) -> Rule:
+    """Every rule must hold (scale-down guards compose with this)."""
+    return _combine(rules, "and")
+
+
+def any_of(*rules: Rule) -> Rule:
+    """Any one rule suffices (scale-up pressure composes with this)."""
+    return _combine(rules, "or")
+
+
+# ------------------------------------------------------------ policy ----
+
+
+class ScalingPolicy:
+    """Bounds + rules + hysteresis for one pool.
+
+    ``up_when`` / ``down_when`` are :data:`Rule` predicates over the
+    controller's per-tick metrics sample. Hysteresis has three layers,
+    all of which must agree before the pool moves:
+
+    - **streaks** — the rule must hold for ``breach_up`` (resp.
+      ``breach_down``) CONSECUTIVE polls; one noisy sample moves
+      nothing, and any non-breaching poll resets the streak;
+    - **cooldowns** — at least ``cooldown_up_s`` since the last
+      scale-up (a new member needs time to absorb load before its
+      absence from the gauges can justify another); scale-down
+      additionally waits ``cooldown_down_s`` since the last action in
+      EITHER direction, so the fleet never shrinks on the quiet gauges
+      a just-added member created;
+    - **bounds** — ``min_replicas`` / ``max_replicas`` clamp hard,
+      whatever the rules say.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 up_when: Optional[Rule] = None,
+                 down_when: Optional[Rule] = None,
+                 breach_up: int = 2, breach_down: int = 3,
+                 cooldown_up_s: float = 5.0,
+                 cooldown_down_s: float = 15.0):
+        if min_replicas < 0 or max_replicas < max(min_replicas, 1):
+            raise ValueError(
+                f"bad bounds: min={min_replicas} max={max_replicas}")
+        if breach_up < 1 or breach_down < 1:
+            raise ValueError("breach thresholds must be >= 1")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_when = up_when
+        self.down_when = down_when
+        self.breach_up = int(breach_up)
+        self.breach_down = int(breach_down)
+        self.cooldown_up_s = float(cooldown_up_s)
+        self.cooldown_down_s = float(cooldown_down_s)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "min": self.min_replicas, "max": self.max_replicas,
+            "up_when": getattr(self.up_when, "describe", None),
+            "down_when": getattr(self.down_when, "describe", None),
+            "breach_up": self.breach_up, "breach_down": self.breach_down,
+            "cooldown_up_s": self.cooldown_up_s,
+            "cooldown_down_s": self.cooldown_down_s,
+        }
+
+
+# ------------------------------------------------------------- pools ----
+
+
+class ReplicaPool:
+    """Scalable-pool adapter over a :class:`ReplicaSet` + a backend
+    factory.
+
+    ``factory`` is a zero-arg callable returning a fresh backend — an
+    in-process engine builder for same-host elasticity, or a closure
+    over :func:`~bigdl_tpu.serving.remote.start_replica_process` for a
+    real child process per member. Scale-up runs warm-before-rotation:
+    the backend joins the set WARMING (visible in gauges and healthz
+    ``total``, unplaceable), compiles via ``warmup()``, then activates —
+    traffic never lands on a cold engine. Scale-down picks the
+    least-loaded serving member and drains it through the PR-7 gate;
+    a member still busy at ``drain_timeout`` bounces the scale-down
+    (``TimeoutError``) instead of failing its streams.
+
+    When a ``registry`` is given, each member's metrics surface is
+    registered under ``<name>.<member>`` on the way in and unregistered
+    on the way out, so ``/metrics`` tracks live membership exactly
+    (the PR-16 registry churn fix)."""
+
+    def __init__(self, rset, factory: Callable[[], Any], *,
+                 name: str = "pool", registry=None, warm: bool = True,
+                 drain_timeout: float = 30.0):
+        self.rset = rset
+        self.factory = factory
+        self.name = name
+        self.registry = registry
+        self.warm = bool(warm)
+        self.drain_timeout = float(drain_timeout)
+        if registry is not None:
+            for r in rset._replicas:
+                self._register_member(r.name, r.backend)
+
+    # ------------------------------------------------- registry churn ----
+
+    def _member_source(self, backend) -> Optional[Any]:
+        if callable(getattr(backend, "snapshot", None)):
+            return backend
+        return getattr(backend, "metrics", None)
+
+    def _register_member(self, member: str, backend) -> None:
+        if self.registry is None:
+            return
+        src = self._member_source(backend)
+        if src is not None:
+            # replace=True: a crashed member may not have unregistered
+            self.registry.register(f"{self.name}.{member}", src,
+                                   replace=True)
+
+    def _unregister_member(self, member: str) -> None:
+        if self.registry is not None:
+            self.registry.unregister(f"{self.name}.{member}")
+
+    # ----------------------------------------------------- membership ----
+
+    def size(self) -> int:
+        """Members that count against the policy bounds: serving plus
+        warming (a member mid-warmup already holds its slot — counting
+        it prevents a double scale-up while it compiles)."""
+        with self.rset._cond:
+            return sum(1 for r in self.rset._replicas
+                       if not r.draining and (r.healthy or r.warming))
+
+    def scale_up(self) -> str:
+        backend = self.factory()
+        name = self.rset.add_replica(backend, warming=self.warm)
+        if self.warm:
+            try:
+                backend.warmup()
+            except Exception:
+                # a backend that cannot even warm must not enter
+                # rotation — drop it and let the next tick retry
+                self.rset.remove_replica(name, force=True)
+                raise
+            self.rset.activate_replica(name)
+        self._register_member(name, backend)
+        return name
+
+    def scale_down(self) -> str:
+        with self.rset._cond:
+            serving = [r for r in self.rset._replicas
+                       if r.healthy and not r.draining and not r.warming]
+            if len(serving) <= 1:
+                raise ValueError(
+                    f"pool {self.name!r}: refusing to drain the last "
+                    f"serving member")
+            # least-loaded first; newest (highest index) among ties, so
+            # steady state converges back to the oldest members
+            victim = min(serving, key=lambda r: (r.inflight, -r.index))
+            name = victim.name
+        self.rset.remove_replica(name, drain_timeout=self.drain_timeout)
+        self._unregister_member(name)
+        return name
+
+    def heal(self) -> List[str]:
+        """Replace members whose PROCESS is gone (a quarantined-but-
+        alive backend stays on the probe/rejoin path — killing it would
+        fight the prober). Returns the replacement member names."""
+        with self.rset._cond:
+            dead = [r.name for r in self.rset._replicas
+                    if not r.healthy
+                    and getattr(r.backend, "process_alive", True) is False]
+        replaced = []
+        for name in dead:
+            self.rset.remove_replica(name, force=True)
+            self._unregister_member(name)
+            record_event("autoscale.heal", pool=self.name, dead=name)
+            replaced.append(self.scale_up())
+        return replaced
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"size": self.size(),
+                "healthy": len(self.rset.healthy_replicas),
+                "warming": len(self.rset.warming_replicas),
+                "total": self.rset.n_replicas}
+
+
+# ------------------------------------------------- disaggregated fleet ----
+
+
+class _FleetStream(GenerationStream):
+    """Front-door stream of one fleet request. Cancels forward to the
+    prefill-role inner stream (so a cancel lands pre-handoff), and any
+    terminal error that is not a request-scoped client error — a member
+    died mid-stream — reaches the consumer as
+    :class:`ReplicaUnavailable` with the member's failure chained, so
+    the chaos contract ("the front door only ever raises
+    Overloaded/ReplicaUnavailable") holds for in-flight streams too."""
+
+    def __init__(self, fleet: "DisaggregatedFleet"):
+        super().__init__()
+        self._fleet = fleet
+        self._inner: Optional[GenerationStream] = None
+
+    def cancel(self) -> None:
+        super().cancel()
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+    def _finish(self, error: Optional[BaseException] = None,
+                now: Optional[float] = None) -> None:
+        if error is not None and not isinstance(error, _CLIENT_ERRORS) \
+                and not isinstance(error, ReplicaUnavailable):
+            wrapped = ReplicaUnavailable(self._fleet.name,
+                                         self._fleet.member_names())
+            wrapped.__cause__ = error
+            error = wrapped
+        super()._finish(error, now)
+
+
+class _FleetMember:
+    __slots__ = ("name", "role", "engine", "inflight", "healthy",
+                 "draining", "warming")
+
+    def __init__(self, name: str, role: str, engine):
+        self.name = name
+        self.role = role
+        self.engine = engine
+        self.inflight = 0
+        self.healthy = True
+        self.draining = False
+        self.warming = False
+
+
+class DisaggregatedFleet:
+    """The PR-15 front door generalised to N prefill + M decode
+    engines, with membership that changes while traffic flows.
+
+    ``make_prefill`` / ``make_decode`` are zero-arg factories returning
+    role engines (``role="prefill"`` / ``role="decode"`` —
+    :class:`DisaggregatedEngine` semantics per member). Placement is
+    least-loaded across the serving members of each pool; a member that
+    rejects with ``Overloaded`` fails over to its siblings and the
+    front door raises ``Overloaded`` only once EVERY serving member
+    rejected. A member that dies (engine loop failure, injected chaos)
+    is marked unhealthy, skipped by placement, and left for
+    :meth:`heal` to replace; its in-flight streams end in
+    :class:`ReplicaUnavailable`.
+
+    The per-request handoff is the PR-15 device gather on the owning
+    prefill member, dispatched to the least-loaded decode member's
+    ``submit_prefilled`` — so KV pages move directly between the two
+    pools involved and a scale-up on either side is immediately
+    routable."""
+
+    def __init__(self, make_prefill: Callable[[], Any],
+                 make_decode: Callable[[], Any], *,
+                 n_prefill: int = 1, n_decode: int = 1,
+                 name: str = "fleet", warm: bool = False):
+        if n_prefill < 1 or n_decode < 1:
+            raise ValueError("a fleet needs at least one member per role")
+        self.name = name
+        self._make = {"prefill": make_prefill, "decode": make_decode}
+        self._cond = threading.Condition()
+        self._members: Dict[str, List[_FleetMember]] = {"prefill": [],
+                                                        "decode": []}
+        self._next = {"prefill": 0, "decode": 0}
+        self._closed = False
+        self.submitted = 0
+        self.rejected = 0
+        self.unavailable = 0
+        for _ in range(n_prefill):
+            self.add_member("prefill", warm=warm)
+        for _ in range(n_decode):
+            self.add_member("decode", warm=warm)
+
+    # ----------------------------------------------------- membership ----
+
+    def _serving(self, role: str) -> List[_FleetMember]:
+        # caller holds self._cond
+        return [m for m in self._members[role]
+                if m.healthy and not m.draining and not m.warming]
+
+    def member_names(self, role: Optional[str] = None) -> List[str]:
+        with self._cond:
+            roles = [role] if role else ["prefill", "decode"]
+            return [m.name for r in roles for m in self._members[r]]
+
+    def pool_size(self, role: str) -> int:
+        """Members holding a slot against the policy bounds (serving or
+        warming; draining and dead members are already on their way
+        out)."""
+        with self._cond:
+            return sum(1 for m in self._members[role]
+                       if not m.draining and (m.healthy or m.warming))
+
+    def add_member(self, role: str, *, warm: bool = True) -> str:
+        """Scale one role up: build the engine, expose it WARMING, warm
+        it off the placement path, then activate. Returns the member
+        name (``p3``/``d1`` — indices monotonic, never reused)."""
+        engine = self._make[role]()
+        with self._cond:
+            if self._closed:
+                engine.close(drain=False)
+                raise RuntimeError("fleet is closed")
+            member = _FleetMember(f"{role[0]}{self._next[role]}", role,
+                                  engine)
+            self._next[role] += 1
+            member.warming = bool(warm)
+            if role == "prefill":
+                engine._handoff_cb = self._handoff_for(member)
+            self._members[role].append(member)
+        if warm:
+            try:
+                engine.warmup()
+            except Exception:
+                with self._cond:
+                    self._members[role].remove(member)
+                engine.close(drain=False)
+                raise
+            with self._cond:
+                member.warming = False
+                self._cond.notify_all()
+        record_event("fleet.member_added", fleet=self.name, role=role,
+                     member=member.name)
+        log.info("fleet %s: %s member %s added", self.name, role,
+                 member.name)
+        return member.name
+
+    def remove_member(self, role: str, name: Optional[str] = None, *,
+                      drain_timeout: float = 30.0,
+                      force: bool = False) -> str:
+        """Scale one role down through the drain gate: stop placing on
+        the member, wait out its in-flight requests, close it. Picks
+        the least-loaded serving member when ``name`` is omitted.
+        Refuses to shrink a role to zero and bounces (``TimeoutError``)
+        rather than failing a stream if the member is still busy at the
+        deadline. ``force=True`` skips both — the heal path for a
+        member that is already dead."""
+        with self._cond:
+            pool = self._members[role]
+            if name is None:
+                serving = self._serving(role)
+                if not serving:
+                    raise ValueError(f"fleet {self.name!r}: no serving "
+                                     f"{role} member to remove")
+                member = min(serving, key=lambda m: (m.inflight, m.name))
+            else:
+                member = next((m for m in pool if m.name == name), None)
+                if member is None:
+                    raise KeyError(f"no {role} member named {name!r}")
+            if not force and len(self._serving(role)) <= 1 \
+                    and member in self._serving(role):
+                raise ValueError(
+                    f"fleet {self.name!r}: refusing to remove the last "
+                    f"serving {role} member {member.name!r}")
+            member.draining = True
+            if not force:
+                deadline = time.monotonic() + float(drain_timeout)
+                while member.inflight > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        member.draining = False
+                        raise TimeoutError(
+                            f"fleet {self.name!r}: {role} member "
+                            f"{member.name!r} still has "
+                            f"{member.inflight} in flight after "
+                            f"{drain_timeout:.1f}s drain; not removed")
+                    self._cond.wait(timeout=min(0.1, left))
+            pool.remove(member)
+        try:
+            member.engine.close(drain=not force, timeout=drain_timeout)
+        except Exception:
+            log.exception("fleet %s: closing %s member %s failed",
+                          self.name, role, member.name)
+        record_event("fleet.member_removed", fleet=self.name, role=role,
+                     member=member.name, forced=bool(force))
+        log.info("fleet %s: %s member %s removed%s", self.name, role,
+                 member.name, " (forced)" if force else " (drained)")
+        return member.name
+
+    def heal(self, role: str) -> List[Tuple[str, str]]:
+        """Replace every dead member of ``role`` (engine loop failed —
+        in-process chaos — or, for members probing a child process, the
+        process is gone). Placement marks a member dead when traffic
+        trips over it; the probe here catches the quiet case — a loop
+        that died with no follow-up traffic to notice. Returns
+        ``(dead, replacement)`` name pairs."""
+        newly_dead: List[_FleetMember] = []
+        with self._cond:
+            for m in self._members[role]:
+                if m.healthy and not m.warming \
+                        and getattr(m.engine, "failed", None) is not None:
+                    m.healthy = False
+                    newly_dead.append(m)
+            if newly_dead:
+                self._cond.notify_all()
+            dead = [m.name for m in self._members[role] if not m.healthy]
+        for m in newly_dead:
+            record_event("fleet.member_died", fleet=self.name,
+                         role=m.role, member=m.name,
+                         error=type(m.engine.failed).__name__)
+            log.warning("fleet %s: %s member %s found dead by the heal "
+                        "probe (%s)", self.name, m.role, m.name,
+                        m.engine.failed)
+        replaced = []
+        for name in dead:
+            self.remove_member(role, name, force=True)
+            new = self.add_member(role)
+            record_event("fleet.healed", fleet=self.name, role=role,
+                         dead=name, replacement=new)
+            replaced.append((name, new))
+        return replaced
+
+    # ------------------------------------------------------ front door ----
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               deadline: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0,
+               seed: Optional[int] = None) -> GenerationStream:
+        """Monolithic-shaped submit with fleet placement. Raises only
+        ``Overloaded`` (every serving prefill member rejected — healthy
+        backpressure) or ``ReplicaUnavailable`` (no serving prefill
+        member at all)."""
+        stream = _FleetStream(self)
+        ctx = {"stream": stream,
+               "deadline": (None if deadline is None
+                            else stream.t_submit + float(deadline)),
+               "dispatched": False}
+        tried: set = set()
+        last_over: Optional[Overloaded] = None
+        while True:
+            with self._cond:
+                if self._closed:
+                    self.unavailable += 1
+                    raise ReplicaUnavailable(self.name, [])
+                cands = [m for m in self._serving("prefill")
+                         if m.name not in tried]
+                if not cands:
+                    if last_over is not None:
+                        self.rejected += 1
+                        raise last_over
+                    self.unavailable += 1
+                    raise ReplicaUnavailable(
+                        self.name, self.member_names("prefill"))
+                member = min(cands, key=lambda m: (m.inflight, m.name))
+                member.inflight += 1
+            try:
+                inner = member.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    deadline=deadline, temperature=temperature,
+                    top_k=top_k, top_p=top_p, seed=seed, tag=ctx)
+            except Overloaded as e:
+                self._release(member)
+                tried.add(member.name)
+                last_over = e
+                continue
+            except (ValueError, TypeError):
+                self._release(member)
+                raise  # a malformed request fails on any member
+            except Exception as e:
+                # the member itself broke (closed/failed loop): out of
+                # placement it goes, the heal pass replaces it
+                self._fail_member(member, e)
+                tried.add(member.name)
+                continue
+            break
+        with self._cond:
+            self.submitted += 1
+        stream._inner = inner
+        inner.add_done_callback(self._relay_for(ctx, member))
+        return stream
+
+    def generate(self, prompt: Sequence[int], *,
+                 timeout: Optional[float] = None, **kw) -> List[int]:
+        return self.submit(prompt, **kw).result(timeout)
+
+    def _release(self, member: _FleetMember) -> None:
+        with self._cond:
+            member.inflight -= 1
+            self._cond.notify_all()
+
+    def _fail_member(self, member: _FleetMember,
+                     error: BaseException) -> None:
+        with self._cond:
+            member.inflight = max(0, member.inflight - 1)
+            fresh = member.healthy
+            member.healthy = False
+            self._cond.notify_all()
+        if fresh:
+            record_event("fleet.member_died", fleet=self.name,
+                         role=member.role, member=member.name,
+                         error=type(error).__name__)
+            log.warning("fleet %s: %s member %s failed (%s); out of "
+                        "placement until healed", self.name, member.role,
+                        member.name, error)
+
+    def _relay_for(self, ctx: dict, member: _FleetMember):
+        """Done-callback on the prefill-role inner stream: release the
+        member, forward a prefill-phase failure or a no-handoff finish
+        (request retired AT its first token) to the front stream."""
+
+        def relay(inner: GenerationStream) -> None:
+            self._release(member)
+            stream: GenerationStream = ctx["stream"]
+            err = inner.error
+            if err is not None:
+                # ReplicaUnavailable here means the DECODE pool had no
+                # one to adopt the handoff — not this member's fault
+                if not isinstance(err, _CLIENT_ERRORS) \
+                        and not isinstance(err, ReplicaUnavailable):
+                    self._mark_dead(member, err)
+                stream._finish(err)  # _FleetStream translates
+                return
+            if ctx["dispatched"]:
+                return
+            now = time.monotonic()
+            for t in inner.tokens:
+                stream._push(int(t), now)
+            stream._finish(None, now)
+
+        return relay
+
+    def _mark_dead(self, member: _FleetMember,
+                   error: BaseException) -> None:
+        with self._cond:
+            fresh = member.healthy
+            member.healthy = False
+            self._cond.notify_all()
+        if fresh:
+            record_event("fleet.member_died", fleet=self.name,
+                         role=member.role, member=member.name,
+                         error=type(error).__name__)
+            log.warning("fleet %s: %s member %s failed mid-stream (%s)",
+                        self.name, member.role, member.name, error)
+
+    # --------------------------------------------------------- handoff ----
+
+    def _handoff_for(self, member: _FleetMember):
+        def on_handoff(payload: dict) -> None:
+            # prefill loop thread, pages still owned by `member`
+            payload["block"] = member.engine._mover.gather(
+                member.engine._cache, payload["page_row"])
+            self._dispatch(payload)
+
+        return on_handoff
+
+    def _dispatch(self, payload: dict) -> None:
+        """Adopt one finished prefill into the least-loaded decode
+        member. Failing members fail over; raising out of here lands in
+        the prefill engine's abort path (pages released, inner stream
+        failed, relay forwards to the front stream)."""
+        ctx = payload.pop("tag")
+        ctx["dispatched"] = True
+        payload["deadline"] = ctx["deadline"]
+        stream: GenerationStream = ctx["stream"]
+        tried: set = set()
+        last: Optional[BaseException] = None
+        while True:
+            with self._cond:
+                cands = [m for m in self._serving("decode")
+                         if m.name not in tried]
+                if not cands:
+                    err = last if isinstance(last, Overloaded) else \
+                        ReplicaUnavailable(self.name,
+                                           self.member_names("decode"))
+                    if last is not None and err is not last:
+                        err.__cause__ = last
+                    stream._finish(err)
+                    raise err
+                member = min(cands, key=lambda m: (m.inflight, m.name))
+                member.inflight += 1
+            try:
+                member.engine.submit_prefilled(payload, stream=stream)
+            except Overloaded as e:
+                self._release(member)
+                tried.add(member.name)
+                last = e
+                continue
+            except Exception as e:
+                self._fail_member(member, e)
+                tried.add(member.name)
+                last = e
+                continue
+            stream.add_done_callback(lambda s, m=member: self._release(m))
+            return
+
+    # ------------------------------------------------------ lifecycle ----
+
+    def warmup(self) -> None:
+        with self._cond:
+            members = [m for r in ("prefill", "decode")
+                       for m in self._members[r]]
+        for m in members:
+            m.engine.warmup()
+            with self._cond:
+                m.warming = False
+                self._cond.notify_all()
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Prefill members first (their drains flush pending handoffs
+        into the decode queues), then decode members."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            members = list(self._members["prefill"]) \
+                + list(self._members["decode"])
+        for m in members:
+            try:
+                m.engine.close(drain=drain, timeout=timeout)
+            except Exception:
+                log.exception("fleet %s: closing member %s failed",
+                              self.name, m.name)
+
+    def __enter__(self) -> "DisaggregatedFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------- queries ----
+
+    def pages_in_use(self, role: Optional[str] = None) -> int:
+        with self._cond:
+            roles = [role] if role else ["prefill", "decode"]
+            members = [m for r in roles for m in self._members[r]]
+        return sum(m.engine.pages_in_use for m in members)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet-level control gauges: per-role aggregates (the signals
+        scaling rules key on — flat numeric leaves under stable keys)
+        plus per-member detail. Latency control signals are the RECENT
+        windows (a lifetime p99 stays breached long after the fleet
+        absorbed the burst — steering on it can never see its own
+        action land)."""
+        with self._cond:
+            members = {r: list(self._members[r])
+                       for r in ("prefill", "decode")}
+            counters = {"submitted": self.submitted,
+                        "rejected": self.rejected,
+                        "unavailable": self.unavailable}
+        out: Dict[str, Any] = dict(counters)
+        detail: Dict[str, Any] = {}
+        for role in ("prefill", "decode"):
+            size = queue = inflight = pages = pages_total = 0
+            warming = dead = 0
+            lat_key = "ttft_recent_ms" if role == "prefill" \
+                else "itl_recent_ms"
+            lat_p99: Optional[float] = None
+            for m in members[role]:
+                es = m.engine.metrics.snapshot()
+                if not m.draining and (m.healthy or m.warming):
+                    size += 1
+                warming += m.warming
+                dead += not m.healthy
+                queue += es["queue_depth"]
+                inflight += m.inflight
+                pages += es["pages_in_use"]
+                pages_total += es["pages_total"]
+                recent = es.get(lat_key)
+                if recent is not None:
+                    p = recent.get("p99")
+                    if p is not None:
+                        lat_p99 = p if lat_p99 is None else max(lat_p99,
+                                                                p)
+                detail[m.name] = {
+                    "role": role, "healthy": m.healthy,
+                    "draining": m.draining, "warming": m.warming,
+                    "inflight": m.inflight,
+                    "queue_depth": es["queue_depth"],
+                    "pages_in_use": es["pages_in_use"],
+                }
+            agg = {"size": size, "warming": warming, "dead": dead,
+                   "inflight": inflight, "queue_depth": queue,
+                   "pages_in_use": pages,
+                   "page_occupancy": (pages / pages_total
+                                      if pages_total else 0.0)}
+            agg["ttft_recent_p99_ms" if role == "prefill"
+                else "itl_recent_p99_ms"] = lat_p99
+            out[role] = agg
+        out["members"] = detail
+        return out
+
+
+class EnginePool:
+    """Scalable-pool adapter over ONE role of a
+    :class:`DisaggregatedFleet` — what gives the controller independent
+    prefill and decode knobs over a single front door."""
+
+    def __init__(self, fleet: DisaggregatedFleet, role: str, *,
+                 drain_timeout: float = 30.0):
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown fleet role {role!r}")
+        self.fleet = fleet
+        self.role = role
+        self.name = f"{fleet.name}.{role}"
+        self.drain_timeout = float(drain_timeout)
+
+    def size(self) -> int:
+        return self.fleet.pool_size(self.role)
+
+    def scale_up(self) -> str:
+        return self.fleet.add_member(self.role)
+
+    def scale_down(self) -> str:
+        return self.fleet.remove_member(self.role,
+                                        drain_timeout=self.drain_timeout)
+
+    def heal(self) -> List[str]:
+        return [new for _dead, new in self.fleet.heal(self.role)]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"size": self.size()}
+
+
+# -------------------------------------------------------- controller ----
+
+
+class _PoolState:
+    __slots__ = ("name", "pool", "policy", "up_streak", "down_streak",
+                 "last_up", "last_down", "scale_ups", "scale_downs",
+                 "heals", "bounced_downs")
+
+    def __init__(self, name: str, pool, policy: ScalingPolicy):
+        self.name = name
+        self.pool = pool
+        self.policy = policy
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_up: Optional[float] = None
+        self.last_down: Optional[float] = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.heals = 0
+        self.bounced_downs = 0
+
+
+class AutoscaleController:
+    """The poll loop closing the elasticity control loop.
+
+    ``pools`` maps a pool name to ``(pool, ScalingPolicy)`` — any
+    object with the pool protocol (``size``/``scale_up``/``scale_down``
+    and optionally ``heal``): :class:`ReplicaPool`,
+    :class:`EnginePool`, or a test stub. Each :meth:`poll_once`:
+
+    1. **heals** — dead members are replaced before policy runs, so a
+       SIGKILL never masquerades as scale-down headroom;
+    2. samples the ``registry`` ONCE (every pool's rules see the same
+       consistent tick);
+    3. per pool: updates breach streaks, then applies at most one
+       membership change, bounded and cooled per the policy. A bounced
+       scale-down (drain timeout — the member was still busy) keeps its
+       streak and retries next tick.
+
+    ``start()`` runs it on a daemon thread every ``interval_s``;
+    :meth:`poll_once` with an injected ``clock`` drives the same state
+    machine deterministically for tests. The controller itself is a
+    metrics source (``snapshot()``) and self-registers as
+    ``autoscale`` when given a registry — its own decisions ride the
+    same ``/metrics`` surface it steers by."""
+
+    def __init__(self, pools: Dict[str, Tuple[Any, ScalingPolicy]], *,
+                 registry=None, interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 register_as: Optional[str] = "autoscale"):
+        if not pools:
+            raise ValueError("at least one pool is required")
+        self._pools = [_PoolState(n, p, pol)
+                       for n, (p, pol) in pools.items()]
+        self.registry = registry
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.polls = 0
+        #: bounded decision log: (t, pool, action, member)
+        self.history: deque = deque(maxlen=256)
+        #: bounded per-tick pool sizes: (t, {pool: size}) — the
+        #: asymmetric-scaling record the fleet bench captures
+        self.size_history: deque = deque(maxlen=4096)
+        if registry is not None and register_as:
+            registry.register(register_as, self, replace=True)
+
+    # ----------------------------------------------------------- loop ----
+
+    def poll_once(self, now: Optional[float] = None,
+                  sample: Optional[Dict[str, Any]] = None) -> List[dict]:
+        """One control tick; returns the actions taken. ``now`` and
+        ``sample`` inject a clock value and a pre-collected metrics
+        sample (tests drive hysteresis with these — no threads, no
+        sleeps)."""
+        if now is None:
+            now = self._clock()
+        if sample is None:
+            sample = self.registry.collect() if self.registry else {}
+        actions: List[dict] = []
+
+        def act(st: _PoolState, action: str, member) -> None:
+            entry = {"t": now, "pool": st.name, "action": action,
+                     "member": member}
+            with self._lock:
+                self.history.append((now, st.name, action, member))
+            actions.append(entry)
+            record_event("autoscale.action", pool=st.name, action=action,
+                         member=member)
+
+        for st in self._pools:
+            healed = []
+            if callable(getattr(st.pool, "heal", None)):
+                try:
+                    healed = st.pool.heal()
+                except Exception:
+                    log.exception("autoscale: heal pass failed for pool "
+                                  "%s", st.name)
+            for member in healed:
+                st.heals += 1
+                # a heal is a scale-up in disguise: start the up
+                # cooldown so policy doesn't immediately double down
+                st.last_up = now
+                act(st, "heal", member)
+
+            pol = st.policy
+            up = bool(pol.up_when(sample)) if pol.up_when else False
+            down = bool(pol.down_when(sample)) if pol.down_when else False
+            if up:
+                down = False  # pressure wins over quiet in a tie
+            st.up_streak = st.up_streak + 1 if up else 0
+            st.down_streak = st.down_streak + 1 if down else 0
+
+            size = st.pool.size()
+            if up and st.up_streak >= pol.breach_up \
+                    and size < pol.max_replicas \
+                    and (st.last_up is None
+                         or now - st.last_up >= pol.cooldown_up_s):
+                try:
+                    member = st.pool.scale_up()
+                except Exception:
+                    log.exception("autoscale: scale-up failed for pool "
+                                  "%s", st.name)
+                else:
+                    st.scale_ups += 1
+                    st.last_up = now
+                    st.up_streak = 0
+                    act(st, "scale_up", member)
+            elif down and st.down_streak >= pol.breach_down \
+                    and size > pol.min_replicas \
+                    and self._down_cooled(st, now):
+                try:
+                    member = st.pool.scale_down()
+                except TimeoutError:
+                    # busy member bounced the drain — keep the streak,
+                    # retry next tick (never fail a stream to shrink)
+                    st.bounced_downs += 1
+                    log.info("autoscale: scale-down of pool %s bounced "
+                             "(member still busy)", st.name)
+                except Exception:
+                    log.exception("autoscale: scale-down failed for "
+                                  "pool %s", st.name)
+                else:
+                    st.scale_downs += 1
+                    st.last_down = now
+                    st.down_streak = 0
+                    act(st, "scale_down", member)
+
+        with self._lock:
+            self.polls += 1
+            self.size_history.append(
+                (now, {st.name: st.pool.size() for st in self._pools}))
+        return actions
+
+    def _down_cooled(self, st: _PoolState, now: float) -> bool:
+        """Scale-down cools against the last action in EITHER
+        direction: shrinking right after growing chases the quiet the
+        new member just created."""
+        for last in (st.last_up, st.last_down):
+            if last is not None and now - last < st.policy.cooldown_down_s:
+                return False
+        return True
+
+    def start(self) -> "AutoscaleController":
+        if self._thread is not None:
+            raise RuntimeError("controller already started")
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    log.exception("autoscale poll failed; continuing")
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop,
+                                        name="bigdl-autoscale",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        """Stop and join the poll thread (idempotent). The pools and
+        their members stay up — the controller owns decisions, not
+        engines."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            self._thread = None
+
+    close = stop
+
+    def __enter__(self) -> "AutoscaleController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -------------------------------------------------------- queries ----
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            polls = self.polls
+        out: Dict[str, Any] = {"polls": polls}
+        pools: Dict[str, Any] = {}
+        for st in self._pools:
+            pools[st.name] = {
+                "size": st.pool.size(),
+                "up_streak": st.up_streak,
+                "down_streak": st.down_streak,
+                "scale_ups": st.scale_ups,
+                "scale_downs": st.scale_downs,
+                "bounced_downs": st.bounced_downs,
+                "heals": st.heals,
+                "policy": st.policy.describe(),
+            }
+        out["pools"] = pools
+        return out
+
+    def format_table(self) -> str:
+        snap = self.snapshot()
+        lines = [f"{'pool':<16} {'size':>5} {'ups':>5} {'downs':>6} "
+                 f"{'heals':>6} {'bounced':>8}"]
+        for name in sorted(snap["pools"]):
+            p = snap["pools"][name]
+            lines.append(f"{name:<16} {p['size']:>5} {p['scale_ups']:>5} "
+                         f"{p['scale_downs']:>6} {p['heals']:>6} "
+                         f"{p['bounced_downs']:>8}")
+        return "\n".join(lines)

@@ -2588,6 +2588,14 @@ class GenerationEngine:
     # --------------------------------------------------------- queries ----
 
     @property
+    def failed(self) -> Optional[BaseException]:
+        """The error that stopped the engine loop (``None`` while
+        healthy). A fleet heal pass probes this instead of waiting for
+        the next placement attempt to trip over the dead loop."""
+        with self._core.cond:
+            return self._failed
+
+    @property
     def active_slots(self) -> int:
         with self._core.cond:
             return len(self._core.active)

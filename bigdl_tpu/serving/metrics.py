@@ -11,9 +11,36 @@ from __future__ import annotations
 
 import random
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
+
+
+class _Window:
+    """Time-windowed sample buffer: percentiles over only the last
+    ``window_s`` seconds (bounded at ``cap`` samples). The lifetime
+    :class:`_Reservoir` is the right record for a BENCH column, but a
+    control loop steering on it would never see a burst END — a p99
+    poisoned by a ten-second storm stays high for the life of the
+    process. The autoscaler reads these instead. Caller holds the
+    metrics lock."""
+
+    def __init__(self, window_s: float = 30.0, cap: int = 4096):
+        self.window_s = float(window_s)
+        self.values: "deque" = deque(maxlen=cap)
+
+    def add(self, v: float, now: Optional[float] = None) -> None:
+        self.values.append(
+            (time.monotonic() if now is None else now, float(v)))
+
+    def percentiles(self, qs, now: Optional[float] = None):
+        cut = (time.monotonic() if now is None else now) - self.window_s
+        vals = [v for t, v in self.values if t >= cut]
+        if not vals:
+            return None
+        return [float(p) for p in np.percentile(vals, qs)]
 
 
 class _Reservoir:
@@ -52,7 +79,8 @@ class ServingMetrics:
 
     LATENCY_QS = (50, 95, 99)
 
-    def __init__(self, reservoir_size: int = 2048):
+    def __init__(self, reservoir_size: int = 2048,
+                 recent_window_s: float = 30.0):
         self._lock = threading.Lock()
         self.served = 0
         self.rejected = 0
@@ -128,6 +156,15 @@ class ServingMetrics:
         # Empty for a non-generating service — snapshot/table keep the
         # earlier shapes (same append-only golden contract as above).
         self._itl = _Reservoir(reservoir_size)          # seconds per gap
+        # recent-window twins (PR 16): the lifetime reservoirs above are
+        # the BENCH record; these time-windowed views are the
+        # autoscaler's control signals — a burst's tail latency must
+        # DECAY out of them once the burst (or a scale-up) resolves it,
+        # or the controller could never see its own action take effect.
+        # Appended at the snapshot tail per the golden contract.
+        self.recent_window_s = float(recent_window_s)
+        self._ttft_recent = _Window(recent_window_s)
+        self._itl_recent = _Window(recent_window_s)
 
     # ------------------------------------------------------- mutators ----
 
@@ -174,6 +211,7 @@ class ServingMetrics:
             self.tokens_out += 1
             if ttft_s is not None:
                 self._ttft.add(ttft_s)
+                self._ttft_recent.add(ttft_s)
 
     def record_decode_step(self, n_active: int, n_slots: int) -> None:
         """One decode iteration serving ``n_active`` of ``n_slots`` slots
@@ -198,6 +236,7 @@ class ServingMetrics:
         with self._lock:
             for _ in range(int(n)):
                 self._itl.add(gap_s)
+                self._itl_recent.add(gap_s)
 
     def record_reload(self) -> None:
         with self._lock:
@@ -421,6 +460,22 @@ class ServingMetrics:
                     f"p{q}": round(v * 1e3, 3)
                     for q, v in zip(self.LATENCY_QS, g)},
                 "itl_samples": self._itl.seen,
+                # recent-window fields (PR 16): appended after every
+                # earlier key, never reordered. None when the window is
+                # empty — an idle engine's tail latency is "no data",
+                # which the autoscaler's scale-down rules treat as
+                # quiet, not as breach.
+                "ttft_recent_ms": None if (tr := self._ttft_recent.
+                                           percentiles(self.LATENCY_QS)
+                                           ) is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, tr)},
+                "itl_recent_ms": None if (gr := self._itl_recent.
+                                          percentiles(self.LATENCY_QS)
+                                          ) is None else {
+                    f"p{q}": round(v * 1e3, 3)
+                    for q, v in zip(self.LATENCY_QS, gr)},
+                "recent_window_s": self.recent_window_s,
             }
 
     def format_table(self) -> str:

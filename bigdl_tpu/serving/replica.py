@@ -91,7 +91,7 @@ class _Replica:
     """Host-side bookkeeping for one backend."""
 
     __slots__ = ("backend", "name", "index", "inflight", "healthy",
-                 "draining", "failures", "served", "failed",
+                 "draining", "warming", "failures", "served", "failed",
                  "weights_version")
 
     def __init__(self, backend, index: int):
@@ -101,6 +101,7 @@ class _Replica:
         self.inflight = 0       # set-tracked depth (the placement key)
         self.healthy = True
         self.draining = False   # rolling reload: excluded from placement
+        self.warming = False    # added but not yet in rotation (scale-up)
         self.failures = 0       # CONSECUTIVE failures (reset on success)
         self.served = 0
         self.failed = 0
@@ -147,6 +148,7 @@ class ReplicaSet:
         self.hedges_won = 0
         self._cond = threading.Condition()
         self._replicas = [_Replica(b, i) for i, b in enumerate(replicas)]
+        self._next_index = len(self._replicas)  # names never reused
         if metrics is None:
             first = getattr(replicas[0], "metrics", None)
             shared = first is not None and all(
@@ -193,11 +195,13 @@ class ReplicaSet:
         loaded roll into a full drain_timeout wait."""
         with self._cond:
             serving = [r for r in self._replicas
-                       if r.healthy and not r.draining]
+                       if r.healthy and not r.draining and not r.warming]
             pool = [r for r in serving if r not in tried]
             if not serving:
+                # a WARMING replica never falls back into placement —
+                # unlike a draining one it cannot serve at all yet
                 pool = [r for r in self._replicas
-                        if r.healthy and r not in tried]
+                        if r.healthy and not r.warming and r not in tried]
             if pool:
                 return min(pool, key=lambda r: (r.inflight, r.index))
             return None
@@ -446,6 +450,11 @@ class ReplicaSet:
     def _note_failure(self, r: _Replica, error: BaseException,
                       where: str) -> None:
         with self._cond:
+            if r not in self._replicas:
+                # late failure from a member already scaled out (a
+                # force-removed dead backend failing its last streams):
+                # not an eviction, and not this set's gauges anymore
+                return
             r.failures += 1
             r.failed += 1
             evict = r.healthy and r.failures >= self.max_failures
@@ -530,9 +539,9 @@ class ReplicaSet:
         if self._probe_fn is None:
             return 0
         rejoined = 0
-        for r in self._replicas:
+        for r in list(self._replicas):
             with self._cond:
-                if r.healthy or self._closed:
+                if r.healthy or self._closed or r not in self._replicas:
                     continue
             try:
                 self._probe_fn(r.backend)
@@ -638,11 +647,127 @@ class ReplicaSet:
             record_event("replica.rolling_reload", set=self.name,
                          version=version)
 
+    # --------------------------------------------- dynamic membership ----
+
+    def _find(self, name: str) -> Optional[_Replica]:
+        for r in self._replicas:
+            if r.name == name:
+                return r
+        return None
+
+    def add_replica(self, backend, *, warming: bool = False) -> str:
+        """Grow the set by one backend (the scale-up half of the
+        elastic fleet). The new member enters rotation immediately
+        unless ``warming=True`` — then it is VISIBLE (gauges, snapshot,
+        healthz ``total``) but unplaceable until
+        :meth:`activate_replica`, so a mid-scale-up fleet neither
+        routes traffic to a still-compiling engine nor reports itself
+        degraded while it waits. Returns the member's name (``rN`` —
+        indices are monotonic and never reused, so per-replica metric
+        sources stay unambiguous across scale-down/up cycles)."""
+        with self._roll_lock:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("replica set is closed")
+                r = _Replica(backend, self._next_index)
+                self._next_index += 1
+                r.warming = bool(warming)
+                # a member born after N rolling-reload sweeps was built
+                # from the tip weights by its factory — stamp it current
+                # so probe-rejoin never "catches it up" backwards
+                r.weights_version = self._weights_version
+                self._replicas.append(r)
+                name = r.name
+        self._update_gauges()
+        record_event("replica.added", set=self.name, replica=name,
+                     warming=bool(warming))
+        log.info("replica %s/%s added to the set%s", self.name, name,
+                 " (warming)" if warming else "")
+        return name
+
+    def activate_replica(self, name: str) -> None:
+        """Flip a warming member into the serving rotation — call it
+        after the backend's ``warmup()`` finished compiling."""
+        with self._cond:
+            r = self._find(name)
+            if r is None:
+                raise KeyError(f"no replica named {name!r}")
+            r.warming = False
+        self._update_gauges()
+        record_event("replica.activated", set=self.name, replica=name)
+
+    def remove_replica(self, name: str, *, drain_timeout: float = 30.0,
+                       close: bool = True, force: bool = False):
+        """Shrink the set by one member (the scale-down half) through
+        the same drain machinery a rolling reload uses: mark draining
+        (no new placements), wait for its in-flight work to finish,
+        then detach and (by default) close it. The drain is a GATE, not
+        a courtesy — a member still busy after ``drain_timeout`` is put
+        back in rotation and ``TimeoutError`` raised, so a scale-down
+        can never fail live streams or strand reserved KV pages.
+
+        ``force=True`` skips the drain and the last-serving-replica
+        check (the autoscaler's replace-a-SIGKILLed-member path: the
+        backend is already dead, its streams already failed over).
+        Refuses to remove the last serving replica otherwise. Returns
+        the detached backend."""
+        with self._roll_lock:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("replica set is closed")
+                r = self._find(name)
+                if r is None:
+                    raise KeyError(f"no replica named {name!r}")
+                serving = [x for x in self._replicas
+                           if x.healthy and not x.draining
+                           and not x.warming]
+                if not force and r in serving and len(serving) <= 1:
+                    raise ValueError(
+                        f"refusing to remove {name!r}: it is the last "
+                        f"serving replica of {self.name!r} (force=True "
+                        f"overrides)")
+                r.draining = True
+            self._update_gauges()
+            if not force:
+                with self._cond:
+                    deadline = time.monotonic() + float(drain_timeout)
+                    while r.inflight > 0:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cond.wait(timeout=min(0.1, left))
+                    drained = r.inflight == 0
+                    if not drained:
+                        inflight = r.inflight
+                        r.draining = False
+                if not drained:
+                    self._update_gauges()
+                    raise TimeoutError(
+                        f"replica {self.name}/{name} still has "
+                        f"{inflight} request(s) in flight after "
+                        f"{drain_timeout:.1f}s drain; not removed")
+            with self._cond:
+                self._replicas.remove(r)
+        self._update_gauges()
+        record_event("replica.removed", set=self.name, replica=name,
+                     forced=bool(force))
+        log.info("replica %s/%s removed from the set%s", self.name, name,
+                 " (forced)" if force else " (drained)")
+        if close:
+            try:
+                r.backend.close(drain=not force, timeout=drain_timeout)
+            except TypeError:
+                r.backend.close(drain=not force)
+            except Exception:
+                log.exception("closing removed replica %s/%s failed",
+                              self.name, name)
+        return r.backend
+
     # ------------------------------------------------------ lifecycle ----
 
     def warmup(self, *args, **kwargs) -> None:
         """Forward ``warmup`` to every replica (compile before traffic)."""
-        for r in self._replicas:
+        for r in list(self._replicas):
             r.backend.warmup(*args, **kwargs)
 
     def close(self, drain: bool = True,
@@ -658,7 +783,7 @@ class ReplicaSet:
             self._probe_cond.notify_all()  # wake a prober mid-backoff
         if self._prober is not None:
             self._prober.join(timeout)
-        for r in self._replicas:
+        for r in list(self._replicas):
             try:
                 r.backend.close(drain=drain, timeout=timeout)
             except TypeError:
@@ -677,7 +802,11 @@ class ReplicaSet:
 
     def _update_gauges(self) -> None:
         with self._cond:
-            healthy = sum(r.healthy for r in self._replicas)
+            # a warming member is in the set but not yet serving — it
+            # counts in total, never in healthy (healthz reads the gap
+            # as quarantine, so warming must not widen it)
+            healthy = sum(r.healthy and not r.warming
+                          for r in self._replicas)
             inflight = {r.name: r.inflight for r in self._replicas}
         self.metrics.set_replicas(healthy, len(self._replicas), inflight)
 
@@ -692,7 +821,13 @@ class ReplicaSet:
     @property
     def healthy_replicas(self) -> List[str]:
         with self._cond:
-            return [r.name for r in self._replicas if r.healthy]
+            return [r.name for r in self._replicas
+                    if r.healthy and not r.warming]
+
+    @property
+    def warming_replicas(self) -> List[str]:
+        with self._cond:
+            return [r.name for r in self._replicas if r.warming]
 
     def inflight(self, index: int) -> int:
         with self._cond:
@@ -705,17 +840,18 @@ class ReplicaSet:
         out: Dict[str, Any] = {"set": self.metrics.snapshot(),
                                "replicas": {}}
         with self._cond:
-            states = [(r.name, r.healthy, r.draining, r.inflight, r.served,
-                       r.failed, r.failures, r.backend)
+            states = [(r.name, r.healthy, r.draining, r.warming, r.inflight,
+                       r.served, r.failed, r.failures, r.backend)
                       for r in self._replicas]
             if self.hedge:
                 out["hedging"] = {"launched": self.hedges_launched,
                                   "won": self.hedges_won}
-        for name, healthy, draining, inflight, served, failed, fails, b in \
-                states:
+        for name, healthy, draining, warming, inflight, served, failed, \
+                fails, b in states:
             entry = {"healthy": healthy, "draining": draining,
-                     "inflight": inflight, "served": served,
-                     "failed": failed, "consecutive_failures": fails}
+                     "warming": warming, "inflight": inflight,
+                     "served": served, "failed": failed,
+                     "consecutive_failures": fails}
             m = getattr(b, "metrics", None)
             if m is not None and m is not self.metrics:
                 entry["metrics"] = m.snapshot()
@@ -736,6 +872,7 @@ class ReplicaSet:
         for name in sorted(snap["replicas"]):
             r = snap["replicas"][name]
             state = ("draining" if r["draining"]
+                     else "warming" if r.get("warming")
                      else "healthy" if r["healthy"] else "quarantined")
             lines.append(f"{name:<10} {state:<12} {r['inflight']:>8} "
                          f"{r['served']:>8} {r['failed']:>8}")

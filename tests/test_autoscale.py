@@ -1,0 +1,543 @@
+"""Elastic fleet (PR 16): scaling rules, hysteresis, pool adapters,
+the multi-member disaggregated fleet, and the heal loop.
+
+Tier-1 discipline per the ROADMAP note: the controller state machine
+runs on stub pools with an injected clock (no threads, no sleeps), the
+fleet tests share one tiny compiled kernel triple across every engine
+they spawn, and anything needing a child process lives behind
+``@pytest.mark.slow``.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.obs import MetricsRegistry
+from bigdl_tpu.serving import (
+    AutoscaleController,
+    DisaggregatedFleet,
+    EnginePool,
+    GenerationEngine,
+    GenerationStream,
+    Overloaded,
+    ReplicaPool,
+    ReplicaSet,
+    ReplicaUnavailable,
+    ScalingPolicy,
+    ServingMetrics,
+)
+from bigdl_tpu.serving.autoscale import above, all_of, any_of, below
+from bigdl_tpu.serving.engine import PagedDecodeKernels
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.default().reset()
+    yield
+    faults.default().reset()
+
+
+# ----------------------------------------------------------- rules ----
+
+
+def test_rules_flat_nested_and_missing_semantics():
+    sample = {"fleet.prefill.queue_depth": 7,
+              "nested": {"itl": {"p99": 12.5}}}
+    assert above("fleet.prefill.queue_depth", 5)(sample)
+    assert not above("fleet.prefill.queue_depth", 7)(sample)  # strict >
+    assert above("nested.itl.p99", 10)(sample)                # dot descent
+    assert below("nested.itl.p99", 20)(sample)
+    # missing signal: no breach for up-pressure, quiet for down-pressure
+    assert not above("absent.key", 0)(sample)
+    assert below("absent.key", 0)(sample)
+    assert above("absent.key", 0, missing=True)(sample)
+    assert not below("absent.key", 0, missing=False)(sample)
+    # non-numeric leaves read as missing, not as a crash
+    assert not above("nested.itl", 0)(sample)
+
+
+def test_rule_combinators_and_describe():
+    up = any_of(above("a", 1), above("b", 1))
+    down = all_of(below("a", 1), below("b", 1))
+    assert up({"a": 2, "b": 0})
+    assert not up({"a": 0, "b": 0})
+    assert down({"a": 0, "b": 0})
+    assert not down({"a": 0, "b": 2})
+    assert "a > 1" in up.describe and "or" in up.describe
+    assert "and" in down.describe
+
+
+def test_policy_validates_bounds_and_streaks():
+    with pytest.raises(ValueError):
+        ScalingPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        ScalingPolicy(breach_up=0)
+    pol = ScalingPolicy(min_replicas=1, max_replicas=4,
+                        up_when=above("x", 1))
+    assert pol.describe()["up_when"] == "x > 1"
+
+
+# ------------------------------------------------------ controller ----
+
+
+class _StubPool:
+    """Pool protocol stub: counts actions, optionally bounces drains
+    and reports dead members for the heal pass."""
+
+    def __init__(self, n=1):
+        self.n = n
+        self.next_id = n
+        self.bounce_downs = 0
+        self.dead = []
+        self.healed = []
+
+    def size(self):
+        return self.n
+
+    def scale_up(self):
+        self.n += 1
+        self.next_id += 1
+        return f"m{self.next_id - 1}"
+
+    def scale_down(self):
+        if self.bounce_downs > 0:
+            self.bounce_downs -= 1
+            raise TimeoutError("member still busy")
+        self.n -= 1
+        return f"m{self.n}"
+
+    def heal(self):
+        replaced = [self.scale_up() for _ in self.dead]
+        self.healed += self.dead
+        self.dead = []
+        return replaced
+
+
+def _controller(pool, **pol_kw):
+    defaults = dict(min_replicas=1, max_replicas=3,
+                    up_when=above("load", 5), down_when=below("load", 1),
+                    breach_up=2, breach_down=3,
+                    cooldown_up_s=10.0, cooldown_down_s=20.0)
+    defaults.update(pol_kw)
+    return AutoscaleController({"p": (pool, ScalingPolicy(**defaults))},
+                               register_as=None)
+
+
+def test_controller_breach_streaks_gate_scale_up():
+    pool = _StubPool()
+    c = _controller(pool)
+    assert c.poll_once(now=0, sample={"load": 9}) == []   # streak 1 of 2
+    # a non-breaching poll resets the streak — one noisy sample moves
+    # nothing, ever
+    assert c.poll_once(now=1, sample={"load": 0}) == []
+    assert c.poll_once(now=2, sample={"load": 9}) == []
+    acts = c.poll_once(now=3, sample={"load": 9})
+    assert [a["action"] for a in acts] == ["scale_up"] and pool.n == 2
+
+
+def test_controller_cooldowns_and_bounds():
+    pool = _StubPool()
+    c = _controller(pool)
+    for t in (0, 1):
+        c.poll_once(now=t, sample={"load": 9})
+    assert pool.n == 2
+    # breaching hard, but inside cooldown_up_s: no action — sustained
+    # pressure KEEPS its streak, so the first cooled poll acts
+    for t in (2, 3, 4):
+        assert c.poll_once(now=t, sample={"load": 9}) == []
+    assert pool.n == 2
+    acts = c.poll_once(now=12, sample={"load": 9})
+    assert [a["action"] for a in acts] == ["scale_up"] and pool.n == 3
+    # at max_replicas the rules can scream all they want
+    for t in (23, 24, 25, 26):
+        assert c.poll_once(now=t, sample={"load": 9}) == []
+    assert pool.n == 3
+    # scale-down: 3-poll streak AND cooldown against the LAST action in
+    # either direction (the scale-up at t=12)
+    for t in (27, 28, 29, 30):
+        assert c.poll_once(now=t, sample={"load": 0}) == []
+    acts = c.poll_once(now=40, sample={"load": 0})
+    assert [a["action"] for a in acts] == ["scale_down"] and pool.n == 2
+    # min_replicas floors the shrink
+    pool.n = 1
+    for t in (70, 71, 72, 73):
+        assert c.poll_once(now=t, sample={"load": 0}) == []
+    assert pool.n == 1
+
+
+def test_controller_bounced_drain_keeps_streak_and_retries():
+    pool = _StubPool(n=2)
+    pool.bounce_downs = 1
+    c = _controller(pool)
+    for t in (0, 1, 2):
+        c.poll_once(now=t, sample={"load": 0})
+    assert pool.n == 2              # drain bounced; no stream was failed
+    snap = c.snapshot()["pools"]["p"]
+    assert snap["bounced_downs"] == 1 and snap["scale_downs"] == 0
+    acts = c.poll_once(now=3, sample={"load": 0})
+    assert [a["action"] for a in acts] == ["scale_down"] and pool.n == 1
+
+
+def test_controller_heal_runs_first_and_starts_up_cooldown():
+    pool = _StubPool(n=2)
+    pool.dead = ["m0"]
+    c = _controller(pool, max_replicas=4)
+    # the heal runs FIRST, before policy, and counts as a scale-up for
+    # cooldown purposes — no double-grow on the same tick
+    acts = c.poll_once(now=0, sample={"load": 9})
+    assert [a["action"] for a in acts] == ["heal"]
+    assert pool.healed == ["m0"] and pool.n == 3
+    for t in (1, 2):
+        assert c.poll_once(now=t, sample={"load": 9}) == []  # cooling
+    acts = c.poll_once(now=12, sample={"load": 9})
+    assert [a["action"] for a in acts] == ["scale_up"]
+
+
+def test_controller_is_a_registry_source_with_size_history():
+    reg = MetricsRegistry()
+    pool = _StubPool()
+    c = AutoscaleController(
+        {"p": (pool, ScalingPolicy(min_replicas=1, max_replicas=3,
+                                   up_when=above("load_src.load", 5),
+                                   breach_up=1, cooldown_up_s=0.0))},
+        registry=reg)
+    reg.register("load_src", lambda: {"load": 9})
+    c.poll_once(now=0)
+    flat = reg.collect()
+    assert flat["autoscale.polls"] == 1
+    assert flat["autoscale.pools.p.size"] == 2
+    assert flat["autoscale.pools.p.scale_ups"] == 1
+    assert c.size_history[-1][1] == {"p": 2}
+    assert "p" in c.format_table()
+
+
+def test_controller_thread_lifecycle():
+    pool = _StubPool()
+    c = AutoscaleController(
+        {"p": (pool, ScalingPolicy(min_replicas=1, max_replicas=2))},
+        interval_s=0.01, register_as=None)
+    with c.start():
+        deadline = time.monotonic() + 5
+        while c.polls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert c.polls >= 1
+    assert not any(t.name == "bigdl-autoscale" and t.is_alive()
+                   for t in threading.enumerate())
+    c.stop()  # idempotent
+
+
+# ---------------------------------------------------- replica pool ----
+
+
+class _PoolBackend:
+    """Stub backend recording warmup order relative to activation."""
+
+    def __init__(self, alive=True):
+        self.metrics = ServingMetrics()
+        self.warmed = False
+        self.closed = False
+        self.process_alive = alive
+
+    def submit(self, x, **kw):
+        s = GenerationStream()
+        s._push(1, time.monotonic())
+        s._finish(None)
+        return s
+
+    def warmup(self, *a, **kw):
+        self.warmed = True
+
+    def reload(self, params, state=None):
+        pass
+
+    def close(self, drain=True, timeout=None):
+        self.closed = True
+
+
+def test_replica_pool_scale_up_warms_before_rotation_and_registers():
+    reg = MetricsRegistry()
+    rs = ReplicaSet([_PoolBackend()], probe_interval=0, name="pl")
+    pool = ReplicaPool(rs, _PoolBackend, name="pl", registry=reg)
+    assert reg.names() == ["pl.r0"]
+    warm_seen = []
+    orig_activate = rs.activate_replica
+    rs.activate_replica = lambda n: (
+        warm_seen.append(rs.warming_replicas), orig_activate(n))[-1]
+    name = pool.scale_up()
+    assert name == "r1" and pool.size() == 2
+    assert warm_seen == [["r1"]]  # warming (unplaceable) until activated
+    assert rs.healthy_replicas == ["r0", "r1"]
+    assert reg.names() == ["pl.r0", "pl.r1"]
+    removed = pool.scale_down()
+    assert removed in ("r0", "r1") and pool.size() == 1
+    assert reg.names() == [f"pl.{rs.healthy_replicas[0]}"]
+    rs.close()
+
+
+def test_replica_pool_heal_replaces_dead_process_members():
+    reg = MetricsRegistry()
+    dead = _PoolBackend(alive=False)
+    rs = ReplicaSet([_PoolBackend(), dead], probe_interval=0, name="pl")
+    pool = ReplicaPool(rs, _PoolBackend, name="pl", registry=reg)
+    with rs._cond:
+        rs._replicas[1].healthy = False       # quarantined + process gone
+    assert pool.heal() == ["r2"]
+    assert rs.healthy_replicas == ["r0", "r2"] and dead.closed
+    assert reg.names() == ["pl.r0", "pl.r2"]
+    # a quarantined member whose process is ALIVE stays on the
+    # probe/rejoin path — heal must not fight the prober
+    with rs._cond:
+        rs._replicas[0].healthy = False
+    assert pool.heal() == []
+    rs.close()
+
+
+def test_replica_pool_failed_warmup_never_enters_rotation():
+    class _ColdBackend(_PoolBackend):
+        def warmup(self, *a, **kw):
+            raise RuntimeError("compile blew up")
+
+    rs = ReplicaSet([_PoolBackend()], probe_interval=0, name="pl")
+    pool = ReplicaPool(rs, _ColdBackend, name="pl")
+    with pytest.raises(RuntimeError):
+        pool.scale_up()
+    assert rs.n_replicas == 1 and rs.healthy_replicas == ["r0"]
+    rs.close()
+
+
+# -------------------------------------------------- fleet (engines) ----
+
+SLOTS, MAXLEN, MAXPROMPT, PAGE = 4, 48, 16, 8
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        filter_size=64, num_hidden_layers=1)
+    params, _ = model.init(jax.random.key(0))
+    return model, params, PagedDecodeKernels(model)
+
+
+def _member_factory(lm, role, **over):
+    model, params, kernels = lm
+    kw = dict(max_slots=SLOTS, max_len=MAXLEN, max_prompt_len=MAXPROMPT,
+              page_size=PAGE, max_queue=16, kernels=kernels,
+              metrics=ServingMetrics(recent_window_s=5.0))
+    kw.update(over)
+
+    def make():
+        return GenerationEngine(model, params, role=role, **kw)
+
+    return make
+
+
+def _fleet(lm, n_prefill=1, n_decode=1, **over):
+    return DisaggregatedFleet(_member_factory(lm, "prefill", **over),
+                              _member_factory(lm, "decode", **over),
+                              n_prefill=n_prefill, n_decode=n_decode,
+                              warm=True)
+
+
+def test_fleet_streams_bit_identical_to_monolithic(lm):
+    model, params, kernels = lm
+    with _fleet(lm, n_prefill=1, n_decode=2) as fleet:
+        outs = [fleet.submit([1, 2, 3, 4], max_new_tokens=6)
+                for _ in range(8)]
+        got = [s.result(60) for s in outs]
+    mono = GenerationEngine(model, params, kernels=kernels,
+                            max_slots=SLOTS, max_len=MAXLEN,
+                            max_prompt_len=MAXPROMPT, page_size=PAGE)
+    mono.warmup()
+    ref = mono.submit([1, 2, 3, 4], max_new_tokens=6).result(60)
+    mono.close()
+    assert all(g == ref for g in got)
+
+
+def test_fleet_scale_cycle_strands_zero_pages(lm):
+    with _fleet(lm, n_prefill=1, n_decode=1) as fleet:
+        added = fleet.add_member("decode")
+        assert fleet.pool_size("decode") == 2
+        outs = [fleet.submit([5, 6, 7], max_new_tokens=4)
+                for _ in range(6)]
+        for s in outs:
+            s.result(60)
+        # drain-gated scale-down: every page released, no stream failed
+        fleet.remove_member("decode", drain_timeout=30.0)
+        assert fleet.pool_size("decode") == 1
+        assert fleet.pages_in_use() == 0
+        assert added in fleet.member_names("decode") or \
+            fleet.member_names("decode") == ["d0"]
+        # the survivor still serves
+        assert fleet.generate([1, 2], max_new_tokens=4, timeout=60)
+
+
+def test_fleet_refuses_shrinking_a_role_to_zero(lm):
+    with _fleet(lm) as fleet:
+        with pytest.raises(ValueError):
+            fleet.remove_member("decode")
+        with pytest.raises(ValueError):
+            fleet.remove_member("prefill")
+
+
+def test_fleet_member_death_contained_and_healed(lm):
+    """The chaos leg, in-process: a decode member dies mid-stream; the
+    affected streams end in ReplicaUnavailable (never the raw engine
+    error), survivors are untouched, and the controller's heal pass
+    replaces the corpse."""
+    with _fleet(lm, n_prefill=1, n_decode=2) as fleet:
+        with fleet._cond:
+            victim = fleet._members["decode"][0]
+        faults.default().arm(
+            "engine.decode", after=1, times=1,
+            only=lambda engine=None, **kw: engine is victim.engine)
+        streams = [fleet.submit([1, 2, 3, 4], max_new_tokens=8)
+                   for _ in range(6)]
+        ok = unavailable = 0
+        for s in streams:
+            try:
+                s.result(60)
+                ok += 1
+            except ReplicaUnavailable as e:
+                assert e.__cause__ is not None   # the real error chains
+                unavailable += 1
+        faults.default().disarm("engine.decode")
+        assert ok >= 1 and unavailable >= 1 and ok + unavailable == 6
+        assert fleet.snapshot()["decode"]["dead"] == 1
+
+        ctrl = AutoscaleController(
+            {"decode": (EnginePool(fleet, "decode"),
+                        ScalingPolicy(min_replicas=2, max_replicas=3))},
+            register_as=None)
+        acts = ctrl.poll_once(now=0.0, sample={})
+        assert [a["action"] for a in acts] == ["heal"]
+        snap = fleet.snapshot()
+        assert snap["decode"]["dead"] == 0 and snap["decode"]["size"] == 2
+        assert victim.name not in fleet.member_names("decode")
+        assert fleet.generate([3, 4], max_new_tokens=4, timeout=60)
+
+
+def test_fleet_heal_probes_quietly_dead_members(lm):
+    """A member whose loop dies with NO follow-up traffic: placement
+    never trips over the corpse, so the heal pass must find it by
+    probing ``engine.failed`` instead of waiting for the next dispatch
+    (regression: heal used to scan only placement-marked deaths, so a
+    quiet fleet kept a dead member until new traffic arrived)."""
+    with _fleet(lm, n_prefill=1, n_decode=1) as fleet:
+        with fleet._cond:
+            victim = fleet._members["decode"][0]
+        faults.default().arm(
+            "engine.decode", times=1,
+            only=lambda engine=None, **kw: engine is victim.engine)
+        s = fleet.submit([1, 2, 3, 4], max_new_tokens=8)
+        with pytest.raises(ReplicaUnavailable):
+            s.result(60)
+        faults.default().disarm("engine.decode")
+        # the ONLY stream is gone — nothing else will touch the member
+        assert victim.engine.failed is not None
+        replaced = fleet.heal("decode")
+        assert [d for d, _ in replaced] == [victim.name]
+        snap = fleet.snapshot()
+        assert snap["decode"]["dead"] == 0 and snap["decode"]["size"] == 1
+        assert fleet.generate([3, 4], max_new_tokens=4, timeout=60)
+
+
+def test_fleet_overload_raises_overloaded_only(lm):
+    """Every serving prefill member rejecting = healthy backpressure:
+    the front door raises Overloaded (with rejected counted), never a
+    member-internal error."""
+    with _fleet(lm, max_slots=1, max_queue=1) as fleet:
+        with fleet._cond:
+            member = fleet._members["prefill"][0]
+        real = member.engine.submit
+        member.engine.submit = lambda *a, **kw: (_ for _ in ()).throw(
+            Overloaded(1, 1))
+        with pytest.raises(Overloaded):
+            fleet.submit([1, 2], max_new_tokens=2)
+        member.engine.submit = real
+        assert fleet.snapshot()["rejected"] == 1
+
+
+def test_fleet_asymmetric_role_scaling_on_own_signals(lm):
+    """Prefill and decode pools move independently: a prompt-queue
+    breach grows ONLY the prefill pool; a decode-latency breach grows
+    ONLY the decode pool (the disaggregation payoff the ISSUE names)."""
+    with _fleet(lm) as fleet:
+        reg = MetricsRegistry().register("fleet", fleet)
+        ctrl = AutoscaleController(
+            {"prefill": (EnginePool(fleet, "prefill"),
+                         ScalingPolicy(
+                             min_replicas=1, max_replicas=2,
+                             up_when=above("fleet.prefill.queue_depth", 2),
+                             breach_up=1, cooldown_up_s=0.0)),
+             "decode": (EnginePool(fleet, "decode"),
+                        ScalingPolicy(
+                            min_replicas=1, max_replicas=2,
+                            up_when=above("fleet.decode.itl_recent_p99_ms",
+                                          50.0),
+                            breach_up=1, cooldown_up_s=0.0))},
+            registry=reg)
+        acts = ctrl.poll_once(
+            now=0.0, sample={"fleet.prefill.queue_depth": 5,
+                             "fleet.decode.itl_recent_p99_ms": 1.0})
+        assert [(a["pool"], a["action"]) for a in acts] == \
+            [("prefill", "scale_up")]
+        assert fleet.pool_size("prefill") == 2
+        assert fleet.pool_size("decode") == 1
+        acts = ctrl.poll_once(
+            now=1.0, sample={"fleet.prefill.queue_depth": 0,
+                             "fleet.decode.itl_recent_p99_ms": 99.0})
+        assert [(a["pool"], a["action"]) for a in acts] == \
+            [("decode", "scale_up")]
+        assert fleet.pool_size("prefill") == 2
+        assert fleet.pool_size("decode") == 2
+        # the registry's own collect() drives the same rules end to end
+        flat = reg.collect()
+        assert flat["fleet.prefill.size"] == 2
+        assert flat["fleet.decode.size"] == 2
+        assert fleet.generate([1, 2, 3], max_new_tokens=4, timeout=60)
+
+
+@pytest.mark.slow
+def test_replica_pool_scales_real_child_processes():
+    """Full fabric loop: the pool factory spawns PR-14 child processes;
+    scale-up/scale-down start and stop real replicas, and heal replaces
+    a SIGKILLed one (child spawn + compile => slow tier)."""
+    from bigdl_tpu.serving import start_replica_process
+
+    reg = MetricsRegistry()
+    procs = []
+
+    def factory():
+        r = start_replica_process(
+            "bigdl_tpu.serving.remote:toy_backend",
+            startup_timeout=120.0)
+        procs.append(r)
+        return r
+
+    first = factory()
+    rs = ReplicaSet([first], probe_interval=0, name="procs")
+    pool = ReplicaPool(rs, factory, name="procs", registry=reg, warm=False)
+    try:
+        pool.scale_up()
+        assert pool.size() == 2
+        assert all(p.process_alive for p in procs)
+        victim = procs[-1]
+        victim.kill()
+        with rs._cond:
+            rs._replicas[-1].healthy = False   # what eviction would do
+        replaced = pool.heal()
+        assert len(replaced) == 1 and pool.size() == 2
+        pool.scale_down()
+        assert pool.size() == 1
+    finally:
+        rs.close()
+        for p in procs:
+            try:
+                p.close()
+            except Exception:
+                pass
+    assert all(not p.process_alive for p in procs)

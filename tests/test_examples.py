@@ -203,6 +203,21 @@ def test_speculative_decoding_demo_runs():
     assert snap["tokens_per_verify"] > 4.0
 
 
+def test_elastic_fleet_demo_runs():
+    """The autoscaler demo: an open-loop burst past one member's
+    modeled capacity grows the fleet, the calm tail shrinks it, and
+    the scale cycle strands nothing."""
+    from bigdl_tpu.examples import elastic_fleet_demo
+
+    out = elastic_fleet_demo.main(
+        ["--rps", "60", "--burst-s", "2.0", "--calm-s", "2.0"])
+    assert out["served"] > 0
+    assert out["served"] + out["shed"] == out["offered"]
+    assert out["scale_ups"] >= 1
+    assert out["peak_prefill"] > 1 or out["peak_decode"] > 1
+    assert out["pages_in_use"] == 0
+
+
 def test_parallel_training_example_runs():
     from bigdl_tpu.examples import parallel_training
 

@@ -621,7 +621,7 @@ def test_kv_metrics_rows_append_after_replica_golden():
     # the PR-9 block sits immediately before the PR-10 speculative,
     # PR-11 step-timeline, PR-12 prefix-cache, and PR-15 ITL keys
     # (append-only: each PR's rows land AFTER every earlier block)
-    assert keys[-18:-15] == ["kv_bytes_in_use", "kv_cache_dtype",
+    assert keys[-21:-18] == ["kv_bytes_in_use", "kv_cache_dtype",
                              "quantized_gemms"]
     assert snap["kv_bytes_in_use"] == 5 * 5248
     assert snap["kv_cache_dtype"] == "int8"

@@ -293,6 +293,33 @@ def test_registry_rejects_duplicates_and_junk():
     assert flat["a.x"] == 1
 
 
+def test_registry_unregister_and_idempotent_reregister():
+    """PR-16 regression: fleet membership churn must keep /metrics
+    clean — a scaled-down or SIGKILLed replica's source unregisters
+    (idempotently), and a replacement re-registers under the same name
+    without tripping the duplicate guard."""
+    reg = MetricsRegistry()
+    reg.register("fleet.r0", lambda: {"x": 1})
+    reg.register("fleet.r1", lambda: {"x": 2})
+    assert reg.unregister("fleet.r1") is True
+    assert reg.unregister("fleet.r1") is False      # idempotent
+    flat = reg.collect()
+    assert "fleet.r1.x" not in flat                 # no dead entry
+    assert "fleet.r1.collect_error" not in flat     # and no degradation
+    # the replacement member reuses the slot name
+    reg.register("fleet.r1", lambda: {"x": 3})
+    assert reg.collect()["fleet.r1.x"] == 3
+    # replace=True swaps in place, KEEPING the key-order position (the
+    # Prometheus round trip pins stable key order)
+    reg.register("fleet.r0", lambda: {"x": 9}, replace=True)
+    flat = reg.collect()
+    assert flat["fleet.r0.x"] == 9
+    assert list(flat) == ["fleet.r0.x", "fleet.r1.x"]
+    # without replace, the duplicate guard still guards
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("fleet.r0", lambda: {})
+
+
 # ----------------------------------------------------------- endpoint ----
 
 
@@ -407,6 +434,54 @@ def test_healthz_reflects_eviction_and_rejoin():
     assert rset.probe_once() == 2      # both rejoin
     code, body = healthz()
     assert code == 200 and body["checks"]["replicas"]["degraded"] is False
+    ep.close()
+    rset.close()
+
+
+def test_healthz_tracks_live_membership_under_scaling():
+    """PR-16 satellite: degraded means QUARANTINE, not head-count. A
+    deliberately scaled-down fleet reports ok; a mid-scale-up fleet
+    (warming member) neither flaps 503 nor reads degraded; the member
+    only counts against health once it is IN rotation and fails out."""
+    rset = ReplicaSet([_StubBackend(), _StubBackend()], max_failures=1,
+                      probe=lambda b: None, probe_interval=0, name="el")
+    ep = MetricsEndpoint(MetricsRegistry(),
+                         health={"replicas": replica_health(rset)})
+
+    def healthz():
+        try:
+            resp = urllib.request.urlopen(ep.url("/healthz"), timeout=10)
+            return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    # a deliberate scale-down LEFT the rotation — it did not fail out
+    rset.remove_replica("r1")
+    code, body = healthz()
+    assert code == 200 and body["ok"] is True
+    assert body["checks"]["replicas"]["degraded"] is False
+    assert body["checks"]["replicas"]["total"] == 1
+
+    # mid-scale-up: the warming member is visible but not yet held to
+    # the health bar — no 503 flap, no degraded while it compiles
+    rset.add_replica(_StubBackend(), warming=True)
+    code, body = healthz()
+    assert code == 200 and body["ok"] is True
+    assert body["checks"]["replicas"]["degraded"] is False
+    assert body["checks"]["replicas"]["total"] == 2
+    assert body["checks"]["replicas"]["warming"] == 1
+
+    rset.activate_replica("r2")
+    code, body = healthz()
+    assert code == 200 and body["checks"]["replicas"]["warming"] == 0
+    assert body["checks"]["replicas"]["healthy"] == ["r0", "r2"]
+
+    # once IN rotation, failing out is quarantine again
+    rset.replicas[0].fail = True
+    rset.submit([1]).result()          # fails over; r0 evicted
+    code, body = healthz()
+    assert code == 200 and body["checks"]["replicas"]["degraded"] is True
+    assert body["checks"]["replicas"]["healthy"] == ["r2"]
     ep.close()
     rset.close()
 
@@ -594,7 +669,7 @@ def test_step_timeline_metrics_rows_append_after_speculative_block():
                      "step_host_frac"]
     snap = m.snapshot()
     # immediately before the PR-12 prefix-cache keys (append-only)
-    assert list(snap)[-11:-7] == ["engine_steps", "step_host_ms",
+    assert list(snap)[-14:-10] == ["engine_steps", "step_host_ms",
                                  "step_device_ms", "step_host_frac"]
     assert snap["engine_steps"] == 2
     assert snap["step_host_ms"] == pytest.approx(3.0)

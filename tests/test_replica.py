@@ -867,3 +867,125 @@ def test_replica_submit_site_injects_failover():
     (a if a.streams else b).release()
     s.result(timeout=10)
     rs.close()
+
+
+# ------------------------------------------ dynamic membership (PR 16) ----
+
+
+def test_add_replica_warming_is_visible_but_unplaceable():
+    """A warming member counts in the set (gauges, snapshot, healthz
+    total) but never takes traffic until activated — scale-up must not
+    route to a cold engine, and must not read as degradation."""
+    a = _GatedBackend()
+    rs = ReplicaSet([a], name="grow")
+    name = rs.add_replica(_GatedBackend(), warming=True)
+    assert name == "r1" and rs.n_replicas == 2
+    assert rs.warming_replicas == ["r1"]
+    assert rs.healthy_replicas == ["r0"]        # placeable members only
+    streams = [rs.submit([1]) for _ in range(4)]
+    warming_backend = rs.replicas[1]
+    assert not warming_backend.streams          # nothing landed on it
+    assert rs.snapshot()["replicas"]["r1"]["warming"] is True
+    assert "warming" in rs.format_table()
+    rs.activate_replica("r1")
+    assert rs.warming_replicas == []
+    assert rs.healthy_replicas == ["r0", "r1"]
+    # least-loaded placement now prefers the idle newcomer
+    rs.submit([2])
+    assert warming_backend.streams
+    a.release()
+    warming_backend.release()
+    for s in streams:
+        s.result(timeout=10)
+    rs.close()
+
+
+def test_add_replica_names_are_never_reused():
+    rs = ReplicaSet([_GatedBackend(), _GatedBackend()], name="mono")
+    rs.remove_replica("r1")
+    assert rs.add_replica(_GatedBackend()) == "r2"
+    rs.remove_replica("r2")
+    assert rs.add_replica(_GatedBackend()) == "r3"
+    assert [r.name for r in rs._replicas] == ["r0", "r3"]
+    rs.close()
+
+
+def test_remove_replica_refuses_last_serving_unless_forced():
+    rs = ReplicaSet([_GatedBackend(), _GatedBackend()], name="floor")
+    rs.remove_replica("r0")
+    with pytest.raises(ValueError):
+        rs.remove_replica("r1")
+    assert rs.healthy_replicas == ["r1"]        # still serving
+    rs.remove_replica("r1", force=True)
+    assert rs.n_replicas == 0
+    rs.close()
+
+
+def test_remove_replica_bounces_busy_member_without_failing_streams():
+    """The drain is a GATE: a member still busy at the timeout goes
+    BACK into rotation and the scale-down reports TimeoutError — a
+    shrink can never fail a live stream."""
+    a, b = _GatedBackend(), _GatedBackend()
+    rs = ReplicaSet([a, b], name="gate")
+    s = rs.submit([1])
+    busy = a if a.streams else b
+    busy_name = "r0" if busy is a else "r1"
+    with pytest.raises(TimeoutError):
+        rs.remove_replica(busy_name, drain_timeout=0.2)
+    assert rs.n_replicas == 2
+    with rs._cond:                              # back in rotation
+        assert not rs._replicas[int(busy_name[1])].draining
+    busy.release()
+    assert s.result(timeout=10) == [1]          # stream survived intact
+    rs.remove_replica(busy_name, drain_timeout=10.0)
+    assert rs.n_replicas == 1
+    rs.close()
+
+
+def test_scale_down_drain_gate_releases_every_page(lm):
+    """PR-16 satellite: a drained scale-down releases EVERY page on the
+    departing engine (pages_in_use == 0) and fails zero in-flight
+    streams — the elastic fleet's no-stranded-pages contract, on real
+    paged engines under live traffic."""
+    model, params, _ = lm
+    kernels = PagedDecodeKernels(model)
+    engines = [
+        GenerationEngine(model, params, max_slots=SLOTS, max_len=MAXLEN,
+                         max_prompt_len=MAXPROMPT, page_size=8,
+                         kernels=_SlowKernels(kernels, step_sleep=0.01),
+                         metrics=ServingMetrics())
+        for _ in range(2)]
+    for e in engines:
+        e.warmup()
+    rs = ReplicaSet(engines, name="pages")
+    streams = [rs.submit([1, 5, 9], max_new_tokens=12) for _ in range(6)]
+    # both replicas hold live pages mid-decode
+    deadline = time.monotonic() + 20
+    while not all(e.pages_in_use > 0 for e in engines) \
+            and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert all(e.pages_in_use > 0 for e in engines)
+
+    departing = engines[1]
+    rs.remove_replica("r1", drain_timeout=30.0)
+    assert departing.pages_in_use == 0          # zero stranded pages
+    assert rs.n_replicas == 1
+    results = [s.result(timeout=30) for s in streams]
+    assert all(results)                         # zero failed streams
+    assert rs.metrics.snapshot()["failed"] == 0
+    # the survivor keeps serving and also drains clean on close
+    rs.submit([2, 4], max_new_tokens=4).result(timeout=30)
+    rs.close()
+    assert engines[0].pages_in_use == 0
+
+
+def test_update_gauges_exclude_warming_from_healthy():
+    rs = ReplicaSet([_GatedBackend()], name="gauge")
+    rs.add_replica(_GatedBackend(), warming=True)
+    snap = rs.metrics.snapshot()
+    assert snap["replicas_total"] == 2
+    assert snap["replicas_healthy"] == 1
+    rs.activate_replica("r1")
+    snap = rs.metrics.snapshot()
+    assert snap["replicas_healthy"] == 2
+    rs.close()

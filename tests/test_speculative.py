@@ -670,7 +670,7 @@ def test_speculative_metrics_rows_append_after_golden_order():
     # the PR-10 block sits immediately before the PR-11 step-timeline
     # and PR-12 prefix-cache keys (append-only: each PR's rows land
     # AFTER every earlier block)
-    assert keys[-15:-11] == ["draft_tokens", "accepted_tokens",
+    assert keys[-18:-14] == ["draft_tokens", "accepted_tokens",
                             "acceptance_rate", "verify_steps"]
 
 
