@@ -1,0 +1,37 @@
+"""graftlint — repo-native static analysis.
+
+Sixteen PRs of review hardening kept re-finding the same bug classes by
+hand: shared exception instances raised across threads, ``time.sleep``
+inside a critical section, busy-wait poll loops where a condition
+variable exists, raw (non-keyed) RNG breaking schedule invariance,
+leaked threads, silently-swallowed exceptions, and compile-heavy tests
+leaking into the tier-1 budget.  This package turns those review
+findings into machine-checked rules that run on every commit:
+
+    python -m bigdl_tpu.analysis --baseline .graftlint-baseline.json
+
+Each rule has a stable ID (``GL001``..), emits ``path:line`` findings,
+honours inline ``# graftlint: disable=GL00X`` suppressions, and matches
+against a checked-in baseline file so pre-existing, triaged-as-
+acceptable debt is frozen while any NEW violation fails the run.  The
+runtime half (lock-order cycle detection + leaked-thread assertions)
+lives in ``tests/_sanitizers.py`` as an always-on pytest plugin.
+"""
+
+from .registry import Finding, Rule, all_rules, get_rule
+from .walker import SourceFile, walk_tree
+from .baseline import load_baseline, write_baseline, split_by_baseline
+from .runner import run_analysis
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SourceFile",
+    "all_rules",
+    "get_rule",
+    "walk_tree",
+    "load_baseline",
+    "write_baseline",
+    "split_by_baseline",
+    "run_analysis",
+]

@@ -1,0 +1,93 @@
+"""CLI: ``python -m bigdl_tpu.analysis [paths...] [options]``.
+
+Exit status 0 when every finding is baselined or suppressed; 1 when any
+new finding exists (or a scanned file fails to parse).  The CI job runs
+
+    python -m bigdl_tpu.analysis --baseline .graftlint-baseline.json \
+        --json graftlint-findings.json
+
+and uploads the findings JSON as an artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .baseline import load_baseline, split_by_baseline, write_baseline
+from .report import dump_json, format_rules_table, format_text, to_json
+from .runner import run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis",
+        description="graftlint — repo-native static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to scan, relative to --root "
+             "(default: bigdl_tpu tests perf bench.py)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected from the "
+                             "installed package location, else cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON; findings present in it pass "
+                             "(default: .graftlint-baseline.json under "
+                             "--root when that file exists)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite --baseline from the current findings "
+                             "(preserving notes on surviving entries)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="dump machine-readable findings JSON")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(format_rules_table())
+        return 0
+
+    root = args.root
+    if root is None:
+        # the package lives at <root>/bigdl_tpu/analysis/
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        root = pkg_root if os.path.isdir(
+            os.path.join(pkg_root, "bigdl_tpu")) else os.getcwd()
+
+    rule_ids = (args.rules.split(",") if args.rules else None)
+    findings, suppressed = run_analysis(root, args.paths or None, rule_ids)
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        default = os.path.join(root, ".graftlint-baseline.json")
+        if os.path.exists(default):
+            baseline_path = default
+    if baseline_path and not os.path.isabs(baseline_path):
+        # relative baselines are always root-relative — resolving against
+        # the cwd instead would make `--write-baseline` clobber whatever
+        # same-named file happens to live where the tool was launched
+        baseline_path = os.path.join(root, baseline_path)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+
+    if args.write_baseline:
+        if not baseline_path:
+            parser.error("--write-baseline requires --baseline")
+        notes = {fp: e["note"] for fp, e in baseline.items() if e.get("note")}
+        write_baseline(baseline_path, findings, notes)
+        print(f"graftlint: wrote {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    new, baselined, stale = split_by_baseline(findings, baseline)
+    print(format_text(new, baselined, stale, suppressed))
+    if args.json:
+        dump_json(args.json, to_json(new, baselined, stale, suppressed))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

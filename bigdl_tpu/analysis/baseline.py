@@ -1,0 +1,68 @@
+"""Baseline I/O — freeze triaged debt, fail everything new.
+
+The baseline is a checked-in JSON file mapping finding fingerprints to
+their triage note.  A finding whose fingerprint appears in the baseline
+is reported as *baselined* (informational) and does not fail the run;
+anything else does.  Stale entries (fingerprints no longer produced by
+the tree) are reported so the baseline only shrinks — re-run with
+``--write-baseline`` after fixing sites to drop them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Tuple
+
+from .registry import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict.  Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a graftlint baseline file")
+    out: Dict[str, dict] = {}
+    for entry in data["entries"]:
+        out[entry["fingerprint"]] = entry
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   notes: Dict[str, str] = None) -> None:
+    notes = notes or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule_id)):
+        entry = f.to_json()
+        note = notes.get(f.fingerprint)
+        if note:
+            entry["note"] = note
+        entries.append(entry)
+    payload = {"version": BASELINE_VERSION, "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def split_by_baseline(
+        findings: Iterable[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, baselined, stale-baseline-entries)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            old.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [e for fp, e in baseline.items() if fp not in seen]
+    return new, old, stale

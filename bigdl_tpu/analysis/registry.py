@@ -1,0 +1,109 @@
+"""Rule registry and the ``Finding`` record.
+
+A rule is a small class with a stable ``rule_id``, a path scope, and a
+``check(src)`` generator over one parsed :class:`~.walker.SourceFile`.
+Registration happens at class-definition time via ``@register`` so the
+CLI, the baseline machinery, and the test fixtures all see the same
+list without a hand-maintained table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Type
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``fingerprint`` is the baseline identity: a hash of the rule id, the
+    repo-relative path, the *normalized text* of the flagged line, and an
+    occurrence index among identical lines in the same file — so a
+    baselined finding survives unrelated edits shifting its line number,
+    but editing the flagged line itself (or adding a new identical
+    violation) surfaces as new.
+    """
+
+    rule_id: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+    fingerprint: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+
+def compute_fingerprint(rule_id: str, path: str, norm_snippet: str,
+                        occurrence: int) -> str:
+    payload = f"{rule_id}|{path}|{norm_snippet}|{occurrence}"
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+def finalize_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Assign occurrence-indexed fingerprints (stable within one run)."""
+    out: List[Finding] = []
+    seen: Dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule_id)):
+        norm = " ".join(f.snippet.split())
+        key = (f.rule_id, f.path, norm)
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out.append(Finding(f.rule_id, f.path, f.line, f.message,
+                           f.snippet, compute_fingerprint(
+                               f.rule_id, f.path, norm, occ)))
+    return out
+
+
+class Rule:
+    """Base class for graftlint rules."""
+
+    rule_id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def applies_to(self, path: str) -> bool:
+        """Path scope (repo-relative, forward slashes). Default: all."""
+        return True
+
+    def check(self, src) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, src, line: int, message: str) -> Finding:
+        snippet = ""
+        if 1 <= line <= len(src.lines):
+            snippet = src.lines[line - 1].strip()
+        return Finding(self.rule_id, src.path, line, message, snippet)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.rule_id:
+        raise ValueError(f"rule {cls.__name__} has no rule_id")
+    if cls.rule_id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.rule_id}")
+    _REGISTRY[cls.rule_id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY[rule_id]

@@ -1,0 +1,62 @@
+"""Finding reporters: human-readable text and machine-readable JSON."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Sequence
+
+from .registry import Finding, all_rules
+
+
+def format_text(new: Sequence[Finding], baselined: Sequence[Finding],
+                stale: Sequence[dict], suppressed_count: int = 0) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.location()}: {f.rule_id} {f.message}")
+    if new:
+        lines.append("")
+    by_rule = Counter(f.rule_id for f in new)
+    summary = ", ".join(f"{rid}={n}" for rid, n in sorted(by_rule.items()))
+    lines.append(
+        f"graftlint: {len(new)} new finding(s)"
+        + (f" [{summary}]" if summary else "")
+        + f", {len(baselined)} baselined, {suppressed_count} suppressed"
+        + (f", {len(stale)} STALE baseline entr"
+           f"{'y' if len(stale) == 1 else 'ies'} (fixed sites — "
+           "re-run with --write-baseline to shrink the baseline)"
+           if stale else ""))
+    if stale:
+        for e in stale:
+            lines.append(
+                f"  stale: {e.get('path')}:{e.get('line')} "
+                f"{e.get('rule')} [{e.get('fingerprint')}]")
+    return "\n".join(lines)
+
+
+def to_json(new: Sequence[Finding], baselined: Sequence[Finding],
+            stale: Sequence[dict], suppressed_count: int = 0) -> Dict:
+    return {
+        "new": [f.to_json() for f in new],
+        "baselined": [f.to_json() for f in baselined],
+        "stale_baseline_entries": list(stale),
+        "suppressed": suppressed_count,
+        "summary": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "stale": len(stale),
+        },
+    }
+
+
+def format_rules_table() -> str:
+    lines = ["graftlint rules:", ""]
+    for rule in all_rules():
+        lines.append(f"  {rule.rule_id}  {rule.title}")
+    return "\n".join(lines)
+
+
+def dump_json(path: str, payload: Dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")

@@ -1,0 +1,577 @@
+"""The graftlint rule set — one class per review-hardening bug class.
+
+Every rule here is a generalization of a bug a human reviewer actually
+caught in this repo (PR numbers in each docstring).  Keep rules cheap
+and syntactic: a false positive costs one inline suppression comment
+with a justification; a false negative costs a review round-trip.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .registry import Finding, Rule, register
+from .walker import SourceFile, enclosing, parent
+
+# ---------------------------------------------------------------- helpers
+
+_EXC_NAME_SUFFIXES = ("Error", "Exception", "Fault", "Warning", "Interrupt",
+                      "Exit", "Cancelled", "Overloaded", "Unavailable")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _looks_like_exception_class(name: str) -> bool:
+    base = name.rsplit(".", 1)[-1]
+    return base[:1].isupper() and (
+        base.endswith(_EXC_NAME_SUFFIXES) or base in {
+            "Exception", "BaseException", "StopIteration", "KeyboardInterrupt",
+        })
+
+
+def _const_number(node: ast.AST) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return float(node.value)
+    return None
+
+
+def _is_sleep_call(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    return name in ("time.sleep", "sleep")
+
+
+def _walk_stop_at_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a statement body without descending into nested def/class
+    bodies (their execution is deferred — a sleep there does not run
+    under the enclosing ``with lock``)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    """Name of a lock-like ``with`` context (lock/mutex/cv/cond), or None.
+
+    A ``Condition`` counts: a sleep while holding the underlying lock
+    blocks every waiter exactly like a plain mutex.
+    """
+    name = dotted(expr)
+    if isinstance(expr, ast.Call):
+        # with self._lock.acquire_timeout(...), with lock() — look inside
+        name = dotted(expr.func)
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1].lower().lstrip("_")
+    if any(tok in tail for tok in ("lock", "mutex")) or tail in (
+            "cv", "cond", "condition"):
+        return name
+    return None
+
+
+def _in_package(path: str, *roots: str) -> bool:
+    return any(path == r or path.startswith(r + "/") for r in roots)
+
+
+# ----------------------------------------------------------------- GL001
+
+
+@register
+class SharedExceptionInstance(Rule):
+    """Raise of a shared exception *instance* stored on self/module.
+
+    PR 8: a fault plan armed with an exception INSTANCE raised the same
+    object on every firing; a later raise mutated the ``__traceback__``
+    of an exception a stream had already captured.  Raising any object
+    that outlives the raise site (a module-level singleton, an attribute
+    on self/cls) aliases traceback and ``__context__`` state across
+    threads.  Fix: store the class + args (or a factory) and raise a
+    fresh copy per site, e.g. ``raise copy.copy(self._err)`` or
+    ``raise type(e)(*e.args)``.
+    """
+
+    rule_id = "GL001"
+    title = "raise of shared exception instance"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        # module-level NAME = SomeError(...) singletons
+        module_instances: Set[str] = set()
+        for stmt in src.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                fn = dotted(stmt.value.func)
+                if fn and _looks_like_exception_class(fn):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            module_instances.add(tgt.id)
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            if isinstance(exc, ast.Name) and exc.id in module_instances:
+                yield self.finding(
+                    src, node.lineno,
+                    f"raises module-level exception instance `{exc.id}` — "
+                    "a shared object whose __traceback__/__context__ is "
+                    "mutated by every raise; raise a fresh instance")
+            elif isinstance(exc, ast.Attribute):
+                base = dotted(exc.value)
+                if base in ("self", "cls") and not self._fresh_in_scope(
+                        node, exc.attr):
+                    yield self.finding(
+                        src, node.lineno,
+                        f"raises stored exception instance `{base}.{exc.attr}`"
+                        " — shared across raise sites/threads; raise a fresh"
+                        " copy (copy.copy / re-construct from class+args)")
+
+    @staticmethod
+    def _fresh_in_scope(raise_node: ast.Raise, attr: str) -> bool:
+        """True if ``self.<attr>`` is assigned from a constructor call in
+        the same function before use — a per-call instance, not shared."""
+        fn = enclosing(raise_node, ast.FunctionDef, ast.AsyncFunctionDef)
+        if fn is None:
+            return False
+        for sub in ast.walk(fn):
+            if (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)
+                    and any(isinstance(t, ast.Attribute) and t.attr == attr
+                            and dotted(t.value) in ("self", "cls")
+                            for t in sub.targets)):
+                return True
+        return False
+
+
+# ----------------------------------------------------------------- GL002
+
+
+@register
+class SleepUnderLock(Rule):
+    """``time.sleep`` while holding a lock.
+
+    PR 8: a latency fault effect slept inside the injector's registry
+    lock and stalled every unrelated site check in the process.  A sleep
+    under a lock converts one slow path into a global convoy; move the
+    sleep outside the critical section (or use ``Condition.wait`` with a
+    timeout, which releases the lock while blocking).
+    """
+
+    rule_id = "GL002"
+    title = "time.sleep while holding a lock"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock_name = None
+            for item in node.items:
+                lock_name = _lockish(item.context_expr)
+                if lock_name:
+                    break
+            if not lock_name:
+                continue
+            for sub in _walk_stop_at_defs(node):
+                if isinstance(sub, ast.Call) and _is_sleep_call(sub):
+                    yield self.finding(
+                        src, sub.lineno,
+                        f"time.sleep inside `with {lock_name}:` — blocks "
+                        "every other acquirer for the full sleep; move it "
+                        "outside the critical section or use Condition.wait")
+
+
+# ----------------------------------------------------------------- GL003
+
+
+@register
+class BusyWaitPollLoop(Rule):
+    """Busy-wait poll loop: ``while ...: ... time.sleep(short)``.
+
+    PR 4/8 replaced fixed-interval poll loops (host_prefetch put-retry,
+    the replica prober) with condition-woken waits — a poll loop burns a
+    core, adds up to one full interval of wake-up latency, and hides
+    shutdown races.  Flagged when a while-loop body sleeps a constant
+    <= 0.5 s; use ``threading.Event.wait`` / ``Condition.wait_for`` with
+    a deadline instead.
+    """
+
+    rule_id = "GL003"
+    title = "busy-wait poll loop (while + short sleep)"
+    MAX_POLL_SLEEP = 0.5
+
+    def applies_to(self, path: str) -> bool:
+        # tests legitimately poll observable side effects with deadlines;
+        # library code has Condition/Event infrastructure to use instead
+        return not _in_package(path, "tests")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            for sub in _walk_stop_at_defs(node):
+                if (isinstance(sub, ast.Call) and _is_sleep_call(sub)
+                        and sub.args):
+                    val = _const_number(sub.args[0])
+                    if val is not None and 0 < val <= self.MAX_POLL_SLEEP:
+                        yield self.finding(
+                            src, sub.lineno,
+                            f"poll loop sleeping {val} s per iteration — "
+                            "use Event.wait/Condition.wait_for with a "
+                            "deadline (condition-woken, no added latency)")
+
+
+# ----------------------------------------------------------------- GL004
+
+
+@register
+class RawNondeterminism(Rule):
+    """Raw (non-keyed) RNG in library code.
+
+    PR 4/6: schedule invariance — a stream being a pure function of its
+    seed regardless of worker count, admission order, or chunking — is a
+    repo-wide contract, and it dies the moment library code draws from
+    process-global or ad-hoc RNG state.  All library randomness routes
+    through ``core.rng`` (splitmix64 ``element_seed`` keys, per-request
+    threefry, ``RandomGenerator``).  Flags ``np.random.*`` /
+    ``random.*`` module state and any argless ``default_rng()``.
+    """
+
+    rule_id = "GL004"
+    title = "raw nondeterministic RNG outside core/rng.py"
+
+    def applies_to(self, path: str) -> bool:
+        return (_in_package(path, "bigdl_tpu")
+                and not _in_package(path, "bigdl_tpu/examples")
+                and path != "bigdl_tpu/core/rng.py")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        random_names = self._random_module_aliases(src)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute):
+                name = dotted(node)
+                if name in ("np.random", "numpy.random"):
+                    # fire on the innermost `np.random` node exactly once
+                    # per chain; report the full np.random.X chain text
+                    yield self.finding(
+                        src, node.lineno,
+                        f"`{self._chain_text(node)}` — np.random state is "
+                        "not keyed; route through core.rng "
+                        "(RandomGenerator / element_seed)")
+                elif (name and isinstance(node.value, ast.Name)
+                      and node.value.id in random_names):
+                    yield self.finding(
+                        src, node.lineno,
+                        f"`{name}` — stdlib random module state is not "
+                        "keyed; route through core.rng")
+            if (isinstance(node, ast.Call) and not node.args
+                    and not node.keywords):
+                fname = dotted(node.func)
+                if fname and fname.rsplit(".", 1)[-1] == "default_rng":
+                    yield self.finding(
+                        src, node.lineno,
+                        "argless default_rng() — OS-entropy seeded, "
+                        "unreproducible; derive the seed via core.rng")
+
+    @staticmethod
+    def _random_module_aliases(src: SourceFile) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "random":
+                        names.add(alias.asname or "random")
+        return names
+
+    @staticmethod
+    def _chain_text(node: ast.Attribute) -> str:
+        # report the full chain the attribute participates in, if any
+        p = parent(node)
+        outer = node
+        while isinstance(p, ast.Attribute):
+            outer = p
+            p = parent(p)
+        return dotted(outer) or dotted(node) or "np.random"
+
+
+# ----------------------------------------------------------------- GL005
+
+
+@register
+class UnmanagedThread(Rule):
+    """``threading.Thread(...)`` without ``daemon=`` or a join path.
+
+    PR 5/8: an unclosed engine's loop thread pinned params+cache through
+    a strong ref forever; the fix pattern is an explicit lifecycle —
+    either ``daemon=True`` (the process may exit under it) or a
+    non-daemon thread with a reachable ``join()``.  A Thread created
+    with neither is a leak the chaos drain gates only catch dynamically.
+    """
+
+    rule_id = "GL005"
+    title = "thread without daemon= or join path"
+
+    def applies_to(self, path: str) -> bool:
+        return _in_package(path, "bigdl_tpu")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname not in ("threading.Thread", "Thread"):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            if self._has_lifecycle(src, node):
+                continue
+            yield self.finding(
+                src, node.lineno,
+                "threading.Thread without daemon= and no visible join/"
+                ".daemon assignment for its target — leaked on close; "
+                "set daemon= explicitly or register a join path")
+
+    @staticmethod
+    def _has_lifecycle(src: SourceFile, call: ast.Call) -> bool:
+        """Assigned to a name/attr that is joined or daemon-flagged
+        somewhere in the same file — directly, or through a list built by
+        a comprehension and joined via a for-loop variable."""
+        assign = call._graftlint_parent if hasattr(
+            call, "_graftlint_parent") else None
+        # walk up through comprehension/list nesting to the Assign
+        while assign is not None and not isinstance(assign, ast.Assign):
+            if isinstance(assign, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module)):
+                assign = None
+                break
+            assign = parent(assign)
+        target_attr: Optional[str] = None
+        if isinstance(assign, ast.Assign) and len(assign.targets) == 1:
+            tgt = assign.targets[0]
+            if isinstance(tgt, ast.Attribute):
+                target_attr = tgt.attr
+            elif isinstance(tgt, ast.Name):
+                target_attr = tgt.id
+        if not target_attr:
+            return False
+        # `for t in <target>:` loop variables inherit the lifecycle check
+        loop_vars: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                it = node.iter
+                it_name = (it.id if isinstance(it, ast.Name)
+                           else it.attr if isinstance(it, ast.Attribute)
+                           else None)
+                if it_name == target_attr:
+                    loop_vars.add(node.target.id)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "join":
+                base = node.value
+                if (isinstance(base, ast.Attribute)
+                        and base.attr == target_attr) or (
+                        isinstance(base, ast.Name)
+                        and base.id in loop_vars | {target_attr}):
+                    return True
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "daemon"):
+                        base = tgt.value
+                        if (isinstance(base, ast.Attribute)
+                                and base.attr == target_attr) or (
+                                isinstance(base, ast.Name)
+                                and base.id == target_attr):
+                            return True
+        return False
+
+
+# ----------------------------------------------------------------- GL006
+
+
+@register
+class SilentExceptionSwallow(Rule):
+    """Broad ``except Exception:`` that swallows without logging/raising.
+
+    Review keeps finding these late: a swallowed exception turns a hard
+    failure into a silent wrong answer (the PR-7 torn-manifest and PR-3
+    failed-async-save classes both started as silent passes).  Flagged
+    when a bare/``Exception``/``BaseException`` handler body neither
+    re-raises nor logs.  Fix by narrowing the exception type, logging at
+    the right level, or re-raising; baseline only sites where silence is
+    the documented contract (best-effort cleanup).
+    """
+
+    rule_id = "GL006"
+    title = "broad except that silently swallows"
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(node.type):
+                continue
+            if self._handles(node):
+                continue
+            yield self.finding(
+                src, node.lineno,
+                "broad except swallows the exception without logging or "
+                "re-raising — narrow the type, log it, or re-raise")
+
+    @staticmethod
+    def _broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [dotted(e) for e in t.elts]
+        else:
+            names = [dotted(t)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    _LOG_NAMES = {"debug", "info", "warning", "warn", "error", "exception",
+                  "critical", "log", "print", "print_exc", "format_exc"}
+
+    def _handles(self, handler: ast.ExceptHandler) -> bool:
+        """Body re-raises, logs, returns a failure value to the caller,
+        or actually *uses* the captured exception object (``as e`` bound
+        and referenced — stored, forwarded to a future/callback).  A
+        body that merely runs cleanup while dropping the exception value
+        still swallows it."""
+        for sub in _walk_stop_at_defs(handler):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                return True
+            if (handler.name and isinstance(sub, ast.Name)
+                    and sub.id == handler.name
+                    and isinstance(sub.ctx, ast.Load)):
+                return True
+            if isinstance(sub, ast.Call):
+                fname = dotted(sub.func) or ""
+                if fname.rsplit(".", 1)[-1] in self._LOG_NAMES:
+                    return True
+        return False
+
+
+# ----------------------------------------------------------------- GL007
+
+
+@register
+class UnmarkedExpensiveTest(Rule):
+    """Multi-process / 8-device-mesh test without ``@pytest.mark.slow``.
+
+    ROADMAP: tier-1 runs ``-m 'not slow'`` under a 1200 s wall-clock
+    budget (~230 s headroom); every compile-heavy 8-device equivalence
+    test and every multi-process test belongs behind the slow marker.
+    This rule enforces the budget mechanically: a test (or fixture) that
+    spawns processes or builds a >= 8-device mesh must carry the marker
+    at function, class, or module level — or a suppression comment
+    documenting why it is cheap enough for tier-1.
+    """
+
+    rule_id = "GL007"
+    title = "expensive test without @pytest.mark.slow"
+    MESH_DEVICES_THRESHOLD = 8
+
+    def applies_to(self, path: str) -> bool:
+        return _in_package(path, "tests")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        module_slow = self._module_slow(src.tree)
+        if module_slow:
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            is_test = node.name.startswith("test")
+            is_fixture = any(
+                "fixture" in (self._decorator_name(d) or "")
+                for d in node.decorator_list)
+            if not (is_test or is_fixture):
+                continue
+            if self._marked_slow(node) or self._class_slow(node):
+                continue
+            reason = self._expensive(node)
+            if reason:
+                kind = "fixture" if is_fixture and not is_test else "test"
+                yield self.finding(
+                    src, node.lineno,
+                    f"{kind} `{node.name}` {reason} but has no "
+                    "@pytest.mark.slow — tier-1 budget pays for it")
+
+    @staticmethod
+    def _decorator_name(d: ast.AST) -> Optional[str]:
+        if isinstance(d, ast.Call):
+            d = d.func
+        return dotted(d)
+
+    @staticmethod
+    def _module_slow(tree: ast.AST) -> bool:
+        for stmt in tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                            for t in stmt.targets)):
+                text = ast.dump(stmt.value)
+                if "'slow'" in text or "slow" in text:
+                    return True
+        return False
+
+    @staticmethod
+    def _marked_slow(fn: ast.AST) -> bool:
+        for d in fn.decorator_list:
+            name = dotted(d) or dotted(getattr(d, "func", ast.Constant(0)))
+            if name and name.endswith("mark.slow"):
+                return True
+        return False
+
+    @staticmethod
+    def _class_slow(fn: ast.AST) -> bool:
+        cls = enclosing(fn, ast.ClassDef)
+        return cls is not None and UnmarkedExpensiveTest._marked_slow(cls)
+
+    def _expensive(self, fn: ast.AST) -> Optional[str]:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.keyword) and sub.arg == "processes":
+                if (isinstance(sub.value, ast.Constant)
+                        and sub.value.value is True):
+                    return "spawns worker processes (processes=True)"
+            if isinstance(sub, ast.Attribute):
+                name = dotted(sub)
+                if name and name.split(".", 1)[0] in ("multiprocessing",
+                                                      "subprocess"):
+                    return f"uses {name.split('.', 1)[0]}"
+            if isinstance(sub, ast.Call):
+                fname = dotted(sub.func) or ""
+                base = fname.rsplit(".", 1)[-1]
+                if base == "Popen":
+                    return "spawns a subprocess (Popen)"
+                if base == "serving_meshes" and len(sub.args) >= 1:
+                    n = _const_number(sub.args[0])
+                    tp = _const_number(sub.args[1]) if len(sub.args) > 1 else 1
+                    if (n is not None and tp is not None
+                            and n * tp >= self.MESH_DEVICES_THRESHOLD):
+                        return (f"builds a {int(n * tp)}-device mesh "
+                                "(serving_meshes)")
+                if base == "Mesh":
+                    for inner in ast.walk(sub):
+                        if not isinstance(inner, ast.Call) or not inner.args:
+                            continue
+                        iname = dotted(inner.func) or ""
+                        if iname.endswith("reshape"):
+                            k = _const_number(inner.args[0])
+                            if k is not None and (
+                                    k >= self.MESH_DEVICES_THRESHOLD):
+                                return f"builds a {int(k)}-device Mesh"
+        return None

@@ -1,0 +1,39 @@
+"""Run the rule set over a source tree and collect findings."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+from .registry import Finding, all_rules, finalize_findings
+from .walker import walk_tree
+
+
+def run_analysis(
+        root: str,
+        paths: Optional[Sequence[str]] = None,
+        rule_ids: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], int]:
+    """All non-suppressed findings over ``root`` + the suppressed count.
+
+    Findings come back fingerprinted and sorted by (path, line, rule).
+    A file that fails to parse yields a single GL000 finding — a syntax
+    error must fail the lint run, not silently skip the file.
+    """
+    active = [r for r in all_rules()
+              if rule_ids is None or r.rule_id in rule_ids]
+    raw: List[Finding] = []
+    suppressed = 0
+    for src in walk_tree(root, paths):
+        if src.parse_error is not None:
+            raw.append(Finding("GL000", src.path, 1, src.parse_error))
+            continue
+        for rule in active:
+            if not rule.applies_to(src.path):
+                continue
+            for f in rule.check(src):
+                if src.suppressed(f.line, f.rule_id):
+                    suppressed += 1
+                else:
+                    raw.append(f)
+    return finalize_findings(raw), suppressed

@@ -1,0 +1,112 @@
+"""Source-tree walker: file discovery, parsing, suppression comments.
+
+One :class:`SourceFile` per ``.py`` file carries the raw text, split
+lines, the parsed AST (with parent back-links, which several rules need
+to find the enclosing function/class), and the per-line suppression
+table parsed from ``# graftlint: disable=GL001[,GL002|all]`` comments.
+A suppression comment on the flagged line OR on the immediately
+preceding (otherwise-blank) line silences the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+# generated protobuf modules and caches are never lint targets
+_SKIP_DIRS = {"__pycache__", ".git", ".github", "node_modules"}
+_SKIP_SUFFIXES = ("_pb2.py",)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+class SourceFile:
+    def __init__(self, path: str, text: str,
+                 tree: Optional[ast.AST], parse_error: Optional[str]):
+        self.path = path          # repo-relative, forward slashes
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        self.parse_error = parse_error
+        self.suppressions = _parse_suppressions(self.lines)
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        rules = self.suppressions.get(line)
+        return rules is not None and (rule_id in rules or "all" in rules)
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    table: Dict[int, Set[str]] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        table.setdefault(i, set()).update(rules)
+        # a standalone suppression comment covers the next line too
+        if raw.split("#", 1)[0].strip() == "":
+            table.setdefault(i + 1, set()).update(rules)
+    return table
+
+
+def _add_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._graftlint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_graftlint_parent", None)
+
+
+def enclosing(node: ast.AST, *types) -> Optional[ast.AST]:
+    """Nearest ancestor of one of the given AST types (or None)."""
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, types):
+            return cur
+        cur = parent(cur)
+    return None
+
+
+def load_source(root: str, relpath: str) -> SourceFile:
+    full = os.path.join(root, relpath)
+    with open(full, "r", encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    tree: Optional[ast.AST] = None
+    err: Optional[str] = None
+    try:
+        tree = ast.parse(text, filename=relpath)
+        _add_parents(tree)
+    except SyntaxError as e:  # surfaced as a finding by the runner
+        err = f"syntax error: {e.msg} (line {e.lineno})"
+    return SourceFile(relpath.replace(os.sep, "/"), text, tree, err)
+
+
+def discover(root: str, paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Repo-relative ``.py`` paths under the given roots (sorted)."""
+    if not paths:
+        paths = ["bigdl_tpu", "tests", "perf", "bench.py"]
+    found: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and p.endswith(".py"):
+            found.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py") or fn.endswith(_SKIP_SUFFIXES):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                found.append(rel.replace(os.sep, "/"))
+    return sorted(set(found))
+
+
+def walk_tree(root: str,
+              paths: Optional[Sequence[str]] = None) -> Iterator[SourceFile]:
+    for rel in discover(root, paths):
+        yield load_source(root, rel)

@@ -140,8 +140,11 @@ class CheckpointManager:
         # save() blocks on the oldest commit (Orbax does the same)
         self.max_pending = max(1, int(max_pending))
         os.makedirs(self.directory, exist_ok=True)
-        self._pool = ThreadPoolExecutor(max_workers=1,
-                                        thread_name_prefix="ckpt-writer")
+        # the writer pool is created on first save and torn down by wait()
+        # once fully drained: a drained manager holds no idle ckpt-writer
+        # thread, so optimize()-style callers that wait() at the end leave
+        # nothing behind (the concurrency sanitizer enforces this)
+        self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         self._inflight: Dict[str, SaveHandle] = {}
         self._closed = False
@@ -154,6 +157,14 @@ class CheckpointManager:
         self.commit_failures = 0
         self.restores = 0
         self.restore_fallbacks = 0  # manifest entries skipped (corrupt)
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        """Create the single-worker writer pool on demand (caller must
+        hold ``self._lock``)."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
+        return self._pool
 
     # ------------------------------------------------------------- save --
     def save(
@@ -200,7 +211,7 @@ class CheckpointManager:
             for t in [t for t, h in self._inflight.items()
                       if h.done() and h._future.exception() is None]:
                 del self._inflight[t]
-            future = self._pool.submit(
+            future = self._ensure_pool().submit(
                 self._commit, tag, snapshot, meta, step, preempted)
             handle = SaveHandle(tag, future)
             self._inflight[tag] = handle
@@ -440,6 +451,16 @@ class CheckpointManager:
         with self._lock:
             for tag in [t for t, h in self._inflight.items() if h.done()]:
                 del self._inflight[tag]
+            # fully drained: release the idle writer thread. save() holds
+            # this same lock to submit, so nothing can enqueue between the
+            # emptiness check and the swap; the next save() re-creates the
+            # pool. Joined outside the lock — the worker is idle, but
+            # _commit's error path takes self._lock.
+            pool = None
+            if not self._inflight and self._pool is not None:
+                pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         if first_error is not None and raise_errors:
             raise first_error
 
@@ -453,7 +474,10 @@ class CheckpointManager:
         try:
             self.wait(raise_errors=False)
         finally:
-            self._pool.shutdown(wait=True)
+            with self._lock:
+                pool, self._pool = self._pool, None
+            if pool is not None:
+                pool.shutdown(wait=True)
             self.uninstall_preemption_hook()
 
     def __enter__(self) -> "CheckpointManager":
@@ -481,7 +505,9 @@ class CheckpointManager:
                                        fsync=self.fsync),
                 describe=f"preemption mark for '{tag}'")
 
-        self._pool.submit(_mark).result()
+        with self._lock:
+            fut = self._ensure_pool().submit(_mark)
+        fut.result()
 
     # ------------------------------------------------------ preemption --
     @property

@@ -55,6 +55,19 @@ def uniform01(seed: int, index: int, stream: int = 0) -> float:
     return element_seed(seed, index, stream) / float(1 << 63)
 
 
+def np_rng(seed: int, index: int = 0, stream: int = 0) -> np.random.Generator:
+    """The blessed constructor for host-side numpy randomness in library
+    code (graftlint GL004 flags any direct ``np.random.*`` touch outside
+    this module).  ``np_rng(seed)`` is bit-identical to
+    ``np.random.default_rng(seed)``; pass ``index``/``stream`` to derive
+    an independent keyed sub-stream via :func:`element_seed` — the same
+    recipe the pipeline pool and the faults tier key their draws with,
+    so every library draw is a pure function of an explicit seed."""
+    if index or stream:
+        seed = element_seed(seed, index, stream)
+    return np.random.default_rng(int(seed))
+
+
 def threefry_key_data(seed: int) -> np.ndarray:
     """Raw ``(2,)`` uint32 threefry key words for ``seed`` — the host-side
     equivalent of ``jax.random.PRNGKey(seed)`` without a device dispatch.

@@ -18,6 +18,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from bigdl_tpu.core.rng import np_rng
+
 MNIST_TRAIN_MEAN = 0.13066047740239506 * 255
 MNIST_TRAIN_STD = 0.3081078 * 255
 CIFAR_MEANS = (125.3, 123.0, 113.9)
@@ -25,12 +27,12 @@ CIFAR_STDS = (63.0, 62.1, 66.7)
 
 
 def _synthetic_images(n: int, shape, n_classes: int, seed: int):
-    rng = np.random.default_rng(seed)
+    rng = np_rng(seed)
     x = (rng.standard_normal((n,) + shape) * 40 + 128).astype(np.float32)
     y = rng.integers(0, n_classes, n).astype(np.int32)
     # class-specific spatial templates (fixed across train/test seeds) give a
     # clearly learnable signal so short demo/CI runs show real convergence
-    template_rng = np.random.default_rng(12345)
+    template_rng = np_rng(12345)
     templates = template_rng.standard_normal((n_classes,) + shape).astype(np.float32) * 25.0
     x += templates[y]
     return x, y
@@ -106,7 +108,7 @@ def load_ptb(
                 train_words = f.read().replace("\n", " <eos> ").split()
             vocab = {w: i for i, w in enumerate(sorted(set(train_words)))}
             return np.asarray([vocab[w] for w in words if w in vocab], np.int32)
-    rng = np.random.default_rng(11 if split == "train" else 12)
+    rng = np_rng(11 if split == "train" else 12)
     # order-1 Markov chain over a small transition matrix → learnable structure
     k = min(vocab_size, 1000)
     next_tok = rng.integers(0, k, size=(k, 4))
@@ -138,11 +140,11 @@ def load_movielens(
                             rows.append([int(parts[0]), int(parts[1]),
                                          int(float(parts[2]))])
                 return np.asarray(rows, np.int32)
-    rng = np.random.RandomState(11)
-    u_f = rng.randn(synthetic_users, 4)
-    i_f = rng.randn(synthetic_items, 4)
-    users = rng.randint(0, synthetic_users, synthetic_ratings)
-    items = rng.randint(0, synthetic_items, synthetic_ratings)
+    rng = np_rng(11)
+    u_f = rng.standard_normal((synthetic_users, 4))
+    i_f = rng.standard_normal((synthetic_items, 4))
+    users = rng.integers(0, synthetic_users, synthetic_ratings)
+    items = rng.integers(0, synthetic_items, synthetic_ratings)
     score = (u_f[users] * i_f[items]).sum(1)
     rating = np.clip(np.round(3 + score), 1, 5).astype(np.int32)
     return np.stack([users + 1, items + 1, rating], 1).astype(np.int32)

@@ -51,6 +51,7 @@ import numpy as np
 from bigdl_tpu import faults
 from bigdl_tpu.core.rng import RandomGenerator, element_seed
 from bigdl_tpu.dataset.transformer import ChainedTransformer, Transformer
+from bigdl_tpu.utils.errors import fresh_exception
 
 log = logging.getLogger("bigdl_tpu.dataset")
 
@@ -272,12 +273,17 @@ class _Failure:
         self.tb_text = tb_text
 
     def reraise(self):
-        if self.exc.__traceback__ is None and self.tb_text:
+        # raise a per-call copy: a _Failure can be rendered more than once
+        # (sticky-fail re-entry, supervised-restart exhaustion reporting),
+        # and re-raising the stored object would mutate the traceback a
+        # prior consumer already captured (GL001)
+        exc = fresh_exception(self.exc)
+        if exc.__traceback__ is None and self.tb_text:
             # crossed a process boundary: pickling drops both the
             # traceback and any __cause__, so re-chain the remote text
-            raise self.exc from RuntimeError(
+            raise exc from RuntimeError(
                 "pipeline worker traceback:\n" + self.tb_text)
-        raise self.exc  # thread worker: original traceback intact
+        raise exc  # thread worker: original traceback intact
 
 
 _PIPELINE_END = None  # process-mode end sentinel (picklable)

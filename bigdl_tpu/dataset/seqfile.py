@@ -25,6 +25,7 @@ from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bigdl_tpu.core.rng import np_rng, request_seed
 from bigdl_tpu.dataset.transformer import Transformer
 
 _MAGIC = b"SEQ"
@@ -87,8 +88,10 @@ class SeqFileWriter:
         self._f = open(path, "wb")
         self.key_class = key_class
         self.value_class = value_class
-        self._sync = np.random.RandomState(
-            abs(hash(path)) % (2 ** 31)).bytes(16)
+        # keyed on the path CONTENT (crc32 via request_seed), not on
+        # Python's per-process randomized hash(): the same records written
+        # to the same path now produce byte-identical files across runs
+        self._sync = np_rng(request_seed(0, path.encode("utf-8"))).bytes(16)
         self._since_sync = 0
         self._write_header()
 

@@ -34,7 +34,6 @@ library arms a site on its own.
 from __future__ import annotations
 
 import contextlib
-import copy
 import threading
 import time
 import zlib
@@ -42,6 +41,7 @@ from typing import Any, Callable, Dict, Optional
 
 from bigdl_tpu.core.rng import uniform01
 from bigdl_tpu.obs.recorder import record_event
+from bigdl_tpu.utils.errors import fresh_exception
 
 # Catalogue of the sites wired into the stack (name -> where it fires).
 # Purely documentary — fire() accepts any name, and tests may invent
@@ -163,9 +163,7 @@ class FaultSpec:
         # an armed INSTANCE on a multi-fire plan: raise a fresh copy per
         # injection — raising one shared object would let a later fire
         # mutate the __traceback__/__context__ a stream already captured
-        fresh = copy.copy(exc)
-        fresh.__traceback__ = None
-        return fresh
+        return fresh_exception(exc, keep_traceback=False)
 
 
 class FaultInjector:

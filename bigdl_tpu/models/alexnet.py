@@ -7,6 +7,7 @@ and ``AlexNet`` (the original Caffe layout with LRN and grouped convs).
 
 from __future__ import annotations
 
+from bigdl_tpu.core.rng import np_rng
 import bigdl_tpu.nn as nn
 
 
@@ -94,9 +95,9 @@ def main(argv=None):
 
     size = 224 if args.variant == "owt" else 227
     model = (build_owt if args.variant == "owt" else build)(args.classNum)
-    rng = np.random.RandomState(0)
-    x = rng.rand(4 * args.batchSize, 3, size, size).astype("float32")
-    y = rng.randint(0, args.classNum, (4 * args.batchSize,)).astype("int32")
+    rng = np_rng(0)
+    x = rng.random((4 * args.batchSize, 3, size, size)).astype("float32")
+    y = rng.integers(0, args.classNum, (4 * args.batchSize,)).astype("int32")
     ds = DataSet.tensors(x, y)
 
     opt = optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=args.batchSize)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.core.rng import np_rng
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.module import Context, Module
 
@@ -106,7 +107,7 @@ def main(argv=None):
 
         img = np.asarray(Image.open(args.image).convert("RGB"), np.float32)
     else:
-        img = (np.random.RandomState(0).rand(224, 224, 3) * 255).astype(np.float32)
+        img = (np_rng(0).random((224, 224, 3)) * 255).astype(np.float32)
     h, w = img.shape[:2]
     x = img.transpose(2, 0, 1)[None] / 128.0 - 1.0
     im_info = np.asarray([[h, w, 1.0, 1.0]], np.float32)

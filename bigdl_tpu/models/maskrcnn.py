@@ -17,6 +17,7 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.core.rng import np_rng
 import bigdl_tpu.nn as nn
 from bigdl_tpu.models import resnet
 from bigdl_tpu.nn.layers.detection import (
@@ -282,7 +283,7 @@ def main(argv=None):
             from PIL import Image
 
             return np.asarray(Image.open(args.image).convert("RGB"))
-        return (np.random.RandomState(0).rand(240, 320, 3) * 255).astype(np.uint8)
+        return (np_rng(0).random((240, 320, 3)) * 255).astype(np.uint8)
 
     if args.mode == "predict":
         out = predictor.predict(load_image())
@@ -298,10 +299,10 @@ def main(argv=None):
         return out
 
     # evaluate: (random-weight) detections vs synthetic truth
-    rng = np.random.RandomState(1)
+    rng = np_rng(1)
     dets, gts, cdets, cgts = [], [], [], []
     for _ in range(args.nImages):
-        img = (rng.rand(160, 200, 3) * 255).astype(np.uint8)
+        img = (rng.random((160, 200, 3)) * 255).astype(np.uint8)
         out = predictor.predict(img)
         keep = np.asarray(out["valid"]).astype(bool)
         dets.append((out["boxes"][keep], out["scores"][keep]))

@@ -22,6 +22,8 @@ import json
 import time
 
 import numpy as np
+
+from bigdl_tpu.core.rng import np_rng
 import jax
 import jax.numpy as jnp
 
@@ -87,8 +89,9 @@ def main(argv=None):
 
     model, shape = build_model(args.model, args.classNum)
     params, mstate = model.init(jax.random.key(0))
-    x = jnp.asarray(np.random.rand(args.batchSize, *shape), dtype)
-    y = jnp.asarray(np.random.randint(0, args.classNum, (args.batchSize,)), jnp.int32)
+    rng = np_rng(0)
+    x = jnp.asarray(rng.random((args.batchSize, *shape)), dtype)
+    y = jnp.asarray(rng.integers(0, args.classNum, (args.batchSize,)), jnp.int32)
 
     if args.mode == "fwd":
         if args.int8:

@@ -8,6 +8,7 @@ inference benchmark config).
 
 from __future__ import annotations
 
+from bigdl_tpu.core.rng import np_rng
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.init import MsraFiller
 
@@ -102,7 +103,7 @@ def main(argv=None):
         graph, params, state = load_caffe(*args.from_caffe)
         shape = getattr(graph, "caffe_input_shapes", {}) or {}
         in_shape = next(iter(shape.values()), (1, 3, 224, 224))
-        x = np.random.rand(args.batchSize, *in_shape[1:]).astype("float32")
+        x = np_rng(0).random((args.batchSize, *in_shape[1:])).astype("float32")
         pred = Predictor(graph, params, state, batch_size=args.batchSize)
         outs = pred.predict(x, flatten=False)  # warmup/compile
         t0 = time.perf_counter()

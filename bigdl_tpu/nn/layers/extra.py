@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bigdl_tpu.core.rng import fold_in_str
+from bigdl_tpu.core.rng import fold_in_str, np_rng
 from bigdl_tpu.nn.init import InitializationMethod, Xavier
 from bigdl_tpu.nn.module import Context, Module
 
@@ -545,7 +545,7 @@ class SpatialConvolutionMap(Module):
     @staticmethod
     def random_table(n_in: int, n_out: int, fanin: int,
                      seed: int = 0) -> np.ndarray:
-        rng = np.random.RandomState(seed)
+        rng = np_rng(seed)
         rows = []
         for o in range(n_out):
             for i in rng.choice(n_in, size=min(fanin, n_in), replace=False):

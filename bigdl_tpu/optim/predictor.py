@@ -175,6 +175,12 @@ class PredictionService:
     def close(self) -> None:
         self.service.close()
 
+    def __enter__(self) -> "PredictionService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     @property
     def served(self) -> int:
         return self.service.metrics.served

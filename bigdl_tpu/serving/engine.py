@@ -102,6 +102,7 @@ from bigdl_tpu.ops.sampling import (
     speculative_sample,
 )
 from bigdl_tpu.serving.batcher import bucket_sizes_for
+from bigdl_tpu.utils.errors import fresh_exception
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
     Overloaded,
@@ -603,7 +604,11 @@ class GenerationStream:
             item = self._q.get()
             if item is _SENTINEL:
                 if self._error is not None:
-                    raise self._error
+                    # the stored terminal error may be raised again by any
+                    # number of result()/__iter__ calls on other threads —
+                    # raise a per-call copy so no raise mutates the
+                    # __traceback__ a sibling already captured (GL001)
+                    raise fresh_exception(self._error)
                 return
             yield item
 
@@ -613,7 +618,7 @@ class GenerationStream:
         if not self._done.wait(timeout):
             raise TimeoutError("generation stream did not finish in time")
         if self._error is not None:
-            raise self._error
+            raise fresh_exception(self._error)  # per-call copy (GL001)
         return list(self._tokens)
 
     def cancel(self) -> None:

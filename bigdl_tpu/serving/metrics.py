@@ -9,13 +9,14 @@ batch-size distribution, padding waste).
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from bigdl_tpu.core.rng import uniform01
 
 
 class _Window:
@@ -52,14 +53,17 @@ class _Reservoir:
         self.size = size
         self.seen = 0
         self.values: List[float] = []
-        self._rng = random.Random(seed)
+        self._seed = seed
 
     def add(self, v: float) -> None:
         self.seen += 1
         if len(self.values) < self.size:
             self.values.append(v)
         else:
-            j = self._rng.randrange(self.seen)
+            # keyed splitmix64 draw on (seed, element index): which slot
+            # element N displaces is a pure function of the seed and N —
+            # the reservoir replays exactly, with no stateful RNG (GL004)
+            j = int(uniform01(self._seed, self.seen) * self.seen)
             if j < self.size:
                 self.values[j] = v
 

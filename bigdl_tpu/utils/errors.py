@@ -1,0 +1,39 @@
+"""Exception-identity hygiene helpers.
+
+Raising one shared exception *object* from more than one site is a
+cross-thread hazard this repo has been bitten by twice (PR 8: a fault
+plan's armed instance; PR 17: a stream's terminal error raised from both
+``__iter__`` and every ``result()`` call): each raise mutates the
+object's ``__traceback__``/``__context__`` in place, corrupting what a
+concurrent consumer already captured.  graftlint rule GL001 flags the
+pattern statically; this helper is the standard fix — a fresh shallow
+copy per raise site.
+"""
+
+from __future__ import annotations
+
+import copy
+
+
+def fresh_exception(exc: BaseException,
+                    keep_traceback: bool = True) -> BaseException:
+    """A per-raise shallow copy of ``exc``.
+
+    The copy carries the original's ``__cause__`` and (when
+    ``keep_traceback``) its ``__traceback__``, so diagnostics are
+    unchanged — but raising the copy appends frames to the COPY's
+    traceback, never to the object other threads hold.  An exception
+    whose constructor defeats ``copy.copy`` (required kwargs lost by
+    ``__reduce__``) degrades to the original object rather than raising
+    a different error than the caller stored.
+    """
+    try:
+        fresh = copy.copy(exc)
+    except Exception:
+        return exc
+    if type(fresh) is not type(exc):  # exotic __reduce__; don't trust it
+        return exc
+    fresh.__traceback__ = exc.__traceback__ if keep_traceback else None
+    fresh.__cause__ = exc.__cause__
+    fresh.__suppress_context__ = exc.__suppress_context__
+    return fresh

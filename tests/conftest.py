@@ -21,6 +21,19 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# Concurrency sanitizers (graftlint's dynamic half): installed AFTER the
+# jax import so jax-internal locks stay untracked, BEFORE any bigdl_tpu
+# module allocates a lock.  The two autouse fixtures re-exported here run
+# the per-test lock-order-cycle and leaked-thread checks.
+import _sanitizers  # noqa: E402
+
+_sanitizers.install()
+
+from _sanitizers import (  # noqa: E402,F401
+    _leaked_thread_sanitizer,
+    _lock_order_sanitizer,
+)
+
 
 @pytest.fixture
 def rng():

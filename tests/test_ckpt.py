@@ -157,13 +157,32 @@ def test_concurrent_save_of_same_tag_raises(tmp_path):
 
     mgr = CheckpointManager(str(tmp_path))
     gate = threading.Event()
-    mgr._pool.submit(gate.wait)  # jam the single writer
+    with mgr._lock:
+        mgr._ensure_pool().submit(gate.wait)  # jam the single writer
     h = mgr.save("model.iter1", _params(), meta={"iteration": 1})
     with pytest.raises(CheckpointInFlightError):
         mgr.save("model.iter1", _params(), meta={"iteration": 1})
     mgr.save("model.iter2", _params(), meta={"iteration": 2})  # other tags ok
     gate.set()
     assert h.result(timeout=30).step == 1
+    mgr.close()
+
+
+def test_wait_releases_idle_writer_thread(tmp_path):
+    """A drained manager must hold no idle ckpt-writer thread — callers
+    that wait() at the end of a run (the optimizer does) leave nothing
+    for the leaked-thread sanitizer to flag — and must stay usable."""
+    import threading
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save("model.iter1", _params(), meta={"iteration": 1})
+    mgr.wait()
+    assert mgr._pool is None
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("ckpt-writer")]
+    mgr.save("model.iter2", _params(), meta={"iteration": 2})  # pool re-spawns
+    mgr.wait()
+    assert [e.step for e in load_manifest(str(tmp_path))] == [1, 2]
     mgr.close()
 
 
@@ -370,7 +389,8 @@ def test_backpressure_bounds_pending_snapshots(tmp_path):
 
     mgr = CheckpointManager(str(tmp_path), max_pending=1)
     gate = threading.Event()
-    mgr._pool.submit(gate.wait)  # jam the single writer
+    with mgr._lock:
+        mgr._ensure_pool().submit(gate.wait)  # jam the single writer
     mgr.save("model.iter1", _params(), meta={"iteration": 1})  # pending=1
     threading.Timer(0.3, gate.set).start()
     mgr.save("model.iter2", _params(), meta={"iteration": 2})  # must block

@@ -155,6 +155,7 @@ def test_perf_cli_runs(capsys):
     assert rec["model"] == "lenet" and "records_per_sec" in rec
 
 
+@pytest.mark.slow  # spawns a bench.py subprocess and waits out its probe loop
 def test_bench_supervisor_emits_diagnostic_json_when_backend_dead():
     """Round-4 contract (VERDICT r3 item 1): a dead TPU tunnel must not
     produce an evidence-free round — bench.py's supervisor prints exactly

@@ -1,0 +1,594 @@
+"""graftlint rule-engine fixture suite + concurrency-sanitizer tests.
+
+Every rule gets the four-quadrant treatment over snippet fixtures written
+to a scratch tree: a demonstrated true positive, a clean negative, a
+suppressed-by-comment case, and (for the engine as a whole) the baseline
+round-trip.  The sanitizer half proves the lock-order graph catches an
+induced ABBA cycle and that the leaked-thread detector sees an abandoned
+library thread — both against private ``LockGraph`` instances so these
+tests never pollute the suite-wide autouse fixtures.
+"""
+
+import textwrap
+import threading
+
+import pytest
+
+import _sanitizers
+from _sanitizers import (
+    LockGraph,
+    _TrackedLock,
+    _TrackedRLock,
+    find_cycle,
+    leaked_library_threads,
+)
+from bigdl_tpu.analysis import (
+    all_rules,
+    load_baseline,
+    run_analysis,
+    split_by_baseline,
+    write_baseline,
+)
+from bigdl_tpu.analysis.__main__ import main as graftlint_main
+
+
+def lint(tmp_path, code, relpath="bigdl_tpu/mod_under_test.py",
+         rules=None):
+    """Write ``code`` at ``relpath`` under a scratch root and lint it."""
+    full = tmp_path / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(textwrap.dedent(code))
+    return run_analysis(str(tmp_path), [relpath], rules)
+
+
+def rule_ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def test_all_seven_rules_registered():
+    assert [r.rule_id for r in all_rules()] == [
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
+
+
+# ---------------------------------------------------------------- GL001
+
+GL001_TP = """
+    class ServiceError(RuntimeError):
+        pass
+
+    _SHUTDOWN = ServiceError("shut down")
+
+    class Stream:
+        def fail(self, exc):
+            self._error = exc
+
+        def result(self):
+            raise self._error
+
+    def reject():
+        raise _SHUTDOWN
+"""
+
+
+def test_gl001_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL001_TP, rules=["GL001"])
+    assert rule_ids(findings) == ["GL001", "GL001"]
+    assert "self._error" in findings[0].message
+    assert "_SHUTDOWN" in findings[1].message
+
+
+def test_gl001_negative_fresh_instances(tmp_path):
+    findings, _ = lint(tmp_path, """
+        class Stream:
+            def result(self):
+                self._error = RuntimeError("per-call instance")
+                raise self._error
+
+        def reject():
+            raise RuntimeError("fresh")
+    """, rules=["GL001"])
+    assert findings == []
+
+
+def test_gl001_suppressed(tmp_path):
+    code = GL001_TP.replace(
+        "raise self._error",
+        "raise self._error  # graftlint: disable=GL001")
+    findings, suppressed = lint(tmp_path, code, rules=["GL001"])
+    assert rule_ids(findings) == ["GL001"] and suppressed == 1
+
+
+# ---------------------------------------------------------------- GL002
+
+GL002_TP = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def backoff():
+        with _lock:
+            time.sleep(1.0)
+"""
+
+
+def test_gl002_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL002_TP, rules=["GL002"])
+    assert rule_ids(findings) == ["GL002"]
+    assert "with _lock" in findings[0].message
+
+
+def test_gl002_negative_sleep_outside_and_deferred(tmp_path):
+    findings, _ = lint(tmp_path, """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def backoff():
+            with _lock:
+                n = 1
+            time.sleep(n)
+
+        def registers_callback():
+            with _lock:
+                def later():
+                    time.sleep(1.0)  # deferred, not under the lock
+                return later
+    """, rules=["GL002"])
+    assert findings == []
+
+
+def test_gl002_suppressed_by_standalone_comment(tmp_path):
+    findings, suppressed = lint(tmp_path, """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def backoff():
+            with _lock:
+                # graftlint: disable=GL002
+                time.sleep(1.0)
+    """, rules=["GL002"])
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------- GL003
+
+GL003_TP = """
+    import time
+
+    def wait_for(flag):
+        while not flag():
+            time.sleep(0.05)
+"""
+
+
+def test_gl003_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL003_TP, rules=["GL003"])
+    assert rule_ids(findings) == ["GL003"]
+    assert "0.05" in findings[0].message
+
+
+def test_gl003_negative_long_sleep_and_tests_scope(tmp_path):
+    findings, _ = lint(tmp_path, """
+        import time
+
+        def heartbeat(stop):
+            while not stop.is_set():
+                time.sleep(5.0)
+    """, rules=["GL003"])
+    assert findings == []
+    # tests/ poll observable side effects legitimately — out of scope
+    findings, _ = lint(tmp_path, GL003_TP,
+                       relpath="tests/test_snippet.py", rules=["GL003"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------- GL004
+
+GL004_TP = """
+    import random
+
+    import numpy as np
+
+    def shuffle(xs, seed):
+        random.shuffle(xs)
+        noise = np.random.rand(4)
+        gen = np.random.default_rng()
+        return xs, noise, gen
+"""
+
+
+def test_gl004_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL004_TP, rules=["GL004"])
+    # random.shuffle, np.random.rand, np.random.default_rng (chain) and
+    # the argless default_rng() call each fire
+    assert rule_ids(findings) == ["GL004"] * 4
+    messages = " | ".join(f.message for f in findings)
+    assert "random.shuffle" in messages
+    assert "np.random.rand" in messages
+    assert "argless default_rng()" in messages
+
+
+def test_gl004_negative_keyed_rng(tmp_path):
+    findings, _ = lint(tmp_path, """
+        from bigdl_tpu.core.rng import np_rng
+
+        def shuffle(xs, seed):
+            order = np_rng(seed).permutation(len(xs))
+            return [xs[i] for i in order]
+    """, rules=["GL004"])
+    assert findings == []
+
+
+def test_gl004_scope_examples_and_core_rng_exempt(tmp_path):
+    for relpath in ("bigdl_tpu/examples/demo.py", "bigdl_tpu/core/rng.py",
+                    "tests/test_snippet.py"):
+        findings, _ = lint(tmp_path, GL004_TP, relpath=relpath,
+                           rules=["GL004"])
+        assert findings == [], relpath
+
+
+def test_gl004_suppressed(tmp_path):
+    code = GL004_TP.replace(
+        "random.shuffle(xs)",
+        "random.shuffle(xs)  # graftlint: disable=GL004")
+    findings, suppressed = lint(tmp_path, code, rules=["GL004"])
+    assert len(findings) == 3 and suppressed == 1
+
+
+# ---------------------------------------------------------------- GL005
+
+GL005_TP = """
+    import threading
+
+    def start(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        return t
+"""
+
+
+def test_gl005_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL005_TP, rules=["GL005"])
+    assert rule_ids(findings) == ["GL005"]
+
+
+def test_gl005_negative_daemon_join_and_comprehension(tmp_path):
+    findings, _ = lint(tmp_path, """
+        import threading
+
+        def daemonized(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+
+        def joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+        def pooled(fn):
+            threads = [threading.Thread(target=fn) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    """, rules=["GL005"])
+    assert findings == []
+
+
+def test_gl005_scope_library_only(tmp_path):
+    findings, _ = lint(tmp_path, GL005_TP,
+                       relpath="tests/test_snippet.py", rules=["GL005"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------- GL006
+
+GL006_TP = """
+    def cleanup(handle):
+        try:
+            handle.close()
+        except Exception:
+            pass
+"""
+
+
+def test_gl006_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL006_TP, rules=["GL006"])
+    assert rule_ids(findings) == ["GL006"]
+
+
+def test_gl006_negative_logged_raised_narrow_or_used(tmp_path):
+    findings, _ = lint(tmp_path, """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def logged(handle):
+            try:
+                handle.close()
+            except Exception:
+                log.warning("close failed")
+
+        def reraised(handle):
+            try:
+                handle.close()
+            except Exception:
+                handle.abort()
+                raise
+
+        def narrow(handle):
+            try:
+                handle.close()
+            except OSError:
+                pass
+
+        def forwarded(handle, fut):
+            try:
+                handle.close()
+            except Exception as e:
+                fut.set_exception(e)
+    """, rules=["GL006"])
+    assert findings == []
+
+
+def test_gl006_suppressed(tmp_path):
+    code = GL006_TP.replace(
+        "except Exception:",
+        "except Exception:  # graftlint: disable=GL006")
+    findings, suppressed = lint(tmp_path, code, rules=["GL006"])
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------- GL007
+
+GL007_TP = """
+    def test_pipeline_process_mode(pipeline):
+        out = list(pipeline(workers=2, processes=True))
+        assert out
+"""
+
+
+def test_gl007_true_positive(tmp_path):
+    findings, _ = lint(tmp_path, GL007_TP,
+                       relpath="tests/test_snippet.py", rules=["GL007"])
+    assert rule_ids(findings) == ["GL007"]
+    assert "processes=True" in findings[0].message
+
+
+def test_gl007_negative_marked_or_cheap(tmp_path):
+    findings, _ = lint(tmp_path, """
+        import pytest
+
+        @pytest.mark.slow
+        def test_pipeline_process_mode(pipeline):
+            out = list(pipeline(workers=2, processes=True))
+            assert out
+
+        def test_cheap(pipeline):
+            assert list(pipeline(workers=2))
+    """, relpath="tests/test_snippet.py", rules=["GL007"])
+    assert findings == []
+
+
+def test_gl007_module_pytestmark_covers_file(tmp_path):
+    code = ("import pytest\n\npytestmark = pytest.mark.slow\n"
+            + textwrap.dedent(GL007_TP))
+    findings, _ = lint(tmp_path, code,
+                       relpath="tests/test_snippet.py", rules=["GL007"])
+    assert findings == []
+
+
+def test_gl007_mesh_threshold(tmp_path):
+    findings, _ = lint(tmp_path, """
+        def test_big_mesh():
+            meshes = serving_meshes(4, 2)
+            assert meshes
+
+        def test_small_mesh():
+            meshes = serving_meshes(2, 2)
+            assert meshes
+    """, relpath="tests/test_snippet.py", rules=["GL007"])
+    assert len(findings) == 1
+    assert "test_big_mesh" in findings[0].message
+
+
+# ------------------------------------------------- engine plumbing ----
+
+
+def test_parse_error_is_a_finding_not_a_skip(tmp_path):
+    findings, _ = lint(tmp_path, "def broken(:\n    pass\n")
+    assert rule_ids(findings) == ["GL000"]
+    assert "syntax error" in findings[0].message
+
+
+def test_fingerprints_survive_line_drift(tmp_path):
+    first, _ = lint(tmp_path, GL006_TP, rules=["GL006"])
+    shifted, _ = lint(tmp_path, "\n\n# a comment\n" + textwrap.dedent(
+        GL006_TP), rules=["GL006"])
+    assert first[0].line != shifted[0].line
+    assert first[0].fingerprint == shifted[0].fingerprint
+
+
+def test_baseline_round_trip(tmp_path):
+    findings, _ = lint(tmp_path, GL006_TP, rules=["GL006"])
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings,
+                   notes={findings[0].fingerprint: "documented"})
+    baseline = load_baseline(str(bl_path))
+    assert baseline[findings[0].fingerprint]["note"] == "documented"
+
+    # unchanged tree: everything baselined, nothing new or stale
+    new, old, stale = split_by_baseline(findings, baseline)
+    assert (new, len(old), stale) == ([], 1, [])
+
+    # a second identical violation gets a new occurrence fingerprint
+    grown, _ = lint(tmp_path, GL006_TP + GL006_TP.replace(
+        "def cleanup", "def cleanup2"), rules=["GL006"])
+    new, old, _ = split_by_baseline(grown, baseline)
+    assert len(new) == 1 and len(old) == 1
+
+    # fixing the site leaves the entry stale (baseline only shrinks)
+    new, old, stale = split_by_baseline([], baseline)
+    assert (new, old, len(stale)) == ([], [], 1)
+
+
+def test_cli_exit_codes_and_baseline_flow(tmp_path, capsys):
+    (tmp_path / "bigdl_tpu").mkdir()
+    (tmp_path / "bigdl_tpu" / "mod.py").write_text(textwrap.dedent(GL006_TP))
+    root = str(tmp_path)
+    assert graftlint_main(["--root", root]) == 1
+    assert graftlint_main(["--root", root, "--baseline", "bl.json",
+                           "--write-baseline"]) == 0
+    assert graftlint_main(["--root", root, "--baseline", "bl.json"]) == 0
+    out = capsys.readouterr().out
+    assert "1 baselined" in out
+    # the checked-in default baseline is picked up with no --baseline flag
+    assert graftlint_main(["--root", root, "--baseline",
+                           ".graftlint-baseline.json",
+                           "--write-baseline"]) == 0
+    assert graftlint_main(["--root", root]) == 0
+
+
+# ------------------------------------------------- sanitizer half ----
+
+
+def test_find_cycle_on_plain_graphs():
+    assert find_cycle({(1, 2): None, (2, 3): None}) is None
+    cycle = find_cycle({(1, 2): None, (2, 3): None, (3, 1): None})
+    assert cycle is not None
+    assert cycle[0] == cycle[-1] and set(cycle) == {1, 2, 3}
+
+
+def _private_locks(n, rlock=False):
+    graph = LockGraph()
+    cls = _TrackedRLock if rlock else _TrackedLock
+    factory = (_sanitizers._real_rlock_factory if rlock
+               else _sanitizers._real_lock_factory)
+    return graph, [cls(factory(), graph=graph) for _ in range(n)]
+
+
+def test_lock_order_sanitizer_catches_abba():
+    """The induced ABBA deadlock: thread 1 takes A then B, thread 2 takes
+    B then A.  Both runs complete (sequential here), but the order graph
+    must report the cycle a concurrent interleaving would deadlock on."""
+    graph, (a, b) = _private_locks(2)
+
+    def a_then_b():
+        with a:
+            with b:
+                pass
+
+    def b_then_a():
+        with b:
+            with a:
+                pass
+
+    for body in (a_then_b, b_then_a):
+        t = threading.Thread(target=body, name="abba-probe")
+        t.start()
+        t.join()
+    cycle = find_cycle(graph.snapshot_edges())
+    assert cycle is not None
+    report = _sanitizers.format_cycle(cycle, graph.snapshot_edges())
+    assert "lock-order cycle" in report and "abba-probe" in report
+
+
+def test_lock_order_sanitizer_clean_on_consistent_order():
+    graph, (a, b) = _private_locks(2)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert find_cycle(graph.snapshot_edges()) is None
+
+
+def test_rlock_recursion_is_not_an_edge():
+    graph, (r,) = _private_locks(1, rlock=True)
+    with r:
+        with r:
+            pass
+    assert graph.snapshot_edges() == {}
+    assert graph.held.get(threading.get_ident(), []) == []
+
+
+def test_condition_wait_releases_held_stack():
+    """``Condition.wait`` fully releases the wrapped RLock via
+    ``_release_save``; the held stack must reflect that, or every lock
+    acquired while *waiting* (not holding) would fabricate edges."""
+    graph, (r,) = _private_locks(1, rlock=True)
+    cond = threading.Condition(r)
+    observed = {}
+
+    def waiter():
+        with cond:
+            observed["held_in_wait"] = None
+            cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter, name="cond-probe")
+    t.start()
+    import time
+
+    deadline = time.monotonic() + 5
+    while "held_in_wait" not in observed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # the waiter is inside wait(): its held stack must be empty and the
+    # lock acquirable from here without blocking
+    assert r.acquire(timeout=5)
+    with cond:
+        cond.notify_all()
+    r.release()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert all(not stack for stack in graph.held.values())
+
+
+def test_cross_thread_lock_handoff_tracked():
+    graph, (a,) = _private_locks(1)
+    a.acquire()
+
+    def releaser():
+        a.release()
+
+    t = threading.Thread(target=releaser, name="handoff-probe")
+    t.start()
+    t.join()
+    assert all(not stack for stack in graph.held.values())
+
+
+def test_tracked_locks_refuse_pickling_like_real_locks():
+    import pickle
+
+    _, (a,) = _private_locks(1)
+    with pytest.raises(TypeError):
+        pickle.dumps(a)
+
+
+def test_sanitizer_installed_in_this_suite():
+    if _sanitizers._disabled():
+        pytest.skip("BIGDL_TPU_NO_SANITIZE set")
+    assert threading.Lock is _sanitizers._tracked_lock
+    assert threading.RLock is _sanitizers._tracked_rlock
+    lock = threading.Lock()
+    assert isinstance(lock, _TrackedLock)
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_leaked_thread_detector_sees_abandoned_library_thread():
+    before = {t.ident for t in threading.enumerate()}
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="bigdl-leak-probe",
+                         daemon=True)
+    t.start()
+    try:
+        assert [lt.name for lt in leaked_library_threads(before)] \
+            == ["bigdl-leak-probe"]
+    finally:
+        release.set()
+        t.join(timeout=5)
+    assert leaked_library_threads(before) == []

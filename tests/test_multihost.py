@@ -71,6 +71,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow  # two real JAX-distributed worker processes
 def test_two_process_distributed_psum(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = tmp_path / "worker.py"

@@ -36,6 +36,7 @@ import pytest
 
 from bigdl_tpu import faults
 from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.utils.errors import fresh_exception
 from bigdl_tpu.obs import (
     FlightRecorder,
     MetricsEndpoint,
@@ -379,7 +380,7 @@ class _StubHandle:
 
     def result(self, timeout=None):
         if self.error is not None:
-            raise self.error
+            raise fresh_exception(self.error)  # per-call copy (GL001)
         return [1]
 
 

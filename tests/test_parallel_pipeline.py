@@ -379,6 +379,7 @@ def _proc_boom(t):
     return t
 
 
+@pytest.mark.slow  # spawns a real process pool (GL007)
 def test_process_pool_matches_thread_pool_bit_identical():
     elems = _imgs(_PROC_ELEMS)
     ref = list(ParallelTransformer(_aug_chain(), 2, base_seed=42)
@@ -393,6 +394,7 @@ def test_process_pool_matches_thread_pool_bit_identical():
     out[0][0][0, 0, 0] = 1
 
 
+@pytest.mark.slow  # spawns a real process pool (GL007)
 def test_process_pool_error_carries_remote_traceback():
     with pytest.raises(ValueError, match="proc kaboom") as ei:
         list(ParallelTransformer(FunctionTransformer(_proc_boom), 2,
@@ -408,6 +410,7 @@ def _proc_hard_exit(t):
     return t
 
 
+@pytest.mark.slow  # spawns a real process pool (GL007)
 def test_process_pool_dead_worker_raises_instead_of_hanging():
     """Ordered mode: the owning worker of the queue being awaited dying
     without its end sentinel must raise, even while sibling workers are
@@ -430,6 +433,7 @@ def test_process_pool_dead_worker_raises_instead_of_hanging():
     assert "died without reporting" in str(result["error"])
 
 
+@pytest.mark.slow  # spawns a real process pool (GL007)
 def test_process_pool_abandonment_bounded_join():
     gen = ParallelTransformer(_aug_chain(), 2, processes=True,
                               join_timeout=10).apply(iter(_imgs() * 30))
@@ -572,6 +576,7 @@ def _proc_flaky(t, flag_dir=None):
     return t
 
 
+@pytest.mark.slow  # spawns a real process pool (GL007)
 def test_process_pool_supervision_heals_transient(tmp_path):
     """Process workers supervise themselves: a fail-once element is
     replayed by the restarted worker and the stream completes bit-equal

@@ -156,16 +156,16 @@ def test_evaluator_requires_labels(setup):
 
 def test_prediction_service_concurrent(setup):
     model, params, state, x, _ = setup
-    svc = PredictionService(model, params, state, n_concurrent=3)
-    outs = [None] * 12
-    def call(i):
-        outs[i] = svc.predict(x[i])
-    threads = [threading.Thread(target=call, args=(i,)) for i in range(12)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    assert svc.served == 12
+    with PredictionService(model, params, state, n_concurrent=3) as svc:
+        outs = [None] * 12
+        def call(i):
+            outs[i] = svc.predict(x[i])
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert svc.served == 12
     full, _ = model.apply(params, x, state=state)
     for i in (0, 5, 11):
         np.testing.assert_allclose(outs[i], np.asarray(full)[i], rtol=1e-5)
@@ -173,6 +173,6 @@ def test_prediction_service_concurrent(setup):
 
 def test_prediction_service_accepts_sample(setup):
     model, params, state, x, y = setup
-    svc = PredictionService(model, params, state)
-    out = svc.predict(Sample.of(x[0], y[0]))
+    with PredictionService(model, params, state) as svc:
+        out = svc.predict(Sample.of(x[0], y[0]))
     assert out.shape == (4,)
