@@ -31,6 +31,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import jax
@@ -220,6 +221,32 @@ def _write_metrics_out(args, sources):
     with open(path, "w") as fh:
         fh.write(to_json(reg.collect(), indent=2) + "\n")
     print(f"metrics-out: wrote {path}", file=sys.stderr)
+
+
+def _wait_until(pred, timeout, interval=0.05):
+    """Deadline-bounded wait on a predicate over FOREIGN state (another
+    object's gauges, a prober's side effects) that exposes no Condition
+    to hook. Parks on an ``Event.wait`` slice per check instead of a
+    bare sleep — interruptible, never waits past the deadline, and
+    returns the predicate's final value."""
+    gate = threading.Event()
+    deadline = time.monotonic() + timeout
+    while not pred():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return bool(pred())
+        gate.wait(min(interval, remaining))
+    return True
+
+
+def _join_threads(prefixes, timeout):
+    """Join every live thread whose name starts with ``prefixes``, under
+    one shared deadline — condition-woken (``join`` returns the instant
+    the thread exits), so a clean drain costs no polling interval."""
+    deadline = time.monotonic() + timeout
+    for t in threading.enumerate():
+        if t.name.startswith(prefixes) and t is not threading.main_thread():
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 def run_serving_bench(args):
@@ -975,6 +1002,95 @@ def run_generation_bench(args):
             "disagg_wall_s": round(dz_wall, 3),
         }
 
+    # KV-tier column (PR 18): the working set the host tier exists for —
+    # a prefix library ~10x the DEVICE pool (20 two-page families vs a
+    # 4-page pool), replayed twice. Round one publishes each family and
+    # the pool's LRU pressure evicts every one of them; with
+    # --host-pages the evictions offload to the HostPageStore instead of
+    # vanishing, so round two's revisits restore host->device and skip
+    # their covered chunks, where the no-host leg re-prefills from
+    # scratch. Prompt kernels carry the same fixed modeled cost as the
+    # prefix leg; TTFT is measured client-side on the revisit round
+    # only. Gates under --smoke: effective hit-rate > 0 where the
+    # no-host leg scores ~0, restored-prefix TTFT p50 < full re-prefill
+    # TTFT p50, ZERO mismatches between the legs, and both tiers
+    # drained at close.
+    host_fields = {}
+    host_store_obj = None
+    if args.host_pages > 0:
+        kv_fams, kv_fam_pages = 20, 2
+        kv_fam_len = kv_fam_pages * page_size
+        kv_device_pages = 4          # one 3-page lane + 1 spare
+        hi = 200 if not on_tpu else 8000
+        kv_rs = np.random.RandomState(6)
+        kv_families = [kv_rs.randint(1, hi, (kv_fam_len,)).tolist()
+                       for _ in range(kv_fams)]
+        kv_round1 = [f + kv_rs.randint(1, hi, (3,)).tolist()
+                     for f in kv_families]
+        kv_round2 = [f + kv_rs.randint(1, hi, (3,)).tolist()
+                     for f in kv_families]
+        kv_new = short_new + 2
+        kv_prompt_cost_ms = 4.0
+
+        def run_kv_leg(host_pages):
+            eng = GenerationEngine(
+                model, params, max_slots=1,
+                max_len=max(max_len, kv_fam_len + 8 + kv_new),
+                max_prompt_len=kv_fam_len + 8,
+                max_queue=max(64, 4 * kv_fams),
+                kernels=_FixedCostKernels(kernels, 0.0,
+                                          kv_prompt_cost_ms / 1e3),
+                page_size=page_size, prefill_chunk=page_size, seed=0,
+                cache_dtype=kv_dtype, quantize=quantize,
+                metrics=ServingMetrics(), prefix_cache=True,
+                num_pages=kv_device_pages, host_pages=host_pages)
+            eng.warmup()
+            outs = [eng.submit(p, max_new_tokens=kv_new,
+                               **sample_spec).result(timeout=600)
+                    for p in kv_round1]
+            ttfts = []
+            for p in kv_round2:
+                t0 = time.perf_counter()
+                s = eng.submit(p, max_new_tokens=kv_new, **sample_spec)
+                it = iter(s)
+                toks = [next(it)]
+                ttfts.append((time.perf_counter() - t0) * 1e3)
+                toks.extend(it)
+                outs.append(toks)
+            leg_snap = eng.metrics.snapshot()
+            host = eng.host_store
+            eng.close()
+            drained = (eng.pages_in_use == 0 and eng.shared_pages == 0
+                       and (host is None or host.pages == 0))
+            ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
+            return outs, leg_snap, ttft_p50, host, drained
+
+        kv_off_outs, kv_off_snap, kv_off_ttft, _, kv_off_drained = \
+            run_kv_leg(None)
+        kv_on_outs, kv_on_snap, kv_on_ttft, host_store_obj, \
+            kv_on_drained = run_kv_leg(args.host_pages)
+        kv_mismatches = sum(1 for a, b in zip(kv_off_outs, kv_on_outs)
+                            if a != b)
+        host_fields = {
+            "host_pages": args.host_pages,
+            "host_device_pages": kv_device_pages,
+            "host_working_set_pages": kv_fams * kv_fam_pages,
+            "host_working_set_vs_device": round(
+                kv_fams * kv_fam_pages / kv_device_pages, 2),
+            "host_offloaded_pages": kv_on_snap["kv_offload_pages"],
+            "host_restored_pages": kv_on_snap["kv_restore_pages"],
+            "host_pages_peak": kv_on_snap["host_pages_peak"],
+            "host_hit_rate_on": round(kv_on_snap["prefix_hit_rate"], 4),
+            "host_hit_rate_off": round(kv_off_snap["prefix_hit_rate"], 4),
+            "host_revisit_ttft_p50_on_ms": round(kv_on_ttft, 3),
+            "host_revisit_ttft_p50_off_ms": round(kv_off_ttft, 3),
+            "host_ttft_reduction": round(kv_off_ttft / kv_on_ttft, 3)
+            if kv_on_ttft else None,
+            "host_prompt_cost_ms": kv_prompt_cost_ms,
+            "host_mismatches": kv_mismatches,
+            "host_tiers_drained": kv_on_drained and kv_off_drained,
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -1021,6 +1137,7 @@ def run_generation_bench(args):
         **spec_fields,
         **prefix_fields,
         **disagg_fields,
+        **host_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -1032,6 +1149,7 @@ def run_generation_bench(args):
                               "timeline": engine.timeline,
                               "prefix": prefix_cache_obj,
                               "disagg": disagg_metrics,
+                              "kv_host": host_store_obj,
                               "bench": result})
     print(json.dumps(result))
     if smoke:
@@ -1152,6 +1270,37 @@ def run_generation_bench(args):
                     % (result["disagg_itl_p99_ms"] or -1,
                        result["mono_itl_p99_ms"] or -1,
                        result["disagg_itl_p99_vs_mono"]))
+        if args.host_pages > 0:
+            if result["host_mismatches"]:
+                raise SystemExit(
+                    "kv-tier smoke: %d request(s) decoded different tokens "
+                    "with the host tier on vs off — an offloaded page must "
+                    "restore the same bits a fresh prefill writes; output "
+                    "must be BIT-identical" % result["host_mismatches"])
+            if not result["host_tiers_drained"]:
+                raise SystemExit(
+                    "kv-tier smoke: a tier still holds pages after every "
+                    "stream resolved — offload/restore/swap must drain "
+                    "BOTH tiers' gauges to zero at close")
+            if result["host_restored_pages"] < 1 or \
+                    result["host_hit_rate_on"] <= 0:
+                raise SystemExit(
+                    "kv-tier smoke: %d pages restored, effective hit rate "
+                    "%.2f at a %.0fx-device working set (gate: restores "
+                    "> 0 and hit rate > 0 — the host tier must actually "
+                    "serve the revisits the device pool evicted)"
+                    % (result["host_restored_pages"],
+                       result["host_hit_rate_on"],
+                       result["host_working_set_vs_device"]))
+            if result["host_revisit_ttft_p50_on_ms"] >= \
+                    result["host_revisit_ttft_p50_off_ms"]:
+                raise SystemExit(
+                    "kv-tier smoke: revisit TTFT p50 %.2f ms with the host "
+                    "tier vs %.2f ms re-prefilling (gate: restored < "
+                    "re-prefill — a restore must skip the covered chunks, "
+                    "not just move bytes)"
+                    % (result["host_revisit_ttft_p50_on_ms"],
+                       result["host_revisit_ttft_p50_off_ms"]))
 
 
 def run_lm_bench(args):
@@ -1926,9 +2075,7 @@ def run_chaos_bench(args):
     # self-healing moment: the schedule is exhausted; transiently-evicted
     # replicas rejoin via the backoff-paced prober (the permanently dead
     # one keeps failing its probe and stays quarantined)
-    heal_deadline = time.monotonic() + 20
-    while not rset.healthy_replicas and time.monotonic() < heal_deadline:
-        time.sleep(0.05)
+    _wait_until(lambda: rset.healthy_replicas, timeout=20)
     healthy_after_heal = list(rset.healthy_replicas)
     if not healthy_after_heal:
         violations.append("serve: no replica rejoined after the fault "
@@ -2078,6 +2225,86 @@ def run_chaos_bench(args):
             f"(shared={pfx_shared_after}, in_use="
             f"{pfx_engine.pages_in_use}) — refcounts must release and "
             f"shared_pages drain to 0")
+
+    # --------------------------------------------- KV-tier leg (PR 18) ----
+    # PR 18: faults at the host-tier copy sites, per page-block copy. A
+    # kv.offload fault drops ONLY the affected entry — the page evicts
+    # plainly and the stream that triggered the eviction is untouched;
+    # a kv.restore fault degrades the matched chain to a miss and the
+    # request re-prefills the SAME bits; and after both schedules BOTH
+    # tiers' gauges drain to zero — nothing strands on either side of
+    # the tier boundary.
+    kv_host_pages = args.host_pages or 16
+    kv_ref = GenerationEngine(
+        model, params, max_slots=2, max_len=max_len, max_prompt_len=20,
+        max_queue=4 * n_requests, kernels=kernels, page_size=8,
+        seed=seed, metrics=ServingMetrics())
+    kv_ref.warmup()
+    kv_engine = GenerationEngine(
+        model, params, max_slots=2, max_len=max_len, max_prompt_len=20,
+        max_queue=4 * n_requests, kernels=kernels, page_size=8,
+        seed=seed, num_pages=4, metrics=ServingMetrics(),
+        prefix_cache=True, host_pages=kv_host_pages)
+    kv_engine.warmup()
+    kv_rs = np.random.RandomState(seed + 9)
+    # three 2-page prefix families against a 4-page pool: every later
+    # admission evicts the previous family, so each pass offloads (or,
+    # under the armed fault, drops) its predecessors' pages
+    kv_families = [kv_rs.randint(1, 60, (16,)).tolist() for _ in range(3)]
+
+    def kv_pass(tail):
+        outs = []
+        for f in kv_families:
+            p = f + tail
+            got = kv_engine.generate(p, max_new_tokens=3, timeout=60)
+            if got != kv_ref.generate(p, max_new_tokens=3, timeout=60):
+                violations.append(
+                    f"kvtier: stream bits diverged from the no-host "
+                    f"reference on tail {tail}")
+            outs.append(got)
+        return outs
+
+    faults.arm("kv.offload",
+               only=lambda engine=None, **_: engine is kv_engine)
+    kv_pass([1, 2])
+    kv_host = kv_engine.host_store
+    if kv_host.offloaded_pages or kv_host.pages:
+        violations.append(
+            f"kvtier: pages reached the host tier through a faulted "
+            f"offload copy (offloaded={kv_host.offloaded_pages}, "
+            f"resident={kv_host.pages})")
+    kv_offload_dropped = kv_host.dropped_pages
+    if kv_offload_dropped < 1:
+        violations.append("kvtier: the armed offload fault never "
+                          "dropped an entry")
+    faults.disarm("kv.offload")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
+    faults.reset()
+    kv_pass([3, 4])          # clean pass: re-publish, offload for real
+    if kv_host.offloaded_pages < 1:
+        violations.append("kvtier: no pages offloaded once the fault "
+                          "was disarmed")
+    faults.arm("kv.restore", nth=1, times=1,
+               only=lambda engine=None, kind=None, **_:
+               engine is kv_engine and kind == "prefix")
+    kv_pass([5, 6])          # first revisit degrades to a miss, bits intact
+    faults.disarm("kv.restore")
+    fired_expected += sum(v["fired"] for v in faults.snapshot().values())
+    faults.reset()
+    kv_restored = kv_host.restored_pages
+    kv_degraded = kv_host.dropped_pages - kv_offload_dropped
+    if kv_degraded < 1:
+        violations.append("kvtier: the armed restore fault never "
+                          "degraded a host entry to a miss")
+    kv_ref.close()
+    kv_engine.close()
+    kv_host_after = kv_host.pages
+    if kv_engine.pages_in_use or kv_engine.shared_pages or kv_host_after:
+        violations.append(
+            f"kvtier: pages stranded after the fault schedule "
+            f"(device={kv_engine.pages_in_use}, "
+            f"shared={kv_engine.shared_pages}, host={kv_host_after}) — "
+            f"both tiers must drain to zero")
 
     # -------------------------------------------- disaggregation leg (PR 15) ----
     # A fault at the engine.page_handoff site (mid-handoff, after the
@@ -2295,11 +2522,8 @@ def run_chaos_bench(args):
         child.close(drain=False, timeout=5)
 
     # ----------------------------------------------------------- drain ----
-    deadline = time.monotonic() + 15
+    _join_threads(("bigdl-", "ckpt-writer", "pipeline-"), timeout=15)
     leftover = own_threads()
-    while leftover and time.monotonic() < deadline:
-        time.sleep(0.1)
-        leftover = own_threads()
     if leftover:
         violations.append(f"drain: bigdl threads still alive: {leftover}")
     shm_leaked = []
@@ -2342,6 +2566,11 @@ def run_chaos_bench(args):
         "prefix_attach_fault_failed_streams": pfx_injected,
         "prefix_hits": pfx_snap["prefix_hits"],
         "prefix_shared_pages_after_fault": pfx_shared_after,
+        "kv_offload_fault_dropped_pages": kv_offload_dropped,
+        "kv_restore_fault_degraded_pages": kv_degraded,
+        "kv_offloaded_pages": kv_host.offloaded_pages,
+        "kv_restored_pages": kv_restored,
+        "kv_host_pages_after_close": kv_host_after,
         "disagg_handoff_faults_failed_streams": dz_injected,
         "disagg_child_faults_fired": dz_child_fired,
         "disagg_child_faults_recorded": dz_child_recorded,
@@ -2370,6 +2599,7 @@ def run_chaos_bench(args):
     _write_metrics_out(args, {"serving": replicas[0].metrics,
                               "speculative": spec_engine.metrics,
                               "prefix": pfx_engine._prefix,
+                              "kv_host": kv_host,
                               "disagg": dz.metrics,
                               "bench": result})
     print(json.dumps(result))
@@ -2561,9 +2791,7 @@ def run_fleet_bench(args):
             records.append(rec)
         # retirement runs between decode steps; give the loops a beat
         # to hand every page back before the stranding check
-        deadline = time.monotonic() + 10
-        while fleet.pages_in_use() and time.monotonic() < deadline:
-            time.sleep(0.05)
+        _wait_until(lambda: not fleet.pages_in_use(), timeout=10)
         return records, fleet.pages_in_use()
 
     def met(rec):
@@ -2683,11 +2911,8 @@ def run_fleet_bench(args):
     bad_errors = [r["outcome"] for r in static_records + auto_records
                   if r["outcome"].startswith("BAD:")]
 
-    deadline = time.monotonic() + 15
+    _join_threads("bigdl-", timeout=15)
     leftover = own_threads()
-    while leftover and time.monotonic() < deadline:
-        time.sleep(0.1)
-        leftover = own_threads()
     children = [p.name for p in multiprocessing.active_children()]
 
     static_att = leg_fields("static", static_records)
@@ -2877,6 +3102,19 @@ def _parse_args(argv=None):
                          "costs; --smoke gates decode ITL p99 <= 0.7x "
                          "monolithic, zero output mismatches (the handoff "
                          "must be bit-exact), and drained role pools")
+    ap.add_argument("--host-pages", type=int, default=0,
+                    help="serving --generate: add the KV-tier column — a "
+                         "prefix working set ~10x the device pool replayed "
+                         "twice through a host-tier engine (HostPageStore "
+                         "of this many pages beneath a 4-page device pool) "
+                         "vs the same engine with no host tier; --smoke "
+                         "gates effective hit-rate > 0, restored-prefix "
+                         "TTFT p50 < full re-prefill TTFT p50, zero "
+                         "output mismatches (offload->restore must be "
+                         "bit-identical), and both tiers drained at close; "
+                         "--mode chaos: arm kv.offload/kv.restore over the "
+                         "same replay and gate both tiers draining to zero "
+                         "under injected copy faults")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

@@ -72,6 +72,21 @@ SITES: Dict[str, str] = {
                     "round before the k+1 draft steps (ctx: engine=)",
     "engine.verify": "GenerationEngine speculative target verify step, "
                      "once per round (ctx: engine=)",
+    "kv.offload": "host-tier page offload, once per page-block copy — "
+                  "kind='prefix' before an evicted prefix page's device "
+                  "gather dispatches, kind='swap' before a stream "
+                  "swap-out's block gathers; a fault drops ONLY the "
+                  "affected entry/swap (the page evicts plainly, the "
+                  "stream stays resident) — nothing strands in either "
+                  "tier (ctx: engine=, kind=)",
+    "kv.restore": "host-tier page restore, once per host->device "
+                  "page-block copy — kind='prefix' before a restored "
+                  "chain allocates device pages (a fault degrades the "
+                  "affected entries to a miss and drops them from the "
+                  "host store; the request re-prefills), kind='swap' "
+                  "before a parked stream's resume adoption (a fault "
+                  "fails ONLY that stream; its pages release) "
+                  "(ctx: engine=, kind=)",
     "feed.producer": "SocketFeedDataSet producer reader, once per frame "
                      "(key = frame index)",
     "rpc.connect": "RemoteReplica client connect attempt "

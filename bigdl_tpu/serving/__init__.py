@@ -67,6 +67,15 @@ jitted gather/scatter between pools (``PagePool.export_pages`` /
 the RPC fabric. Streams are bit-identical to the monolithic engine
 (see README "Disaggregated prefill/decode").
 
+The KV memory hierarchy (PR 18) adds a host tier beneath the device
+``PagePool``: ``host_pages=N`` on a paged, prefix-cached engine spills
+LRU-evicted prefix pages to a :class:`HostPageStore` (async,
+double-buffered device→host copies overlapped with decode) and
+restores them bit-identically on a later hit; ``submit(priority=)``
+lets the engine swap out a low-priority idle stream's pages to host to
+admit a blocked higher-priority request, resuming the parked stream
+byte-exact (see README "KV memory hierarchy").
+
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """
@@ -92,6 +101,7 @@ from bigdl_tpu.serving.engine import (
     SpeculativeKernels,
     static_generate,
 )
+from bigdl_tpu.serving.kv_tiers import HostPageStore
 from bigdl_tpu.serving.paging import PagePool
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.errors import (
@@ -128,6 +138,7 @@ __all__ = [
     "PageBlockMover",
     "PrefillWorker",
     "GenerationStream",
+    "HostPageStore",
     "InferenceService",
     "ModelRouter",
     "Overloaded",

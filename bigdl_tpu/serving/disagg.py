@@ -41,7 +41,7 @@ the RPC path.
 from __future__ import annotations
 
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -166,6 +166,7 @@ class DisaggregatedEngine:
         tracer = shared.pop("tracer", None)
         prefix = bool(shared.pop("prefix_cache", False))
         cam = bool(shared.pop("cache_aware_admission", False))
+        host_pages = shared.pop("host_pages", None)
 
         decode_kw = dict(shared)
         decode_kw["metrics"] = metrics or ServingMetrics()
@@ -181,6 +182,10 @@ class DisaggregatedEngine:
             prefill_kw["prefix_cache"] = prefix
             prefill_kw["cache_aware_admission"] = cam
             prefill_kw["tracer"] = tracer
+            if host_pages is not None:
+                # the host tier hangs off the prefix index, which lives
+                # with the prefill role in the disaggregated split
+                prefill_kw["host_pages"] = host_pages
             prefill_kw.update(prefill_overrides or {})
             self._prefill = GenerationEngine(model, params, role="prefill",
                                              **prefill_kw)
@@ -388,7 +393,7 @@ class PrefillWorker:
                     fut.set_result({"complete": True,
                                     "tokens": np.asarray(s.tokens,
                                                          np.int32)})
-            except Exception:
+            except InvalidStateError:
                 pass  # lost the race with the handoff resolution
 
         inner.add_done_callback(relay)
@@ -409,8 +414,8 @@ class PrefillWorker:
         if not fut.done():
             try:
                 fut.set_result(payload)
-            except Exception:
-                pass
+            except InvalidStateError:
+                pass  # the relay resolved it between the check and here
 
     def reload(self, params, state=None) -> None:
         self.engine.reload(params, state)

@@ -108,6 +108,7 @@ from bigdl_tpu.serving.errors import (
     Overloaded,
     StreamCancelled,
 )
+from bigdl_tpu.serving.kv_tiers import HostPageStore
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.paging import PagePool, page_bytes, pages_per_lane
 from bigdl_tpu.serving.prefix_cache import PrefixCache
@@ -659,15 +660,39 @@ class GenerationStream:
         return None if self.t_first is None else self.t_first - self.t_submit
 
 
+def _start_host_copy(leaf):
+    """Kick an async device->host transfer for one gathered block leaf
+    (the offload double-buffer overlaps with decode steps; the drain
+    poll reads it back with ``np.asarray`` once landed). Best-effort:
+    backends without the API just pay the copy at read time."""
+    try:
+        leaf.copy_to_host_async()
+    except (AttributeError, NotImplementedError, RuntimeError):
+        pass
+    return leaf
+
+
+def _block_ready(block) -> bool:
+    """True when every leaf of a gathered block has its data available
+    (the non-blocking completion poll between scheduler iterations)."""
+    for leaf in jax.tree_util.tree_leaves(block):
+        ready = getattr(leaf, "is_ready", None)
+        if ready is not None and not ready():
+            return False
+    return True
+
+
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "deadline", "stream",
-                 "temperature", "top_k", "top_p", "seed", "tag", "handoff")
+                 "temperature", "top_k", "top_p", "seed", "tag", "handoff",
+                 "priority")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  deadline: Optional[float], stream: GenerationStream,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: Optional[int] = None,
-                 tag: Any = None, handoff: Optional[dict] = None):
+                 tag: Any = None, handoff: Optional[dict] = None,
+                 priority: int = 0):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
@@ -678,6 +703,9 @@ class _GenRequest:
         self.seed = seed
         self.tag = tag            # opaque caller context, rides the handoff
         self.handoff = handoff    # adopt payload (decode-role admission)
+        self.priority = int(priority)  # QoS tier (PR 18): a page-blocked
+        #                                higher-priority head may swap out
+        #                                lower-priority active streams
 
     @property
     def sampled(self) -> bool:
@@ -765,7 +793,14 @@ def _fail_streams(core: _Core, error: BaseException,
             engine._prefix.clear()
             if engine._dprefix is not None:
                 engine._dprefix.clear()
-        if states or engine._prefix is not None:
+        if engine._host is not None:
+            # the host tier drains with the device tier: in-flight
+            # offload copies drop (their device pages already evicted
+            # cleanly) and every resident entry/booking releases, so
+            # both tiers' gauges reach zero together (chaos drain gate)
+            engine._pending_offloads.clear()
+            engine._host.clear()
+        if states or engine._prefix is not None or engine._host is not None:
             engine._report_pages()
     for r in reqs:
         if not r.stream.done:
@@ -879,6 +914,7 @@ class GenerationEngine:
                  speculate: Optional[tuple] = None,
                  prefix_cache: bool = False,
                  cache_aware_admission: bool = False,
+                 host_pages: Optional[int] = None,
                  role: str = "both",
                  tracer=None,
                  timeline_capacity: int = 512,
@@ -1116,6 +1152,38 @@ class GenerationEngine:
                     "the prefix index lives with the prefill role (pages "
                     "are published where prompts are written); pass "
                     "prefix_cache=True to the prefill engine instead")
+        # two-tier KV (PR 18): host_pages=N backs the device pool with a
+        # HostPageStore — prefix chains the device index would evict LRU
+        # offload to host RAM instead (async device->host, double-
+        # buffered, polled between iterations) and restore on a later
+        # hit bit-identically; a page-blocked higher-priority head may
+        # swap OUT a lower-priority active stream through the same tier.
+        self._host: Optional[HostPageStore] = None
+        self._pending_offloads: List[dict] = []
+        self._offload_inflight_cap = 2   # double-buffer: never more
+        #                                  in-flight copies than overlap
+        self._swap_seq = 0               # swap booking ids (engine-local)
+        if host_pages is not None:
+            if not self.paged:
+                raise ValueError(
+                    "host_pages needs the paged engine (the host tier "
+                    "stores physical KV pages; the dense slot-lane path "
+                    "has none)")
+            if self.speculative:
+                raise ValueError(
+                    "host_pages excludes speculative decoding (draft-"
+                    "lane pages do not offload yet)")
+            if self.role == "decode":
+                raise ValueError(
+                    "the host tier lives with the prefix index "
+                    "(prefill/both roles — pages offload where prompts "
+                    "are written); pass host_pages to the prefill "
+                    "engine instead")
+            if not self.prefix_caching:
+                raise ValueError(
+                    "host_pages needs prefix_cache=True — the host tier "
+                    "is indexed by the same (version, prefix) radix keys "
+                    "the device prefix index files pages under")
         if self.paged:
             # chunked prefill lifts the prompt-length wall: anything that
             # leaves room for one generated token is admitted and chunked
@@ -1198,11 +1266,15 @@ class GenerationEngine:
                 self._prefix = PrefixCache(self._pool, name="target")
                 if self.speculative:
                     self._dprefix = PrefixCache(self._pool, name="draft")
-            if self.role != "both":
-                # gather (prefill export) / scatter (decode adopt) jits:
-                # one executable each per role, counted like the kernel
-                # triples (compile-once is test-pinned per role). Lazy
-                # import: disagg.py imports this module at its top.
+            if host_pages is not None:
+                self._host = HostPageStore(
+                    int(host_pages), page_bytes=self._kv_page_bytes)
+            if self.role != "both" or self._host is not None:
+                # gather (prefill export / host offload) / scatter
+                # (decode adopt / host restore) jits: one executable
+                # each, counted like the kernel triples (compile-once is
+                # test-pinned). Lazy import: disagg.py imports this
+                # module at its top.
                 from bigdl_tpu.serving.disagg import PageBlockMover
 
                 self._mover = PageBlockMover(
@@ -1259,7 +1331,8 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0,
                seed: Optional[int] = None,
-               tag: Any = None) -> GenerationStream:
+               tag: Any = None,
+               priority: int = 0) -> GenerationStream:
         """Enqueue one prompt (sequence of token ids). ``max_new_tokens``
         caps generation (default: whatever fits in ``max_len``);
         ``deadline`` is seconds from now — an expired request retires
@@ -1276,7 +1349,15 @@ class GenerationEngine:
 
         ``tag`` is an opaque caller context that rides the request into
         a prefill-role engine's handoff payload (the DisaggregatedEngine
-        threads its per-request routing state through it)."""
+        threads its per-request routing state through it).
+
+        ``priority`` (QoS, PR 18; meaningful on a host-tier engine —
+        inert otherwise): when this request heads the FIFO queue
+        page-blocked and nothing else frees room, active streams of
+        STRICTLY lower priority may swap out through the host tier to
+        admit it; they resume byte-exactly once pages free. Equal
+        priorities never displace each other — default-0 traffic is
+        plain FIFO."""
         if self.role == "decode":
             raise RuntimeError(
                 "a decode-role engine admits only prefilled requests "
@@ -1327,7 +1408,7 @@ class GenerationEngine:
                           stream, temperature=temperature, top_k=int(top_k),
                           top_p=float(top_p),
                           seed=None if seed is None else int(seed),
-                          tag=tag)
+                          tag=tag, priority=int(priority))
         core = self._core
         try:
             with core.cond:
@@ -1409,7 +1490,8 @@ class GenerationEngine:
                           temperature=float(payload.get("temperature", 0.0)),
                           top_k=int(payload.get("top_k", 0)),
                           top_p=float(payload.get("top_p", 1.0)),
-                          handoff=payload)
+                          handoff=payload,
+                          priority=int(payload.get("priority", 0)))
         core = self._core
         with core.cond:
             if self._failed is not None:
@@ -1472,11 +1554,25 @@ class GenerationEngine:
             self._prefix.clear()
             if self._dprefix is not None:
                 self._dprefix.clear()
+            if self._host is not None:
+                # host entries are keyed by the OLD index version and
+                # can never match again — drop them (and any copies
+                # still in flight) so the tier gauge drains with the
+                # device index
+                self._pending_offloads.clear()
+                self._host.clear()
             self._evict_stale = False
             self._report_pages()
+        if self._pending_offloads:
+            # reap landed device->host offload copies between
+            # iterations — a non-blocking poll; a copy still in flight
+            # waits for the next iteration, never a decode step
+            self._drain_offloads()
         prefill_s = decode_s = verify_s = 0.0
         core = self._core
         while True:
+            swap_head = None
+            swap_need = 0
             with core.cond:
                 if not core.pending or not core.free:
                     break
@@ -1495,17 +1591,29 @@ class GenerationEngine:
                         # the head waits)
                         bypass = self._pick_bypass()
                         if bypass is None:
-                            break
-                        take = bypass
-                if take == 0:
-                    self._head_bypasses = 0
-                    req = core.pending.popleft()
-                else:
-                    self._head_bypasses += 1
-                    self.admission_bypasses += 1
-                    req = core.pending[take]
-                    del core.pending[take]
-                depth = len(core.pending)
+                            # last resort before the FIFO wait: a host-
+                            # tier engine may swap OUT lower-priority
+                            # active streams for the head (QoS, PR 18)
+                            # — decided outside the lock below, then
+                            # the head re-evaluates
+                            swap_head = core.pending[0]
+                            swap_need = need_alloc
+                        else:
+                            take = bypass
+                if swap_head is None:
+                    if take == 0:
+                        self._head_bypasses = 0
+                        req = core.pending.popleft()
+                    else:
+                        self._head_bypasses += 1
+                        self.admission_bypasses += 1
+                        req = core.pending[take]
+                        del core.pending[take]
+                    depth = len(core.pending)
+            if swap_head is not None:
+                if self._swap_out_for(swap_head, swap_need):
+                    continue
+                break
             self.metrics.set_queue_depth(depth)
             if req.handoff is not None:
                 self._admit_prefilled(req)
@@ -1577,6 +1685,9 @@ class GenerationEngine:
                 self._prefix.pages
                 + (self._dprefix.pages if self._dprefix is not None
                    else 0))
+        if self._host is not None:
+            self.metrics.set_host_pages(self._host.pages,
+                                        self._host.bytes_used)
         if not self._kv_page_bytes:
             return
         if self.speculative:
@@ -1656,13 +1767,271 @@ class GenerationEngine:
             protect.update(pr[1])
         shortfall = need_alloc - self._pool.free_pages
         freed = 0
+        # host tier (PR 18): target-lane victims offload instead of
+        # vanishing — the hook dispatches each page's device gather
+        # BEFORE evict() releases it (speculative engines never have a
+        # host tier, so the draft lane below stays hook-less)
+        on_evict = self._offload_page if self._host is not None else None
         for cache in (self._prefix, self._dprefix):
             if cache is None or shortfall <= freed:
                 break
-            freed += cache.evict(shortfall - freed, frozenset(protect))
+            freed += cache.evict(shortfall - freed, frozenset(protect),
+                                 on_evict=on_evict)
+            on_evict = None
         if freed == 0:
             self._evict_stale = True
         return self._pool.can_reserve(need_alloc)
+
+    # ------------------------------------------------ host tier (PR 18) ----
+
+    def _offload_page(self, prefix: Tuple[int, ...], page: int) -> None:
+        """Prefix-eviction hook (``PrefixCache.evict`` ``on_evict``):
+        gather the victim page into a fixed-shape device block — the
+        SAME jitted gather the disaggregation handoff compiles, row 0
+        real, the rest trash — and start its async device->host copy.
+        Runs BEFORE evict() releases the page, so the pure-read gather
+        can never race the page's next owner (donation waits on pending
+        readers). Completion is polled between scheduler iterations
+        (``_drain_offloads``); at most ``_offload_inflight_cap`` copies
+        are ever in flight — past the cap the page just evicts (the
+        pre-PR-18 behaviour), counted as a drop. Must not raise: the
+        eviction proceeds regardless."""
+        if len(self._pending_offloads) >= self._offload_inflight_cap:
+            self._drain_offloads()   # non-blocking: reap what landed
+        if len(self._pending_offloads) >= self._offload_inflight_cap:
+            self._host.record_drop()
+            self.metrics.record_offload_dropped()
+            return
+        try:
+            faults.fire("kv.offload", engine=self, kind="prefix")
+        except BaseException as exc:
+            # fault-injected copy failure: the page evicts plainly
+            # (never strands in either tier), only this entry is lost
+            log.debug("kv.offload copy faulted; entry dropped: %s", exc)
+            self._host.record_drop()
+            self.metrics.record_offload_dropped()
+            return
+        idx = np.full((self._pool.pages_per_slot,), self._pool.trash,
+                      np.int32)
+        idx[0] = page
+        block = self._mover.gather(self._cache, idx)
+        jax.tree_util.tree_map(_start_host_copy, block)
+        self._pending_offloads.append({
+            "kind": "prefix", "key": tuple(prefix),
+            "version": self._prefix.version, "block": block})
+
+    def _drain_offloads(self, wait: bool = False) -> None:
+        """Reap finished device->host offload copies, FIFO. Non-blocking
+        by default (one poll per scheduler iteration — an unfinished
+        copy waits, a decode step never does); ``wait=True`` blocks
+        until everything lands (tests and drain paths only)."""
+        host = self._host
+        drained = False
+        while self._pending_offloads:
+            entry = self._pending_offloads[0]
+            block = (entry["block"] if entry["kind"] == "prefix"
+                     else entry["payload"]["block"])
+            if not wait and not _block_ready(block):
+                break
+            self._pending_offloads.pop(0)
+            drained = True
+            if entry["kind"] == "prefix":
+                if entry["version"] != self._prefix.version:
+                    # a reload flush raced the copy: bytes the OLD
+                    # params wrote must not enter the host index
+                    host.record_drop()
+                    self.metrics.record_offload_dropped()
+                    continue
+                rows = jax.tree_util.tree_map(
+                    lambda leaf: np.asarray(leaf[0]), entry["block"])
+                host.put_prefix(entry["version"], entry["key"], rows)
+                self.metrics.record_offload(1)
+            else:
+                # swap payload: the block's device buffers release once
+                # the rows live host-side (the payload itself already
+                # rides the re-queued resume request; device_put at
+                # adoption uploads np leaves identically)
+                payload = entry["payload"]
+                payload["block"] = jax.tree_util.tree_map(
+                    lambda leaf: np.asarray(leaf), payload["block"])
+        if drained:
+            self._report_pages()
+
+    def _restore_prefix(self, req: _GenRequest, cached_len: int
+                        ) -> Tuple[List[int], int]:
+        """Extend a device prefix hit from the HOST tier: consecutive
+        page-aligned chunks past the device hit whose bytes were
+        offloaded come back host->device — fresh pages allocate, ONE
+        batched scatter (the warmed executable) writes them, and the
+        chunks REPUBLISH into the device index, so the attach in
+        ``_admit_paged`` sees them exactly as never-evicted entries
+        (the copy is a memcpy both ways — bit-identity is free, int8
+        scale pools ride as ordinary leaves). Returns ``(restored
+        pages, new cached_len)``; the restored pages replace tail
+        allocations one for one, so the admission gate's reservation
+        arithmetic is unchanged. An injected ``kv.restore`` fault
+        degrades the affected entries to a plain miss (they leave the
+        host store; the request re-prefills; the stream is unharmed)."""
+        host = self._host
+        ps = self.page_size
+        prompt = req.prompt
+        version = self._prefix.version
+        start_k = cached_len // ps
+        hits: List[Tuple[int, ...]] = []
+        for k in range(start_k, (len(prompt) - 1) // ps):
+            key = tuple(prompt[:(k + 1) * ps])
+            if not host.has_prefix(version, key):
+                break
+            hits.append(key)
+        if not hits:
+            return [], cached_len
+        try:
+            faults.fire("kv.restore", engine=self, kind="prefix")
+        except BaseException:
+            for key in hits:
+                host.drop_prefix(version, key)
+            self._report_pages()
+            return [], cached_len
+        pages = self._pool.alloc(len(hits), owner="target")
+        ppn = self._pool.pages_per_slot
+        idx = np.full((ppn,), self._pool.trash, np.int32)
+        idx[:len(pages)] = pages
+        rows = [host.take_prefix(version, key) for key in hits]
+
+        def _fill(leaf, *page_rows):
+            out = np.zeros((ppn,) + leaf.shape[1:], leaf.dtype)
+            for i, r in enumerate(page_rows):
+                out[i] = r
+            return out
+
+        block = jax.tree_util.tree_map(_fill, self._cache, *rows)
+        if self._cache_sharding is not None:
+            block = jax.device_put(
+                block, _cache_sharding_tree(block, self._cache_sharding))
+        else:
+            block = jax.device_put(block)
+        self._cache = self._mover.scatter(self._cache, block, idx)
+        # republish: the restored chunks re-enter the device index with
+        # their own cache references (request ref + cache ref, the
+        # never-evicted end state). Rows before start_k descend the
+        # live device chain — publish only reads the row for NEW nodes.
+        end = (start_k + len(hits)) * ps
+        pub_row = np.full((ppn,), self._pool.trash, np.int32)
+        pub_row[start_k:start_k + len(pages)] = pages
+        self._prefix.publish(prompt[:end], pub_row)
+        self._evict_stale = False
+        self.metrics.record_restore(len(pages))
+        self._report_pages()
+        return pages, end
+
+    def _swap_out_for(self, head: _GenRequest, need_alloc: int) -> bool:
+        """QoS swap (PR 18): the FIFO head is page-blocked and neither
+        eviction nor bypass helped — swap OUT lowest-priority, longest-
+        idle active decode streams (pages + PRNG key + position through
+        the host tier; the stream parks on a re-queued resume request)
+        until the head's reservation fits. Only STRICTLY lower priority
+        yields, so a swap chain terminates and equal-priority traffic
+        never thrashes. False leaves the plain FIFO wait in place."""
+        if self._host is None or self.role != "both":
+            return False
+        core = self._core
+        swapped = False
+        while not self._pool.can_reserve(need_alloc):
+            with core.cond:
+                victims = [
+                    (st.req.priority, st.t_last, slot, st)
+                    for slot, st in core.active.items()
+                    if st.phase == "decode" and st.pages
+                    and st.req.priority < head.priority
+                    and st.generated < st.req.max_new_tokens
+                    and st.position < self.max_len]
+            if not victims:
+                return swapped and self._pool.can_reserve(need_alloc)
+            victims.sort(key=lambda v: (v[0], v[1]))
+            _, _, slot, st = victims[0]
+            if not self._swap_out_slot(slot, st):
+                return False
+            swapped = True
+        return True
+
+    def _swap_out_slot(self, slot: int, st: _SlotState) -> bool:
+        """Export one active decode stream to the host tier: gather its
+        whole lane (the handoff gather), start the async host copy,
+        export the pages, park the slot, and re-queue a resume request
+        carrying the handoff-shaped payload — adoption replays it
+        byte-exactly (the PRNG key splits once per emitted token while
+        resident, so park/resume never skews a sampled stream). A
+        faulted swap-out aborts BEFORE anything moves: the victim stays
+        resident with all its pages."""
+        try:
+            faults.fire("kv.offload", engine=self, kind="swap")
+        except BaseException:
+            self._host.record_drop()
+            self.metrics.record_offload_dropped()
+            return False
+        req = st.req
+        self._swap_seq += 1
+        swap_id = self._swap_seq
+        ps = self.page_size
+        plen = len(req.prompt)
+        meta = np.asarray(
+            [(int(p), self._pool.generation(p), int((i + 1) * ps <= plen))
+             for i, p in enumerate(st.pages)], np.int64).reshape(-1, 3)
+        block = self._mover.gather(self._cache, st.page_row)
+        jax.tree_util.tree_map(_start_host_copy, block)
+        payload = {
+            "prompt": np.asarray(req.prompt, np.int32),
+            "first_token": int(st.last_token),
+            "key": self._keys[slot].copy(),
+            "plen": plen,
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "deadline": req.deadline,
+            "page_row": st.page_row.copy(),
+            "page_meta": meta,
+            "source": self.handoff_source,
+            "tag": req.tag,
+            "block": block,
+            "swap": True,
+            "swap_id": swap_id,
+            "position": int(st.position),
+            "generated": int(st.generated),
+            "priority": int(req.priority),
+            "t_admit": float(st.t_admit),
+        }
+        self._pending_offloads.append({"kind": "swap", "payload": payload})
+        core = self._core
+        with core.cond:
+            core.active.pop(slot, None)
+            core.free.append(slot)
+        # the request's references leave through handoff accounting: the
+        # gather above captured the bytes (a pure read the pages' next
+        # owner must wait on), the ids free for the head. No publish —
+        # nothing may newly enter the device index off a parked stream.
+        self._pool.export_pages(st.pages or ())
+        st.pages = None
+        self._page_map[slot] = self._pool.trash
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._keys[slot] = 0
+        self._evict_stale = False
+        self._host.park_stream(swap_id, len(meta))
+        self.metrics.record_swap_out()
+        resume = _GenRequest(req.prompt, req.max_new_tokens, req.deadline,
+                             req.stream, temperature=req.temperature,
+                             top_k=req.top_k, top_p=req.top_p,
+                             seed=req.seed, tag=req.tag, handoff=payload,
+                             priority=req.priority)
+        with core.cond:
+            # FIFO tail: the resumed stream waits its turn like any
+            # arrival — fairness under repeated pressure is bounded by
+            # the strict-priority rule, not by queue position
+            core.pending.append(resume)
+        self._report_pages()
+        return True
 
     def _chunk_invocations(self, n_tokens: int) -> int:
         """Kernel invocations (non-final chunks + the final prefill) a
@@ -1722,6 +2091,7 @@ class GenerationEngine:
         hit_k = 0
         shared_pages: List[int] = []
         dshared_pages: List[int] = []
+        restored: List[int] = []
         if self._prefix is not None:
             cached_len, probes = self._prefix_probe(req)
             assert cached_len % self.page_size == 0 \
@@ -1734,6 +2104,13 @@ class GenerationEngine:
                 if self._dprefix is not None:
                     dshared_pages = list(probes[1][0])
                     self._pool.share(dshared_pages)
+            if self._host is not None and not self._prefix_flush:
+                # host tier (PR 18): chains the device index evicted may
+                # live one tier down — restored pages slot in right
+                # after the device hit and count as cached from here on
+                restored, cached_len = self._restore_prefix(req,
+                                                            cached_len)
+                hit_k = cached_len // self.page_size
             skipped = (self._chunk_invocations(len(req.prompt))
                        - self._chunk_invocations(len(req.prompt)
                                                  - cached_len))
@@ -1742,8 +2119,8 @@ class GenerationEngine:
                 self._dprefix.record_probe(hit_k > 0, cached_len)
             self.metrics.record_prefix_probe(hit_k > 0,
                                              skipped * self._lanes)
-        pages = shared_pages + self._pool.alloc(need - hit_k,
-                                                owner="target")
+        pages = shared_pages + restored + self._pool.alloc(
+            need - hit_k, owner="target")
         row = np.full((self._pool.pages_per_slot,), self._pool.trash,
                       np.int32)
         row[:len(pages)] = pages
@@ -1792,12 +2169,22 @@ class GenerationEngine:
         token. A failure between adopt and scatter is REQUEST-scoped:
         the cache is untouched until the scatter lands, so only this
         stream fails and its pages release; the engine keeps serving."""
+        payload = req.handoff
+        swap = bool(payload.get("swap"))
+        if swap:
+            # the parked booking ends the moment the resume admission
+            # runs, whatever its outcome — expiry, cancellation, an
+            # injected fault, or a clean adoption; the payload is the
+            # only thing that survives a failed resume, and it dies
+            # with the request
+            self._host.unpark_stream(int(payload["swap_id"]))
+            self.metrics.record_swap_in()
+            self._report_pages()
         now = time.monotonic()
         why = self._retire_why(None, req, now)
         if why is not None:
             self._finish_request(req, why, now, queue_wait=None)
             return
-        payload = req.handoff
         core = self._core
         with core.cond:
             core.free.sort()
@@ -1807,6 +2194,11 @@ class GenerationEngine:
         k_p = len(meta)
         pages: List[int] = []
         try:
+            if swap:
+                # fault site: before a parked stream's resume adoption —
+                # an injected fault fails ONLY this stream (the except
+                # below releases its pages); the engine keeps serving
+                faults.fire("kv.restore", engine=self, kind="swap")
             # fault site: between the prefill engine's export and this
             # pool's adopt — the chaos gate proves a mid-handoff fault
             # drains BOTH pools' per-owner gauges to zero
@@ -1841,13 +2233,25 @@ class GenerationEngine:
         self._page_map[slot] = row
         tok = int(payload["first_token"])
         now = time.monotonic()
-        st = _SlotState(req, tok, len(req.prompt), 1, now, phase="decode",
-                        pages=pages, page_row=row)
+        if swap:
+            # a resumed stream continues MID-generation: position,
+            # progress and the queue-wait base restore from the payload,
+            # and the consumer already holds every pushed token — push
+            # nothing, decode on from the parked key (which split once
+            # per emitted token while resident: byte-exact resume)
+            st = _SlotState(req, tok, int(payload["position"]),
+                            int(payload["generated"]),
+                            float(payload["t_admit"]), phase="decode",
+                            pages=pages, page_row=row)
+        else:
+            st = _SlotState(req, tok, len(req.prompt), 1, now,
+                            phase="decode", pages=pages, page_row=row)
         st.t_last = now
         with core.cond:
             core.active[slot] = st
         self._report_pages()
-        req.stream._push(tok, now)
+        if not swap:
+            req.stream._push(tok, now)
         why = self._retire_why(st, req, now)
         if why is not None:
             self._release_slot(slot, st)
@@ -1886,6 +2290,7 @@ class GenerationEngine:
             "page_meta": meta,
             "source": self.handoff_source,
             "tag": req.tag,
+            "priority": int(req.priority),
         }
 
     def _handoff_slot(self, slot: int, st: _SlotState) -> None:
@@ -2504,6 +2909,26 @@ class GenerationEngine:
                     block = jax.device_put(block)
                 self._cache = self._mover.scatter(self._cache, block,
                                                   trash_row)
+            if self._host is not None:
+                # host tier (PR 18): the offload/swap gather and the
+                # restore scatter warm exactly like the role-split
+                # engines' — ONE executable each, runtime calls place
+                # their blocks identically (compile-once is test-pinned)
+                if self.role != "prefill":
+                    jax.block_until_ready(
+                        self._mover.gather(self._cache, trash_row))
+                block = jax.tree_util.tree_map(
+                    lambda leaf: np.zeros(
+                        (self._pool.pages_per_slot,) + leaf.shape[1:],
+                        leaf.dtype), self._cache)
+                if self._cache_sharding is not None:
+                    block = jax.device_put(
+                        block,
+                        _cache_sharding_tree(block, self._cache_sharding))
+                else:
+                    block = jax.device_put(block)
+                self._cache = self._mover.scatter(self._cache, block,
+                                                  trash_row)
             # warmup consumed one split per slot key: re-arm the zeros so
             # the first real admission starts from its request seed (it
             # overwrites the row anyway; this keeps the invariant obvious)
@@ -2659,6 +3084,19 @@ class GenerationEngine:
             return 0
         return self._prefix.pages + (self._dprefix.pages
                                      if self._dprefix is not None else 0)
+
+    @property
+    def host_pages_in_use(self) -> int:
+        """Pages resident in the host tier — offloaded prefix entries
+        plus parked-stream bookings (0 without ``host_pages``); the
+        second gauge the two-tier drain gate asserts reaches zero."""
+        return self._host.pages if self._host is not None else 0
+
+    @property
+    def host_store(self) -> Optional[HostPageStore]:
+        """The host tier itself (``None`` without ``host_pages``) —
+        snapshot()-able like the PagePool, for registry scrapes."""
+        return self._host
 
 
 def static_generate(model, params, requests, *, max_slots: int,

@@ -1,0 +1,218 @@
+"""Host-RAM KV tier beneath the device ``PagePool`` (PR 18).
+
+Device HBM is the scarcest resource in the stack, and until this PR the
+``PagePool`` treated it as the ONLY tier: a prefix page that lost the
+LRU race was gone (its next request re-prefills from scratch) and page
+pressure was a hard admission wall. Host RAM is 10-100x HBM on every
+TPU host, and the PR-15 handoff machinery — ``PageBlockMover``
+gather/scatter plus ``export_pages``/``adopt_pages`` accounting — is
+already exactly the device half of a tier boundary. This module is the
+host half, in the spirit of tiered-KV serving systems (Mooncake,
+InfiniGen — PAPERS.md):
+
+- **offloaded prefixes.** When the prefix cache would evict an
+  unreferenced page chain, the engine gathers each victim page into a
+  fixed-shape device block (the SAME jitted gather the disaggregation
+  handoff compiled), starts an async device->host copy, and — once the
+  copy lands, polled between scheduler iterations, never blocking a
+  step — files the page's host bytes here under the same
+  ``(model version, page-aligned token prefix)`` radix key the device
+  index used. A later admission that misses the device index probes
+  this store; a hit allocates fresh device pages, scatters the host
+  rows back (one jitted scatter, bit-identical bytes — the copy is a
+  memcpy in both directions, int8 scale pools ride along as ordinary
+  leaves), and republishes the chain. Restore MOVES the entry back to
+  the device tier: a page lives in exactly one tier at a time, which
+  keeps the drain invariants first-order ("both tiers reach zero").
+- **parked streams.** Stream swap-out (the QoS path: a low-priority
+  active stream yields its device pages to a higher-priority waiter)
+  books its exported pages here while the stream is parked; the
+  payload itself rides the re-queued request. Accounting only — the
+  store never owns a ``GenerationStream``.
+- **bounded, LRU.** ``capacity_pages`` caps the prefix side; inserting
+  past it evicts the oldest host entries (beyond the last tier there
+  is only the floor). Parked streams are never evicted — a parked
+  stream is a live request, not a cache entry.
+
+Single-writer like the ``PagePool``: all mutation happens on the
+engine loop thread; ``snapshot()`` reads plain ints and is safe to
+scrape from any thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["HostPageStore"]
+
+# a prefix entry's radix key: (prefix-index version, the page-aligned
+# token prefix ending at the stored page)
+_PrefixKey = Tuple[int, Tuple[int, ...]]
+
+
+class _HostEntry:
+    """One offloaded page: host-side numpy rows per cache leaf (shape
+    ``leaf.shape[1:]`` — one page of K/V, scale-pool rows included for
+    int8 lanes) plus the LRU stamp of its last touch."""
+
+    __slots__ = ("rows", "stamp")
+
+    def __init__(self, rows: Any, stamp: int):
+        self.rows = rows
+        self.stamp = stamp
+
+
+class HostPageStore:
+    """Host-RAM page store: the tier beneath one device ``PagePool``.
+
+    ``capacity_pages`` bounds the PREFIX side (parked-stream pages are
+    live requests and never count against it); ``page_bytes`` prices
+    one page across all layers (``paging.page_bytes`` x num_layers, the
+    engine's ``_kv_page_bytes``) so the byte gauges agree with the
+    device tier's accounting.
+    """
+
+    def __init__(self, capacity_pages: int, *, page_bytes: int = 0,
+                 name: str = "host"):
+        if capacity_pages < 1:
+            raise ValueError("capacity_pages must be >= 1")
+        self.capacity_pages = int(capacity_pages)
+        self.page_bytes = int(page_bytes)
+        self.name = name
+        self._prefix: Dict[_PrefixKey, _HostEntry] = {}
+        self._streams: Dict[int, int] = {}   # swap id -> parked pages
+        self._clock = 0
+        # counters (monotonic; gauges derive from the dicts above)
+        self.offloaded_pages = 0   # device -> host prefix copies landed
+        self.restored_pages = 0    # host -> device prefix copies
+        self.dropped_pages = 0     # offloads abandoned (fault / in-flight cap)
+        self.evicted_pages = 0     # host-side LRU evictions (capacity)
+        self.stream_swaps_out = 0  # streams parked here
+        self.stream_swaps_in = 0   # parked streams resumed
+
+    # ------------------------------------------------------- queries ----
+
+    @property
+    def prefix_pages(self) -> int:
+        return len(self._prefix)
+
+    @property
+    def stream_pages(self) -> int:
+        return sum(self._streams.values())
+
+    @property
+    def pages(self) -> int:
+        """Pages currently resident in the host tier (gauge): offloaded
+        prefix entries plus every parked stream's exported pages."""
+        return len(self._prefix) + self.stream_pages
+
+    @property
+    def bytes_used(self) -> int:
+        return self.pages * self.page_bytes
+
+    def has_prefix(self, version: int, prefix: Tuple[int, ...]) -> bool:
+        """Pure membership probe (no LRU touch) — the admission path
+        counts its consecutive host hits before committing pages."""
+        return (int(version), tuple(prefix)) in self._prefix
+
+    # ------------------------------------------------------ mutators ----
+
+    def put_prefix(self, version: int, prefix: Tuple[int, ...],
+                   rows: Any) -> bool:
+        """File one offloaded page under its radix key, LRU-evicting the
+        oldest host entries past ``capacity_pages`` (the floor below the
+        last tier is the floor). Re-offloading a live key refreshes it
+        in place. Returns False when the page was dropped instead
+        (capacity zero-sum against newer entries never happens — the
+        incoming page is always the newest)."""
+        key = (int(version), tuple(prefix))
+        self._clock += 1
+        hit = self._prefix.get(key)
+        if hit is not None:
+            hit.rows = rows
+            hit.stamp = self._clock
+            return True
+        while len(self._prefix) >= self.capacity_pages:
+            oldest = min(self._prefix.items(), key=lambda kv: kv[1].stamp)
+            del self._prefix[oldest[0]]
+            self.evicted_pages += 1
+        self._prefix[key] = _HostEntry(rows, self._clock)
+        self.offloaded_pages += 1
+        return True
+
+    def take_prefix(self, version: int,
+                    prefix: Tuple[int, ...]) -> Optional[Any]:
+        """Restore hit: remove the entry and return its host rows (MOVE
+        semantics — the page re-enters the device tier; a later
+        eviction re-offloads it). None on miss."""
+        entry = self._prefix.pop((int(version), tuple(prefix)), None)
+        if entry is None:
+            return None
+        self.restored_pages += 1
+        return entry.rows
+
+    def drop_prefix(self, version: int, prefix: Tuple[int, ...]) -> bool:
+        """Discard one entry without restoring it (a faulted restore
+        degrades the affected entry to a miss — it must not strand in
+        the host tier)."""
+        if self._prefix.pop((int(version), tuple(prefix)), None) is None:
+            return False
+        self.dropped_pages += 1
+        return True
+
+    def record_drop(self, n: int = 1) -> None:
+        """Count ``n`` offload candidates abandoned BEFORE reaching the
+        store (an injected ``kv.offload`` fault, or the in-flight copy
+        cap) — the pages simply evicted, nothing strands."""
+        self.dropped_pages += int(n)
+
+    def park_stream(self, swap_id: int, n_pages: int) -> None:
+        """Book a swapped-out stream's exported pages in the host tier.
+        The swap payload itself rides the re-queued request — the store
+        holds accounting only, so a failed resume can never strand
+        device state here."""
+        self._streams[int(swap_id)] = int(n_pages)
+        self.stream_swaps_out += 1
+
+    def unpark_stream(self, swap_id: int) -> int:
+        """Drop a parked stream's booking (resume admission, expiry, or
+        a faulted swap-in — every exit path). Returns the pages it
+        held (0 if unknown — idempotent on purpose)."""
+        n = self._streams.pop(int(swap_id), None)
+        if n is None:
+            return 0
+        self.stream_swaps_in += 1
+        return n
+
+    def clear(self) -> int:
+        """Drop everything (engine close, reload flush, terminal
+        failure paths) so both tiers drain to zero together. Returns
+        pages released."""
+        released = self.pages
+        self._prefix.clear()
+        self._streams.clear()
+        return released
+
+    # ------------------------------------------------------- readers ----
+
+    def snapshot(self) -> dict:
+        """Plain-int gauges/counters for the obs registry — the host
+        half of the two-tier accounting, shaped like the PagePool's
+        with ``tier`` naming which side of the boundary it reports."""
+        return {
+            "tier": "host",
+            "pages_total": self.capacity_pages,
+            "pages_in_use": self.pages,
+            "prefix_pages": self.prefix_pages,
+            "stream_pages": self.stream_pages,
+            "bytes_in_use": self.bytes_used,
+            "by_owner": {k: v for k, v in (("prefix", self.prefix_pages),
+                                           ("stream", self.stream_pages))
+                         if v},
+            "offloaded_pages": self.offloaded_pages,
+            "restored_pages": self.restored_pages,
+            "dropped_pages": self.dropped_pages,
+            "evicted_pages": self.evicted_pages,
+            "stream_swaps_out": self.stream_swaps_out,
+            "stream_swaps_in": self.stream_swaps_in,
+        }

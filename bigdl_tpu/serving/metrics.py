@@ -169,6 +169,17 @@ class ServingMetrics:
         self.recent_window_s = float(recent_window_s)
         self._ttft_recent = _Window(recent_window_s)
         self._itl_recent = _Window(recent_window_s)
+        # KV-tier counters (PR 18); zero for an engine without a host
+        # tier — snapshot/table keep the earlier shapes (same
+        # append-only golden contract as every block above)
+        self.kv_offload_pages = 0    # device->host prefix copies landed
+        self.kv_restore_pages = 0    # host->device prefix copies
+        self.kv_offload_dropped = 0  # offload candidates abandoned
+        self.kv_swaps_out = 0        # streams parked under QoS pressure
+        self.kv_swaps_in = 0         # parked streams resumed
+        self.host_pages = 0          # host-tier resident pages (gauge)
+        self.host_bytes = 0          # host-tier resident bytes (gauge)
+        self.host_pages_peak = 0
 
     # ------------------------------------------------------- mutators ----
 
@@ -333,6 +344,45 @@ class ServingMetrics:
         with self._lock:
             self.shared_pages = int(n)
 
+    # -------------------------------------------------- KV-tier mutators ----
+
+    def record_offload(self, n_pages: int) -> None:
+        """``n_pages`` prefix pages crossed device->host (the async copy
+        landed and the host store filed them)."""
+        with self._lock:
+            self.kv_offload_pages += int(n_pages)
+
+    def record_restore(self, n_pages: int) -> None:
+        """``n_pages`` prefix pages crossed host->device (a later
+        admission hit the host tier and re-attached them)."""
+        with self._lock:
+            self.kv_restore_pages += int(n_pages)
+
+    def record_offload_dropped(self, n_pages: int = 1) -> None:
+        """``n_pages`` offload candidates were abandoned instead of
+        copied (an injected ``kv.offload`` fault, the in-flight copy
+        cap, or host-capacity pressure) — the pages evicted plainly."""
+        with self._lock:
+            self.kv_offload_dropped += int(n_pages)
+
+    def record_swap_out(self) -> None:
+        """One active stream exported its pages and parked (QoS swap)."""
+        with self._lock:
+            self.kv_swaps_out += 1
+
+    def record_swap_in(self) -> None:
+        """One parked stream re-adopted its pages and resumed."""
+        with self._lock:
+            self.kv_swaps_in += 1
+
+    def set_host_pages(self, pages: int, bytes_used: int) -> None:
+        """Host-tier residency gauges (prefix entries + parked streams);
+        drain to zero on engine close exactly like the device pool's."""
+        with self._lock:
+            self.host_pages = int(pages)
+            self.host_bytes = int(bytes_used)
+            self.host_pages_peak = max(self.host_pages_peak, int(pages))
+
     # --------------------------------------------- replica mutators ----
 
     def set_replicas(self, healthy: int, total: int,
@@ -480,6 +530,16 @@ class ServingMetrics:
                     f"p{q}": round(v * 1e3, 3)
                     for q, v in zip(self.LATENCY_QS, gr)},
                 "recent_window_s": self.recent_window_s,
+                # KV-tier fields (PR 18): appended after every earlier
+                # key, never reordered
+                "kv_offload_pages": self.kv_offload_pages,
+                "kv_restore_pages": self.kv_restore_pages,
+                "kv_offload_dropped": self.kv_offload_dropped,
+                "kv_swaps_out": self.kv_swaps_out,
+                "kv_swaps_in": self.kv_swaps_in,
+                "host_pages": self.host_pages,
+                "host_bytes": self.host_bytes,
+                "host_pages_peak": self.host_pages_peak,
             }
 
     def format_table(self) -> str:
@@ -586,4 +646,19 @@ class ServingMetrics:
             for q, v in s["itl_ms"].items():
                 row(f"itl_{q}(ms)", f"{v:.3f}")
             row("itl_samples", s["itl_samples"])
+        # KV-tier rows: appended strictly after the ITL block and only
+        # when a host tier actually moved or held pages — every earlier
+        # table stays a byte-identical strict prefix (append-only
+        # golden contract, test-enforced)
+        if (s["kv_offload_pages"] or s["kv_restore_pages"]
+                or s["kv_swaps_out"] or s["host_pages"]
+                or s["kv_offload_dropped"]):
+            row("kv_offload_pages", s["kv_offload_pages"])
+            row("kv_restore_pages", s["kv_restore_pages"])
+            row("kv_offload_dropped", s["kv_offload_dropped"])
+            row("kv_swaps_out", s["kv_swaps_out"])
+            row("kv_swaps_in", s["kv_swaps_in"])
+            row("host_pages", s["host_pages"])
+            row("host_bytes", s["host_bytes"])
+            row("host_pages_peak", s["host_pages_peak"])
         return "\n".join(lines)

@@ -282,6 +282,12 @@ class PagePool:
             "pages_exported": self.pages_exported,
             "pages_adopted": self.pages_adopted,
             "pages_adopt_shared": self.pages_adopt_shared,
+            # PR 18 tier dimension (append-only): this pool is always
+            # the DEVICE side of the two-tier hierarchy; the host side
+            # (serving.kv_tiers.HostPageStore) reports the same gauge
+            # shape with tier="host", so per-owner/occupancy scrapes
+            # join on it
+            "tier": "hbm",
         }
 
     @property

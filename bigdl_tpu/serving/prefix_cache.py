@@ -44,7 +44,7 @@ discipline as :class:`~bigdl_tpu.serving.paging.PagePool`);
 from __future__ import annotations
 
 import heapq
-from typing import FrozenSet, List, Optional, Sequence, Tuple
+from typing import Callable, FrozenSet, List, Optional, Sequence, Tuple
 
 
 class _PrefixNode:
@@ -178,40 +178,88 @@ class PrefixCache:
             node = child
         return added
 
+    def node_prefix(self, nd: _PrefixNode) -> Tuple[int, ...]:
+        """The page-aligned token prefix ending at ``nd`` — the chain's
+        chunks root-to-node, flattened. This IS the node's radix key
+        (together with ``version``), which is what the host tier files
+        an offloaded page under."""
+        chunks: List[Tuple[int, ...]] = []
+        while nd is not None and nd is not self._root:
+            chunks.append(nd.chunk)
+            nd = nd.parent
+        out: List[int] = []
+        for chunk in reversed(chunks):
+            out.extend(chunk)
+        return tuple(out)
+
     def evict(self, n_pages: int,
-              protect: FrozenSet[_PrefixNode] = frozenset()) -> int:
+              protect: FrozenSet[_PrefixNode] = frozenset(),
+              on_evict: Optional[
+                  Callable[[Tuple[int, ...], int], None]] = None) -> int:
         """Free up to ``n_pages`` pages by evicting least-recently-used
         UNREFERENCED leaves (pool refcount exactly the cache's own, no
         children — evicting an interior node would orphan its
         descendants' chains). ``protect`` shields the chain a pending
-        admission just matched. Returns pages actually freed; evicting
-        a leaf may expose its parent, which joins the candidate heap."""
+        admission just matched. Returns pages actually freed.
+
+        Eviction is LEAF-FIRST, in rounds: one round drains the CURRENT
+        evictable frontier in LRU order, and only when the shortfall
+        survives a whole round do the parents that round exposed
+        become the next frontier. The pre-PR-18 version pushed an exposed
+        parent into the SAME heap under its own stamp — and because
+        ``lookup``/``publish`` stamp a whole chain with one clock
+        value, a parent is never younger than its coldest descendant,
+        so one cold deep leaf let eviction climb its ancestor chain and
+        drop the whole thing while OTHER chains' (younger-stamped)
+        leaves survived untouched. An ancestor serves every branch
+        below it; a leaf serves one. Round ordering makes the policy
+        match that value: shorter shared prefixes outlive single-branch
+        tails under equal pressure — and each evicted node leaves
+        individually (shortest prefixes last), which is exactly the
+        granularity the host tier wants its offload candidates in.
+
+        ``on_evict(prefix_tokens, page)`` is invoked per victim BEFORE
+        the page's reference is released — the engine's host-tier hook
+        dispatches its device gather there, while the page still cannot
+        be reallocated. The callback must not raise (the engine wraps
+        its fault site); eviction proceeds regardless of what it does.
+        """
         if n_pages <= 0 or not self._pages:
             return 0
-        heap: List[Tuple[int, int, _PrefixNode]] = []
 
         def _evictable(nd: _PrefixNode) -> bool:
             return (not nd.children and nd not in protect
                     and self._pool.refcount(nd.page) == 1)
 
+        frontier: List[_PrefixNode] = []
         stack = list(self._root.children.values())
         while stack:
             nd = stack.pop()
             if nd.children:
                 stack.extend(nd.children.values())
             elif _evictable(nd):
-                heapq.heappush(heap, (nd.stamp, id(nd), nd))
+                frontier.append(nd)
         freed = 0
-        while heap and freed < n_pages:
-            _, _, leaf = heapq.heappop(heap)
-            parent = leaf.parent
-            del parent.children[leaf.chunk]
-            self._pool.release([leaf.page])
-            self._pages -= 1
-            self.evicted_pages += 1
-            freed += 1
-            if parent is not self._root and _evictable(parent):
-                heapq.heappush(heap, (parent.stamp, id(parent), parent))
+        while frontier and freed < n_pages:
+            heap: List[Tuple[int, int, _PrefixNode]] = [
+                (nd.stamp, id(nd), nd) for nd in frontier]
+            heapq.heapify(heap)
+            exposed: List[_PrefixNode] = []
+            while heap and freed < n_pages:
+                _, _, leaf = heapq.heappop(heap)
+                if on_evict is not None:
+                    on_evict(self.node_prefix(leaf), leaf.page)
+                parent = leaf.parent
+                del parent.children[leaf.chunk]
+                self._pool.release([leaf.page])
+                self._pages -= 1
+                self.evicted_pages += 1
+                freed += 1
+                if parent is not self._root and _evictable(parent):
+                    # next ROUND's candidate, never this round's: the
+                    # leaf-first fix (see docstring)
+                    exposed.append(parent)
+            frontier = exposed
         return freed
 
     def clear(self) -> int:

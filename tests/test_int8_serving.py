@@ -619,9 +619,10 @@ def test_kv_metrics_rows_append_after_replica_golden():
     snap = m.snapshot()
     keys = list(snap.keys())
     # the PR-9 block sits immediately before the PR-10 speculative,
-    # PR-11 step-timeline, PR-12 prefix-cache, and PR-15 ITL keys
-    # (append-only: each PR's rows land AFTER every earlier block)
-    assert keys[-21:-18] == ["kv_bytes_in_use", "kv_cache_dtype",
+    # PR-11 step-timeline, PR-12 prefix-cache, PR-15 ITL, and PR-18
+    # KV-tier keys (append-only: each PR's rows land AFTER every
+    # earlier block)
+    assert keys[-29:-26] == ["kv_bytes_in_use", "kv_cache_dtype",
                              "quantized_gemms"]
     assert snap["kv_bytes_in_use"] == 5 * 5248
     assert snap["kv_cache_dtype"] == "int8"

@@ -1,0 +1,440 @@
+"""KV memory hierarchy (PR 18): host tier beneath the device PagePool.
+
+The load-bearing properties, per the subsystem contract:
+
+- the HEADLINE: engine output with the host tier ON is bit-identical to
+  OFF — greedy and sampled, float and int8 KV, short and chunk-spanning
+  tails — including revisits served by a host->device restore (an
+  offloaded page holds the same bytes a fresh prefill writes);
+- offload→restore actually moves pages through the host tier
+  (``kv_offload_pages``/``kv_restore_pages`` > 0) and restore MOVES the
+  entry (a page lives in exactly one tier at a time);
+- stream swap-out under QoS pressure (``submit(priority=)``) parks the
+  lowest-priority idle stream and resumes it byte-exact, while the
+  higher-priority waiter admits immediately;
+- compile-once: the host tier rides the PR-15 handoff gather/scatter —
+  warmup plus offload plus restore traffic leaves exactly one trace of
+  each;
+- faults at ``kv.offload``/``kv.restore`` fail only the affected
+  entry/stream (offload → page evicts plainly; restore → degrade to a
+  miss) and never strand pages in either tier;
+- leaf-first prefix eviction: under equal pressure a shorter shared
+  prefix outlives a single branch's deep tail (the PR-18 bugfix);
+- both tiers' gauges drain to zero at close.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import faults
+from bigdl_tpu.faults import InjectedFault
+from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.serving import (
+    GenerationEngine,
+    HostPageStore,
+    PagePool,
+    PagedDecodeKernels,
+    PrefixCache,
+)
+
+SLOTS, MAXLEN = 4, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+    params, _ = model.init(jax.random.key(0))
+    kernels = PagedDecodeKernels(model)
+    return model, params, kernels
+
+
+def make_engine(lm, **kw):
+    model, params, kernels = lm
+    kw.setdefault("max_slots", SLOTS)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("kernels", kernels)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefill_chunk", 4)
+    return GenerationEngine(model, params, **kw)
+
+
+def family_prompts(n_families=4):
+    """n 3-page prefix families with two divergent tails each — more
+    published pages (12) than a 12-page pool can keep alongside a live
+    5-page reservation, so admissions force LRU evictions and the
+    revisit pass below exercises the host->device restore path."""
+    fams = [[int(t) for t in np.random.RandomState(100 + i).randint(1, 60, 12)]
+            for i in range(n_families)]
+    return fams, [[1, 2], [3, 4]]
+
+
+# ------------------------------------------------------ store (unit) ----
+
+
+class TestHostPageStore:
+    def test_put_take_move_semantics(self):
+        st = HostPageStore(8, page_bytes=64)
+        rows = {"k": np.ones(3)}
+        assert st.put_prefix(0, (1, 2, 3, 4), rows)
+        assert st.has_prefix(0, (1, 2, 3, 4))
+        assert not st.has_prefix(1, (1, 2, 3, 4))     # version keyed
+        assert st.pages == 1 and st.bytes_used == 64
+        got = st.take_prefix(0, (1, 2, 3, 4))
+        assert got is rows
+        # MOVE: the entry left with the restore
+        assert not st.has_prefix(0, (1, 2, 3, 4))
+        assert st.take_prefix(0, (1, 2, 3, 4)) is None
+        assert st.pages == 0
+        assert st.offloaded_pages == 1 and st.restored_pages == 1
+
+    def test_lru_capacity_eviction(self):
+        st = HostPageStore(2)
+        st.put_prefix(0, (1,), "a")
+        st.put_prefix(0, (2,), "b")
+        st.put_prefix(0, (1,), "a2")     # refresh in place, no eviction
+        assert st.evicted_pages == 0 and st.prefix_pages == 2
+        st.put_prefix(0, (3,), "c")      # capacity: (2,) is now oldest
+        assert st.evicted_pages == 1
+        assert not st.has_prefix(0, (2,))
+        assert st.has_prefix(0, (1,)) and st.has_prefix(0, (3,))
+        assert st.take_prefix(0, (1,)) == "a2"
+
+    def test_drop_and_park_bookkeeping(self):
+        st = HostPageStore(4, page_bytes=10)
+        st.put_prefix(0, (1,), "a")
+        assert st.drop_prefix(0, (1,)) and not st.drop_prefix(0, (1,))
+        st.record_drop(2)
+        assert st.dropped_pages == 3
+        st.park_stream(7, 5)
+        assert st.stream_pages == 5 and st.pages == 5
+        snap = st.snapshot()
+        assert snap["tier"] == "host"
+        assert snap["by_owner"] == {"stream": 5}
+        assert snap["bytes_in_use"] == 50
+        assert st.unpark_stream(7) == 5
+        assert st.unpark_stream(7) == 0   # idempotent: every exit path
+        assert st.stream_swaps_out == 1 and st.stream_swaps_in == 1
+        st.put_prefix(0, (2,), "b")
+        assert st.clear() == 1 and st.pages == 0
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            HostPageStore(0)
+
+
+# ------------------------------------- leaf-first eviction (PR-18 fix) ----
+
+
+class TestLeafFirstEviction:
+    def _tree(self):
+        """root -> n1 {n2 -> n3, n4}: chain A (3 pages, stamp 1) and a
+        younger branch B sharing the first page (n1, n4 stamped 2)."""
+        pool = PagePool(16, 4, 32)
+        cache = PrefixCache(pool)
+        a = list(range(1, 13))               # c1+c2+c3
+        b = a[:4] + list(range(21, 25))      # c1+c4
+        pa = pool.alloc(3)
+        cache.publish(a, pa)
+        pool.release(pa)
+        pb = pool.alloc(2)
+        cache.publish(b, pb)
+        pool.release(pb)
+        assert cache.pages == 4
+        return pool, cache, a, b
+
+    def test_shorter_shared_prefix_survives(self):
+        """The regression: one cold deep leaf (n3, stamp 1) used to let
+        eviction climb its ancestor chain — dropping n2 (stamp 1)
+        before the YOUNGER branch leaf n4 (stamp 2). Leaf-first rounds
+        evict both current leaves before any exposed parent."""
+        pool, cache, a, b = self._tree()
+        assert cache.evict(2) == 2
+        # survivors are the shared prefix chain n1 -> n2, not n4
+        matched, _, _ = cache.lookup(a + [63])
+        assert matched == 8
+        assert len(cache.match_pages(b, 2)) == 1   # c4 gone, c1 lives
+
+    def test_round_order_shortest_prefix_last(self):
+        """Full drain leaves individually, leaves before their parents
+        and LRU within a round — the granularity the host tier offloads
+        candidates in."""
+        pool, cache, a, b = self._tree()
+        order = []
+        cache.evict(4, on_evict=lambda prefix, page: order.append(prefix))
+        assert cache.pages == 0 and pool.in_use == 0
+        c1, c2, c3 = tuple(a[:4]), tuple(a[4:8]), tuple(a[8:12])
+        c4 = tuple(b[4:8])
+        assert order == [c1 + c2 + c3,   # round 1, stamp 1
+                         c1 + c4,        # round 1, stamp 2
+                         c1 + c2,        # round 2: exposed parent
+                         c1]             # round 3: root child last
+
+    def test_protect_shields_matched_chain(self):
+        pool, cache, a, _ = self._tree()
+        _, _, nodes = cache.lookup(a + [63])   # matches the whole A chain
+        assert cache.evict(4, protect=frozenset(nodes)) == 1  # n4 only
+        assert cache.pages == 3
+
+
+# -------------------------------------------------- engine headline ----
+
+
+class TestOffloadRestoreIdentity:
+    @pytest.mark.parametrize("spec_kw,cache_dtype", [
+        ({}, jnp.float32),
+        (dict(temperature=0.9, top_k=20, top_p=0.95), jnp.float32),
+        ({}, "int8"),
+        (dict(temperature=0.9, top_k=20, top_p=0.95), "int8"),
+    ], ids=["greedy-f32", "sampled-f32", "greedy-int8", "sampled-int8"])
+    def test_bit_identical_host_tier_on_vs_off(self, lm, spec_kw,
+                                               cache_dtype):
+        """THE acceptance assertion: a pool too small for the working
+        set (3 prefix families, 9 published pages, 12-page pool) with
+        the host tier on serves revisits by restoring offloaded pages —
+        and every stream is bit-identical to the no-host-tier engine.
+        Short and chunk-spanning divergent tails ride in the prompt
+        set, so whole and chunked prefills both cross the tier."""
+        fams, tails = family_prompts()
+        long_tail = [int(t) for t in np.random.RandomState(9).randint(1, 60, 7)]
+        prompts = [f + t for f in fams for t in tails]
+        revisit = [f + [5, 6] for f in fams] + [fams[0] + long_tail]
+
+        def run(host_pages):
+            eng = make_engine(lm, max_slots=2, seed=3, num_pages=12,
+                              cache_dtype=cache_dtype, prefix_cache=True,
+                              host_pages=host_pages)
+            outs = [eng.generate(p, max_new_tokens=3, timeout=60, **spec_kw)
+                    for p in prompts + revisit]
+            host = eng.host_store
+            snap = eng.metrics.snapshot()
+            eng.close()
+            assert eng.pages_in_use == 0 and eng.shared_pages == 0
+            return outs, snap, host
+
+        want, _, none_host = run(None)
+        assert none_host is None
+        got, snap, host = run(32)
+        assert got == want
+        # pages really moved through the tier, both directions
+        assert snap["kv_offload_pages"] > 0
+        assert snap["kv_restore_pages"] > 0
+        assert host.offloaded_pages == snap["kv_offload_pages"]
+        assert host.restored_pages == snap["kv_restore_pages"]
+        assert snap["host_pages_peak"] > 0
+        # drain gate: close cleared the tier, gauges at zero
+        assert host.pages == 0
+
+    def test_restore_cheaper_than_reprefill(self, lm):
+        """A restored prefix skips its covered chunks exactly like a
+        device-index hit: the revisit pass runs fewer prefill chunks
+        than the no-host engine's full re-prefills."""
+        fams, tails = family_prompts()
+        prompts = [f + t for f in fams for t in tails]
+        revisit = [f + [5, 6] for f in fams]
+
+        def run(host_pages):
+            eng = make_engine(lm, max_slots=2, num_pages=12,
+                              prefix_cache=True, host_pages=host_pages)
+            for p in prompts:
+                eng.generate(p, max_new_tokens=3, timeout=60)
+            pre = eng.metrics.snapshot()["prefill_chunks"]
+            for p in revisit:
+                eng.generate(p, max_new_tokens=3, timeout=60)
+            snap = eng.metrics.snapshot()
+            eng.close()
+            return snap["prefill_chunks"] - pre, snap
+
+        chunks_off, _ = run(None)
+        chunks_on, snap = run(32)
+        assert snap["kv_restore_pages"] > 0
+        assert chunks_on < chunks_off
+
+
+class TestCompileOnce:
+    def test_host_copies_add_no_traces(self, lm):
+        """Warmup compiles the gather/scatter pair once; offload and
+        restore traffic reuses both executables — the host tier adds
+        zero traces on top of the PR-15 handoff shapes."""
+        fams, tails = family_prompts()
+        eng = make_engine(lm, max_slots=2, num_pages=12,
+                          prefix_cache=True, host_pages=32)
+        eng.warmup()
+        assert eng.handoff_gather_compilations == 1
+        assert eng.handoff_scatter_compilations == 1
+        for p in [f + t for f in fams for t in tails] + \
+                [f + [5, 6] for f in fams]:
+            eng.generate(p, max_new_tokens=3, timeout=60)
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert snap["kv_offload_pages"] > 0 and snap["kv_restore_pages"] > 0
+        assert eng.handoff_gather_compilations == 1
+        assert eng.handoff_scatter_compilations == 1
+
+
+# ----------------------------------------------------- stream swap ----
+
+
+class TestStreamSwap:
+    def _swap_run(self, lm, **arm):
+        """Two low-priority long streams fill both 12-page lanes; a
+        priority-5 request then heads the FIFO. Returns the three
+        streams' results (or errors) plus the engine's final metrics."""
+        eng = make_engine(lm, max_slots=3, num_pages=24,
+                          prefix_cache=True, host_pages=64)
+        # 6 + 42 = 48 tokens = max_len: each low reserves a full
+        # 12-page lane, so the 24-page pool has zero free pages and the
+        # priority-5 head can only admit by swapping a low out
+        lows = [eng.submit([i + 1] * 6, max_new_tokens=42)
+                for i in range(2)]
+        deadline = time.monotonic() + 30
+        while eng.active_slots < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.active_slots == 2
+        high = eng.submit([40, 41, 42], max_new_tokens=4, priority=5)
+        outs = []
+        for s in [high] + lows:
+            try:
+                outs.append(("ok", s.result(timeout=60)))
+            except InjectedFault as e:
+                outs.append(("fault", type(e).__name__))
+        host = eng.host_store
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert eng.pages_in_use == 0 and host.pages == 0
+        return outs, snap
+
+    def test_swap_out_and_resume_byte_exact(self, lm):
+        refs = {}
+        eng = make_engine(lm, max_slots=3, num_pages=24)
+        for i in range(2):
+            refs[i] = eng.generate([i + 1] * 6, max_new_tokens=42,
+                                   timeout=60)
+        refs["high"] = eng.generate([40, 41, 42], max_new_tokens=4,
+                                    timeout=60)
+        eng.close()
+
+        outs, snap = self._swap_run(lm)
+        assert snap["kv_swaps_out"] >= 1
+        assert snap["kv_swaps_in"] == snap["kv_swaps_out"]
+        assert outs[0] == ("ok", refs["high"])
+        # the parked stream resumed BYTE-EXACT: same tokens as an
+        # unpressured run (pages, PRNG key, position all round-tripped)
+        assert outs[1] == ("ok", refs[0]) and outs[2] == ("ok", refs[1])
+
+    def test_equal_priority_never_swaps(self, lm):
+        eng = make_engine(lm, max_slots=3, num_pages=24,
+                          prefix_cache=True, host_pages=64)
+        # three 9-page reservations against a 24-page pool: the third
+        # waits at the FIFO head under pressure, but with equal
+        # priorities it must WAIT (a delay, never a swap)
+        streams = [eng.submit([i + 1] * 6, max_new_tokens=30)
+                   for i in range(3)]
+        outs = [s.result(timeout=60) for s in streams]
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert all(len(o) == 30 for o in outs)
+        assert snap["kv_swaps_out"] == 0
+
+    def test_swap_resume_fault_fails_only_that_stream(self, lm):
+        """An injected ``kv.restore`` (kind='swap') at the parked
+        stream's resume fails ONLY that stream; the high-priority
+        request and the untouched low both complete, and both tiers
+        still drain to zero."""
+        with faults.armed("kv.restore", nth=1,
+                          only=lambda kind=None, **_: kind == "swap"):
+            outs, snap = self._swap_run(lm)
+        assert snap["kv_swaps_out"] >= 1
+        assert outs[0][0] == "ok" and len(outs[0][1]) == 4
+        kinds = sorted(o[0] for o in outs[1:])
+        assert kinds == ["fault", "ok"]
+
+
+# ---------------------------------------------------------- faults ----
+
+
+class TestHostTierFaults:
+    def test_offload_fault_drops_entry_never_strands(self, lm):
+        """Every offload copy faults: pages evict plainly (dropped
+        counter, empty host tier), streams are untouched, gauges
+        drain."""
+        fams, tails = family_prompts()
+        prompts = [f + t for f in fams for t in tails]
+        eng = make_engine(lm, max_slots=2, num_pages=12,
+                          prefix_cache=True, host_pages=32)
+        with faults.armed("kv.offload",
+                          only=lambda engine=None, **_: engine is eng):
+            outs = [eng.generate(p, max_new_tokens=3, timeout=60)
+                    for p in prompts]
+        host = eng.host_store
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert all(len(o) == 3 for o in outs)
+        assert host.offloaded_pages == 0 and host.pages == 0
+        assert snap["kv_offload_dropped"] > 0
+        assert snap["kv_offload_pages"] == 0
+        assert eng.pages_in_use == 0 and eng.shared_pages == 0
+
+    def test_restore_fault_degrades_to_miss(self, lm):
+        """A faulted prefix restore drops the affected host entries and
+        re-prefills — the stream's tokens are still bit-identical to
+        the no-host reference."""
+        fams, tails = family_prompts()
+        prompts = [f + t for f in fams for t in tails]
+        revisit = [f + [5, 6] for f in fams]
+
+        ref = make_engine(lm, max_slots=2, num_pages=12, prefix_cache=True)
+        want = [ref.generate(p, max_new_tokens=3, timeout=60)
+                for p in prompts + revisit]
+        ref.close()
+
+        eng = make_engine(lm, max_slots=2, num_pages=12,
+                          prefix_cache=True, host_pages=32)
+        outs = [eng.generate(p, max_new_tokens=3, timeout=60)
+                for p in prompts]
+        with faults.armed("kv.restore",
+                          only=lambda engine=None, kind=None, **_:
+                          engine is eng and kind == "prefix"):
+            outs += [eng.generate(p, max_new_tokens=3, timeout=60)
+                     for p in revisit]
+        host = eng.host_store
+        snap = eng.metrics.snapshot()
+        eng.close()
+        assert outs == want
+        assert snap["kv_restore_pages"] == 0
+        assert host.dropped_pages > 0        # degraded entries left the tier
+        assert host.pages == 0 and eng.pages_in_use == 0
+
+
+# -------------------------------------------------- gauges and API ----
+
+
+class TestAccountingAndValidation:
+    def test_tier_tagged_snapshots_and_drain(self, lm):
+        fams, tails = family_prompts()
+        eng = make_engine(lm, max_slots=2, num_pages=12,
+                          prefix_cache=True, host_pages=32)
+        for p in [f + t for f in fams for t in tails]:
+            eng.generate(p, max_new_tokens=3, timeout=60)
+        pool_snap = eng._pool.snapshot()
+        host_snap = eng.host_store.snapshot()
+        assert pool_snap["tier"] == "hbm"
+        assert host_snap["tier"] == "host"
+        assert host_snap["pages_in_use"] == eng.host_pages_in_use
+        eng.close()
+        closed = eng.metrics.snapshot()
+        assert closed["host_pages"] == 0 and closed["host_bytes"] == 0
+        assert eng.host_pages_in_use == 0
+        assert eng.host_store.snapshot()["by_owner"] == {}
+
+    def test_host_pages_requires_paged_prefix_engine(self, lm):
+        with pytest.raises(ValueError, match="paged"):
+            make_engine(lm, page_size=None, kernels=None, host_pages=8)
+        with pytest.raises(ValueError, match="prefix_cache"):
+            make_engine(lm, host_pages=8)
+        with pytest.raises(ValueError, match="prefill"):
+            make_engine(lm, prefix_cache=True, host_pages=8, role="decode")

@@ -670,7 +670,7 @@ def test_step_timeline_metrics_rows_append_after_speculative_block():
                      "step_host_frac"]
     snap = m.snapshot()
     # immediately before the PR-12 prefix-cache keys (append-only)
-    assert list(snap)[-14:-10] == ["engine_steps", "step_host_ms",
+    assert list(snap)[-22:-18] == ["engine_steps", "step_host_ms",
                                  "step_device_ms", "step_host_frac"]
     assert snap["engine_steps"] == 2
     assert snap["step_host_ms"] == pytest.approx(3.0)

@@ -667,10 +667,10 @@ def test_speculative_metrics_rows_append_after_golden_order():
     # + 5 speculative extras)
     assert snap["tokens_out"] == 9
     keys = list(snap)
-    # the PR-10 block sits immediately before the PR-11 step-timeline
-    # and PR-12 prefix-cache keys (append-only: each PR's rows land
-    # AFTER every earlier block)
-    assert keys[-18:-14] == ["draft_tokens", "accepted_tokens",
+    # the PR-10 block sits immediately before the PR-11 step-timeline,
+    # PR-12 prefix-cache, and PR-18 KV-tier keys (append-only: each
+    # PR's rows land AFTER every earlier block)
+    assert keys[-26:-22] == ["draft_tokens", "accepted_tokens",
                             "acceptance_rate", "verify_steps"]
 
 
