@@ -424,6 +424,69 @@ class _FixedCostSpecKernels:
         return self.inner.decode_traces
 
 
+class _LazyValue:
+    """Device-future stand-in (PR 19): ``np.asarray`` on it blocks
+    until a deadline set at dispatch, then yields the wrapped array —
+    exactly how a jax device future behaves on a real accelerator
+    (dispatch returns immediately, materialization waits for the
+    step). ``_FixedCostKernels`` sleeps on the DISPATCHING thread,
+    which would serialize the async scheduler's overlap window and
+    make the A/B comparison measure nothing."""
+
+    def __init__(self, value, ready_at):
+        self._value = value
+        self._ready_at = ready_at
+
+    def __array__(self, dtype=None, copy=None):
+        wait = self._ready_at - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+class _AsyncCostKernels:
+    """Paged-kernels wrapper whose decode cost is paid at
+    MATERIALIZATION, not dispatch — the modeled device for the
+    async-scheduling column. ``decode`` returns immediately with its
+    token/key outputs wrapped in :class:`_LazyValue` (ready at
+    t_dispatch + step_cost); the cache result passes through unwrapped
+    because it feeds back into the next jitted call. Both legs of the
+    A/B run this same shim, so the ratio isolates the SCHEDULER: the
+    sync loop materializes right after dispatch and pays step + host
+    serially, the async loop does its host work under the in-flight
+    step. Prompt kernels run unpriced — overlap targets the decode
+    loop."""
+
+    def __init__(self, inner, step_cost_s):
+        self.inner = inner
+        self.step_cost_s = float(step_cost_s)
+        self.cache_sharding = getattr(inner, "cache_sharding", None)
+
+    def prefill(self, *a, **kw):
+        return self.inner.prefill(*a, **kw)
+
+    def chunk(self, *a, **kw):
+        return self.inner.chunk(*a, **kw)
+
+    def decode(self, *a, **kw):
+        ready_at = time.perf_counter() + self.step_cost_s
+        toks, keys, cache = self.inner.decode(*a, **kw)
+        return _LazyValue(toks, ready_at), _LazyValue(keys, ready_at), cache
+
+    @property
+    def prefill_traces(self):
+        return self.inner.prefill_traces
+
+    @property
+    def chunk_traces(self):
+        return self.inner.chunk_traces
+
+    @property
+    def decode_traces(self):
+        return self.inner.decode_traces
+
+
 def _bench_cache_sharding(mesh, kv_dtype_name):
     """Cache sharding for a sharded bench engine: pages on the heads
     axis, plus the replicated scale-pool sharding when KV is int8 (the
@@ -497,7 +560,18 @@ def run_generation_bench(args):
     Gates under ``--smoke``: tokens/sec >= 1.5x plain at the modeled
     ratio, ZERO greedy mismatches (speculative greedy is lossless), and
     no kernel re-traces after warmup (acceptance lengths are data).
-    Composes with ``--kv-dtype int8`` / ``--quantize int8``."""
+    Composes with ``--kv-dtype int8`` / ``--quantize int8``.
+
+    PR 19 — ``--async-sched``: the async-scheduling A/B column. The
+    same workload slice runs through a sync engine and an
+    ``async_scheduling=True`` engine over a modeled device whose step
+    cost is paid at MATERIALIZATION (``_AsyncCostKernels`` — dispatch
+    returns immediately, exactly like real async dispatch), plus a
+    fixed per-step host cost slept on the loop thread. Sync pays
+    step + host serially; async folds the host share into the
+    in-flight step's window. Gates under ``--smoke``: zero output
+    mismatches (byte-exact streams), ``step_overlap_frac`` > 0.5,
+    and async >= 1.2x sync tokens/sec at the 8 ms / 3 ms defaults."""
     from bigdl_tpu.nn.layers.attention import Transformer
     from bigdl_tpu.parallel import serving_meshes
     from bigdl_tpu.serving import (
@@ -1091,6 +1165,79 @@ def run_generation_bench(args):
             "host_tiers_drained": kv_on_drained and kv_off_drained,
         }
 
+    # async-scheduling column (PR 19): the first 2*slots requests of
+    # the same workload through a sync engine vs an
+    # async_scheduling=True engine, both over _AsyncCostKernels (the
+    # modeled step cost is paid at MATERIALIZATION, like a real
+    # accelerator's async dispatch) plus a fixed per-step host cost
+    # slept on the loop thread by the metrics hook below. The sync
+    # loop pays step + host serially every iteration (~11 ms at the
+    # 8/3 defaults); the async loop lands step N, dispatches N+1, and
+    # does the host share inside the in-flight window (~8 ms), so
+    # tokens/sec and ITL improve by ~host/step while the streams stay
+    # byte-exact. Gates under --smoke: ZERO mismatches,
+    # step_overlap_frac > 0.5, async >= 1.2x sync tokens/sec.
+    async_fields = {}
+    if args.async_sched:
+        as_step_ms = step_cost_ms if step_cost_ms > 0 else 8.0
+        as_host_ms = args.host_cost_ms
+        as_requests = requests[:2 * slots]
+
+        class _CostedMetrics(ServingMetrics):
+            # the modeled HOST share of one engine iteration
+            # (scheduling, delivery, stream pushes), slept on the loop
+            # thread where the real host work runs: record_decode_step
+            # fires once per decode step from inside the sync decode
+            # pass / the async landed-step processing, which is
+            # exactly the serial-vs-overlapped placement under test
+            def record_decode_step(self, *a, **kw):
+                time.sleep(as_host_ms / 1e3)
+                return super().record_decode_step(*a, **kw)
+
+        def run_async_leg(async_sched):
+            eng = GenerationEngine(
+                model, params, max_slots=slots, max_len=max_len,
+                max_prompt_len=max_prompt,
+                max_queue=max(64, 2 * len(as_requests)),
+                kernels=_AsyncCostKernels(kernels, as_step_ms / 1e3),
+                page_size=page_size, seed=0, cache_dtype=kv_dtype,
+                quantize=quantize, metrics=_CostedMetrics(),
+                async_scheduling=async_sched)
+            eng.warmup()
+            t0 = time.perf_counter()
+            ss = [eng.submit(p, max_new_tokens=m, **sample_spec)
+                  for p, m in as_requests]
+            leg_outs = [s.result(timeout=600) for s in ss]
+            wall = time.perf_counter() - t0
+            leg_snap = eng.metrics.snapshot()
+            eng.close()
+            return leg_outs, leg_snap, wall
+
+        as_sync_outs, as_sync_snap, as_sync_wall = run_async_leg(False)
+        as_outs, as_snap, as_wall = run_async_leg(True)
+        as_mismatches = sum(1 for a, b in zip(as_sync_outs, as_outs)
+                            if a != b)
+        as_tps = sum(len(o) for o in as_outs) / as_wall
+        as_sync_tps = sum(len(o) for o in as_sync_outs) / as_sync_wall
+        sync_itl = as_sync_snap["itl_ms"] or {}
+        async_itl = as_snap["itl_ms"] or {}
+        async_fields = {
+            "async_step_cost_ms": as_step_ms,
+            "async_host_cost_ms": as_host_ms,
+            "async_requests": len(as_requests),
+            "async_tokens_per_sec": round(as_tps, 2),
+            "sync_tokens_per_sec": round(as_sync_tps, 2),
+            "async_vs_sync": round(as_tps / as_sync_tps, 3),
+            "sync_itl_p50_ms": sync_itl.get("p50"),
+            "sync_itl_p99_ms": sync_itl.get("p99"),
+            "async_itl_p50_ms": async_itl.get("p50"),
+            "async_itl_p99_ms": async_itl.get("p99"),
+            "async_overlapped_steps": as_snap["overlapped_steps"],
+            "async_step_overlap_frac": round(
+                as_snap["step_overlap_frac"], 4),
+            "async_mismatches": as_mismatches,
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -1133,11 +1280,13 @@ def run_generation_bench(args):
         "speculate": args.speculate,
         "prefix_cache": bool(args.prefix_cache),
         "disaggregate": bool(args.disaggregate),
+        "async_sched": bool(args.async_sched),
         **rep_fields,
         **spec_fields,
         **prefix_fields,
         **disagg_fields,
         **host_fields,
+        **async_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -1301,6 +1450,29 @@ def run_generation_bench(args):
                     "not just move bytes)"
                     % (result["host_revisit_ttft_p50_on_ms"],
                        result["host_revisit_ttft_p50_off_ms"]))
+        if args.async_sched:
+            if result["async_mismatches"]:
+                raise SystemExit(
+                    "async smoke: %d request(s) decoded different tokens "
+                    "under async vs sync scheduling — the one-step "
+                    "scheduling lag discards rider tokens and the double "
+                    "buffer isolates in-flight dispatches; streams must "
+                    "be BYTE-exact" % result["async_mismatches"])
+            if result["async_step_overlap_frac"] <= 0.5:
+                raise SystemExit(
+                    "async smoke: only %.0f%% of engine steps ran host "
+                    "work under an in-flight decode step (gate: > 50%% — "
+                    "the overlap window must actually absorb the host "
+                    "share)" % (100 * result["async_step_overlap_frac"]))
+            if result["async_vs_sync"] < 1.2:
+                raise SystemExit(
+                    "async smoke: async scheduling sustains only %.2fx "
+                    "sync tokens/sec at the modeled %.0f ms step / "
+                    "%.0f ms host cost (gate: >= 1.2x — the host share "
+                    "must fold into the in-flight step's window)"
+                    % (result["async_vs_sync"],
+                       result["async_step_cost_ms"],
+                       result["async_host_cost_ms"]))
 
 
 def run_lm_bench(args):
@@ -3115,6 +3287,23 @@ def _parse_args(argv=None):
                          "--mode chaos: arm kv.offload/kv.restore over the "
                          "same replay and gate both tiers draining to zero "
                          "under injected copy faults")
+    ap.add_argument("--async-sched", action="store_true",
+                    help="serving --generate: add the async-scheduling "
+                         "column (PR 19) — the same workload slice through "
+                         "a sync engine vs an async_scheduling=True engine "
+                         "over a modeled device whose step cost is paid at "
+                         "MATERIALIZATION (dispatch returns immediately, "
+                         "like real async dispatch) plus a fixed per-step "
+                         "host cost on the loop thread; --smoke gates zero "
+                         "output mismatches (async must be byte-exact), "
+                         "step_overlap_frac > 0.5, and async >= 1.2x sync "
+                         "tokens/sec at the default 8 ms step / 3 ms host")
+    ap.add_argument("--host-cost-ms", type=float, default=3.0,
+                    help="--async-sched: modeled per-step HOST cost "
+                         "(scheduling, delivery, stream pushes), slept on "
+                         "the engine loop thread — the share async "
+                         "scheduling folds into the in-flight step's "
+                         "window and sync pays serially")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

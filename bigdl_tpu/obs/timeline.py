@@ -17,6 +17,15 @@ Semantics of the split (recorded by ``GenerationEngine._step``):
   wait since the host blocks fetching each step's tokens;
 - ``host_s`` — the iteration's remainder: admission, page
   reservation, retirement, metrics — pure host scheduling cost.
+
+PR 19 (``async_scheduling=True``) adds the overlap split:
+
+- ``step_gap_s`` — host-side gap between landing step N's tokens and
+  dispatching step N+1 (a lower bound on device idle between
+  consecutive steps; the sync path never records it);
+- ``host_overlapped_s`` — host work done AFTER step N+1 was
+  dispatched, i.e. scheduling/bookkeeping hidden under the in-flight
+  device step instead of serialized before the next dispatch.
 """
 
 from __future__ import annotations
@@ -30,7 +39,8 @@ from typing import Any, Callable, Dict, List, Optional
 class StepTimeline:
     """Bounded ring of per-iteration engine records + running totals."""
 
-    _FIELDS = ("host_s", "prefill_s", "decode_s", "verify_s")
+    _FIELDS = ("host_s", "prefill_s", "decode_s", "verify_s",
+               "step_gap_s", "host_overlapped_s")
 
     def __init__(self, capacity: int = 512,
                  clock: Callable[[], float] = time.monotonic):
@@ -45,6 +55,7 @@ class StepTimeline:
 
     def record(self, *, host_s: float, prefill_s: float = 0.0,
                decode_s: float = 0.0, verify_s: float = 0.0,
+               step_gap_s: float = 0.0, host_overlapped_s: float = 0.0,
                active: int = 0, queue_depth: int = 0,
                occupancy: float = 0.0, pages_in_use: int = 0) -> None:
         """One scheduler iteration (engine loop thread only)."""
@@ -54,10 +65,14 @@ class StepTimeline:
             self._totals["prefill_s"] += prefill_s
             self._totals["decode_s"] += decode_s
             self._totals["verify_s"] += verify_s
+            self._totals["step_gap_s"] += step_gap_s
+            self._totals["host_overlapped_s"] += host_overlapped_s
             self._rows.append({
                 "iter": self.iterations, "t": self._clock(),
                 "host_s": host_s, "prefill_s": prefill_s,
                 "decode_s": decode_s, "verify_s": verify_s,
+                "step_gap_s": step_gap_s,
+                "host_overlapped_s": host_overlapped_s,
                 "active": active, "queue_depth": queue_depth,
                 "occupancy": occupancy, "pages_in_use": pages_in_use,
             })
@@ -96,6 +111,11 @@ class StepTimeline:
             "window_mean_occupancy": (sum(occ) / len(occ)) if occ else 0.0,
             "window_mean_queue_depth": (sum(depth) / len(depth)
                                         if depth else 0.0),
+            # async-scheduling overlap split (PR 19) — appended after
+            # every earlier key, never reordered
+            "step_gap_ms": round(totals["step_gap_s"] * 1e3, 3),
+            "host_overlapped_ms": round(
+                totals["host_overlapped_s"] * 1e3, 3),
         }
 
     def format_timeline(self, last: int = 16) -> str:

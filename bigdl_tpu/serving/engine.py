@@ -743,6 +743,27 @@ class _SlotState:
         self.t_last = 0.0                 # last token's push time (ITL)
 
 
+class _StepTicket:
+    """One in-flight async decode step (PR 19): the device futures plus
+    the dispatch-time view the land needs. ``parts`` pins the exact
+    ``(slot, _SlotState)`` pairs the step computed for — at land time a
+    participant whose slot no longer maps to the SAME state (retired
+    and re-admitted, swapped out, cancelled) is skipped: its token is
+    the discarded rider token of the one-step scheduling lag.
+    ``positions`` is the dispatched position snapshot (unclamped rows
+    feed ``position + 1`` back into the live dispatch arrays)."""
+
+    __slots__ = ("parts", "positions", "toks", "keys", "overlap_s")
+
+    def __init__(self, parts: List[Tuple[int, "_SlotState"]],
+                 positions: "np.ndarray", toks, keys):
+        self.parts = parts
+        self.positions = positions
+        self.toks = toks          # device future: int32[max_slots]
+        self.keys = keys          # device future (paged) or None (dense)
+        self.overlap_s = 0.0      # host work done while in flight
+
+
 class _Core:
     """State shared between the engine facade and the loop thread:
     request/stream bookkeeping only, nothing heavy — so the loop can
@@ -829,12 +850,41 @@ def _engine_loop(engine_ref: "weakref.ref[GenerationEngine]",
             engine._watchdog.close(timeout=0)
 
 
+def _notify_core(core: _Core) -> None:
+    """``weakref.finalize`` callback registered on every engine: when
+    the owner drops the last strong reference without ``close()``, the
+    idle loop thread is parked in a PURE ``cond.wait()`` (no timeout —
+    the last GL003 busy-wait left the hot loop in PR 19), so GC itself
+    must deliver the wakeup that lets the loop observe the dead weakref
+    and exit. Takes the core, never the engine: a strong engine ref in
+    the finalizer's args would keep the engine alive forever."""
+    try:
+        with core.cond:
+            core.cond.notify_all()
+    except Exception:  # graftlint: disable=GL006
+        # interpreter teardown can run finalizers after the lock
+        # machinery is gone (nothing to log TO either); the daemon loop
+        # thread dies with the process anyway, so swallowing is safe
+        pass
+
+
 def _engine_loop_body(engine_ref: "weakref.ref[GenerationEngine]",
                       core: _Core) -> None:
     while True:
         with core.cond:
             while not core.pending and not core.active and not core.closed:
-                core.cond.wait(timeout=0.05)
+                # check the weakref BEFORE waiting, under the lock: the
+                # finalize hook notifies under this same lock, so a GC
+                # that lands between iterations (the collector holds the
+                # GIL, so the loop can be parked anywhere) is either seen
+                # here or its notify arrives after wait() releases the
+                # lock — the wakeup cannot be lost
+                if engine_ref() is None:
+                    break
+                # pure wait: close() notifies, submit() notifies, and
+                # engine GC notifies via the weakref.finalize hook —
+                # every wake source is explicit, so no polling timeout
+                core.cond.wait()
                 if engine_ref() is None:
                     break
             if core.closed:
@@ -919,7 +969,8 @@ class GenerationEngine:
                  tracer=None,
                  timeline_capacity: int = 512,
                  profile_dir: Optional[str] = None,
-                 profile_iters: int = 10):
+                 profile_iters: int = 10,
+                 async_scheduling: bool = False):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if max_len < 2:
@@ -941,6 +992,9 @@ class GenerationEngine:
         # occupancy); its aggregate feeds the metrics' engine_steps
         # block. `profile_dir` arms an opt-in jax.profiler trace
         # bracketing the first `profile_iters` scheduler iterations.
+        # `async_scheduling` (PR 19) overlaps the host share of every
+        # iteration with the in-flight decode step — same stream
+        # bytes, one step of scheduling lag; see _step_async.
         self.tracer = tracer
         self.timeline = StepTimeline(timeline_capacity)
         self._profile_dir = profile_dir
@@ -1318,6 +1372,34 @@ class GenerationEngine:
         if stall_timeout is not None:
             self._watchdog = Watchdog(
                 f"engine@{id(self):x}", stall_timeout, self._on_stall)
+        # async scheduling (PR 19): the loop lands step N's tokens,
+        # immediately dispatches step N+1 from snapshot inputs, and does
+        # ALL host work (delivery, retirement, admission, prefill
+        # chunks, KV-tier polls) while N+1 runs on device. Scheduling
+        # decisions lag one step — see _step_async. The speculative
+        # round's accept count is a host decision gating the round's
+        # FIRST draft input, so there is no overlap window to exploit
+        # without changing the speculative contract: a speculative
+        # engine keeps the sync path whatever the knob says.
+        self.async_scheduling = bool(async_scheduling)
+        self._async = self.async_scheduling and not self.speculative
+        self._inflight: Optional[_StepTicket] = None
+        # live per-slot dispatch inputs, the host half of the double
+        # buffer: arming (admission / final prefill chunk) and landing
+        # write here; every dispatch hands the kernels private COPIES,
+        # so mutations for step N+2 can never race the in-flight N+1
+        # (jax may alias a numpy argument's buffer on the CPU backend)
+        self._step_tokens = np.zeros((self.max_slots,), np.int32)
+        self._step_positions = np.zeros((self.max_slots,), np.int32)
+        # slots armed since the last dispatch: the next land must NOT
+        # fold the old ticket's rows over their fresh arming (a retired
+        # slot re-admitted while its last step was still in flight)
+        self._armed_dirty: set = set()
+        # GC-liveness wakeup for the pure cond.wait() idle loop: when
+        # the last strong engine ref drops, this finalizer (which holds
+        # only the core) nudges the loop awake to observe the dead
+        # weakref and exit
+        weakref.finalize(self, _notify_core, self._core)
         self._thread = threading.Thread(
             target=_engine_loop, args=(weakref.ref(self), self._core),
             name="bigdl-serving-engine", daemon=True)
@@ -1543,13 +1625,56 @@ class GenerationEngine:
         advance one prefill chunk per prefilling slot, then one decode
         step over every decoding slot. Each iteration lands one row in
         the step timeline (host vs device split) and the aggregate in
-        the metrics' ``engine_steps`` block."""
+        the metrics' ``engine_steps`` block.
+
+        With ``async_scheduling=True`` (and no speculative draft) the
+        iteration runs :meth:`_step_async` instead: land step N,
+        dispatch step N+1, then do the host work under the in-flight
+        step — same stream bytes, same executables, one step of
+        scheduling lag."""
+        if self._async:
+            return self._step_async()
         t_iter = time.monotonic()
         self._profile_tick()
+        self._maybe_flush_prefix()
+        if self._pending_offloads:
+            # reap landed device->host offload copies between
+            # iterations — a non-blocking poll; a copy still in flight
+            # waits for the next iteration, never a decode step
+            self._drain_offloads()
+        decode_s = verify_s = 0.0
+        core = self._core
+        prefill_s = self._admit_and_prefill()
+        with core.cond:
+            active = sorted((s, st) for s, st in core.active.items()
+                            if st.phase == "decode")
+        if active:
+            t0 = time.monotonic()
+            if self.speculative:
+                self._speculative_round(active)
+                verify_s = time.monotonic() - t0
+            else:
+                self._decode_once(active)
+                decode_s = time.monotonic() - t0
+        with core.cond:
+            depth = len(core.pending)
+            n_active = len(core.active)
+        device_s = prefill_s + decode_s + verify_s
+        host_s = max(0.0, time.monotonic() - t_iter - device_s)
+        self.timeline.record(
+            host_s=host_s, prefill_s=prefill_s, decode_s=decode_s,
+            verify_s=verify_s, active=n_active, queue_depth=depth,
+            occupancy=n_active / self.max_slots,
+            pages_in_use=self._pool.in_use if self.paged else 0)
+        self.metrics.record_engine_step(host_s, device_s)
+
+    def _maybe_flush_prefix(self) -> None:
+        """Apply a pending ``reload()`` prefix flush on the loop thread
+        (the only thread allowed to touch the pool)."""
         if self._prefix is not None and self._prefix_flush:
             # reload() ran on another thread: cached pages hold K/V the
-            # OLD params wrote — drop them here, on the only thread
-            # allowed to touch the pool, before any admission can probe
+            # OLD params wrote — drop them here before any admission
+            # can probe
             self._prefix_flush = False
             self._prefix.clear()
             if self._dprefix is not None:
@@ -1563,12 +1688,12 @@ class GenerationEngine:
                 self._host.clear()
             self._evict_stale = False
             self._report_pages()
-        if self._pending_offloads:
-            # reap landed device->host offload copies between
-            # iterations — a non-blocking poll; a copy still in flight
-            # waits for the next iteration, never a decode step
-            self._drain_offloads()
-        prefill_s = decode_s = verify_s = 0.0
+
+    def _admit_and_prefill(self) -> float:
+        """Admission + chunked-prefill pass shared by the sync and
+        async iterations; returns the prefill wall share. In the async
+        iteration this runs AFTER the next decode step was dispatched,
+        i.e. inside the overlap window."""
         core = self._core
         while True:
             swap_head = None
@@ -1621,6 +1746,7 @@ class GenerationEngine:
                 self._admit_paged(req)
             else:
                 self._admit(req)
+        prefill_s = 0.0
         if self.paged:
             with core.cond:
                 prefilling = sorted((s, st) for s, st in core.active.items()
@@ -1630,28 +1756,190 @@ class GenerationEngine:
                 for slot, st in prefilling:
                     self._prefill_chunk_once(slot, st)
                 prefill_s = time.monotonic() - t0
+        return prefill_s
+
+    def _step_async(self) -> None:
+        """One ASYNC scheduler iteration (``async_scheduling=True``):
+
+        1. LAND the in-flight step's token/key futures — the only
+           device sync in the loop;
+        2. DISPATCH the next decode step immediately, from the live
+           step arrays (landed rows folded in, re-armed rows skipped),
+           before ANY host bookkeeping runs;
+        3. PROCESS the landed step under the in-flight one: token
+           delivery, ITL, retirement — then admission, prefill chunks,
+           and the KV-tier offload poll, all inside the overlap window.
+
+        Scheduling decisions lag one step: a slot whose landed token
+        hits EOS / max-tokens / the deadline already rides in the step
+        dispatched at (2). Its extra token is discarded at the next
+        land (the participant no longer maps to the same slot state),
+        and its garbage K/V write goes to its own — by then possibly
+        recycled — pages at a clamped position: device program order
+        puts that write BEFORE any later owner's prefill, and causal
+        masking hides whatever the prefill does not overwrite (the same
+        recycled-page argument the sync engine already relies on).
+
+        Stream bytes are identical to the sync path: decode is per-row
+        independent (per-slot attention lanes, per-slot sampling keys),
+        so rider rows and stale garbage rows cannot perturb a live
+        row's token, and every dispatch input is a host numpy array
+        exactly like the sync path's — same executable signature, so
+        compile-once holds with zero new traces."""
+        t_iter = time.monotonic()
+        self._profile_tick()
+        self._maybe_flush_prefix()
+        core = self._core
+        decode_s = 0.0
+        ticket = self._inflight
+        toks = None
+        t_land_end = None
+        if ticket is not None:
+            self._inflight = None
+            t0 = time.monotonic()
+            toks = np.asarray(ticket.toks)
+            keys = (np.asarray(ticket.keys) if ticket.keys is not None
+                    else None)
+            decode_s = time.monotonic() - t0
+            t_land_end = time.monotonic()
+            # fold the landed rows into the live dispatch arrays —
+            # skipping rows armed since the ticket left: a slot retired
+            # and re-admitted while its last step was still in flight
+            # must keep its fresh arming, not the old ticket's output
+            for slot, _st in ticket.parts:
+                if slot in self._armed_dirty:
+                    continue
+                self._step_tokens[slot] = toks[slot]
+                self._step_positions[slot] = ticket.positions[slot] + 1
+                if keys is not None:
+                    self._keys[slot] = keys[slot]
+        # dispatch the next step BEFORE any host bookkeeping: from here
+        # to the next land, the device and the host run concurrently
         with core.cond:
             active = sorted((s, st) for s, st in core.active.items()
                             if st.phase == "decode")
+        step_gap_s = 0.0
+        t_disp = None
         if active:
             t0 = time.monotonic()
-            if self.speculative:
-                self._speculative_round(active)
-                verify_s = time.monotonic() - t0
-            else:
-                self._decode_once(active)
-                decode_s = time.monotonic() - t0
+            self._dispatch_decode(active)
+            t_disp = time.monotonic()
+            if t_land_end is not None:
+                # host-side gap between landing step N and dispatching
+                # step N+1 — a lower bound on device idle per step
+                step_gap_s = t0 - t_land_end
+        self._armed_dirty.clear()
+        # ---- overlap window: everything below runs while the step
+        # dispatched above is in flight on device ----
+        if ticket is not None:
+            self._process_landed(ticket, toks)
+        if self._pending_offloads:
+            # KV-tier poll (PR 18), relocated into the overlap window:
+            # reap landed device->host offload copies while the decode
+            # step runs instead of serializing before the next dispatch
+            self._drain_offloads()
+        prefill_s = self._admit_and_prefill()
         with core.cond:
             depth = len(core.pending)
             n_active = len(core.active)
-        device_s = prefill_s + decode_s + verify_s
-        host_s = max(0.0, time.monotonic() - t_iter - device_s)
+        t_end = time.monotonic()
+        overlapped_s = 0.0
+        if t_disp is not None:
+            # host share of the iteration spent under the in-flight
+            # step (the prefill-chunk device waits are not host work)
+            overlapped_s = max(0.0, t_end - t_disp - prefill_s)
+            if self._inflight is not None:
+                self._inflight.overlap_s = overlapped_s
+        device_s = prefill_s + decode_s
+        host_s = max(0.0, t_end - t_iter - device_s)
         self.timeline.record(
             host_s=host_s, prefill_s=prefill_s, decode_s=decode_s,
-            verify_s=verify_s, active=n_active, queue_depth=depth,
+            step_gap_s=step_gap_s, host_overlapped_s=overlapped_s,
+            active=n_active, queue_depth=depth,
             occupancy=n_active / self.max_slots,
             pages_in_use=self._pool.in_use if self.paged else 0)
-        self.metrics.record_engine_step(host_s, device_s)
+        self.metrics.record_engine_step(host_s, device_s,
+                                        overlapped=overlapped_s > 0)
+
+    def _dispatch_decode(self, active: List[Tuple[int, _SlotState]]) -> None:
+        """Launch one decode step without waiting for it (async path).
+        Inputs are freshly built / copied host arrays — the device-side
+        half of the double buffer: the engine may mutate the live
+        arrays for step N+2 the moment this returns. Positions clamp at
+        the lane end for rider rows (the speculative round's clamp
+        precedent); a rider's write lands in its own lane/pages and is
+        causally invisible to every later owner."""
+        faults.fire("engine.decode", engine=self)
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        for slot, _st in active:
+            tokens[slot] = self._step_tokens[slot]
+            positions[slot] = min(int(self._step_positions[slot]),
+                                  self.max_len - 1)
+        if self.paged:
+            toks_dev, keys_dev, self._cache = self.kernels.decode(
+                self._params, self._cache, tokens, positions,
+                self._page_map.copy(), self._temps.copy(),
+                self._top_ks.copy(), self._top_ps.copy(),
+                self._keys.copy())
+        else:
+            toks_dev, self._cache = self.kernels.decode(
+                self._params, self._cache, tokens, positions)
+            keys_dev = None
+        self._inflight = _StepTicket(list(active), positions, toks_dev,
+                                     keys_dev)
+
+    def _arm_async_slot(self, slot: int, st: _SlotState) -> None:
+        """Arm a slot's live dispatch inputs (async path). Every site
+        that hands a slot its first decodable token — dense admission,
+        the final prefill chunk, a decode-role / swap-resume admission
+        — writes the token and position HERE; the dispatch side reads
+        only these rows, because the slot state itself is updated by
+        the landing side one step late. Marking the row dirty keeps an
+        in-flight ticket's land from folding stale output over a fresh
+        arming (the slot retired and was re-admitted mid-flight)."""
+        if not self._async:
+            return
+        self._step_tokens[slot] = st.last_token
+        self._step_positions[slot] = st.position
+        self._armed_dirty.add(slot)
+
+    def _process_landed(self, ticket: _StepTicket,
+                        toks: "np.ndarray") -> None:
+        """Deliver a landed async step: push tokens, tick traces, record
+        ITL, retire — the sync `_decode_once` tail, one step late.
+        Participants whose slot no longer maps to the SAME state
+        (retired rider, swapped-out victim, re-admitted slot) are
+        skipped: their token is discarded, their stream untouched."""
+        core = self._core
+        with core.cond:
+            live = [(slot, st) for slot, st in ticket.parts
+                    if core.active.get(slot) is st]
+        now = time.monotonic()
+        self.metrics.record_decode_step(len(ticket.parts), self.max_slots)
+        sampled = 0
+        retired = []
+        for slot, st in live:
+            tok = int(toks[slot])
+            st.last_token = tok
+            st.position += 1
+            st.generated += 1
+            sampled += st.req.sampled
+            tr = st.req.stream.trace
+            if tr is not None:
+                tr.tick("decode")
+            if st.t_last:
+                self.metrics.record_itl(now - st.t_last)
+            st.t_last = now
+            st.req.stream._push(tok, now)
+            why = self._retire_why(st, st.req, now)
+            if why is not None:
+                retired.append((slot, st, why))
+        if sampled:
+            self.metrics.record_sampled(sampled)
+        for slot, st, why in retired:
+            self._release_slot(slot, st)
+            self._finish_slot(st, why, now)
 
     def _profile_tick(self) -> None:
         """Opt-in ``jax.profiler`` bracket: with ``profile_dir`` set,
@@ -2249,6 +2537,7 @@ class GenerationEngine:
         st.t_last = now
         with core.cond:
             core.active[slot] = st
+        self._arm_async_slot(slot, st)
         self._report_pages()
         if not swap:
             req.stream._push(tok, now)
@@ -2433,6 +2722,7 @@ class GenerationEngine:
         st.position = len(prompt)
         st.generated = 1
         st.t_last = now
+        self._arm_async_slot(slot, st)
         why = self._retire_why(st, req, now)
         if why is not None:
             self._release_slot(slot, st)
@@ -2622,6 +2912,7 @@ class GenerationEngine:
         if why is None:
             with core.cond:
                 core.active[slot] = st
+            self._arm_async_slot(slot, st)
         else:
             with core.cond:
                 core.free.append(slot)

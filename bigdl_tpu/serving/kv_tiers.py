@@ -14,8 +14,10 @@ InfiniGen — PAPERS.md):
   unreferenced page chain, the engine gathers each victim page into a
   fixed-shape device block (the SAME jitted gather the disaggregation
   handoff compiled), starts an async device->host copy, and — once the
-  copy lands, polled between scheduler iterations, never blocking a
-  step — files the page's host bytes here under the same
+  copy lands, polled between scheduler iterations (under async
+  scheduling the poll runs inside the overlap window, while the
+  dispatched decode step is still in flight on device), never
+  blocking a step — files the page's host bytes here under the same
   ``(model version, page-aligned token prefix)`` radix key the device
   index used. A later admission that misses the device index probes
   this store; a hit allocates fresh device pages, scatters the host

@@ -180,6 +180,13 @@ class ServingMetrics:
         self.host_pages = 0          # host-tier resident pages (gauge)
         self.host_bytes = 0          # host-tier resident bytes (gauge)
         self.host_pages_peak = 0
+        # async-scheduling counters (PR 19); zero for a sync engine —
+        # snapshot/table keep the earlier shapes (same append-only
+        # golden contract as every block above). A step is "overlapped"
+        # when host scheduling work ran while it was in flight on
+        # device (the engine dispatched step N+1 before processing
+        # step N's tokens).
+        self.overlapped_steps = 0
 
     # ------------------------------------------------------- mutators ----
 
@@ -314,14 +321,19 @@ class ServingMetrics:
 
     # ------------------------------------------- step-timeline mutators ----
 
-    def record_engine_step(self, host_s: float, device_s: float) -> None:
+    def record_engine_step(self, host_s: float, device_s: float,
+                           overlapped: bool = False) -> None:
         """One engine scheduler iteration: ``host_s`` spent on host-side
         scheduling/bookkeeping, ``device_s`` inside the iteration's
-        kernel-call regions (prefill chunks + decode/verify)."""
+        kernel-call regions (prefill chunks + decode/verify).
+        ``overlapped`` marks an async-scheduling iteration whose host
+        work ran under an in-flight device step (PR 19)."""
         with self._lock:
             self.engine_steps += 1
             self.step_host_s += float(host_s)
             self.step_device_s += float(device_s)
+            if overlapped:
+                self.overlapped_steps += 1
 
     # ----------------------------------------- prefix-cache mutators ----
 
@@ -540,6 +552,12 @@ class ServingMetrics:
                 "host_pages": self.host_pages,
                 "host_bytes": self.host_bytes,
                 "host_pages_peak": self.host_pages_peak,
+                # async-scheduling fields (PR 19): appended after every
+                # earlier key, never reordered
+                "overlapped_steps": self.overlapped_steps,
+                "step_overlap_frac": (self.overlapped_steps
+                                      / self.engine_steps
+                                      if self.engine_steps else 0.0),
             }
 
     def format_table(self) -> str:
@@ -661,4 +679,12 @@ class ServingMetrics:
             row("host_pages", s["host_pages"])
             row("host_bytes", s["host_bytes"])
             row("host_pages_peak", s["host_pages_peak"])
+        # async-scheduling rows: appended strictly after the KV-tier
+        # block and only when the engine actually overlapped a step —
+        # every earlier table stays a byte-identical strict prefix
+        # (append-only golden contract, test-enforced)
+        if s["overlapped_steps"]:
+            row("overlapped_steps", s["overlapped_steps"])
+            row("step_overlap_frac",
+                f"{s['step_overlap_frac'] * 100:.1f}%")
         return "\n".join(lines)

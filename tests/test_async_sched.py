@@ -1,0 +1,339 @@
+"""Async engine scheduling (PR 19): overlap host scheduling with the
+in-flight decode step.
+
+The load-bearing properties, per the subsystem contract:
+
+- ``async_scheduling=True`` emits BYTE-exact streams vs the sync
+  scheduler across {greedy, sampled} x {dense, paged} x {f32, int8} x
+  {whole, chunked prefill} x {tp1, tp2}; speculative engines fall back
+  to the sync path (the verify round's accept count is a host decision
+  gating the next round's first draft — no overlap window exists);
+- scheduling decisions lag ONE step: an EOS / max-token / deadline /
+  cancelled slot rides one extra in-flight step whose token is
+  discarded — neighbours' streams are untouched, slots and pages drain;
+- the double buffer holds: admissions and retirements mutating the
+  live step arrays mid-flight never perturb the dispatched step;
+- compile-once is preserved: async traffic adds ZERO decode traces and
+  ZERO pjit-cache entries over the sync warmup (numpy snapshot inputs
+  keep the one committed executable signature);
+- a step failure during an overlapped step fails every stream and
+  reconciles slots/pages exactly like the sync path;
+- the metrics/timeline overlap accounting moves: ``overlapped_steps``
+  and ``step_overlap_frac`` are live under async, zero under sync.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn.layers.attention import Transformer
+from bigdl_tpu.serving import (
+    DeadlineExceeded,
+    DecodeKernels,
+    GenerationEngine,
+    PagedDecodeKernels,
+    StreamCancelled,
+)
+
+from _serving_shims import SlowKernels as _SlowKernels  # noqa: E402
+from _serving_shims import arm_step_failure  # noqa: E402
+
+SLOTS, MAXLEN = 4, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+    params, _ = model.init(jax.random.key(0))
+    # one kernel pair for the whole module: the jit cache persists
+    # across engines, so each test pays bookkeeping, not recompilation
+    kernels = PagedDecodeKernels(model)
+    dense = DecodeKernels(model)
+    return model, params, kernels, dense
+
+
+def make_engine(lm, *, dense=False, **kw):
+    model, params, kernels, dkernels = lm
+    kw.setdefault("max_slots", SLOTS)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("kernels", dkernels if dense else kernels)
+    if not dense:
+        kw.setdefault("page_size", 8)
+    return GenerationEngine(model, params, **kw)
+
+
+# a mixed greedy+sampled workload with uneven lengths: staggered
+# retirements exercise the rider/lag path on every run
+PROMPTS = [[1, 5, 9], [2, 4, 6, 8, 10, 12], [3], [7, 11, 2, 9],
+           [6, 6, 6, 6, 6], [12, 1]]
+LENS = [8, 5, 11, 7, 4, 9]
+
+
+def run_workload(eng, *, sampled=True):
+    streams = []
+    for i, (p, n) in enumerate(zip(PROMPTS, LENS)):
+        kw = dict(max_new_tokens=n)
+        if sampled and i % 2:
+            kw.update(temperature=0.8, top_k=8, seed=100 + i)
+        streams.append(eng.submit(p, **kw))
+    outs = [s.result(timeout=60) for s in streams]
+    eng.close()
+    return outs
+
+
+# ------------------------------------------------------- bit identity ----
+
+
+class TestBitIdentity:
+    def test_paged_mixed_sampling(self, lm):
+        """The acceptance anchor: async == sync to the byte over a mixed
+        greedy+sampled paged workload with staggered retirements."""
+        want = run_workload(make_engine(lm))
+        got = run_workload(make_engine(lm, async_scheduling=True))
+        assert got == want
+
+    def test_paged_chunked_prefill(self, lm):
+        """Chunked prefill inside the overlap window: prompt chunks run
+        while a decode step is in flight; streams stay byte-exact."""
+        want = run_workload(make_engine(lm, prefill_chunk=4))
+        got = run_workload(make_engine(lm, prefill_chunk=4,
+                                       async_scheduling=True))
+        assert got == want
+
+    def test_dense_greedy(self, lm):
+        """The dense slot-table engine overlaps too (admission prefill
+        chains after the in-flight step on device; bytes unchanged)."""
+        want = run_workload(make_engine(lm, dense=True), sampled=False)
+        got = run_workload(make_engine(lm, dense=True,
+                                       async_scheduling=True),
+                           sampled=False)
+        assert got == want
+
+    @pytest.mark.slow
+    def test_int8(self, lm):
+        """int8 weights under async scheduling: same quantized streams."""
+        want = run_workload(make_engine(lm, quantize="int8", kernels=None))
+        got = run_workload(make_engine(lm, quantize="int8", kernels=None,
+                                       async_scheduling=True))
+        assert got == want
+
+    @pytest.mark.slow
+    def test_tp2(self, lm):
+        """tp=2: async over the sharded serving mesh equals the sync
+        sharded engine token for token."""
+        from bigdl_tpu.parallel import serving_meshes
+
+        model, params, _, _ = lm
+        outs = []
+        for async_sched in (False, True):
+            mesh = serving_meshes(1, 2)[0]
+            eng = GenerationEngine(model, params, max_slots=2,
+                                   max_len=MAXLEN, page_size=8, mesh=mesh,
+                                   async_scheduling=async_sched)
+            outs.append([eng.submit(p, max_new_tokens=n).result(timeout=240)
+                         for p, n in zip(PROMPTS[:3], LENS[:3])])
+            eng.close()
+        assert outs[1] == outs[0]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_speculative_falls_back_to_sync(self, lm, k):
+        """A speculative engine ignores the knob (no overlap window in
+        the draft/verify round) — the flag reads back, the loop runs the
+        sync path, and streams match a knob-off speculative engine."""
+        model, params, _, _ = lm
+        draft = Transformer(vocab_size=64, hidden_size=16, num_heads=2,
+                            filter_size=32, num_hidden_layers=1)
+        dparams, _ = draft.init(jax.random.key(1))
+        outs = []
+        for async_sched in (False, True):
+            eng = GenerationEngine(model, params, max_slots=2,
+                                   max_len=MAXLEN, page_size=8,
+                                   speculate=(draft, dparams, k),
+                                   async_scheduling=async_sched)
+            assert eng.async_scheduling is async_sched
+            assert eng._async is False  # spec always syncs
+            outs.append([eng.submit(p, max_new_tokens=n).result(timeout=240)
+                         for p, n in zip(PROMPTS[:3], LENS[:3])])
+            eng.close()
+        assert outs[1] == outs[0]
+
+
+# --------------------------------------------------- one-step-lag legs ----
+
+
+class _EchoPosition:
+    """Scripted stub (near-zero compile cost): the argmax token IS the
+    cache position, so a length-n prompt yields [n, n, n+1, n+2, ...]
+    — retirement points are exact and EOS lands where we script it."""
+
+    VOCAB = 64
+
+    def init_cache(self, max_slots, max_len, dtype):
+        import jax.numpy as jnp
+
+        return {"kv": jnp.zeros((max_slots, 1, max_len, 1), dtype)}
+
+    def prefill(self, params, cache, slot, tokens, length):
+        return jax.nn.one_hot(length, self.VOCAB), cache
+
+    def decode_step(self, params, cache, tokens, positions):
+        return jax.nn.one_hot(positions, self.VOCAB), cache
+
+
+def test_eos_retires_at_the_wall_despite_lag():
+    """Decode-time EOS under async: the EOS token is detected one step
+    LATE (at land), the slot rides one extra in-flight step, and that
+    rider token is discarded — the stream ends exactly at EOS while a
+    no-EOS neighbour runs to its max untouched."""
+    stub = _EchoPosition()
+    eng = GenerationEngine(stub, {}, max_slots=2, max_len=32,
+                           max_prompt_len=8, eos_id=5 + 2,
+                           async_scheduling=True)
+    with_eos = eng.submit([1, 2, 3, 4, 5], max_new_tokens=20)   # n = 5
+    without = eng.submit([1, 2, 3], max_new_tokens=6)           # n = 3
+    assert with_eos.result(timeout=30) == [5, 5, 6, 7]
+    assert without.result(timeout=30) == [3, 3, 4, 5, 6, 7]
+    assert eng.metrics.snapshot()["served"] == 2
+    assert sorted(eng.free_slots) == [0, 1]
+    eng.close()
+
+
+def test_deadline_expires_during_lag_window(lm):
+    """A deadline expiring while its slot's next step is already in
+    flight retires the stream at the land: DeadlineExceeded, partial
+    tokens kept, the concurrent no-deadline stream completes."""
+    model, params, kernels, _ = lm
+    eng = make_engine(lm, kernels=_SlowKernels(kernels),
+                      async_scheduling=True)
+    doomed = eng.submit([1, 2, 3], max_new_tokens=40, deadline=0.03)
+    live = eng.submit([4, 5], max_new_tokens=40)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    assert doomed.tokens, "expiry should keep the partial stream"
+    assert len(doomed.tokens) < 40
+    assert len(live.result(timeout=30)) == 40
+    snap = eng.metrics.snapshot()
+    assert snap["expired"] == 1 and snap["served"] == 1
+    eng.close()
+    assert eng._pool.in_use == 0
+
+
+def test_cancel_midflight_discards_rider_token(lm):
+    """cancel() lands at the next boundary even though a step for the
+    slot is in flight; the rider token never reaches the stream and the
+    pages drain."""
+    model, params, kernels, _ = lm
+    eng = make_engine(lm, kernels=_SlowKernels(kernels),
+                      async_scheduling=True)
+    s = eng.submit([1, 2], max_new_tokens=46)
+    deadline = time.monotonic() + 10
+    while len(s.tokens) < 2 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    s.cancel()
+    with pytest.raises(StreamCancelled):
+        s.result(timeout=30)
+    n_at_cancel = len(s.tokens)
+    assert 2 <= n_at_cancel < 46
+    time.sleep(0.05)  # the rider step lands here if anything leaked
+    assert len(s.tokens) == n_at_cancel
+    eng.close()
+    assert eng._pool.in_use == 0
+
+
+def test_admission_during_inflight_step_is_race_free(lm):
+    """The double-buffer contract: slots admitted (and slots retired +
+    re-admitted) while a step is in flight never perturb that step —
+    staggered submissions produce the same bytes as the sync engine."""
+    model, params, kernels, _ = lm
+
+    def staggered(eng):
+        streams = []
+        for i, (p, n) in enumerate(zip(PROMPTS, LENS)):
+            kw = dict(max_new_tokens=n)
+            if i % 2:
+                kw.update(temperature=0.8, top_k=8, seed=100 + i)
+            streams.append(eng.submit(p, **kw))
+            # land mid-flight: the ~2ms step cost guarantees a step is
+            # in the air when the next admission (and the re-admission
+            # into slots freed by short streams) mutates the arrays
+            time.sleep(0.003)
+        outs = [s.result(timeout=60) for s in streams]
+        eng.close()
+        return outs
+
+    want = staggered(make_engine(lm, max_slots=2,
+                                 kernels=_SlowKernels(kernels)))
+    got = staggered(make_engine(lm, max_slots=2,
+                                kernels=_SlowKernels(kernels),
+                                async_scheduling=True))
+    assert got == want
+
+
+# --------------------------------------- compile bounds / fault / metrics ----
+
+
+def test_async_adds_zero_traces_and_zero_executables(lm):
+    """Async dispatch feeds numpy snapshots — the SAME committed
+    executable signature as the sync path. Over the module's shared
+    (already-warm) kernels, an async run adds zero decode traces and
+    the pjit cache stays at one entry."""
+    model, params, kernels, _ = lm
+    # sync warms the signature, async must then add NOTHING (other
+    # tests in this module legitimately add entries for other
+    # max_slots shapes, so pin the delta, not the absolute size)
+    run_workload(make_engine(lm))
+    traces = kernels.decode_traces
+    cache = kernels._decode._cache_size()
+    run_workload(make_engine(lm, async_scheduling=True))
+    assert kernels.decode_traces == traces
+    assert kernels._decode._cache_size() == cache
+
+
+def test_step_failure_during_overlap_fails_streams_and_drains(lm):
+    """An armed engine.decode fault fires at DISPATCH of an overlapped
+    step: every stream fails loudly, the loop stops, and slots/pages
+    reconcile to empty — the sync failure contract, unchanged."""
+    model, params, kernels, _ = lm
+    eng = make_engine(lm, async_scheduling=True)
+    spec = arm_step_failure(eng, after=2)
+    streams = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(PROMPTS[:3], LENS[:3])]
+    for s in streams:
+        with pytest.raises(RuntimeError, match="injected"):
+            s.result(timeout=30)
+    assert spec.fired == 1
+    assert eng._pool.in_use == 0
+    assert eng._core.active == {}
+    eng.close()
+
+
+def test_overlap_accounting_moves_only_under_async(lm):
+    """overlapped_steps / step_overlap_frac count iterations whose host
+    work ran under an in-flight step: live under async, zero under
+    sync; the timeline's overlap split mirrors them."""
+    eng = make_engine(lm)
+    streams = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(PROMPTS[:3], LENS[:3])]
+    for s in streams:
+        s.result(timeout=60)
+    sync_snap = eng.metrics.snapshot()
+    eng.close()
+    assert sync_snap["overlapped_steps"] == 0
+    assert sync_snap["step_overlap_frac"] == 0.0
+
+    eng = make_engine(lm, async_scheduling=True)
+    streams = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(PROMPTS[:3], LENS[:3])]
+    for s in streams:
+        s.result(timeout=60)
+    snap = eng.metrics.snapshot()
+    tl = eng.timeline.snapshot()
+    eng.close()
+    assert snap["overlapped_steps"] > 0
+    assert 0.0 < snap["step_overlap_frac"] <= 1.0
+    assert tl["host_overlapped_ms"] > 0.0
+    assert tl["step_gap_ms"] >= 0.0

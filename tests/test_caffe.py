@@ -240,6 +240,9 @@ def test_standalone_scale_layer(tmp_path):
     np.testing.assert_allclose(out[0, :, 0, 0], gamma + beta, rtol=1e-6)
 
 
+@pytest.mark.slow  # full VGG16 build + roundtrip dominates tier-1 (~50 s);
+# the conv/BN/pool/IP conversion paths stay covered by the lighter
+# per-layer and inception/resnet roundtrips above
 def test_vgg16_caffe_roundtrip(tmp_path):
     """The BASELINE 'VGG-16 Caffe-loaded inference' config: persist our
     VGG-16 (width-reduced for CPU test speed via the same builder code

@@ -43,6 +43,8 @@ def test_rnn_cli():
     assert params is not None
 
 
+@pytest.mark.slow  # VGG16 end-to-end through the CLI (~40 s); the CLI
+# plumbing itself is covered by the fast non-VGG legs below
 def test_vgg_caffe_inference_cli(tmp_path):
     """The BASELINE 'VGG-16 Caffe-loaded inference' runnable config."""
     from bigdl_tpu.interop.caffe import save_caffe

@@ -669,13 +669,64 @@ def test_step_timeline_metrics_rows_append_after_speculative_block():
     assert extra == ["engine_steps", "step_host_ms", "step_device_ms",
                      "step_host_frac"]
     snap = m.snapshot()
-    # immediately before the PR-12 prefix-cache keys (append-only)
-    assert list(snap)[-22:-18] == ["engine_steps", "step_host_ms",
+    # immediately before the PR-12 prefix-cache keys (append-only;
+    # re-anchored for the PR-18 KV-tier and PR-19 async blocks)
+    assert list(snap)[-24:-20] == ["engine_steps", "step_host_ms",
                                  "step_device_ms", "step_host_frac"]
     assert snap["engine_steps"] == 2
     assert snap["step_host_ms"] == pytest.approx(3.0)
     assert snap["step_device_ms"] == pytest.approx(13.0)
     assert snap["step_host_frac"] == pytest.approx(3 / 16)
+
+
+def test_async_overlap_rows_append_after_kv_tier_block():
+    """PR-19 golden contract: the async-scheduling rows render strictly
+    AFTER the PR-18 KV-tier block — append-only, never reordered — and
+    the snapshot keys land at the tail."""
+    m = ServingMetrics()
+    m.record_served(0.010, 0.004)
+    m.record_decode_step(3, 4)
+    m.record_engine_step(0.002, 0.006)
+    m.record_itl(0.005)
+    m.record_offload(4)
+    m.record_restore(2)
+    m.record_swap_out()
+    m.record_swap_in()
+    m.set_host_pages(2, 4096)
+    pre_tokens = [ln.split()[0] for ln in m.format_table().splitlines()]
+    assert "overlapped_steps" not in pre_tokens   # sync engine: no rows
+
+    m.record_engine_step(0.001, 0.008, overlapped=True)
+    m.record_engine_step(0.001, 0.008, overlapped=True)
+    tokens = [ln.split()[0] for ln in m.format_table().splitlines()]
+    # the async rows are the table TAIL, strictly after the KV-tier
+    # block; every earlier row keeps its position (values aside)
+    assert tokens[:-2] == pre_tokens
+    assert tokens[-2:] == ["overlapped_steps", "step_overlap_frac"]
+    assert tokens.index("host_pages_peak") < tokens.index(
+        "overlapped_steps")
+    snap = m.snapshot()
+    assert list(snap)[-2:] == ["overlapped_steps", "step_overlap_frac"]
+    assert snap["overlapped_steps"] == 2
+    assert snap["step_overlap_frac"] == pytest.approx(2 / 3)
+
+
+def test_step_timeline_overlap_fields():
+    """PR-19: the timeline ring carries the per-iteration overlap
+    split and aggregates it in the snapshot (appended at the tail)."""
+    from bigdl_tpu.obs import StepTimeline
+
+    tl = StepTimeline(capacity=8)
+    tl.record(host_s=0.001, decode_s=0.004)
+    tl.record(host_s=0.001, decode_s=0.004, step_gap_s=0.0005,
+              host_overlapped_s=0.003, active=2, occupancy=0.5)
+    snap = tl.snapshot()
+    assert snap["step_gap_ms"] == pytest.approx(0.5)
+    assert snap["host_overlapped_ms"] == pytest.approx(3.0)
+    assert list(snap)[-2:] == ["step_gap_ms", "host_overlapped_ms"]
+    row = tl.recent(last=1)[0]
+    assert row["step_gap_s"] == pytest.approx(0.0005)
+    assert row["host_overlapped_s"] == pytest.approx(0.003)
 
 
 def test_step_timeline_ring_and_summary():

@@ -495,6 +495,8 @@ class TestSpeculativeEngine:
         e2.close()
         assert got == want
 
+    @pytest.mark.slow  # tp2 mesh leg (~27 s) — same tier as the other
+    # sharded identity legs (async/int8 tp2 are slow-marked too)
     def test_tp2_token_identity(self, lm):
         """tp=2 over the speculative tier: both models shard on the
         serving mesh, greedy decode equals the single-device engine
@@ -668,9 +670,9 @@ def test_speculative_metrics_rows_append_after_golden_order():
     assert snap["tokens_out"] == 9
     keys = list(snap)
     # the PR-10 block sits immediately before the PR-11 step-timeline,
-    # PR-12 prefix-cache, and PR-18 KV-tier keys (append-only: each
-    # PR's rows land AFTER every earlier block)
-    assert keys[-26:-22] == ["draft_tokens", "accepted_tokens",
+    # PR-12 prefix-cache, PR-18 KV-tier, and PR-19 async-scheduling
+    # keys (append-only: each PR's rows land AFTER every earlier block)
+    assert keys[-28:-24] == ["draft_tokens", "accepted_tokens",
                             "acceptance_rate", "verify_steps"]
 
 
