@@ -1238,6 +1238,148 @@ def run_generation_bench(args):
             "async_mismatches": as_mismatches,
         }
 
+    # structured-generation column (PR 20): the same prompts run
+    # CONSTRAINED by a token-level grammar automaton (--grammar
+    # regex|json) through the same kernels. Finite grammars only — the
+    # parse gate is 1.0, so the grammar must guarantee termination
+    # under greedy (fixed-length regex / enum+boolean-only schema; an
+    # unbounded [0-9]* integer field can legally out-digit any token
+    # budget and turn the gate into a coin flip). Columns: constrained
+    # tokens/sec, parse rate, masked-vocab fraction, engine-vs-static
+    # and speculative-vs-plain mismatches, and the speculative
+    # ACCEPTANCE-RATE DELTA vs unconstrained on the same prompts — the
+    # mask zeroes every illegal token's target probability, so
+    # rejections rise exactly where the draft would have wandered
+    # off-grammar. Gates under --smoke: parse rate 1.0 on BOTH
+    # constrained legs, zero mismatches, and compile-once (the mask is
+    # data riding the existing bias argument, never a new shape).
+    grammar_fields = {}
+    grammar_metrics = None
+    if args.grammar:
+        from bigdl_tpu.grammar import (
+            compile_grammar,
+            json_schema_grammar,
+            regex_grammar,
+        )
+        from bigdl_tpu.serving import SpeculativeKernels
+
+        # toy tokenizer over the bench vocab: printable ASCII at its
+        # codepoint (single-char tokens), everything else a placeholder
+        # string no character DFA can step through
+        gr_eos = 3
+        gr_vocab = [chr(i) if 32 <= i < 127 else f"<tok{i}>"
+                    for i in range(model.vocab_size)]
+        if args.grammar == "regex":
+            gr_spec = regex_grammar("id-[0-9][0-9][0-9]")
+        else:
+            gr_spec = json_schema_grammar({
+                "type": "object",
+                "properties": {"tool": {"enum": ["search", "calc"]},
+                               "ok": {"type": "boolean"}},
+                "required": ["tool", "ok"],
+            })
+        g = compile_grammar(gr_spec, gr_vocab, eos_id=gr_eos)
+        # longest legal emission + EOS with headroom; the grammar
+        # terminates every stream via EOS long before this budget
+        gr_new = 48
+
+        geng = GenerationEngine(
+            model, params, max_slots=slots, max_len=max_len,
+            max_prompt_len=max_prompt, max_queue=max(64, 2 * n_requests),
+            kernels=kernels, page_size=page_size, seed=0, eos_id=gr_eos,
+            cache_dtype=kv_dtype, quantize=quantize,
+            metrics=ServingMetrics())
+        geng.warmup()
+        gr_warm = (kernels.prefill_traces, kernels.chunk_traces,
+                   kernels.decode_traces)
+        t0 = time.perf_counter()
+        gstreams = [geng.submit(p, max_new_tokens=gr_new, grammar=g)
+                    for p, _ in requests]
+        gouts = [s.result(timeout=600) for s in gstreams]
+        gr_wall = time.perf_counter() - t0
+        gr_snap = geng.metrics.snapshot()
+        gr_buckets = geng.prompt_buckets
+        grammar_metrics = geng.metrics
+        geng.close()
+        gr_tokens = sum(len(o) for o in gouts)
+        gr_parse = sum(1 for o in gouts if g.matches(o))
+
+        # engine vs static under the grammar: the schedule-invariance
+        # contract extends to constrained streams (same kernels, same
+        # automaton, same per-slot bias rows)
+        gsouts, _ = static_generate(
+            model, params, [(p, gr_new) for p, _ in requests],
+            max_slots=slots, max_len=max_len, eos_id=gr_eos,
+            kernels=kernels, prompt_buckets=gr_buckets,
+            page_size=page_size, seed=0, cache_dtype=kv_dtype,
+            quantize=quantize,
+            sampling=[{"grammar": g}] * n_requests)
+        gr_post = (kernels.prefill_traces, kernels.chunk_traces,
+                   kernels.decode_traces)
+        gr_static_mismatches = sum(1 for a, b in zip(gouts, gsouts)
+                                   if a != b)
+
+        # speculative A/B on the same prompts: constrained vs
+        # unconstrained acceptance over one shared kernel set (the
+        # draft IS the target here, so unconstrained acceptance is the
+        # in-family ceiling and the delta isolates the mask's cost)
+        gr_k = args.speculate if args.speculate > 0 else 3
+        gr_skern = SpeculativeKernels(model, model)
+
+        def run_grammar_spec_leg(grammar):
+            eng = GenerationEngine(
+                model, params, max_slots=slots, max_len=max_len,
+                max_prompt_len=max_prompt,
+                max_queue=max(64, 2 * n_requests),
+                kernels=gr_skern, page_size=page_size, seed=0,
+                eos_id=gr_eos, cache_dtype=kv_dtype, quantize=quantize,
+                metrics=ServingMetrics(),
+                speculate=(model, params, gr_k))
+            eng.warmup()
+            ss = [eng.submit(p, max_new_tokens=gr_new, grammar=grammar)
+                  for p, _ in requests]
+            leg_outs = [s.result(timeout=600) for s in ss]
+            leg_snap = eng.metrics.snapshot()
+            eng.close()
+            return leg_outs, leg_snap
+
+        gspec_outs, gspec_snap = run_grammar_spec_leg(g)
+        gr_spec_warm = (gr_skern.draft_traces, gr_skern.verify_traces,
+                        gr_skern.chunk_traces, gr_skern.prefill_traces)
+        uspec_outs, uspec_snap = run_grammar_spec_leg(None)
+        gr_spec_post = (gr_skern.draft_traces, gr_skern.verify_traces,
+                        gr_skern.chunk_traces, gr_skern.prefill_traces)
+        acc_con = gspec_snap["acceptance_rate"]
+        acc_unc = uspec_snap["acceptance_rate"]
+        gr_spec_parse = sum(1 for o in gspec_outs if g.matches(o))
+        # speculative constrained greedy must be token-identical to
+        # plain constrained greedy — the masked-verify losslessness
+        gr_spec_mismatches = sum(1 for a, b in zip(gouts, gspec_outs)
+                                 if a != b)
+
+        grammar_fields = {
+            "grammar_kind": args.grammar,
+            "grammar_key": g.key,
+            "grammar_states": g.n_states,
+            "constrained_tokens_per_sec": round(gr_tokens / gr_wall, 2),
+            "constrained_tokens": gr_tokens,
+            "grammar_parse_rate": round(gr_parse / n_requests, 4),
+            "grammar_spec_parse_rate": round(gr_spec_parse / n_requests, 4),
+            "grammar_masked_vocab_frac": round(
+                gr_snap["masked_vocab_frac"], 4),
+            "grammar_constrained_streams": gr_snap["constrained_streams"],
+            "grammar_compile_cache_hits": gr_snap[
+                "grammar_compile_cache_hits"],
+            "grammar_static_mismatches": gr_static_mismatches,
+            "grammar_spec_vs_plain_mismatches": gr_spec_mismatches,
+            "grammar_speculate_k": gr_k,
+            "grammar_acceptance_constrained": round(acc_con, 4),
+            "grammar_acceptance_unconstrained": round(acc_unc, 4),
+            "grammar_acceptance_delta": round(acc_con - acc_unc, 4),
+            "grammar_compile_once": (gr_warm == gr_post
+                                     and gr_spec_warm == gr_spec_post),
+        }
+
     cont_tps = cont_tokens / cont_wall
     static_tps = static_tokens / static_wall
     ttft = snap["ttft_ms"] or {}
@@ -1281,12 +1423,14 @@ def run_generation_bench(args):
         "prefix_cache": bool(args.prefix_cache),
         "disaggregate": bool(args.disaggregate),
         "async_sched": bool(args.async_sched),
+        "grammar": args.grammar or "none",
         **rep_fields,
         **spec_fields,
         **prefix_fields,
         **disagg_fields,
         **host_fields,
         **async_fields,
+        **grammar_fields,
         "smoke": smoke,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
@@ -1299,6 +1443,7 @@ def run_generation_bench(args):
                               "prefix": prefix_cache_obj,
                               "disagg": disagg_metrics,
                               "kv_host": host_store_obj,
+                              "grammar": grammar_metrics,
                               "bench": result})
     print(json.dumps(result))
     if smoke:
@@ -1473,6 +1618,36 @@ def run_generation_bench(args):
                     % (result["async_vs_sync"],
                        result["async_step_cost_ms"],
                        result["async_host_cost_ms"]))
+        if args.grammar:
+            if result["grammar_parse_rate"] < 1.0 \
+                    or result["grammar_spec_parse_rate"] < 1.0:
+                raise SystemExit(
+                    "grammar smoke: parse rate %.2f plain / %.2f "
+                    "speculative (gate: 1.0 on BOTH — every constrained "
+                    "stream must parse; a finite grammar terminates via "
+                    "EOS inside any reasonable budget)"
+                    % (result["grammar_parse_rate"],
+                       result["grammar_spec_parse_rate"]))
+            if result["grammar_static_mismatches"]:
+                raise SystemExit(
+                    "grammar smoke: %d request(s) decoded different "
+                    "tokens under the engine vs static batching with the "
+                    "same grammar — constrained greedy is argmax over the "
+                    "legal set and must stay schedule-invariant"
+                    % result["grammar_static_mismatches"])
+            if result["grammar_spec_vs_plain_mismatches"]:
+                raise SystemExit(
+                    "grammar smoke: %d request(s) decoded different "
+                    "tokens speculatively vs plain under the same grammar "
+                    "— the mask zeroes illegal target probability, so "
+                    "masked speculative greedy must stay LOSSLESS"
+                    % result["grammar_spec_vs_plain_mismatches"])
+            if not result["grammar_compile_once"]:
+                raise SystemExit(
+                    "grammar smoke: a kernel re-traced after warmup with "
+                    "grammar masks in flight — the mask is DATA riding "
+                    "the existing per-slot bias argument, never a new "
+                    "traced shape")
 
 
 def run_lm_bench(args):
@@ -3304,6 +3479,18 @@ def _parse_args(argv=None):
                          "the engine loop thread — the share async "
                          "scheduling folds into the in-flight step's "
                          "window and sync pays serially")
+    ap.add_argument("--grammar", choices=("json", "regex"), default=None,
+                    help="serving --generate: add the structured-"
+                         "generation column (PR 20) — the same prompts "
+                         "constrained by a token-level grammar automaton "
+                         "(json: an enum+boolean tool-call schema; regex: "
+                         "a fixed-length id pattern) through the same "
+                         "kernels, plus a speculative constrained-vs-"
+                         "unconstrained acceptance-rate A/B; --smoke "
+                         "gates parse rate 1.0 on both constrained legs, "
+                         "zero engine-vs-static and speculative-vs-plain "
+                         "mismatches, and compile-once (the mask is data "
+                         "riding the per-slot bias argument)")
     ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
                     default="fp32",
                     help="serving --generate: KV page-pool storage dtype. "

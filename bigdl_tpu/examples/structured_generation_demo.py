@@ -1,0 +1,148 @@
+"""Structured-generation demo: a JSON tool-call schema through the
+router, every stream guaranteed to parse.
+
+N client threads submit prompts through a
+:class:`~bigdl_tpu.serving.ModelRouter` front door with a compiled
+grammar attached: a JSON schema for a tool call (``{"tool": ...,
+"ok": ...}``) lowered to a token-level automaton over the model's
+vocabulary (PR 20). Every step of a constrained stream samples under
+the automaton's current-state mask inside the jitted step — greedy is
+argmax over the LEGAL set — so the untrained toy model still emits
+syntactically perfect tool calls. The run ends with the metrics table
+(``constrained_streams`` / ``grammar_compile_cache_hits`` /
+``masked_vocab_frac``), the observed parse rate, and a few decoded
+calls.
+
+Run: ``python -m bigdl_tpu.examples.structured_generation_demo -n 12``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+
+# the demo's toy tokenizer: one printable character per token id (ids
+# 2..), id 0 = pad, id 1 = EOS — enough alphabet to spell a tool call
+_CHARS = ("abcdefghijklmnopqrstuvwxyz"
+          "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+          "0123456789{}\":,.-_[]() ")
+EOS_ID = 1
+
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "tool": {"enum": ["search", "calculator", "weather"]},
+        "ok": {"type": "boolean"},
+    },
+    "required": ["tool", "ok"],
+}
+
+
+def build_lm(vocab_size: int = 128):
+    from bigdl_tpu.nn.layers.attention import Transformer
+
+    return Transformer(vocab_size=vocab_size, hidden_size=160, num_heads=4,
+                       filter_size=320, num_hidden_layers=2)
+
+
+def make_vocab(n: int = 128):
+    vocab = [f"<{i}>" for i in range(n)]
+    for j, ch in enumerate(_CHARS):
+        vocab[j + 2] = ch
+    return vocab
+
+
+def main(argv=None):
+    from bigdl_tpu.grammar import compile_grammar, json_schema_grammar
+    from bigdl_tpu.serving import (
+        GenerationEngine, ModelRouter, PagedDecodeKernels,
+    )
+
+    ap = argparse.ArgumentParser("structured-generation-demo")
+    ap.add_argument("-n", "--requests", type=int, default=12,
+                    help="total tool-call requests")
+    ap.add_argument("-c", "--concurrency", type=int, default=4,
+                    help="client threads")
+    ap.add_argument("-s", "--slots", type=int, default=4,
+                    help="engine slot-table size")
+    ap.add_argument("--max-new", type=int, default=64,
+                    help="token budget per call (the grammar terminates "
+                         "via EOS well inside it)")
+    ap.add_argument("--temperature", type=float, default=0.8,
+                    help="sampling temperature over the LEGAL set "
+                         "(0 = constrained greedy)")
+    args = ap.parse_args(argv)
+
+    vocab_size = 128
+    model = build_lm(vocab_size)
+    params, _ = model.init(jax.random.key(0))
+    kernels = PagedDecodeKernels(model)
+
+    # one compile per distinct grammar — every request below shares it
+    grammar = compile_grammar(json_schema_grammar(TOOL_SCHEMA),
+                              make_vocab(vocab_size), eos_id=EOS_ID)
+    print(f"grammar: {grammar.n_states} automaton states over "
+          f"{grammar.vocab_size} tokens, start-state mask excludes "
+          f"{grammar.masked_frac(grammar.start_state) * 100:.1f}% of "
+          f"the vocabulary")
+
+    rs = np.random.RandomState(0)
+    requests = [rs.randint(2, vocab_size, (int(rs.randint(2, 10)),)).tolist()
+                for _ in range(args.requests)]
+
+    engine = GenerationEngine(
+        model, params, max_slots=args.slots, max_len=96,
+        max_prompt_len=16, max_queue=max(64, 2 * args.requests),
+        kernels=kernels, page_size=16, seed=0, eos_id=EOS_ID)
+    engine.warmup()
+
+    router = ModelRouter()
+    router.register("lm", engine)
+
+    outs = [None] * args.requests
+
+    def client(cid: int) -> None:
+        time.sleep(0.002 * cid)
+        streams = {}
+        for i in range(cid, args.requests, args.concurrency):
+            streams[i] = router.submit(
+                "lm", requests[i], max_new_tokens=args.max_new,
+                temperature=args.temperature, top_k=8, seed=100 + i,
+                grammar=grammar)
+        for i, stream in streams.items():
+            outs[i] = [tok for tok in stream]
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    snap = engine.metrics.snapshot()
+    print(engine.metrics.format_table())
+    router.close()
+
+    served = [o for o in outs if o is not None]
+    parsed = [o for o in served if grammar.matches(o)]
+    parse_rate = len(parsed) / max(len(served), 1)
+    tokens = sum(len(o) for o in served)
+    print(f"{len(served)} constrained streams, {tokens} tokens in "
+          f"{wall * 1e3:.0f} ms — parse rate "
+          f"{parse_rate * 100:.0f}%, mean masked-vocab fraction "
+          f"{snap['masked_vocab_frac'] * 100:.1f}%")
+    for o in served[:3]:
+        call = json.loads(grammar.text_of(o))
+        print(f"  tool call: {call}")
+    snap["parse_rate"] = parse_rate
+    return snap
+
+
+if __name__ == "__main__":
+    main()

@@ -184,17 +184,34 @@ class Attention(Module):
                     pk = pk.at[pg, :, row].set(k[:, :, 0, :].astype(pk.dtype))
                     pv = pv.at[pg, :, row].set(v[:, :, 0, :].astype(pv.dtype))
                 if bias is not None:
-                    # positions fully define the mask in a paged decode
-                    # step; no caller passes one (keep the contract
-                    # narrow instead of carrying an untested mask-
-                    # composition path)
-                    raise ValueError(
-                        "paged decode attention takes no external bias")
-                out3 = paged_attention(
-                    q[:, :, 0, :], pk, pv, page_map, pos,
-                    k_scales=pks, v_scales=pvs,
-                    use_kernel=paged.get("use_kernel"))
-                out = out3[:, :, None, :]
+                    # external-bias composition: gather the logical
+                    # lanes and add the caller's bias to the position-
+                    # validity mask — the same op sequence (and scale)
+                    # as paged_attention_reference, so an all-zero bias
+                    # is bit-identical to the unbiased path below. The
+                    # bias broadcasts against (S, 1, 1, L).
+                    if int8_kv:
+                        lk = dequantize_lanes(
+                            gather_kv_lanes(pk, page_map),
+                            gather_scale_lanes(pks, page_map))
+                        lv = dequantize_lanes(
+                            gather_kv_lanes(pv, page_map),
+                            gather_scale_lanes(pvs, page_map))
+                    else:
+                        lk = gather_kv_lanes(pk, page_map)  # (S, H, L, D)
+                        lv = gather_kv_lanes(pv, page_map)
+                    cols = jnp.arange(lk.shape[2])
+                    validity = jnp.where(
+                        cols[None, :] <= pos[:, None], 0.0,
+                        -1e9)[:, None, None, :]         # (S, 1, 1, L)
+                    out = dot_product_attention(q, lk, lv,
+                                                bias + validity)
+                else:
+                    out3 = paged_attention(
+                        q[:, :, 0, :], pk, pv, page_map, pos,
+                        k_scales=pks, v_scales=pvs,
+                        use_kernel=paged.get("use_kernel"))
+                    out = out3[:, :, None, :]
             else:
                 # prefill chunk: q rows are positions idx..idx+C-1 of ONE
                 # sequence whose page ids are the (ppn,) "map" row. Rows

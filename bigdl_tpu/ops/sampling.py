@@ -29,7 +29,7 @@ import numpy as np
 
 
 def sample_tokens(logits, temperature, top_k, top_p,
-                  key_data) -> Tuple[jax.Array, jax.Array]:
+                  key_data, bias=None) -> Tuple[jax.Array, jax.Array]:
     """Sample one token per slot from ``logits`` — jit-friendly, all
     per-slot parameters dynamic.
 
@@ -43,6 +43,11 @@ def sample_tokens(logits, temperature, top_k, top_p,
       (the first token is always kept).
     - ``key_data``: ``(S, 2)`` uint32 raw threefry key words, one stream
       per slot (see ``core.rng.threefry_key_data``).
+    - ``bias``: optional ``(S, V)`` float32 additive logit bias, applied
+      BEFORE everything else (greedy argmax included) — the grammar
+      mask's entry point (0 legal / -1e9 illegal rows from
+      ``grammar.TokenAutomaton``; an all-zero row is a no-op). A traced
+      value like the parameter arrays: changing it never recompiles.
 
     Returns ``(tokens (S,) int32, new_key_data (S, 2) uint32)``. Exactly
     ONE split is consumed per slot per call — token ``i`` of a stream
@@ -52,6 +57,8 @@ def sample_tokens(logits, temperature, top_k, top_p,
     evolution independent of the mix of sampling params in the batch).
     """
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     n, vocab = logits.shape
     temperature = temperature.astype(jnp.float32)
 
@@ -158,15 +165,23 @@ def position_uniform_host(key_data, stream: int, position: int) -> float:
     return float(jax.random.uniform(k, (), jnp.float32))
 
 
-def filtered_probs(logits, temperature, top_k, top_p) -> jax.Array:
+def filtered_probs(logits, temperature, top_k, top_p,
+                   bias=None) -> jax.Array:
     """The sampling DISTRIBUTION each slot actually draws from, in vocab
     order: ``logits`` (S, V) -> (S, V) float32 probabilities, normalized
     over the kept set after temperature scaling and the same top-k /
     top-p prefix filters as :func:`sample_tokens`. ``temperature <= 0``
     rows return the one-hot argmax delta — greedy expressed as a
     distribution, which is what lets the speculative accept/residual
-    formulas cover greedy rows with no special cases."""
+    formulas cover greedy rows with no special cases. ``bias`` is the
+    same optional (S, V) additive mask :func:`sample_tokens` takes —
+    softmax of a -1e9-masked logit underflows to exact f32 zero, so a
+    grammar-illegal token has zero probability here, which is what lets
+    ``speculative_sample`` stay unchanged under a grammar (an illegal
+    draft proposal is rejected with certainty: p_target = 0)."""
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     n, vocab = logits.shape
     temperature = temperature.astype(jnp.float32)
     t_safe = jnp.where(temperature > 0, temperature, 1.0)[:, None]
@@ -208,14 +223,15 @@ def pick_token(weights, u) -> jax.Array:
 
 
 def draft_sample(logits, temperature, top_k, top_p, key_data,
-                 out_pos) -> Tuple[jax.Array, jax.Array]:
+                 out_pos, bias=None) -> Tuple[jax.Array, jax.Array]:
     """One draft proposal per slot: sample from the draft model's
     filtered distribution using the DRAFT_STREAM draw for each slot's
     output position. Returns ``(tokens (S,) int32, dists (S, V)
     float32)`` — the full distribution rides along because the verify
     step needs it for the accept ratio and the residual. Greedy rows
-    (``temperature <= 0``) return the argmax and its one-hot delta."""
-    dists = filtered_probs(logits, temperature, top_k, top_p)
+    (``temperature <= 0``) return the argmax and its one-hot delta.
+    ``bias`` masks the draft under a grammar so proposals stay legal."""
+    dists = filtered_probs(logits, temperature, top_k, top_p, bias)
     u = position_uniform(key_data, DRAFT_STREAM, out_pos)
     return pick_token(dists, u), dists
 
@@ -296,10 +312,12 @@ def speculative_sample(target_logits, draft_tokens, draft_dists,
 
 
 def numpy_reference_filtered(logits, temperature, top_k,
-                             top_p) -> np.ndarray:
+                             top_p, bias=None) -> np.ndarray:
     """Pure-numpy single-slot mirror of :func:`filtered_probs` (vocab
     order), same f32 op sequence."""
     logits = np.asarray(logits, np.float32)
+    if bias is not None:
+        logits = (logits + np.asarray(bias, np.float32)).astype(np.float32)
     vocab = logits.shape[-1]
     if temperature <= 0:
         out = np.zeros(vocab, np.float32)
@@ -336,23 +354,30 @@ def numpy_reference_pick(weights, u) -> int:
 
 
 def numpy_reference_draft(logits, temperature, top_k, top_p, key_data,
-                          out_pos):
+                          out_pos, bias=None):
     """Single-slot oracle for :func:`draft_sample`: -> (token, dist)."""
-    dist = numpy_reference_filtered(logits, temperature, top_k, top_p)
+    dist = numpy_reference_filtered(logits, temperature, top_k, top_p,
+                                    bias)
     u = position_uniform_host(key_data, DRAFT_STREAM, out_pos)
     return numpy_reference_pick(dist, u), dist
 
 
 def numpy_reference_speculative(target_logits, draft_tokens, draft_dists,
                                 temperature, top_k, top_p, key_data,
-                                out_base):
+                                out_base, bias=None):
     """Single-slot oracle for one :func:`speculative_sample` step:
     ``target_logits`` (k+1, V), ``draft_tokens`` (k,), ``draft_dists``
     (k, V); -> ``(n_accepted, emitted token list of length
     n_accepted + 1)``. Uniforms replay via
     :func:`position_uniform_host`, so the oracle is driven by exactly
-    the draws the jitted sampler consumes."""
+    the draws the jitted sampler consumes. ``bias`` is the grammar mask
+    per verify position ((k+1, V)) — added to the target logits before
+    filtering, exactly where the verify kernel adds it (the sampler
+    itself stays unchanged: masked tokens carry zero target mass)."""
     target_logits = np.asarray(target_logits, np.float32)
+    if bias is not None:
+        target_logits = (target_logits
+                         + np.asarray(bias, np.float32)).astype(np.float32)
     k = len(draft_tokens)
     p = [numpy_reference_filtered(target_logits[i], temperature, top_k,
                                   top_p) for i in range(k + 1)]
@@ -383,12 +408,18 @@ def split_key_data(key_data: np.ndarray):
     return np.asarray(pair[0]), u
 
 
-def numpy_reference_sample(logits, temperature, top_k, top_p, u) -> int:
+def numpy_reference_sample(logits, temperature, top_k, top_p, u,
+                           bias=None) -> int:
     """Pure-numpy single-slot oracle for one :func:`sample_tokens` step,
     given the SAME uniform draw ``u`` (replay it with
     :func:`split_key_data`). The tests assert the jitted sampler picks
-    the identical token id per step at fixed seed."""
+    the identical token id per step at fixed seed. ``bias`` mirrors the
+    sampler's grammar-mask row: added to the f32 logits before the
+    greedy argmax and the filters — constrained greedy is the argmax
+    over the LEGAL set."""
     logits = np.asarray(logits, np.float32)
+    if bias is not None:
+        logits = (logits + np.asarray(bias, np.float32)).astype(np.float32)
     vocab = logits.shape[-1]
     if temperature <= 0:
         return int(np.argmax(logits))

@@ -76,6 +76,20 @@ lets the engine swap out a low-priority idle stream's pages to host to
 admit a blocked higher-priority request, resuming the parked stream
 byte-exact (see README "KV memory hierarchy").
 
+Structured generation (PR 20) makes a grammar a property of the
+request: ``submit(grammar=...)`` takes a token-level automaton compiled
+once per distinct regex/JSON-schema grammar (``bigdl_tpu.grammar``,
+cached and shared across requests), and every decode step of that
+stream samples under the automaton's current-state mask — delivered as
+the per-slot additive-bias argument the jitted step already traces, so
+compile-once and schedule invariance survive, and constrained/
+unconstrained slots share one executable. Every emitted stream parses;
+a budget-exhausted or wedged stream fails with a typed
+:class:`GrammarViolation`. Composes with chunked prefill, int8,
+tensor parallelism, and speculative decoding (masked tokens carry zero
+target probability, so the rejection sampler needs no changes — see
+README "Structured generation").
+
 ``optim.predictor.PredictionService`` is now a thin compatibility shim
 over :class:`InferenceService`.
 """
@@ -106,6 +120,7 @@ from bigdl_tpu.serving.paging import PagePool
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
+    GrammarViolation,
     Overloaded,
     RemoteError,
     ReplicaUnavailable,
@@ -135,6 +150,7 @@ __all__ = [
     "DynamicBatcher",
     "EnginePool",
     "GenerationEngine",
+    "GrammarViolation",
     "PageBlockMover",
     "PrefillWorker",
     "GenerationStream",

@@ -105,6 +105,7 @@ from bigdl_tpu.serving.batcher import bucket_sizes_for
 from bigdl_tpu.utils.errors import fresh_exception
 from bigdl_tpu.serving.errors import (
     DeadlineExceeded,
+    GrammarViolation,
     Overloaded,
     StreamCancelled,
 )
@@ -261,12 +262,12 @@ class PagedDecodeKernels:
         pin = _cache_pinner(cache_sharding)
 
         def prefill(params, cache, pages, tokens, start, length, trash,
-                    temp, top_k, top_p, key):
+                    temp, top_k, top_p, key, bias):
             counts.prefill += 1
             logits, cache = model.prefill_paged(
                 params, cache, pages, tokens, start, length, trash)
             toks, new_key = sample_tokens(logits[None], temp, top_k, top_p,
-                                          key)
+                                          key, bias)
             return toks[0], new_key, pin(cache)
 
         def chunk(params, cache, pages, tokens, start, length, trash):
@@ -276,13 +277,13 @@ class PagedDecodeKernels:
                                            need_logits=False))
 
         def decode(params, cache, tokens, positions, page_map,
-                   temps, top_ks, top_ps, keys):
+                   temps, top_ks, top_ps, keys, bias):
             counts.decode += 1
             logits, cache = model.decode_step_paged(
                 params, cache, tokens, positions, page_map,
                 use_kernel=use_kernel)
             toks, new_keys = sample_tokens(logits, temps, top_ks, top_ps,
-                                           keys)
+                                           keys, bias)
             return toks, new_keys, pin(cache)
 
         dn = (1,) if donate else ()
@@ -303,10 +304,11 @@ class PagedDecodeKernels:
         return self.counts.decode
 
     def prefill(self, params, cache, pages, tokens, start, length, trash,
-                temperature=0.0, top_k=0, top_p=1.0, key=None):
+                temperature=0.0, top_k=0, top_p=1.0, key=None, bias=None):
         """Final (or only) chunk of one prompt: writes its K/V rows and
-        samples the first generated token. -> ``(token, new_key (1, 2),
-        new cache)``; donates ``cache``."""
+        samples the first generated token (under the optional ``(1, V)``
+        grammar mask ``bias``). -> ``(token, new_key (1, 2), new
+        cache)``; donates ``cache``."""
         if key is None:
             key = np.zeros(2, np.uint32)
         return self._prefill(
@@ -314,7 +316,8 @@ class PagedDecodeKernels:
             np.asarray(tokens, np.int32), int(start), int(length),
             int(trash), np.asarray([temperature], np.float32),
             np.asarray([top_k], np.int32), np.asarray([top_p], np.float32),
-            np.asarray(key, np.uint32).reshape(1, 2))
+            np.asarray(key, np.uint32).reshape(1, 2),
+            None if bias is None else np.asarray(bias, np.float32))
 
     def chunk(self, params, cache, pages, tokens, start, length, trash):
         """Non-final prompt chunk: K/V writes only. -> new cache
@@ -325,15 +328,19 @@ class PagedDecodeKernels:
             int(trash))
 
     def decode(self, params, cache, tokens, positions, page_map,
-               temps, top_ks, top_ps, keys):
-        """One decode step for every slot. -> ``(next token per slot
-        (S,), new keys (S, 2), new cache)``; donates ``cache``."""
+               temps, top_ks, top_ps, keys, bias=None):
+        """One decode step for every slot (``bias``: optional ``(S, V)``
+        grammar mask, a traced value — pass it consistently, None or
+        array, to keep the one-executable contract). -> ``(next token
+        per slot (S,), new keys (S, 2), new cache)``; donates
+        ``cache``."""
         return self._decode(
             params, cache, np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32),
             np.asarray(page_map, np.int32),
             np.asarray(temps, np.float32), np.asarray(top_ks, np.int32),
-            np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32))
+            np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32),
+            None if bias is None else np.asarray(bias, np.float32))
 
 
 class _SpecTraceCounts:
@@ -407,11 +414,11 @@ class SpeculativeKernels:
         pin = _cache_pinner(cache_sharding)
 
         def prefill(params, cache, pages, tokens, start, length, trash,
-                    temp, top_k, top_p, key):
+                    temp, top_k, top_p, key, bias):
             counts.prefill += 1
             logits, cache = model.prefill_paged(
                 params, cache, pages, tokens, start, length, trash)
-            dist = filtered_probs(logits[None], temp, top_k, top_p)
+            dist = filtered_probs(logits[None], temp, top_k, top_p, bias)
             u = position_uniform(key, EXTRA_STREAM,
                                  jnp.zeros((1,), jnp.int32))
             return pick_token(dist, u)[0], pin(cache)
@@ -430,23 +437,28 @@ class SpeculativeKernels:
                 need_logits=False))
 
         def draft(dparams, dcache, tokens, positions, page_map, temps,
-                  top_ks, top_ps, keys, out_pos):
+                  top_ks, top_ps, keys, out_pos, bias):
             counts.draft += 1
             logits, dcache = draft_model.decode_step_paged(
                 dparams, dcache, tokens, positions, page_map,
                 use_kernel=use_kernel)
             toks, dists = draft_sample(logits, temps, top_ks, top_ps,
-                                       keys, out_pos)
+                                       keys, out_pos, bias)
             return toks, dists, pin(dcache)
 
         def verify(params, cache, last_tokens, draft_tokens, positions,
                    page_map, trash, temps, top_ks, top_ps, keys,
-                   out_base, draft_dists):
+                   out_base, draft_dists, bias):
             counts.verify += 1
             tokens = jnp.stack((last_tokens,) + tuple(draft_tokens),
                                axis=1)
             logits, cache = model.decode_verify_paged(
                 params, cache, tokens, positions, page_map, trash)
+            if bias is not None:
+                # grammar mask per verify position: masked tokens get
+                # zero target probability, so speculative_sample itself
+                # is untouched (an illegal draft is rejected w.p. 1)
+                logits = logits.astype(jnp.float32) + bias
             n_acc, out = speculative_sample(
                 logits, jnp.stack(tuple(draft_tokens), axis=1),
                 jnp.stack(tuple(draft_dists), axis=1),
@@ -488,11 +500,12 @@ class SpeculativeKernels:
         return self.counts.verify
 
     def prefill(self, params, cache, pages, tokens, start, length, trash,
-                temperature=0.0, top_k=0, top_p=1.0, key=None):
+                temperature=0.0, top_k=0, top_p=1.0, key=None, bias=None):
         """Final (or only) chunk of one prompt through the TARGET:
         writes its K/V rows and samples the first generated token (the
-        EXTRA_STREAM draw at output position 0). -> ``(token, new
-        cache)``; donates ``cache``."""
+        EXTRA_STREAM draw at output position 0, under the optional
+        ``(1, V)`` grammar mask). -> ``(token, new cache)``; donates
+        ``cache``."""
         if key is None:
             key = np.zeros(2, np.uint32)
         return self._prefill(
@@ -500,7 +513,8 @@ class SpeculativeKernels:
             np.asarray(tokens, np.int32), int(start), int(length),
             int(trash), np.asarray([temperature], np.float32),
             np.asarray([top_k], np.int32), np.asarray([top_p], np.float32),
-            np.asarray(key, np.uint32).reshape(1, 2))
+            np.asarray(key, np.uint32).reshape(1, 2),
+            None if bias is None else np.asarray(bias, np.float32))
 
     def chunk(self, params, cache, pages, tokens, start, length, trash):
         """Non-final prompt chunk through the TARGET: K/V writes only.
@@ -520,30 +534,36 @@ class SpeculativeKernels:
             int(trash))
 
     def draft(self, dparams, dcache, tokens, positions, page_map, temps,
-              top_ks, top_ps, keys, out_pos):
-        """One draft decode step for every slot. -> ``(tokens (S,),
-        dists (S, V), new draft cache)``; donates ``dcache``."""
+              top_ks, top_ps, keys, out_pos, bias=None):
+        """One draft decode step for every slot (``bias``: optional
+        ``(S, V)`` grammar mask — the draft proposes only legal
+        tokens). -> ``(tokens (S,), dists (S, V), new draft cache)``;
+        donates ``dcache``."""
         return self._draft(
             dparams, dcache, np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32),
             np.asarray(page_map, np.int32), np.asarray(temps, np.float32),
             np.asarray(top_ks, np.int32), np.asarray(top_ps, np.float32),
-            np.asarray(keys, np.uint32), np.asarray(out_pos, np.int32))
+            np.asarray(keys, np.uint32), np.asarray(out_pos, np.int32),
+            None if bias is None else np.asarray(bias, np.float32))
 
     def verify(self, params, cache, last_tokens, draft_tokens, positions,
                page_map, trash, temps, top_ks, top_ps, keys, out_base,
-               draft_dists):
+               draft_dists, bias=None):
         """The target's verify forward + rejection sampler.
         ``draft_tokens`` / ``draft_dists`` are the k-tuples of device
-        arrays the draft steps returned. -> ``(n_accepted (S,), tokens
-        (S, k+1), new cache)``; donates ``cache``."""
+        arrays the draft steps returned; ``bias`` is the optional
+        ``(S, k+1, V)`` stacked grammar mask added to the target logits
+        before the sampler. -> ``(n_accepted (S,), tokens (S, k+1), new
+        cache)``; donates ``cache``."""
         return self._verify(
             params, cache, np.asarray(last_tokens, np.int32),
             tuple(draft_tokens), np.asarray(positions, np.int32),
             np.asarray(page_map, np.int32), int(trash),
             np.asarray(temps, np.float32), np.asarray(top_ks, np.int32),
             np.asarray(top_ps, np.float32), np.asarray(keys, np.uint32),
-            np.asarray(out_base, np.int32), tuple(draft_dists))
+            np.asarray(out_base, np.int32), tuple(draft_dists),
+            None if bias is None else np.asarray(bias, np.float32))
 
 
 class GenerationStream:
@@ -685,14 +705,14 @@ def _block_ready(block) -> bool:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "deadline", "stream",
                  "temperature", "top_k", "top_p", "seed", "tag", "handoff",
-                 "priority")
+                 "priority", "grammar")
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
                  deadline: Optional[float], stream: GenerationStream,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: Optional[int] = None,
                  tag: Any = None, handoff: Optional[dict] = None,
-                 priority: int = 0):
+                 priority: int = 0, grammar=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
@@ -706,6 +726,7 @@ class _GenRequest:
         self.priority = int(priority)  # QoS tier (PR 18): a page-blocked
         #                                higher-priority head may swap out
         #                                lower-priority active streams
+        self.grammar = grammar    # compiled TokenAutomaton (PR 20) or None
 
     @property
     def sampled(self) -> bool:
@@ -720,7 +741,8 @@ class _SlotState:
 
     __slots__ = ("req", "last_token", "position", "generated", "t_admit",
                  "phase", "pages", "page_row", "prefill_pos",
-                 "draft_pages", "dpage_row", "cache_version", "t_last")
+                 "draft_pages", "dpage_row", "cache_version", "t_last",
+                 "grammar_state", "grammar_error")
 
     def __init__(self, req: _GenRequest, last_token: int, position: int,
                  generated: int, t_admit: float, phase: str = "decode",
@@ -741,6 +763,8 @@ class _SlotState:
         self.dpage_row = dpage_row        # draft (ppn,) map row (spec)
         self.cache_version = 0            # prefix-index version at admit
         self.t_last = 0.0                 # last token's push time (ITL)
+        self.grammar_state = None         # automaton state (None until armed)
+        self.grammar_error = None         # pending GrammarViolation
 
 
 class _StepTicket:
@@ -1285,6 +1309,21 @@ class GenerationEngine:
             self._top_ks = np.zeros((self.max_slots,), np.int32)
             self._top_ps = np.ones((self.max_slots,), np.float32)
             self._keys = np.zeros((self.max_slots, 2), np.uint32)
+            # grammar-constrained decoding (PR 20): per-slot additive
+            # mask rows, a traced (S, V) input of every sampling kernel.
+            # Always the SAME kind of argument per engine (array, or
+            # consistently None when the model exposes no vocab_size):
+            # jit treats None as an empty pytree, so flip-flopping would
+            # double the executable set. Unconstrained slots keep
+            # all-zero rows — a constant shift, bitwise no-op.
+            vocab = getattr(model, "vocab_size", None)
+            self._bias = (np.zeros((self.max_slots, int(vocab)), np.float32)
+                          if vocab else None)
+            # distinct grammar keys seen by THIS engine: a submit whose
+            # automaton key is already here shares the compiled tables
+            # (the module compile cache made that sharing free) — the
+            # grammar_compile_cache_hits metric counts those reuses
+            self._grammars: set = set()
             if self.speculative:
                 # the draft cache spans the same page-id space; its map
                 # rows park on the shared trash page exactly like the
@@ -1414,7 +1453,8 @@ class GenerationEngine:
                top_p: float = 1.0,
                seed: Optional[int] = None,
                tag: Any = None,
-               priority: int = 0) -> GenerationStream:
+               priority: int = 0,
+               grammar=None) -> GenerationStream:
         """Enqueue one prompt (sequence of token ids). ``max_new_tokens``
         caps generation (default: whatever fits in ``max_len``);
         ``deadline`` is seconds from now — an expired request retires
@@ -1439,7 +1479,16 @@ class GenerationEngine:
         STRICTLY lower priority may swap out through the host tier to
         admit it; they resume byte-exactly once pages free. Equal
         priorities never displace each other — default-0 traffic is
-        plain FIFO."""
+        plain FIFO.
+
+        ``grammar`` (PR 20, paged engine only): a compiled
+        :class:`~bigdl_tpu.grammar.TokenAutomaton` over this model's
+        vocabulary. Every step of the stream then samples under the
+        automaton's current-state mask (greedy = argmax over the LEGAL
+        set), the state advances host-side per emitted token, and the
+        stream is guaranteed to parse — a stream that cannot reach a
+        parse (budget exhausted mid-grammar, or a stuck state) fails
+        with :class:`GrammarViolation` instead of emitting garbage."""
         if self.role == "decode":
             raise RuntimeError(
                 "a decode-role engine admits only prefilled requests "
@@ -1458,6 +1507,34 @@ class GenerationEngine:
                 "dense DecodeKernels path is the greedy PR-5 baseline")
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
+        if grammar is not None:
+            if not self.paged:
+                raise ValueError(
+                    "grammar-constrained decoding needs the paged engine "
+                    "(the mask rides the in-step sampler)")
+            if self._bias is None:
+                raise ValueError(
+                    "grammar-constrained decoding needs a model exposing "
+                    "vocab_size (the per-slot mask is (S, vocab))")
+            if self.role != "both":
+                raise ValueError(
+                    "grammar-constrained decoding does not cross the "
+                    "prefill/decode handoff yet — submit to a monolithic "
+                    "(role='both') engine")
+            if not hasattr(grammar, "bias_row"):
+                raise TypeError(
+                    "grammar must be a compiled TokenAutomaton — build "
+                    "one with grammar.compile_grammar(regex_grammar(...) "
+                    "or json_schema_grammar(...), vocab, eos_id)")
+            if grammar.vocab_size != self._bias.shape[1]:
+                raise ValueError(
+                    f"grammar compiled over a {grammar.vocab_size}-token "
+                    f"vocabulary, model has {self._bias.shape[1]}")
+            if grammar.eos_id != self.eos_id:
+                raise ValueError(
+                    f"grammar compiled with eos_id={grammar.eos_id}, "
+                    f"engine has eos_id={self.eos_id} — the EOS mask "
+                    f"column is how a constrained stream terminates")
         room = self.max_len - len(prompt)
         mnt = room if max_new_tokens is None else min(int(max_new_tokens), room)
         if mnt < 1:
@@ -1490,7 +1567,7 @@ class GenerationEngine:
                           stream, temperature=temperature, top_k=int(top_k),
                           top_p=float(top_p),
                           seed=None if seed is None else int(seed),
-                          tag=tag, priority=int(priority))
+                          tag=tag, priority=int(priority), grammar=grammar)
         core = self._core
         try:
             with core.cond:
@@ -1509,6 +1586,15 @@ class GenerationEngine:
                     # trace — a post-notify event would mutate a trace
                     # already retired into the finished ring
                     tr.event("submit", queue_depth=len(core.pending) + 1)
+                if grammar is not None:
+                    # shared-grammar accounting: a key this engine has
+                    # already served means the compiled automaton (and
+                    # its mask tables) were reused via the module
+                    # compile cache rather than rebuilt
+                    if grammar.key in self._grammars:
+                        self.metrics.record_grammar_cache_hit()
+                    else:
+                        self._grammars.add(grammar.key)
                 core.pending.append(req)
                 depth = len(core.pending)
                 core.cond.notify_all()
@@ -1813,6 +1899,14 @@ class GenerationEngine:
                 self._step_positions[slot] = ticket.positions[slot] + 1
                 if keys is not None:
                     self._keys[slot] = keys[slot]
+                # grammar (PR 20): the advance must land HERE, before
+                # the next dispatch reads self._bias — the mask for
+                # step N+1 reflects the token step N emitted. Verdicts
+                # (stuck/violation) are recorded on the slot state and
+                # surfaced by _process_landed below; the fold-in filter
+                # (armed-dirty skip) matches _process_landed's identity
+                # filter, so exactly the delivered slots advance.
+                self._grammar_step(slot, _st, int(toks[slot]))
         # dispatch the next step BEFORE any host bookkeeping: from here
         # to the next land, the device and the host run concurrently
         with core.cond:
@@ -1881,7 +1975,8 @@ class GenerationEngine:
                 self._params, self._cache, tokens, positions,
                 self._page_map.copy(), self._temps.copy(),
                 self._top_ks.copy(), self._top_ps.copy(),
-                self._keys.copy())
+                self._keys.copy(),
+                bias=None if self._bias is None else self._bias.copy())
         else:
             toks_dev, self._cache = self.kernels.decode(
                 self._params, self._cache, tokens, positions)
@@ -1932,7 +2027,10 @@ class GenerationEngine:
                 self.metrics.record_itl(now - st.t_last)
             st.t_last = now
             st.req.stream._push(tok, now)
-            why = self._retire_why(st, st.req, now)
+            # the automaton already advanced in the fold-in (the mask
+            # had to be live before the dispatch above) — only the
+            # verdict is read here
+            why = self._grammar_why(st, self._retire_why(st, st.req, now))
             if why is not None:
                 retired.append((slot, st, why))
         if sampled:
@@ -2231,6 +2329,11 @@ class GenerationEngine:
                     for slot, st in core.active.items()
                     if st.phase == "decode" and st.pages
                     and st.req.priority < head.priority
+                    and st.req.grammar is None
+                    # constrained streams never swap: the resume payload
+                    # carries no automaton state, and replaying the
+                    # advance through the host tier buys nothing — the
+                    # head waits for a different victim instead
                     and st.generated < st.req.max_new_tokens
                     and st.position < self.max_len]
             if not victims:
@@ -2305,6 +2408,8 @@ class GenerationEngine:
         self._top_ks[slot] = 0
         self._top_ps[slot] = 1.0
         self._keys[slot] = 0
+        if self._bias is not None:
+            self._bias[slot] = 0.0
         self._evict_stale = False
         self._host.park_stream(swap_id, len(meta))
         self.metrics.record_swap_out()
@@ -2357,6 +2462,8 @@ class GenerationEngine:
         if why is not None:
             self._finish_request(req, why, now, queue_wait=None)
             return
+        if req.grammar is not None:
+            self.metrics.record_constrained_stream()
         core = self._core
         with core.cond:
             core.free.sort()
@@ -2681,10 +2788,17 @@ class GenerationEngine:
         # the final chunk arms the slot's step inputs: sampling params,
         # the request's PRNG key (fresh HERE, so token i always draws
         # from split i whatever decode traffic ran during the prefill),
-        # and — after the K/V writes land — the live page-map row
+        # the grammar start-state mask row (a stale async fold-in may
+        # have scribbled a retired owner's row — reset then arm), and —
+        # after the K/V writes land — the live page-map row
         self._temps[slot] = req.temperature
         self._top_ks[slot] = req.top_k
         self._top_ps[slot] = req.top_p
+        if self._bias is not None:
+            self._bias[slot] = 0.0
+            self._grammar_arm(slot, st)
+        bias1 = (None if self._bias is None
+                 else self._bias[slot:slot + 1].copy())
         if self.speculative:
             # speculative sampling is keyed by (request, output
             # position), never by step — `_keys[slot]` holds the CONSTANT
@@ -2693,7 +2807,7 @@ class GenerationEngine:
             tok_dev, self._cache = self.kernels.prefill(
                 self._params, self._cache, pages_row, padded, start,
                 remaining, self._pool.trash, self._temps[slot],
-                self._top_ks[slot], self._top_ps[slot], key)
+                self._top_ks[slot], self._top_ps[slot], key, bias=bias1)
             self._dcache = self.kernels.draft_write(
                 self._draft_params, self._dcache, st.dpage_row, padded,
                 start, remaining, self._pool.trash)
@@ -2704,7 +2818,7 @@ class GenerationEngine:
                 self._params, self._cache, pages_row, padded, start,
                 remaining, self._pool.trash, self._temps[slot],
                 self._top_ks[slot], self._top_ps[slot],
-                self._request_key(req))
+                self._request_key(req), bias=bias1)
             self._keys[slot] = np.asarray(key_dev)[0]
         tok = int(np.asarray(tok_dev))
         self._page_map[slot] = pages_row
@@ -2722,8 +2836,9 @@ class GenerationEngine:
         st.position = len(prompt)
         st.generated = 1
         st.t_last = now
+        self._grammar_step(slot, st, tok)
         self._arm_async_slot(slot, st)
-        why = self._retire_why(st, req, now)
+        why = self._grammar_why(st, self._retire_why(st, req, now))
         if why is not None:
             self._release_slot(slot, st)
             self._finish_slot(st, why, now)
@@ -2794,6 +2909,9 @@ class GenerationEngine:
             self._top_ks[slot] = 0
             self._top_ps[slot] = 1.0
             self._keys[slot] = 0
+            if self._bias is not None:
+                self._bias[slot] = 0.0  # unconstrained no-op row
+            st.grammar_state = None
             self._evict_stale = False   # released pages: re-scan is live
             self._report_pages()
 
@@ -2932,7 +3050,7 @@ class GenerationEngine:
             toks_dev, keys_dev, self._cache = self.kernels.decode(
                 self._params, self._cache, tokens, positions,
                 self._page_map, self._temps, self._top_ks, self._top_ps,
-                self._keys)
+                self._keys, bias=self._bias)
             self._keys = np.array(keys_dev)  # writable copy (host-mutated)
         else:
             toks_dev, self._cache = self.kernels.decode(
@@ -2957,7 +3075,8 @@ class GenerationEngine:
                 self.metrics.record_itl(now - st.t_last)
             st.t_last = now
             st.req.stream._push(tok, now)
-            why = self._retire_why(st, st.req, now)
+            self._grammar_step(slot, st, tok)
+            why = self._grammar_why(st, self._retire_why(st, st.req, now))
             if why is not None:
                 retired.append((slot, st, why))
         if sampled:
@@ -2988,10 +3107,31 @@ class GenerationEngine:
             tokens[slot] = st.last_token
             positions[slot] = st.position
             out_base[slot] = st.generated
+        # grammar (PR 20): draft step i and verify position i share one
+        # mask — the automaton state after the first i draft proposals,
+        # walked on a per-round SCRATCH copy of each constrained slot's
+        # state (the canonical state only advances on EMITTED tokens,
+        # below). The accepted prefix always equals the draft prefix, so
+        # verify's residual resample at the first rejection is masked by
+        # exactly its true predecessor state; rows past a terminal go
+        # through a dead scratch state, whose all-zero bias row is the
+        # uniform-shift no-op the emit cap discards anyway.
+        gslots = [(slot, st) for slot, st in active
+                  if st.req.grammar is not None]
+        g_scratch = {slot: st.grammar_state for slot, st in gslots}
         d_tokens = []
         d_dists = []
+        bias_list = []
         cur = tokens
         for i in range(k + 1):
+            if self._bias is None:
+                bias_i = None
+            elif gslots:
+                bias_i = self._bias.copy()
+                for slot, st in gslots:
+                    bias_i[slot] = st.req.grammar.bias_row(g_scratch[slot])
+            else:
+                bias_i = self._bias
             # positions clamp at the lane end: a slot about to retire at
             # max_len keeps fixed shapes (garbage proposals there are
             # rejected or discarded by the room cap below)
@@ -2999,20 +3139,26 @@ class GenerationEngine:
             cur, dist, self._dcache = self.kernels.draft(
                 self._draft_params, self._dcache, cur, pos_i,
                 self._dpage_map, self._temps, self._top_ks, self._top_ps,
-                self._keys, out_base + i)
+                self._keys, out_base + i, bias=bias_i)
             # host round trip on purpose: feeding the committed device
             # output straight back would key a SECOND pjit executable
             # (committed vs uncommitted int32[S]) — compile-once pins
             # exactly one entry per kernel
             cur = np.asarray(cur)
+            for slot, st in gslots:
+                g_scratch[slot] = st.req.grammar.advance(
+                    g_scratch[slot], int(cur[slot]))
+            bias_list.append(bias_i)
             if i < k:
                 d_tokens.append(cur)
                 d_dists.append(dist)
         faults.fire("engine.verify", engine=self)
+        bias_v = (None if self._bias is None
+                  else np.stack(bias_list, axis=1))  # (S, k+1, V)
         n_dev, out_dev, self._cache = self.kernels.verify(
             self._params, self._cache, tokens, d_tokens, positions,
             self._page_map, self._pool.trash, self._temps, self._top_ks,
-            self._top_ps, self._keys, out_base, d_dists)
+            self._top_ps, self._keys, out_base, d_dists, bias=bias_v)
         n_acc = np.asarray(n_dev)
         outs = np.asarray(out_dev)
         now = time.monotonic()
@@ -3032,6 +3178,12 @@ class GenerationEngine:
                 pushed += 1
                 if self.eos_id is not None and tok == self.eos_id:
                     break
+                # canonical advance per EMITTED token (the scratch walk
+                # above covered proposals); a stuck verdict stops the
+                # emission — nothing unparseable streams past it
+                self._grammar_step(slot, st, tok)
+                if st.grammar_error is not None:
+                    break
             accepted_total += min(int(n_acc[slot]), pushed)
             pushed_total += pushed
             tr = st.req.stream.trace
@@ -3046,7 +3198,7 @@ class GenerationEngine:
             st.position += pushed
             st.generated += pushed
             sampled += pushed if st.req.sampled else 0
-            why = self._retire_why(st, st.req, now)
+            why = self._grammar_why(st, self._retire_why(st, st.req, now))
             if why is not None:
                 retired.append((slot, st, why))
         self.metrics.record_verify_step(k * len(active), accepted_total,
@@ -3075,7 +3227,95 @@ class GenerationEngine:
             return "expired"
         return None
 
+    # ---------------------------------------- grammar (PR 20) hooks ----
+
+    def _grammar_arm(self, slot: int, st: _SlotState) -> None:
+        """Arm a constrained slot's mask row for its FIRST sampled token
+        (the final prefill chunk): the automaton begins at its start
+        state, and the start state's bias row must be live in
+        ``self._bias`` BEFORE the prefill kernel samples."""
+        g = st.req.grammar
+        if g is None:
+            return
+        st.grammar_state = g.start_state
+        self._bias[slot] = g.bias_row(st.grammar_state)
+        self.metrics.record_masked_frac(g.masked_frac(st.grammar_state))
+
+    def _grammar_step(self, slot: int, st: _SlotState, tok: int) -> None:
+        """Advance a constrained slot's automaton on one emitted token
+        and re-arm ``self._bias[slot]`` for the NEXT step. A verdict
+        (stuck terminal, or the defensive illegal-token case) is
+        recorded on ``st.grammar_error`` — surfaced by
+        :meth:`_grammar_why` at the retirement decision, never raised
+        here (this runs inside the scheduler loop / the async fold-in,
+        where an exception would take down every stream)."""
+        g = st.req.grammar
+        if g is None or st.grammar_error is not None:
+            return
+        if self.eos_id is not None and tok == self.eos_id:
+            # the EOS column is legal only in ACCEPTING states, so
+            # sampling it IS the parse — nothing left to re-arm
+            return
+        state = g.advance(st.grammar_state, tok)
+        st.grammar_state = state
+        if state < 0:
+            # defensive: the mask makes illegal tokens unsampleable
+            # (exp(-1e9) underflows to exact f32 zero), so a dead state
+            # here means the mask was not applied — fail the stream
+            # rather than emit unparseable text
+            st.grammar_error = GrammarViolation(
+                f"token {tok} is not legal from the previous state",
+                state=state, tokens_out=st.generated, grammar_key=g.key)
+            return
+        if not g.has_continuation(state) and not g.is_accepting(state):
+            st.grammar_error = GrammarViolation(
+                "stuck state: no legal continuation and no legal EOS "
+                "over this vocabulary", state=state,
+                tokens_out=st.generated, grammar_key=g.key)
+            return
+        self._bias[slot] = g.bias_row(state)
+        self.metrics.record_masked_frac(g.masked_frac(state))
+
+    def _grammar_why(self, st: _SlotState,
+                     why: Optional[str]) -> Optional[str]:
+        """Fold the grammar verdict into the retirement disposition:
+
+        - a recorded violation (stuck state, defensive illegal token)
+          always fails the stream;
+        - a budget/length ``done`` in a NON-accepting state is a
+          violation — the emitted text does not parse (an EOS-sampled
+          ``done`` always lands accepting: EOS is only legal there);
+        - with no EOS id configured, an accepting state with nothing
+          legal left retires ``done`` — the parse is complete and the
+          next mask row would be the all-illegal no-op.
+        Cancel/expired dispositions pass through: their own errors win.
+        """
+        g = st.req.grammar
+        if g is None:
+            return why
+        if st.grammar_error is not None:
+            return "grammar"
+        if why == "done" and not g.is_accepting(st.grammar_state):
+            st.grammar_error = GrammarViolation(
+                "token budget exhausted before the grammar could "
+                "complete", state=st.grammar_state,
+                tokens_out=st.generated, grammar_key=g.key)
+            return "grammar"
+        if (why is None and self.eos_id is None
+                and g.is_accepting(st.grammar_state)
+                and not g.has_continuation(st.grammar_state)):
+            return "done"
+        return why
+
     def _finish_slot(self, st: _SlotState, why: str, now: float) -> None:
+        if why == "grammar":
+            err = st.grammar_error
+            self.metrics.record_failed()
+            st.req.stream._finish(err, now)
+            tr = st.req.stream.trace
+            if tr is not None:
+                tr.finish(outcome="grammar_violation", tokens=st.generated)
+            return
         self._finish_request(st.req, why, now,
                              queue_wait=st.t_admit - st.req.stream.t_submit,
                              generated=st.generated)
@@ -3120,10 +3360,19 @@ class GenerationEngine:
             trash_row = np.full((self._pool.pages_per_slot,),
                                 self._pool.trash, np.int32)
             k = self.spec_k
+            # grammar bias rows warm as the same argument KIND traffic
+            # passes (host arrays when the model has a vocab, else
+            # consistently None) — a kind flip would key a second pjit
+            # executable per kernel and break the compile-once pins
+            wb = self._bias
+            wb1 = None if wb is None else wb[:1]
+            wbv = (None if wb is None else
+                   np.zeros((self.max_slots, k + 1, wb.shape[1]),
+                            np.float32))
             _, wd, self._dcache = self.kernels.draft(
                 self._draft_params, self._dcache, zeros, zeros,
                 self._dpage_map, self._temps, self._top_ks, self._top_ps,
-                self._keys, zeros)
+                self._keys, zeros, bias=wb)
             # verify must see the RUNTIME argument kinds: draft tokens
             # arrive as host arrays (the round's committed-output
             # normalization) but dists stay device-resident — a numpy
@@ -3134,7 +3383,8 @@ class GenerationEngine:
             _, _, self._cache = self.kernels.verify(
                 self._params, self._cache, zeros, zt, zeros,
                 self._page_map, self._pool.trash, self._temps,
-                self._top_ks, self._top_ps, self._keys, zeros, zd)
+                self._top_ks, self._top_ps, self._keys, zeros, zd,
+                bias=wbv)
             if self.max_prompt_len > self.prefill_chunk:
                 chunk_pad = np.full((self.prefill_chunk,), self.pad_id,
                                     np.int32)
@@ -3148,7 +3398,7 @@ class GenerationEngine:
                 pad = np.full((bucket,), self.pad_id, np.int32)
                 _, self._cache = self.kernels.prefill(
                     self._params, self._cache, trash_row, pad, 0, bucket,
-                    self._pool.trash)
+                    self._pool.trash, bias=wb1)
                 self._dcache = self.kernels.draft_write(
                     self._draft_params, self._dcache, trash_row, pad, 0,
                     bucket, self._pool.trash)
@@ -3161,11 +3411,16 @@ class GenerationEngine:
             # traces decode and vice versa — trace-counter-pinned).
             trash_row = np.full((self._pool.pages_per_slot,),
                                 self._pool.trash, np.int32)
+            # grammar bias rows warm as the same argument KIND traffic
+            # passes (arrays when the model has a vocab, else None) —
+            # a kind flip would key a second pjit executable
+            wb = self._bias
+            wb1 = None if wb is None else wb[:1]
             if self.role != "prefill":
                 _, self._keys, self._cache = self.kernels.decode(
                     self._params, self._cache, zeros, zeros,
                     self._page_map, self._temps, self._top_ks,
-                    self._top_ps, self._keys)
+                    self._top_ps, self._keys, bias=wb)
                 self._keys = np.asarray(self._keys)
             if self.role != "decode":
                 if self.max_prompt_len > self.prefill_chunk:
@@ -3178,7 +3433,7 @@ class GenerationEngine:
                     _, _, self._cache = self.kernels.prefill(
                         self._params, self._cache, trash_row,
                         np.full((bucket,), self.pad_id, np.int32), 0,
-                        bucket, self._pool.trash)
+                        bucket, self._pool.trash, bias=wb1)
             if self.role == "prefill":
                 # the export gather (pure read off the trash rows)
                 jax.block_until_ready(
@@ -3390,6 +3645,38 @@ class GenerationEngine:
         return self._host
 
 
+def _static_grammar_step(g, state, tok, eos_id, n_out):
+    """``static_generate``'s per-token automaton advance — the engine's
+    ``_grammar_step`` semantics, raising :class:`GrammarViolation`
+    instead of failing a stream (the static baseline has no stream)."""
+    if g is None or (eos_id is not None and tok == eos_id):
+        return state
+    state = g.advance(state, tok)
+    if state < 0:
+        raise GrammarViolation(
+            f"token {tok} is not legal from the previous state",
+            state=state, tokens_out=n_out, grammar_key=g.key)
+    if not g.has_continuation(state) and not g.is_accepting(state):
+        raise GrammarViolation(
+            "stuck state: no legal continuation and no legal EOS over "
+            "this vocabulary", state=state, tokens_out=n_out,
+            grammar_key=g.key)
+    return state
+
+
+def _static_grammar_finish(g, state, tok, eos_id, n_out):
+    """Completion check at a static stream's retirement: a budget /
+    length ``done`` must land in an accepting state, or the emitted
+    text does not parse (an EOS-terminated stream always does — the
+    EOS column is only legal in accepting states)."""
+    if g is None or (eos_id is not None and tok == eos_id):
+        return
+    if not g.is_accepting(state):
+        raise GrammarViolation(
+            "token budget exhausted before the grammar could complete",
+            state=state, tokens_out=n_out, grammar_key=g.key)
+
+
 def static_generate(model, params, requests, *, max_slots: int,
                     max_len: int, eos_id: Optional[int] = None,
                     pad_id: int = 0, cache_dtype=jnp.float32,
@@ -3558,6 +3845,11 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
     top_ks = np.zeros((max_slots,), np.int32)
     top_ps = np.ones((max_slots,), np.float32)
     keys = np.zeros((max_slots, 2), np.uint32)
+    # grammar (PR 20): same bias-kind rule as the engine (arrays iff the
+    # model exposes a vocab — one executable per kernel, shared or not)
+    vocab = getattr(model, "vocab_size", None)
+    bias = (np.zeros((max_slots, int(vocab)), np.float32)
+            if vocab else None)
 
     outputs: List[Optional[List[int]]] = [None] * len(requests)
     total_rounds = 0
@@ -3576,6 +3868,15 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
             top_ks[slot] = int(spec.get("top_k", 0))
             top_ps[slot] = float(spec.get("top_p", 1.0))
             keys[slot] = _tkd(req_seed)
+            g = spec.get("grammar")
+            gstate = None
+            if g is not None:
+                if bias is None:
+                    raise ValueError(
+                        "sampling['grammar'] needs a model exposing "
+                        "vocab_size")
+                gstate = g.start_state
+                bias[slot] = g.bias_row(gstate)
             need = pool.pages_for(min(n + target - 1, max_len))
             if not pool.can_reserve(2 * need):
                 raise ValueError(
@@ -3604,15 +3905,23 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
             tok_dev, cache = kernels.prefill(
                 params, cache, page_map[slot], padded, start, remaining,
                 pool.trash, temps[slot], top_ks[slot], top_ps[slot],
-                keys[slot])
+                keys[slot],
+                bias=None if bias is None else bias[slot:slot + 1].copy())
             dcache = kernels.draft_write(
                 draft_params, dcache, dpage_map[slot], padded, start,
                 remaining, pool.trash)
             tok = int(np.asarray(tok_dev))
+            gstate = _static_grammar_step(g, gstate, tok, eos_id, 1)
+            if g is not None:
+                bias[slot] = g.bias_row(gstate)
+            done = (eos_id is not None and tok == eos_id) or target <= 1
+            if done:
+                _static_grammar_finish(g, gstate, tok, eos_id, 1)
             states.append({
                 "tokens": [tok], "last": tok, "pos": n,
                 "target": target, "pages": pages, "dpages": dpages,
-                "done": (eos_id is not None and tok == eos_id) or target <= 1,
+                "grammar": g, "gstate": gstate,
+                "done": done,
             })
         while not all(s["done"] for s in states):
             tokens = np.zeros((max_slots,), np.int32)
@@ -3622,22 +3931,43 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
                 tokens[slot] = s["last"]
                 positions[slot] = s["pos"]
                 out_base[slot] = len(s["tokens"])
+            # draft step i and verify position i share one mask, walked
+            # on a per-round scratch copy of each live grammar state —
+            # the engine's _speculative_round discipline exactly
+            glive = [(slot, s) for slot, s in enumerate(states)
+                     if not s["done"] and s["grammar"] is not None]
+            g_scratch = {slot: s["gstate"] for slot, s in glive}
             d_tokens = []
             d_dists = []
+            bias_list = []
             cur = tokens
             for i in range(k + 1):
+                if bias is None:
+                    bias_i = None
+                elif glive:
+                    bias_i = bias.copy()
+                    for slot, s in glive:
+                        bias_i[slot] = s["grammar"].bias_row(
+                            g_scratch[slot])
+                else:
+                    bias_i = bias
                 pos_i = np.minimum(positions + i, max_len - 1)
                 cur, dist, dcache = kernels.draft(
                     draft_params, dcache, cur, pos_i, dpage_map, temps,
-                    top_ks, top_ps, keys, out_base + i)
+                    top_ks, top_ps, keys, out_base + i, bias=bias_i)
                 cur = np.asarray(cur)   # one executable: see engine loop
+                for slot, s in glive:
+                    g_scratch[slot] = s["grammar"].advance(
+                        g_scratch[slot], int(cur[slot]))
+                bias_list.append(bias_i)
                 if i < k:
                     d_tokens.append(cur)
                     d_dists.append(dist)
             n_dev, out_dev, cache = kernels.verify(
                 params, cache, tokens, d_tokens, positions, page_map,
                 pool.trash, temps, top_ks, top_ps, keys, out_base,
-                d_dists)
+                d_dists,
+                bias=None if bias is None else np.stack(bias_list, axis=1))
             n_acc = np.asarray(n_dev)
             outs = np.asarray(out_dev)
             total_rounds += 1
@@ -3647,6 +3977,7 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
                 room = min(s["target"] - len(s["tokens"]),
                            max_len - s["pos"])
                 emit = min(int(n_acc[slot]) + 1, room)
+                g = s["grammar"]
                 pushed = 0
                 for j in range(emit):
                     tok = int(outs[slot, j])
@@ -3654,12 +3985,18 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
                     pushed += 1
                     if eos_id is not None and tok == eos_id:
                         break
+                    s["gstate"] = _static_grammar_step(
+                        g, s["gstate"], tok, eos_id, len(s["tokens"]))
+                if g is not None:
+                    bias[slot] = g.bias_row(s["gstate"])
                 s["last"] = int(outs[slot, pushed - 1])
                 s["pos"] += pushed
                 if ((eos_id is not None and s["last"] == eos_id)
                         or len(s["tokens"]) >= s["target"]
                         or s["pos"] >= max_len):
                     s["done"] = True
+                    _static_grammar_finish(g, s["gstate"], s["last"],
+                                           eos_id, len(s["tokens"]))
         for i, s in enumerate(states):
             outputs[base + i] = s["tokens"]
             pool.release(s["pages"])
@@ -3670,6 +4007,8 @@ def _static_generate_spec(model, params, requests, kernels, draft_params,
         top_ks[:] = 0
         top_ps[:] = 1.0
         keys[:] = 0
+        if bias is not None:
+            bias[:] = 0.0
     return outputs, total_rounds
 
 
@@ -3695,6 +4034,12 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
     top_ks = np.zeros((max_slots,), np.int32)
     top_ps = np.ones((max_slots,), np.float32)
     keys = np.zeros((max_slots, 2), np.uint32)
+    # grammar (PR 20): same bias-kind rule as the engine — arrays iff
+    # the model exposes a vocab, so a kernels set shared with an engine
+    # keeps its one executable per kernel
+    vocab = getattr(model, "vocab_size", None)
+    bias = (np.zeros((max_slots, int(vocab)), np.float32)
+            if vocab else None)
 
     outputs: List[Optional[List[int]]] = [None] * len(requests)
     total_steps = 0
@@ -3713,6 +4058,15 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
             top_ks[slot] = int(spec.get("top_k", 0))
             top_ps[slot] = float(spec.get("top_p", 1.0))
             keys[slot] = threefry_key_data(req_seed)
+            g = spec.get("grammar")
+            gstate = None
+            if g is not None:
+                if bias is None:
+                    raise ValueError(
+                        "sampling['grammar'] needs a model exposing "
+                        "vocab_size")
+                gstate = g.start_state
+                bias[slot] = g.bias_row(gstate)
             need = pool.pages_for(min(n + target - 1, max_len))
             if not pool.can_reserve(need):
                 raise ValueError(
@@ -3736,13 +4090,24 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
             tok_dev, key_dev, cache = kernels.prefill(
                 params, cache, page_map[slot], padded, start, remaining,
                 pool.trash, temps[slot], top_ks[slot], top_ps[slot],
-                keys[slot])
+                keys[slot],
+                bias=None if bias is None else bias[slot:slot + 1].copy())
             tok = int(np.asarray(tok_dev))
             keys[slot] = np.asarray(key_dev)[0]
+            gstate = _static_grammar_step(g, gstate, tok, eos_id, 1)
+            if g is not None:
+                bias[slot] = g.bias_row(gstate)
+            done = (eos_id is not None and tok == eos_id) or target <= 1
+            if done:
+                _static_grammar_finish(g, gstate, tok, eos_id, 1)
+            if (not done and g is not None and eos_id is None
+                    and not g.has_continuation(gstate)):
+                done = True  # parse complete, nothing legal remains
             states.append({
                 "tokens": [tok], "last": tok, "pos": n,
                 "target": target, "pages": pages,
-                "done": (eos_id is not None and tok == eos_id) or target <= 1,
+                "grammar": g, "gstate": gstate,
+                "done": done,
             })
         while not all(s["done"] for s in states):
             tokens = np.zeros((max_slots,), np.int32)
@@ -3752,7 +4117,7 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
                 positions[slot] = s["pos"]
             toks_dev, keys_dev, cache = kernels.decode(
                 params, cache, tokens, positions, page_map, temps, top_ks,
-                top_ps, keys)
+                top_ps, keys, bias=bias)
             toks = np.asarray(toks_dev)
             keys = np.array(keys_dev)
             total_steps += 1
@@ -3763,9 +4128,19 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
                 s["tokens"].append(tok)
                 s["last"] = tok
                 s["pos"] += 1
+                g = s["grammar"]
+                s["gstate"] = _static_grammar_step(
+                    g, s["gstate"], tok, eos_id, len(s["tokens"]))
+                if g is not None:
+                    bias[slot] = g.bias_row(s["gstate"])
                 if ((eos_id is not None and tok == eos_id)
                         or len(s["tokens"]) >= s["target"]
                         or s["pos"] >= max_len):
+                    s["done"] = True
+                    _static_grammar_finish(g, s["gstate"], tok, eos_id,
+                                           len(s["tokens"]))
+                elif (g is not None and eos_id is None
+                        and not g.has_continuation(s["gstate"])):
                     s["done"] = True
         for i, s in enumerate(states):
             outputs[base + i] = s["tokens"]
@@ -3775,4 +4150,6 @@ def _static_generate_paged(model, params, requests, kernels, *, max_slots,
         top_ks[:] = 0
         top_ps[:] = 1.0
         keys[:] = 0
+        if bias is not None:
+            bias[:] = 0.0
     return outputs, total_steps

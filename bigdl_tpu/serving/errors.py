@@ -76,6 +76,27 @@ class DeadlineExceeded(ServingError):
         self.deadline_s = deadline_s
 
 
+class GrammarViolation(ServingError):
+    """A grammar-constrained stream reached a terminal it cannot parse
+    from: either the token budget ran out in a non-accepting automaton
+    state, or the state has no legal continuation and no legal EOS
+    (stuck). The contract is "every emitted stream parses" — so the
+    stream FAILS with this error instead of delivering garbage. Tokens
+    produced before the violation are preserved on the stream for
+    debugging; ``state`` is the automaton state the stream died in."""
+
+    def __init__(self, why: str, *, state: int, tokens_out: int,
+                 grammar_key: "str | None" = None):
+        what = f" for grammar '{grammar_key}'" if grammar_key else ""
+        super().__init__(
+            f"constrained stream cannot complete a parse{what}: {why} "
+            f"(automaton state {state}, {tokens_out} tokens emitted)")
+        self.why = why
+        self.state = state
+        self.tokens_out = tokens_out
+        self.grammar_key = grammar_key
+
+
 class TransportError(ServingError):
     """The RPC transport to a remote replica failed: connect refused, a
     send/recv died mid-frame, the peer vanished, or the connection-level

@@ -187,6 +187,13 @@ class ServingMetrics:
         # device (the engine dispatched step N+1 before processing
         # step N's tokens).
         self.overlapped_steps = 0
+        # structured-generation counters (PR 20); zero without grammar
+        # traffic — snapshot/table keep the earlier shapes (same
+        # append-only golden contract as every block above).
+        self.constrained_streams = 0        # grammar requests admitted
+        self.grammar_compile_cache_hits = 0  # automaton reuses at submit
+        self._masked_frac_sum = 0.0  # mean masked-vocab fraction over
+        self._masked_frac_n = 0      # every armed constrained step
 
     # ------------------------------------------------------- mutators ----
 
@@ -334,6 +341,28 @@ class ServingMetrics:
             self.step_device_s += float(device_s)
             if overlapped:
                 self.overlapped_steps += 1
+
+    # ----------------------------------- structured-generation mutators ----
+
+    def record_constrained_stream(self) -> None:
+        """One grammar-constrained request reached admission (PR 20)."""
+        with self._lock:
+            self.constrained_streams += 1
+
+    def record_grammar_cache_hit(self) -> None:
+        """A submit reused a grammar key this engine already served —
+        the compiled automaton came from the module compile cache
+        instead of a fresh regex->DFA->token-lift compilation."""
+        with self._lock:
+            self.grammar_compile_cache_hits += 1
+
+    def record_masked_frac(self, frac: float) -> None:
+        """Fraction of the vocabulary the just-armed mask row excludes
+        (one sample per constrained-step arming; the snapshot reports
+        the running mean — how tight the grammar squeezes sampling)."""
+        with self._lock:
+            self._masked_frac_sum += float(frac)
+            self._masked_frac_n += 1
 
     # ----------------------------------------- prefix-cache mutators ----
 
@@ -558,6 +587,14 @@ class ServingMetrics:
                 "step_overlap_frac": (self.overlapped_steps
                                       / self.engine_steps
                                       if self.engine_steps else 0.0),
+                # structured-generation fields (PR 20): appended after
+                # every earlier key, never reordered
+                "constrained_streams": self.constrained_streams,
+                "grammar_compile_cache_hits":
+                    self.grammar_compile_cache_hits,
+                "masked_vocab_frac": (self._masked_frac_sum
+                                      / self._masked_frac_n
+                                      if self._masked_frac_n else 0.0),
             }
 
     def format_table(self) -> str:
@@ -687,4 +724,14 @@ class ServingMetrics:
             row("overlapped_steps", s["overlapped_steps"])
             row("step_overlap_frac",
                 f"{s['step_overlap_frac'] * 100:.1f}%")
+        # structured-generation rows: appended strictly after the
+        # async-scheduling block and only when constrained streams
+        # actually ran — every earlier table stays a byte-identical
+        # strict prefix (append-only golden contract, test-enforced)
+        if s["constrained_streams"]:
+            row("constrained_streams", s["constrained_streams"])
+            row("grammar_compile_cache_hits",
+                s["grammar_compile_cache_hits"])
+            row("masked_vocab_frac",
+                f"{s['masked_vocab_frac'] * 100:.1f}%")
         return "\n".join(lines)

@@ -1,0 +1,519 @@
+"""Structured generation (PR 20): grammar-constrained decoding as a
+first-class request type.
+
+The load-bearing properties, per the subsystem contract:
+
+- a regex / JSON-schema grammar lowers to a char DFA, lifts to a token
+  automaton over the vocabulary, and compiles ONCE per distinct
+  (grammar, vocab, eos) — the module cache shares automata across
+  requests and engines;
+- every constrained stream PARSES: the per-state mask enters the jitted
+  step as a per-slot additive bias, greedy is argmax over the legal
+  set, and a stream that cannot reach a legal continuation retires with
+  a typed ``GrammarViolation`` instead of emitting garbage;
+- the composition matrix holds: {greedy, sampled} x {f32, int8} x
+  {whole, chunked prefill} x {plain, speculative} constrained streams
+  all parse, are identical across admission orders and runs, and
+  engine == static under the same grammar;
+- compile-once survives: the mask is DATA riding the bias argument
+  (always an array on a vocab-bearing model — zero rows for
+  unconstrained slots), so constrained traffic adds no kernel traces;
+- satellite 1: the paged decode attention branch COMPOSES an external
+  bias with the position-validity mask (the PR-6 unreachable-arm
+  ValueError is gone); a zero bias is bit-identical to the unbiased
+  reference path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.grammar import (
+    DEAD,
+    NEG_BIAS,
+    RegexError,
+    SchemaError,
+    clear_compile_cache,
+    compile_cache_stats,
+    compile_grammar,
+    compile_regex,
+    json_schema_grammar,
+    json_schema_regex,
+    regex_grammar,
+)
+from bigdl_tpu.nn.layers.attention import Attention, Transformer
+from bigdl_tpu.nn.module import Context
+from bigdl_tpu.serving import (
+    DecodeKernels,
+    GenerationEngine,
+    GrammarViolation,
+    PagedDecodeKernels,
+    ServingMetrics,
+    SpeculativeKernels,
+    static_generate,
+)
+
+SLOTS, MAXLEN = 4, 64
+EOS = 1
+
+# toy tokenizer over the 64-id test vocab: one printable char per id
+# (ids 2..), id 0 = pad, id 1 = EOS, the rest placeholders no char DFA
+# can step through
+_CHARS = "abcdefghijklmnopqrstuvwxyz0123456789{}\":,.-[] "
+
+
+def make_vocab(n=64):
+    vocab = [f"<{i}>" for i in range(n)]
+    for j, ch in enumerate(_CHARS):
+        vocab[j + 2] = ch
+    return vocab
+
+
+VOCAB = make_vocab()
+
+# finite grammars only (parse-guaranteed under greedy): a fixed-length
+# regex and an enum+boolean-only schema terminate via EOS inside any
+# reasonable budget; an unbounded [0-9]* integer field would not
+REGEX_PATTERN = "id-[0-9][0-9]"
+TOOL_SCHEMA = {
+    "type": "object",
+    "properties": {"tool": {"enum": ["search", "calc"]},
+                   "ok": {"type": "boolean"}},
+    "required": ["tool", "ok"],
+}
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=4,
+                        filter_size=64, num_hidden_layers=2)
+    params, _ = model.init(jax.random.key(0))
+    # one kernel set for the whole module: the jit cache persists
+    # across engines, so each test pays bookkeeping, not recompilation
+    kernels = PagedDecodeKernels(model)
+    skernels = SpeculativeKernels(model, model)
+    return model, params, kernels, skernels
+
+
+@pytest.fixture(scope="module")
+def grammars(lm):
+    model = lm[0]
+    g_re = compile_grammar(regex_grammar(REGEX_PATTERN), VOCAB, eos_id=EOS)
+    g_js = compile_grammar(json_schema_grammar(TOOL_SCHEMA), VOCAB,
+                           eos_id=EOS)
+    assert g_re.vocab_size == model.vocab_size
+    return g_re, g_js
+
+
+def make_engine(lm, *, speculate=0, **kw):
+    model, params, kernels, skernels = lm
+    kw.setdefault("max_slots", SLOTS)
+    kw.setdefault("max_len", MAXLEN)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("eos_id", EOS)
+    kw.setdefault("metrics", ServingMetrics())
+    if speculate:
+        kw.setdefault("kernels", skernels)
+        kw.setdefault("speculate", (model, params, speculate))
+    else:
+        kw.setdefault("kernels", kernels)
+    return GenerationEngine(model, params, **kw)
+
+
+PROMPTS = [[4, 9, 2], [7, 3, 5, 11], [2], [12, 8]]
+
+
+# --------------------------------------------------- automaton level ----
+
+
+class TestRegexAndSchema:
+    def test_char_dfa_fullmatch(self):
+        dfa = compile_regex("a(b|c)d*", _CHARS)
+        assert dfa.fullmatch("abd")
+        assert dfa.fullmatch("ac")
+        assert dfa.fullmatch("abddd")
+        assert not dfa.fullmatch("ad")
+        assert not dfa.fullmatch("abdx")
+        assert not dfa.fullmatch("")
+
+    def test_bad_regex_raises(self):
+        with pytest.raises(RegexError):
+            compile_regex("a(b", _CHARS)
+
+    def test_schema_regex_matches_canonical_json(self):
+        import json as _json
+
+        dfa = compile_regex(json_schema_regex(TOOL_SCHEMA), _CHARS)
+        assert dfa.fullmatch('{"tool":"search","ok":true}')
+        assert dfa.fullmatch('{"tool":"calc","ok":false}')
+        assert not dfa.fullmatch('{"tool":"grep","ok":true}')
+        # the accepted surface IS canonical compact JSON
+        assert dfa.fullmatch(_json.dumps(
+            {"tool": "calc", "ok": True}, separators=(",", ":")))
+
+    def test_bad_schema_raises(self):
+        with pytest.raises(SchemaError):
+            json_schema_regex({"enum": []})
+        with pytest.raises(SchemaError):
+            json_schema_regex({"type": "object", "properties": {}})
+
+    def test_automaton_advance_masks_and_terminal(self):
+        g = compile_grammar(regex_grammar("ab"), VOCAB, eos_id=EOS)
+        a_id, b_id = VOCAB.index("a"), VOCAB.index("b")
+        s0 = g.start_state
+        row = g.bias_row(s0)
+        assert row[a_id] == 0.0
+        assert row[b_id] == NEG_BIAS and row[EOS] == NEG_BIAS
+        assert g.legal_count(s0) == 1
+        assert g.masked_frac(s0) == pytest.approx(63 / 64)
+        s1 = g.advance(s0, a_id)
+        assert not g.is_accepting(s1) and g.has_continuation(s1)
+        s2 = g.advance(s1, b_id)
+        # accepting terminal: only EOS is legal
+        assert g.is_accepting(s2) and not g.has_continuation(s2)
+        assert g.bias_row(s2)[EOS] == 0.0
+        # illegal token -> DEAD, DEAD propagates, DEAD row is all-zeros
+        assert g.advance(s0, b_id) == DEAD
+        assert g.advance(DEAD, a_id) == DEAD
+        assert not np.any(g.bias_row(DEAD))
+        assert g.masked_frac(DEAD) == 1.0
+        assert g.matches([a_id, b_id, EOS])
+        assert g.matches([a_id, b_id])
+        assert not g.matches([a_id])
+        assert g.text_of([a_id, b_id, EOS]) == "ab"
+
+    def test_compile_cache_shares_automata(self):
+        clear_compile_cache()
+        h0, m0 = compile_cache_stats()
+        g1 = compile_grammar(regex_grammar("xy"), VOCAB, eos_id=EOS)
+        g2 = compile_grammar(regex_grammar("xy"), VOCAB, eos_id=EOS)
+        assert g2 is g1
+        h1, m1 = compile_cache_stats()
+        assert (h1 - h0, m1 - m0) == (1, 1)
+        # a different vocab (or eos) is a different automaton
+        g3 = compile_grammar(regex_grammar("xy"), make_vocab(80), eos_id=EOS)
+        assert g3 is not g1
+        assert compile_cache_stats()[1] - m0 == 2
+
+
+# ---------------------------------------------- satellite 1: attention ----
+
+
+class TestPagedDecodeBiasComposition:
+    """The PR-6 paged decode branch used to raise ``ValueError`` on any
+    external bias; PR 20 replaced the arm with real mask/bias
+    composition (the grammar mask reaches attention through it)."""
+
+    def _setup(self, rng, heads=2, d=8, n_pages=6, ps=4, slots=3):
+        attn = Attention(hidden_size=heads * d, num_heads=heads)
+        params, _ = attn.init(jax.random.key(1))
+        pools = {
+            "k": jnp.asarray(rng.randn(n_pages, heads, ps, d)
+                             .astype(np.float32)),
+            "v": jnp.asarray(rng.randn(n_pages, heads, ps, d)
+                             .astype(np.float32)),
+            "map": jnp.asarray(np.stack(
+                [rng.choice(n_pages, 2, replace=False)
+                 for _ in range(slots)]).astype(np.int32)),
+        }
+        positions = jnp.asarray([2, 5, 7], jnp.int32)
+        x = jnp.asarray(rng.randn(slots, 1, heads * d).astype(np.float32))
+        ctx = Context(params, {}, False, None)
+        return attn, ctx, pools, positions, x
+
+    def test_zero_bias_bit_identical_to_unbiased(self):
+        """An all-zero external bias must trace the same op sequence
+        (and bits) as the reference path the unbiased arm takes."""
+        rng = np.random.RandomState(0)
+        attn, ctx, pools, positions, x = self._setup(rng)
+        want, _ = attn.forward(ctx, x, cache_index=positions, paged=pools)
+        lanes = pools["map"].shape[1] * pools["k"].shape[2]
+        zero = jnp.zeros((x.shape[0], 1, 1, lanes), jnp.float32)
+        got, _ = attn.forward(ctx, x, bias=zero, cache_index=positions,
+                              paged=pools)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_bias_masks_to_single_column(self):
+        """A bias that leaves ONE column legal pins the attention
+        weight there: the output is exactly the projected V row that
+        this step just wrote (the freshest token attends to itself)."""
+        rng = np.random.RandomState(1)
+        attn, ctx, pools, positions, x = self._setup(rng)
+        lanes = pools["map"].shape[1] * pools["k"].shape[2]
+        cols = np.arange(lanes)
+        bias = np.where(cols[None, :] == np.asarray(positions)[:, None],
+                        0.0, float(NEG_BIAS)).astype(np.float32)
+        bias = jnp.asarray(bias)[:, None, None, :]
+        out, _ = attn.forward(ctx, x, bias=bias, cache_index=positions,
+                              paged=pools)
+        v = attn._split_heads(attn.run_child(ctx, "v_layer", x))
+        want = attn.run_child(ctx, "output_layer", attn._join_heads(v))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_verify_branch_still_rejects_bias(self):
+        """The W>1 verify arm keeps its guard — only the decode arm
+        grew composition (verify masks ride speculative scratch
+        states, never an attention bias)."""
+        rng = np.random.RandomState(2)
+        attn, ctx, pools, positions, x = self._setup(rng)
+        pools = dict(pools, trash=5)
+        xw = jnp.asarray(rng.randn(3, 2, 16).astype(np.float32))
+        lanes = pools["map"].shape[1] * pools["k"].shape[2]
+        bias = jnp.zeros((3, 1, 1, lanes), jnp.float32)
+        with pytest.raises(ValueError, match="no external bias"):
+            attn.forward(ctx, xw, bias=bias, cache_index=positions,
+                         paged=pools)
+
+
+# ----------------------------------------------------- engine level ----
+
+
+def submit_all(eng, specs, *, order=None):
+    """Submit (prompt, max_new, grammar, sampling) specs in the given
+    admission order; return streams re-sorted to spec order."""
+    idx = list(order if order is not None else range(len(specs)))
+    streams = [None] * len(specs)
+    for i in idx:
+        p, n, g, sample = specs[i]
+        streams[i] = eng.submit(p, max_new_tokens=n, grammar=g, **sample)
+    return streams
+
+
+class TestConstrainedStreams:
+    def test_constrained_greedy_parses_and_is_deterministic(self, lm,
+                                                            grammars):
+        g_re, g_js = grammars
+        specs = [(PROMPTS[0], 40, g_re, {}),
+                 (PROMPTS[1], 40, g_js, {}),
+                 (PROMPTS[2], 40, g_re, {}),
+                 (PROMPTS[3], 8, None, {})]   # unconstrained neighbour
+        outs = []
+        for order in (None, [3, 2, 1, 0]):
+            eng = make_engine(lm)
+            streams = submit_all(eng, specs, order=order)
+            outs.append([s.result(timeout=60) for s in streams])
+            eng.close()
+        # identical across admission orders, and every constrained
+        # stream is a word of its grammar
+        assert outs[0] == outs[1]
+        assert g_re.matches(outs[0][0])
+        assert g_js.matches(outs[0][1])
+        assert g_re.matches(outs[0][2])
+        # same grammar + same greedy argmax -> same surface
+        assert g_re.text_of(outs[0][0]) == g_re.text_of(outs[0][2])
+        import json as _json
+
+        _json.loads(g_js.text_of(outs[0][1]))
+
+    def test_metrics_rows(self, lm, grammars):
+        g_re, _ = grammars
+        eng = make_engine(lm)
+        for p in PROMPTS[:3]:
+            eng.submit(p, max_new_tokens=40,
+                       grammar=g_re).result(timeout=60)
+        snap = eng.metrics.snapshot()
+        table = eng.metrics.format_table()
+        eng.close()
+        assert snap["constrained_streams"] == 3
+        # one submit published the key, the other two hit it
+        assert snap["grammar_compile_cache_hits"] == 2
+        assert 0.0 < snap["masked_vocab_frac"] <= 1.0
+        assert list(snap)[-3:] == ["constrained_streams",
+                                   "grammar_compile_cache_hits",
+                                   "masked_vocab_frac"]
+        assert "constrained_streams" in table
+        assert "masked_vocab_frac" in table
+
+    def test_submit_validation(self, lm, grammars):
+        g_re, _ = grammars
+        model, params = lm[0], lm[1]
+        # dense engines have no per-slot bias plumbing
+        dense = GenerationEngine(model, params, max_slots=SLOTS,
+                                 max_len=MAXLEN, eos_id=EOS,
+                                 kernels=DecodeKernels(model))
+        with pytest.raises(ValueError, match="paged"):
+            dense.submit(PROMPTS[0], max_new_tokens=4, grammar=g_re)
+        dense.close()
+        eng = make_engine(lm)
+        with pytest.raises(TypeError, match="TokenAutomaton"):
+            eng.submit(PROMPTS[0], max_new_tokens=4, grammar="a[0-9]")
+        # eos mismatch: the EOS column is the accept bit of the mask
+        g_bad = compile_grammar(regex_grammar(REGEX_PATTERN), VOCAB,
+                                eos_id=2)
+        with pytest.raises(ValueError, match="eos"):
+            eng.submit(PROMPTS[0], max_new_tokens=4, grammar=g_bad)
+        g_small = compile_grammar(regex_grammar(REGEX_PATTERN),
+                                  make_vocab(80), eos_id=EOS)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit(PROMPTS[0], max_new_tokens=4, grammar=g_small)
+        eng.close()
+
+    def test_budget_exhaustion_is_grammar_violation(self, lm, grammars):
+        """A budget that ends mid-parse retires the stream with the
+        typed violation — never a silently truncated non-word."""
+        g_re, _ = grammars
+        eng = make_engine(lm)
+        s = eng.submit(PROMPTS[0], max_new_tokens=2, grammar=g_re)
+        with pytest.raises(GrammarViolation) as ei:
+            s.result(timeout=60)
+        assert ei.value.grammar_key == g_re.key
+        assert eng.metrics.snapshot()["failed"] == 1
+        eng.close()
+
+    def test_stuck_state_is_grammar_violation(self, lm):
+        """A vocabulary that cannot spell any continuation: after 'a'
+        the automaton has no legal token and no legal EOS -> stuck."""
+        vocab = make_vocab()
+        b_id = VOCAB.index("b")
+        vocab[b_id] = "<gone>"
+        g = compile_grammar(regex_grammar("ab"), vocab, eos_id=EOS)
+        eng = make_engine(lm)
+        s = eng.submit(PROMPTS[0], max_new_tokens=8, grammar=g)
+        with pytest.raises(GrammarViolation, match="stuck"):
+            s.result(timeout=60)
+        eng.close()
+
+    def test_compile_once_and_slot_reuse(self, lm, grammars):
+        """Constrained traffic adds ZERO kernel traces over warmup, and
+        a slot that carried a grammar is clean for its next tenant."""
+        g_re, g_js = grammars
+        kernels = lm[2]
+        eng = make_engine(lm)
+        eng.warmup()
+        warm = (kernels.prefill_traces, kernels.chunk_traces,
+                kernels.decode_traces)
+        for g in (g_re, g_js, None, g_re):
+            out = eng.submit(PROMPTS[0], max_new_tokens=40,
+                             grammar=g).result(timeout=60)
+            if g is not None:
+                assert g.matches(out)
+        post = (kernels.prefill_traces, kernels.chunk_traces,
+                kernels.decode_traces)
+        eng.close()
+        assert post == warm
+
+    def test_async_scheduling_matches_sync(self, lm, grammars):
+        g_re, g_js = grammars
+        specs = [(PROMPTS[0], 40, g_re, {}),
+                 (PROMPTS[1], 40, g_js, {}),
+                 (PROMPTS[2], 6, None, {})]
+        outs = []
+        for async_sched in (False, True):
+            eng = make_engine(lm, async_scheduling=async_sched)
+            streams = submit_all(eng, specs)
+            outs.append([s.result(timeout=60) for s in streams])
+            eng.close()
+        assert outs[0] == outs[1]
+        assert g_re.matches(outs[1][0]) and g_js.matches(outs[1][1])
+
+
+# ---------------------------------------------- composition matrix ----
+
+
+class TestCompositionMatrix:
+    @pytest.mark.parametrize("speculate", [0, 3],
+                             ids=["plain", "speculative"])
+    @pytest.mark.parametrize("chunked", [False, True],
+                             ids=["whole", "chunked"])
+    @pytest.mark.parametrize("quantize", [None, "int8"],
+                             ids=["f32", "int8"])
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_matrix(self, lm, grammars, sampled, quantize, chunked,
+                    speculate):
+        model, params = lm[0], lm[1]
+        g_re, g_js = grammars
+        sample = (dict(temperature=0.9, top_k=8, seed=11)
+                  if sampled else {})
+        specs = [(PROMPTS[0], 40, g_re, sample),
+                 (PROMPTS[1], 40, g_js, sample),
+                 (PROMPTS[2], 6, None, sample)]
+        kw = dict(quantize=quantize)
+        if chunked:
+            kw["prefill_chunk"] = 8
+        runs = []
+        for order in (None, [2, 1, 0]):
+            eng = make_engine(lm, speculate=speculate, **kw)
+            streams = submit_all(eng, specs, order=order)
+            runs.append([s.result(timeout=60) for s in streams])
+            eng.close()
+        # identical across admission orders/runs; constrained parse
+        assert runs[0] == runs[1]
+        assert g_re.matches(runs[0][0])
+        assert g_js.matches(runs[0][1])
+        # engine == static under the same grammar
+        sampling = [dict(s[3], grammar=s[2]) if s[2] is not None
+                    else dict(s[3]) for s in specs]
+        souts, _ = static_generate(
+            model, params, [(s[0], s[1]) for s in specs],
+            max_slots=SLOTS, max_len=MAXLEN, eos_id=EOS,
+            kernels=lm[3] if speculate else lm[2], page_size=8,
+            prefill_chunk=8 if chunked else None, sampling=sampling,
+            quantize=quantize,
+            speculate=(model, params, speculate) if speculate else None)
+        assert souts == runs[0]
+
+    def test_speculative_greedy_equals_plain_constrained(self, lm,
+                                                         grammars):
+        """Masked tokens have ZERO target probability, so masked
+        speculative greedy is lossless vs plain constrained greedy."""
+        g_re, g_js = grammars
+        specs = [(PROMPTS[0], 40, g_re, {}), (PROMPTS[1], 40, g_js, {})]
+        outs = []
+        for speculate in (0, 3):
+            eng = make_engine(lm, speculate=speculate)
+            streams = submit_all(eng, specs)
+            outs.append([s.result(timeout=60) for s in streams])
+            eng.close()
+        assert outs[0] == outs[1]
+
+    def test_int8_cache_dtype_constrained(self, lm, grammars):
+        g_re, _ = grammars
+        eng = make_engine(lm, cache_dtype="int8")
+        out = eng.submit(PROMPTS[0], max_new_tokens=40,
+                         grammar=g_re).result(timeout=60)
+        eng.close()
+        assert g_re.matches(out)
+
+
+# ----------------------------------------------------- oracle level ----
+
+
+class TestSamplingOracle:
+    def test_sample_tokens_bias_matches_numpy_oracle(self):
+        """Fixed seed, 10 masked steps x 4 slots under mixed
+        temperature / top-k / top-p: the jitted sampler under a grammar
+        bias picks the SAME token as the per-step numpy oracle, and
+        every draw is legal under the mask."""
+        from bigdl_tpu.core.rng import threefry_key_data
+        from bigdl_tpu.ops.sampling import (
+            numpy_reference_sample,
+            sample_tokens,
+            split_key_data,
+        )
+
+        rng = np.random.RandomState(3)
+        temps = np.asarray([0.0, 0.8, 1.0, 1.4], np.float32)
+        top_ks = np.asarray([0, 8, 0, 5], np.int32)
+        top_ps = np.asarray([1.0, 1.0, 0.9, 1.0], np.float32)
+        keys = np.stack([threefry_key_data(200 + s) for s in range(4)])
+        fn = jax.jit(sample_tokens)
+        for _ in range(10):
+            logits = rng.randn(4, 64).astype(np.float32) * 2.0
+            legal = rng.rand(4, 64) < 0.2
+            legal[:, 0] = True  # at least one legal token per row
+            bias = np.where(legal, 0.0, float(NEG_BIAS)).astype(np.float32)
+            toks, new_keys = fn(jnp.asarray(logits), jnp.asarray(temps),
+                                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                                jnp.asarray(keys), jnp.asarray(bias))
+            toks, new_keys = np.asarray(toks), np.asarray(new_keys)
+            for s in range(4):
+                _, u = split_key_data(keys[s])
+                want = numpy_reference_sample(
+                    logits[s], float(temps[s]), int(top_ks[s]),
+                    float(top_ps[s]), u, bias[s])
+                assert int(toks[s]) == want
+                assert legal[s, int(toks[s])]
+            keys = new_keys

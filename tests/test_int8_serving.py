@@ -620,9 +620,9 @@ def test_kv_metrics_rows_append_after_replica_golden():
     keys = list(snap.keys())
     # the PR-9 block sits immediately before the PR-10 speculative,
     # PR-11 step-timeline, PR-12 prefix-cache, PR-15 ITL, PR-18
-    # KV-tier, and PR-19 async-scheduling keys (append-only: each
-    # PR's rows land AFTER every earlier block)
-    assert keys[-31:-28] == ["kv_bytes_in_use", "kv_cache_dtype",
+    # KV-tier, PR-19 async-scheduling, and PR-20 structured-generation
+    # keys (append-only: each PR's rows land AFTER every earlier block)
+    assert keys[-34:-31] == ["kv_bytes_in_use", "kv_cache_dtype",
                              "quantized_gemms"]
     assert snap["kv_bytes_in_use"] == 5 * 5248
     assert snap["kv_cache_dtype"] == "int8"

@@ -670,8 +670,9 @@ def test_step_timeline_metrics_rows_append_after_speculative_block():
                      "step_host_frac"]
     snap = m.snapshot()
     # immediately before the PR-12 prefix-cache keys (append-only;
-    # re-anchored for the PR-18 KV-tier and PR-19 async blocks)
-    assert list(snap)[-24:-20] == ["engine_steps", "step_host_ms",
+    # re-anchored for the PR-18 KV-tier, PR-19 async, and PR-20
+    # structured-generation blocks)
+    assert list(snap)[-27:-23] == ["engine_steps", "step_host_ms",
                                  "step_device_ms", "step_host_frac"]
     assert snap["engine_steps"] == 2
     assert snap["step_host_ms"] == pytest.approx(3.0)
@@ -706,7 +707,11 @@ def test_async_overlap_rows_append_after_kv_tier_block():
     assert tokens.index("host_pages_peak") < tokens.index(
         "overlapped_steps")
     snap = m.snapshot()
-    assert list(snap)[-2:] == ["overlapped_steps", "step_overlap_frac"]
+    # re-anchored past the PR-20 structured-generation tail keys
+    assert list(snap)[-5:-3] == ["overlapped_steps", "step_overlap_frac"]
+    assert list(snap)[-3:] == ["constrained_streams",
+                               "grammar_compile_cache_hits",
+                               "masked_vocab_frac"]
     assert snap["overlapped_steps"] == 2
     assert snap["step_overlap_frac"] == pytest.approx(2 / 3)
 

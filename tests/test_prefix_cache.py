@@ -680,13 +680,13 @@ def test_prefix_metrics_rows_append_after_golden_order():
     assert extra == ["prefix_hits", "prefix_misses", "prefix_hit_rate",
                      "shared_pages", "prefill_chunks_skipped"]
     snap = m.snapshot()
-    assert list(snap)[-20:-15] == ["prefix_hits", "prefix_misses",
+    assert list(snap)[-23:-18] == ["prefix_hits", "prefix_misses",
                                "prefix_hit_rate", "shared_pages",
                                "prefill_chunks_skipped"]
     # the PR-15 ITL keys append strictly after the prefix block
-    # (PR-16 recent-window, PR-18 KV-tier, and PR-19 async-scheduling
-    # keys land after them)
-    assert list(snap)[-15:-13] == ["itl_ms", "itl_samples"]
+    # (PR-16 recent-window, PR-18 KV-tier, PR-19 async-scheduling, and
+    # PR-20 structured-generation keys land after them)
+    assert list(snap)[-18:-16] == ["itl_ms", "itl_samples"]
     assert snap["prefix_hits"] == 2 and snap["prefix_misses"] == 1
     assert snap["prefix_hit_rate"] == pytest.approx(2 / 3)
     assert snap["shared_pages"] == 6

@@ -670,9 +670,10 @@ def test_speculative_metrics_rows_append_after_golden_order():
     assert snap["tokens_out"] == 9
     keys = list(snap)
     # the PR-10 block sits immediately before the PR-11 step-timeline,
-    # PR-12 prefix-cache, PR-18 KV-tier, and PR-19 async-scheduling
-    # keys (append-only: each PR's rows land AFTER every earlier block)
-    assert keys[-28:-24] == ["draft_tokens", "accepted_tokens",
+    # PR-12 prefix-cache, PR-18 KV-tier, PR-19 async-scheduling, and
+    # PR-20 structured-generation keys (append-only: each PR's rows
+    # land AFTER every earlier block)
+    assert keys[-31:-27] == ["draft_tokens", "accepted_tokens",
                             "acceptance_rate", "verify_steps"]
 
 
